@@ -1,0 +1,24 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A ground-up re-design of the capabilities of ngaut/pilosa (a fork of
+pilosa/pilosa, the distributed roaring-bitmap index) for TPU hardware:
+
+- fragments are dense bit-packed ``uint32[rows, 32768]`` matrices pinned in
+  per-chip HBM instead of roaring container trees (roaring remains the
+  host/disk interchange format — see ``pilosa_tpu.roaring``),
+- container set-ops + popcounts become fused XLA bitwise/popcount kernels
+  (``pilosa_tpu.ops``),
+- the per-shard mapReduce of the reference executor becomes ``shard_map``
+  over a device mesh with ICI collectives (``pilosa_tpu.parallel``),
+- PQL, the storage tree (holder→index→field→view→fragment), HTTP API and
+  clustering semantics are preserved (``pilosa_tpu.pql``,
+  ``pilosa_tpu.storage``, ``pilosa_tpu.server``).
+
+Reference layout this mirrors (see SURVEY.md §1–2): roaring/, row.go,
+fragment.go, field.go, index.go, holder.go, pql/, executor.go, http/,
+cluster.go, server.go.
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, WORDS_PER_SHARD

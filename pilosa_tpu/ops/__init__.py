@@ -1,0 +1,12 @@
+"""Device-side bitmap kernels (the TPU-native replacement for roaring/ ops).
+
+The reference's roaring container ops (array/bitmap/run × union/intersect/
+difference/xor, popcount-based Count/CountRange — roaring/roaring.go) are
+re-expressed as dense bitwise + population_count XLA ops over bit-packed
+uint32 tensors. Per-container branching is replaced by uniform vector ops
+the VPU executes at full width; XLA fuses chains of bitwise ops with the
+final popcount reduction so intermediate bitmaps never hit HBM.
+"""
+
+from pilosa_tpu.ops.packing import pack_bits, unpack_bits, pack_shard_row
+from pilosa_tpu.ops import bitops

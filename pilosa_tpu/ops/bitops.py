@@ -1,0 +1,149 @@
+"""Core bitwise/popcount kernels over bit-packed uint32 tensors.
+
+TPU-native re-expression of the reference's roaring container ops
+(roaring/roaring.go: Union/Intersect/Difference/Xor/Count/CountRange/Flip
+and row.go Shift). Every op is a uniform dense vector op — no container
+kind dispatch — so XLA fuses arbitrary PQL expression trees
+(e.g. Count(Intersect(Union(a,b), Not(c)))) into a single HBM pass.
+
+Shapes: ops are shape-polymorphic over uint32 arrays; a shard-row is
+``uint32[32768]`` and a row-block is ``uint32[rows, 32768]``. Counts are
+returned as int32 per row (max 2^20 per shard-row, far below overflow);
+cross-shard / cross-row totals are summed host-side in Python ints.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pilosa_tpu.shardwidth import WORD_BITS
+
+_U32 = jnp.uint32
+
+
+@jax.jit
+def union(a, b):
+    return a | b
+
+
+@jax.jit
+def intersect(a, b):
+    return a & b
+
+
+@jax.jit
+def difference(a, b):
+    return a & ~b
+
+
+@jax.jit
+def xor(a, b):
+    return a ^ b
+
+
+@jax.jit
+def count(a):
+    """Total set bits in the whole tensor (int32 scalar).
+
+    Safe for a single shard-row or a small batch; use count_rows + host sum
+    for large row-blocks.
+    """
+    return jnp.sum(lax.population_count(a).astype(jnp.int32))
+
+
+@jax.jit
+def count_rows(a):
+    """Per-row popcount for a row-block uint32[rows, words] -> int32[rows]."""
+    return jnp.sum(lax.population_count(a).astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def intersect_count(a, b):
+    """Fused Intersect+Count — the north-star metric op. XLA fuses the AND
+    with the popcount reduce so the intersection bitmap never materializes."""
+    return jnp.sum(lax.population_count(a & b).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnums=0)
+def _range_mask(n_words, start, stop):
+    """uint32[n_words] mask with bits [start, stop) set."""
+    idx = lax.iota(jnp.int32, n_words)
+    word_lo = jnp.asarray(start, jnp.int32) // WORD_BITS
+    word_hi = jnp.asarray(stop, jnp.int32) // WORD_BITS
+    bit_lo = jnp.asarray(start, jnp.int32) % WORD_BITS
+    bit_hi = jnp.asarray(stop, jnp.int32) % WORD_BITS
+    full = ((idx > word_lo) & (idx < word_hi)).astype(_U32) * _U32(0xFFFFFFFF)
+    # Partial masks at the boundary words. (-1 << b) keeps bits >= b.
+    lo_mask = _U32(0xFFFFFFFF) << bit_lo.astype(_U32)
+    hi_mask = jnp.where(
+        bit_hi > 0, ~(_U32(0xFFFFFFFF) << bit_hi.astype(_U32)), _U32(0)
+    )
+    both = lo_mask & hi_mask
+    mask = full
+    mask = jnp.where(idx == word_lo, jnp.where(word_lo == word_hi, both, lo_mask), mask)
+    mask = jnp.where((idx == word_hi) & (word_hi > word_lo), hi_mask, mask)
+    return jnp.where(jnp.asarray(stop, jnp.int32) > jnp.asarray(start, jnp.int32), mask, _U32(0))
+
+
+def range_mask(n_words: int, start, stop):
+    return _range_mask(n_words, start, stop)
+
+
+@jax.jit
+def count_range(a, start, stop):
+    """Count set bits with position in [start, stop) along the last axis
+    (reference roaring CountRange)."""
+    mask = _range_mask(a.shape[-1], start, stop)
+    return jnp.sum(lax.population_count(a & mask).astype(jnp.int32))
+
+
+@jax.jit
+def flip_range(a, start, stop):
+    """Flip bits in [start, stop) (reference roaring Flip; basis of Not)."""
+    mask = _range_mask(a.shape[-1], start, stop)
+    return a ^ mask
+
+
+@jax.jit
+def shift(a, n):
+    """Shift set bits toward higher positions by n along the last axis
+    (reference row.go Shift / executor Shift(row, n)). Negative n shifts
+    toward lower positions. Bits shifted past either end are dropped
+    (per-shard semantics; cross-shard carry handled by the executor on
+    host)."""
+    n = jnp.asarray(n, jnp.int32)
+    # Floor division/mod so negative n (shift toward lower positions) also
+    # decomposes as n = 32*word_shift + bit_shift with bit_shift in [0, 32).
+    word_shift = jnp.floor_divide(n, WORD_BITS)
+    bit_shift = jnp.mod(n, WORD_BITS).astype(_U32)
+    n_words = a.shape[-1]
+    idx = lax.iota(jnp.int32, n_words)
+
+    def gather(src):
+        take = jnp.clip(src, 0, n_words - 1)
+        in_range = (src >= 0) & (src < n_words)
+        return jnp.where(in_range, jnp.take(a, take, axis=-1), _U32(0))
+
+    moved = gather(idx - word_shift)
+    prev = gather(idx - word_shift - 1)
+    lo = moved << bit_shift
+    carry = jnp.where(
+        bit_shift > 0, prev >> (_U32(WORD_BITS) - bit_shift), _U32(0)
+    )
+    return lo | carry
+
+
+@jax.jit
+def any_set(a):
+    """True if any bit is set (used by Rows() existence filtering)."""
+    return jnp.any(a != 0)
+
+
+@jax.jit
+def rows_any(a):
+    """Per-row non-empty flags for uint32[rows, words] -> bool[rows]."""
+    return jnp.any(a != 0, axis=-1)

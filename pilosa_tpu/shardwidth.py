@@ -1,0 +1,24 @@
+"""Shard-width constants (reference: shardwidth/shardwidth.go, SURVEY.md §2 #27).
+
+The column axis is partitioned into shards of 2^20 columns. On device a
+shard-row is a dense bit-vector packed into 32-bit words: 2^20 bits =
+32768 uint32 words = 128 KiB. 32768 is a multiple of the TPU lane count
+(128), so a row tiles cleanly onto the VPU; uint32 is the native vector
+lane width.
+"""
+
+SHARD_WIDTH_EXP = 20
+SHARD_WIDTH = 1 << SHARD_WIDTH_EXP  # columns per shard (reference: ShardWidth)
+
+WORD_BITS = 32
+WORDS_PER_SHARD = SHARD_WIDTH // WORD_BITS  # 32768 uint32 words per row
+
+
+def shard_of(column_id: int) -> int:
+    """Shard that owns an absolute column id (reference: col / ShardWidth)."""
+    return column_id >> SHARD_WIDTH_EXP
+
+
+def position(column_id: int) -> int:
+    """Column position within its shard."""
+    return column_id & (SHARD_WIDTH - 1)

@@ -1,0 +1,29 @@
+"""Test configuration: run JAX on an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy of exercising multi-node logic in one
+process without a real cluster (test.MustRunCluster — SURVEY.md §4): we
+exercise multi-chip sharding logic without TPUs by forcing 8 host CPU
+devices.
+
+Note: this image's sitecustomize imports jax at interpreter startup (to
+register the axon TPU plugin), so JAX_PLATFORMS in os.environ is captured
+before conftest runs — we must switch platforms via jax.config instead.
+Setting JAX_PLATFORMS=cpu in the *parent* environment hangs the axon
+registration, so don't do that either; for subprocesses spawned by tests,
+drop PALLAS_AXON_POOL_IPS to skip axon registration entirely.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# For any subprocess tests spawn: skip axon registration + force CPU there.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

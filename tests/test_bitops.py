@@ -1,0 +1,113 @@
+"""M0 kernel tests: packed bitwise ops vs a numpy set oracle.
+
+Modeled on the reference's exhaustive pairwise container-op tests
+(roaring/roaring_test.go randomized ops vs a map oracle — SURVEY.md §4):
+we randomize id sets, run the device kernel, and compare against python
+set algebra.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitops
+from pilosa_tpu.ops.packing import pack_bits, unpack_bits, popcount_words
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+N_BITS = 1 << 14  # small width keeps tests fast; ops are shape-polymorphic
+N_WORDS = N_BITS // 32
+
+# Density patterns mirroring roaring's container kinds: sparse ~ array
+# containers, dense ~ bitmap containers, runs ~ run containers.
+DENSITIES = [0.0005, 0.02, 0.5]
+
+
+def rand_ids(rng, density):
+    mask = rng.random(N_BITS) < density
+    return np.nonzero(mask)[0]
+
+
+def rand_run_ids(rng):
+    """Run-heavy set (oracle for run-container-style data)."""
+    ids = []
+    pos = 0
+    while pos < N_BITS:
+        run = int(rng.integers(1, 500))
+        if rng.random() < 0.5:
+            ids.extend(range(pos, min(pos + run, N_BITS)))
+        pos += run
+    return np.array(ids, dtype=np.int64)
+
+
+@pytest.mark.parametrize("da", DENSITIES)
+@pytest.mark.parametrize("db", DENSITIES)
+def test_pairwise_set_ops(da, db):
+    rng = np.random.default_rng(int(da * 1e6) * 31 + int(db * 1e6))
+    a_ids, b_ids = rand_ids(rng, da), rand_ids(rng, db)
+    a, b = pack_bits(a_ids, N_BITS), pack_bits(b_ids, N_BITS)
+    sa, sb = set(a_ids.tolist()), set(b_ids.tolist())
+
+    assert set(unpack_bits(np.asarray(bitops.union(a, b))).tolist()) == sa | sb
+    assert set(unpack_bits(np.asarray(bitops.intersect(a, b))).tolist()) == sa & sb
+    assert set(unpack_bits(np.asarray(bitops.difference(a, b))).tolist()) == sa - sb
+    assert set(unpack_bits(np.asarray(bitops.xor(a, b))).tolist()) == sa ^ sb
+    assert int(bitops.count(a)) == len(sa)
+    assert int(bitops.intersect_count(a, b)) == len(sa & sb)
+
+
+def test_run_heavy_ops():
+    rng = np.random.default_rng(7)
+    a_ids, b_ids = rand_run_ids(rng), rand_run_ids(rng)
+    a, b = pack_bits(a_ids, N_BITS), pack_bits(b_ids, N_BITS)
+    sa, sb = set(a_ids.tolist()), set(b_ids.tolist())
+    assert set(unpack_bits(np.asarray(bitops.xor(a, b))).tolist()) == sa ^ sb
+    assert int(bitops.intersect_count(a, b)) == len(sa & sb)
+
+
+@pytest.mark.parametrize(
+    "start,stop",
+    [(0, N_BITS), (0, 0), (5, 37), (32, 64), (31, 33), (100, 100), (0, 31),
+     (N_BITS - 13, N_BITS), (1000, 9999), (64, 96)],
+)
+def test_count_range_and_flip(start, stop):
+    rng = np.random.default_rng(start * 7919 + stop)
+    ids = rand_ids(rng, 0.3)
+    a = pack_bits(ids, N_BITS)
+    s = set(ids.tolist())
+    expected = len([i for i in s if start <= i < stop])
+    assert int(bitops.count_range(a, start, stop)) == expected
+
+    flipped = set(unpack_bits(np.asarray(bitops.flip_range(a, start, stop))).tolist())
+    expected_flip = (s - set(range(start, stop))) | (set(range(start, stop)) - s)
+    assert flipped == expected_flip
+
+
+@pytest.mark.parametrize("n", [0, 1, 31, 32, 33, 64, 100, 1000,
+                               -1, -5, -31, -32, -33, -100])
+def test_shift(n):
+    rng = np.random.default_rng(abs(n) + 1)
+    ids = rand_ids(rng, 0.1)
+    a = pack_bits(ids, N_BITS)
+    shifted = set(unpack_bits(np.asarray(bitops.shift(a, n))).tolist())
+    expected = {i + n for i in ids.tolist() if 0 <= i + n < N_BITS}
+    assert shifted == expected
+
+
+def test_row_block_ops():
+    rng = np.random.default_rng(3)
+    rows = [rand_ids(rng, d) for d in (0.001, 0.2, 0.6, 0.0)]
+    block = np.stack([pack_bits(r, N_BITS) for r in rows])
+    counts = np.asarray(bitops.count_rows(block))
+    assert counts.tolist() == [len(r) for r in rows]
+    nonempty = np.asarray(bitops.rows_any(block))
+    assert nonempty.tolist() == [len(r) > 0 for r in rows]
+
+
+def test_full_shard_width_roundtrip():
+    rng = np.random.default_rng(11)
+    ids = np.sort(rng.choice(SHARD_WIDTH, size=5000, replace=False))
+    words = pack_bits(ids, SHARD_WIDTH)
+    assert words.shape == (WORDS_PER_SHARD,)
+    assert popcount_words(words) == 5000
+    np.testing.assert_array_equal(unpack_bits(words, offset=1 << 20),
+                                  ids.astype(np.uint64) + (1 << 20))
+    assert int(bitops.count(words)) == 5000
