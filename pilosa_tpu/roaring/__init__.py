@@ -1,0 +1,26 @@
+"""Host-side roaring bitmap: the durable storage / interchange format.
+
+The reference keeps its entire engine in roaring containers
+(roaring/roaring.go); on TPU we deliberately flip the representation
+(SURVEY.md §7.1): device bitmaps are dense bit-packed tensors, and roaring
+survives only on the host as (a) the on-disk fragment format with an
+append-only op log, and (b) the wire format for import-roaring. This
+package implements the 64-bit roaring model: containers keyed by the high
+48 bits, each holding low-16-bit values as an array / bitmap / run
+container, plus serialization and the op log.
+"""
+
+from pilosa_tpu.roaring.bitmap import (
+    RoaringBitmap,
+    ARRAY,
+    BITMAP,
+    RUN,
+)
+from pilosa_tpu.roaring.format import (
+    serialize,
+    deserialize,
+    OpLogWriter,
+    replay_ops,
+    OP_ADD,
+    OP_REMOVE,
+)

@@ -1,0 +1,238 @@
+"""64-bit roaring bitmap on the host (numpy-vectorized).
+
+Model follows the reference roaring engine (roaring/roaring.go): values are
+uint64, containers are keyed by ``value >> 16`` and hold the low 16 bits in
+one of three kinds — sorted uint16 **array**, 1024×uint64 **bitmap**, or
+**run** list of inclusive [start, last] uint16 intervals. Unlike the
+reference this implementation is vectorized numpy (no per-value loops) and
+exists only for durability/interchange; set algebra at query time happens
+on device (pilosa_tpu.ops.bitops).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ARRAY = 1
+BITMAP = 2
+RUN = 3
+
+# Above this cardinality an array container is worse than a bitmap
+# (4096 * 2 bytes == 8 KiB == bitmap size), same threshold reasoning as the
+# roaring papers (PAPERS.md: Chambi et al.).
+ARRAY_MAX = 4096
+BITMAP_N_WORDS = 1024  # uint64 words per container (65536 bits)
+
+
+class Container:
+    __slots__ = ("kind", "data", "n")
+
+    def __init__(self, kind: int, data: np.ndarray, n: int):
+        self.kind = kind
+        self.data = data
+        self.n = n  # cardinality
+
+    # --- constructors ---
+
+    @staticmethod
+    def from_lows(lows: np.ndarray) -> "Container":
+        """Build the optimal container for sorted unique uint16 lows."""
+        n = int(lows.size)
+        if n == 0:
+            return Container(ARRAY, np.empty(0, np.uint16), 0)
+        n_runs = int(np.count_nonzero(np.diff(lows.astype(np.int32)) != 1)) + 1
+        # cost in bytes: array 2n, run 4*n_runs, bitmap 8192
+        if 4 * n_runs < min(2 * n, 8192):
+            d = np.diff(lows.astype(np.int32))
+            starts_idx = np.concatenate(([0], np.nonzero(d != 1)[0] + 1))
+            ends_idx = np.concatenate((np.nonzero(d != 1)[0], [n - 1]))
+            runs = np.stack([lows[starts_idx], lows[ends_idx]], axis=1)
+            return Container(RUN, np.ascontiguousarray(runs, np.uint16), n)
+        if n <= ARRAY_MAX:
+            return Container(ARRAY, np.ascontiguousarray(lows, np.uint16), n)
+        words = np.zeros(BITMAP_N_WORDS * 8, np.uint8)
+        np.bitwise_or.at(
+            words,
+            (lows >> np.uint16(3)).astype(np.int64),
+            np.uint8(1) << (lows & np.uint16(7)).astype(np.uint8),
+        )
+        return Container(BITMAP, words.view("<u8").copy(), n)
+
+    # --- conversions ---
+
+    def lows(self) -> np.ndarray:
+        """Sorted unique uint16 values in this container."""
+        if self.kind == ARRAY:
+            return self.data
+        if self.kind == BITMAP:
+            bits = np.unpackbits(
+                np.ascontiguousarray(self.data).view(np.uint8), bitorder="little"
+            )
+            return np.nonzero(bits)[0].astype(np.uint16)
+        # RUN
+        runs = self.data.astype(np.int64)
+        if runs.size == 0:
+            return np.empty(0, np.uint16)
+        lengths = runs[:, 1] - runs[:, 0] + 1
+        total = int(lengths.sum())
+        out = np.repeat(runs[:, 0] - np.concatenate(([0], np.cumsum(lengths)[:-1])), lengths)
+        return (out + np.arange(total)).astype(np.uint16)
+
+    def dense_words32(self) -> np.ndarray:
+        """Container as 2048 uint32 words (65536 bits) — device format block."""
+        if self.kind == BITMAP:
+            return np.ascontiguousarray(self.data).view("<u4").copy()
+        lows = self.lows()
+        words = np.zeros(2048 * 4, np.uint8)
+        if lows.size:
+            np.bitwise_or.at(
+                words,
+                (lows >> np.uint16(3)).astype(np.int64),
+                np.uint8(1) << (lows & np.uint16(7)).astype(np.uint8),
+            )
+        return words.view("<u4").copy()
+
+
+class RoaringBitmap:
+    """Sorted map: container key (high 48 bits) → Container."""
+
+    def __init__(self):
+        self.keys: list[int] = []
+        self._containers: dict[int, Container] = {}
+
+    # --- constructors ---
+
+    @classmethod
+    def from_ids(cls, ids) -> "RoaringBitmap":
+        b = cls()
+        ids = np.unique(np.asarray(ids, dtype=np.uint64))
+        if ids.size == 0:
+            return b
+        hi = (ids >> np.uint64(16)).astype(np.int64)
+        lows = (ids & np.uint64(0xFFFF)).astype(np.uint16)
+        boundaries = np.concatenate(
+            ([0], np.nonzero(np.diff(hi))[0] + 1, [ids.size])
+        )
+        for i in range(boundaries.size - 1):
+            lo_i, hi_i = int(boundaries[i]), int(boundaries[i + 1])
+            key = int(hi[lo_i])
+            b._containers[key] = Container.from_lows(lows[lo_i:hi_i])
+        b.keys = sorted(b._containers)
+        return b
+
+    @classmethod
+    def from_dense_words(cls, words: np.ndarray, base: int = 0) -> "RoaringBitmap":
+        """From packed uint32 words; bit i → id base + i (base must be
+        65536-aligned)."""
+        assert base % 65536 == 0
+        bits = np.unpackbits(
+            np.ascontiguousarray(words, np.uint32).view(np.uint8), bitorder="little"
+        )
+        ids = np.nonzero(bits)[0].astype(np.uint64) + np.uint64(base)
+        return cls.from_ids(ids)
+
+    # --- accessors ---
+
+    def container(self, key: int) -> Container | None:
+        return self._containers.get(key)
+
+    def to_ids(self) -> np.ndarray:
+        parts = []
+        for key in self.keys:
+            lows = self._containers[key].lows().astype(np.uint64)
+            parts.append(lows + (np.uint64(key) << np.uint64(16)))
+        if not parts:
+            return np.empty(0, np.uint64)
+        return np.concatenate(parts)
+
+    def count(self) -> int:
+        return sum(c.n for c in self._containers.values())
+
+    def count_range(self, start: int, stop: int) -> int:
+        if stop <= start:
+            return 0
+        lo_key, hi_key = start >> 16, (stop - 1) >> 16
+        total = 0
+        for key in self.keys:
+            if key < lo_key or key > hi_key:
+                continue
+            c = self._containers[key]
+            if lo_key < key < hi_key:
+                total += c.n
+            else:
+                lows = c.lows().astype(np.int64) + (key << 16)
+                total += int(((lows >= start) & (lows < stop)).sum())
+        return total
+
+    def dense_range_words32(self, start: int, stop: int) -> np.ndarray:
+        """Materialize [start, stop) as packed uint32 words (both 65536-aligned).
+
+        This is the host→device decode path: a fragment row (2^20 bits = 16
+        containers) becomes uint32[32768] for device_put.
+        """
+        assert start % 65536 == 0 and stop % 65536 == 0 and stop > start
+        n_containers = (stop - start) >> 16
+        out = np.zeros((n_containers, 2048), np.uint32)
+        base_key = start >> 16
+        for i in range(n_containers):
+            c = self._containers.get(base_key + i)
+            if c is not None:
+                out[i] = c.dense_words32()
+        return out.reshape(-1)
+
+    # --- mutation (op-log replay + write path) ---
+
+    def add_ids(self, ids) -> int:
+        """Set bits; returns number actually changed (reference Add)."""
+        return self._merge(ids, remove=False)
+
+    def remove_ids(self, ids) -> int:
+        return self._merge(ids, remove=True)
+
+    def _merge(self, ids, remove: bool) -> int:
+        ids = np.unique(np.asarray(ids, dtype=np.uint64))
+        if ids.size == 0:
+            return 0
+        hi = (ids >> np.uint64(16)).astype(np.int64)
+        lows = (ids & np.uint64(0xFFFF)).astype(np.uint16)
+        boundaries = np.concatenate(
+            ([0], np.nonzero(np.diff(hi))[0] + 1, [ids.size])
+        )
+        changed = 0
+        dirty = False
+        for i in range(boundaries.size - 1):
+            lo_i, hi_i = int(boundaries[i]), int(boundaries[i + 1])
+            key = int(hi[lo_i])
+            batch = lows[lo_i:hi_i]
+            c = self._containers.get(key)
+            existing = c.lows() if c is not None else np.empty(0, np.uint16)
+            if remove:
+                new = np.setdiff1d(existing, batch, assume_unique=True)
+            else:
+                new = np.union1d(existing, batch)
+            delta = abs(int(new.size) - int(existing.size))
+            if delta == 0:
+                continue
+            changed += delta
+            if new.size == 0:
+                self._containers.pop(key, None)
+            else:
+                self._containers[key] = Container.from_lows(new)
+            dirty = True
+        if dirty:
+            self.keys = sorted(self._containers)
+        return changed
+
+    def __contains__(self, id_: int) -> bool:
+        c = self._containers.get(int(id_) >> 16)
+        if c is None:
+            return False
+        return int(id_) & 0xFFFF in c.lows()
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, RoaringBitmap):
+            return NotImplemented
+        return self.keys == other.keys and all(
+            np.array_equal(self._containers[k].lows(), other._containers[k].lows())
+            for k in self.keys
+        )

@@ -1,0 +1,20 @@
+"""Storage tree: holder → index → field → view → fragment.
+
+Same hierarchy as the reference (holder.go / index.go / field.go / view.go /
+fragment.go — SURVEY.md §2 #3–#8), with the TPU twist that a fragment's
+durable truth is a host roaring file + op log while its *queryable* form is
+dense bit-packed rows cached in device HBM (pilosa_tpu.storage.residency).
+"""
+
+from pilosa_tpu.storage.cache import LRUCache, NoneCache, RankCache, new_row_cache
+from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu.storage.view import (
+    View,
+    VIEW_STANDARD,
+    view_name_bsi,
+    views_by_time_range,
+    views_for_time,
+)
+from pilosa_tpu.storage.field import Field, FieldOptions
+from pilosa_tpu.storage.index import Index
+from pilosa_tpu.storage.holder import Holder

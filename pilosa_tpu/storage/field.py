@@ -1,0 +1,252 @@
+"""Field: a named boolean matrix with a schema (type, cache, keys, quantum).
+
+Reference: field.go (SURVEY.md §2 #6). Field types:
+
+- ``set``   — default multi-value rows.
+- ``mutex`` — single-value: setting a column's row clears its previous row.
+- ``bool``  — mutex restricted to rows {0:false, 1:true}.
+- ``time``  — set + a time quantum (YMDH) generating time views on
+  timestamped writes.
+- ``int``   — BSI bit-sliced integers: one ``bsig_<field>`` view whose rows
+  are [exists, sign, bit 0 … bit depth-1]; values are offset-encoded
+  against the field minimum so all stored magnitudes are non-negative
+  (aggregates add ``base·count`` back — see executor BSI kernels).
+
+Write ops fan into views; every view write lands in a fragment chosen by
+``column >> 20``.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import os
+
+from pilosa_tpu.shardwidth import position, shard_of
+from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE
+from pilosa_tpu.storage.view import (
+    VIEW_STANDARD,
+    View,
+    validate_quantum,
+    view_name_bsi,
+    views_for_time,
+)
+
+TYPE_SET = "set"
+TYPE_INT = "int"
+TYPE_TIME = "time"
+TYPE_MUTEX = "mutex"
+TYPE_BOOL = "bool"
+
+# BSI plane layout within the bsig view.
+BSI_EXISTS_ROW = 0
+BSI_SIGN_ROW = 1  # reserved; offset encoding keeps magnitudes non-negative
+BSI_OFFSET_ROW = 2
+
+
+class FieldOptions:
+    def __init__(
+        self,
+        type: str = TYPE_SET,
+        cache_type: str = CACHE_TYPE_RANKED,
+        cache_size: int = DEFAULT_CACHE_SIZE,
+        min: int = 0,
+        max: int = 0,
+        time_quantum: str = "",
+        keys: bool = False,
+    ):
+        if type not in (TYPE_SET, TYPE_INT, TYPE_TIME, TYPE_MUTEX, TYPE_BOOL):
+            raise ValueError(f"invalid field type {type!r}")
+        if type == TYPE_INT and max < min:
+            raise ValueError("int field requires max >= min")
+        if type == TYPE_TIME:
+            validate_quantum(time_quantum)
+            if not time_quantum:
+                raise ValueError("time field requires a time quantum")
+        self.type = type
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.min = min
+        self.max = max
+        self.time_quantum = time_quantum
+        self.keys = keys
+
+    @property
+    def base(self) -> int:
+        return self.min
+
+    @property
+    def bit_depth(self) -> int:
+        span = self.max - self.min
+        return max(1, span.bit_length())
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "cacheType": self.cache_type,
+            "cacheSize": self.cache_size,
+            "min": self.min,
+            "max": self.max,
+            "timeQuantum": self.time_quantum,
+            "keys": self.keys,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FieldOptions":
+        return cls(
+            type=d.get("type", TYPE_SET),
+            cache_type=d.get("cacheType", CACHE_TYPE_RANKED),
+            cache_size=d.get("cacheSize", DEFAULT_CACHE_SIZE),
+            min=d.get("min", 0),
+            max=d.get("max", 0),
+            time_quantum=d.get("timeQuantum", ""),
+            keys=d.get("keys", False),
+        )
+
+
+class Field:
+    def __init__(self, path: str, index: str, name: str, options: FieldOptions | None = None):
+        self.path = path
+        self.index = index
+        self.name = name
+        self.options = options or FieldOptions()
+        self.views: dict[str, View] = {}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def open(self) -> "Field":
+        os.makedirs(self.path, exist_ok=True)
+        meta = os.path.join(self.path, ".meta")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                self.options = FieldOptions.from_dict(json.load(f))
+        else:
+            self._save_meta()
+        views_dir = os.path.join(self.path, "views")
+        if os.path.isdir(views_dir):
+            for name in sorted(os.listdir(views_dir)):
+                self.views[name] = View(
+                    os.path.join(views_dir, name),
+                    self.index,
+                    self.name,
+                    name,
+                    cache_type=self.options.cache_type,
+                    cache_size=self.options.cache_size,
+                ).open()
+        return self
+
+    def close(self) -> None:
+        for v in self.views.values():
+            v.close()
+
+    def _save_meta(self) -> None:
+        with open(os.path.join(self.path, ".meta"), "w") as f:
+            json.dump(self.options.to_dict(), f)
+
+    # ----------------------------------------------------------------- views
+
+    def view(self, name: str, create: bool = False) -> View | None:
+        v = self.views.get(name)
+        if v is None and create:
+            v = View(
+                os.path.join(self.path, "views", name),
+                self.index,
+                self.name,
+                name,
+                cache_type=self.options.cache_type,
+                cache_size=self.options.cache_size,
+            ).open()
+            self.views[name] = v
+        return v
+
+    def bsi_view_name(self) -> str:
+        return view_name_bsi(self.name)
+
+    def available_shards(self) -> list[int]:
+        shards: set[int] = set()
+        for v in self.views.values():
+            shards.update(v.available_shards())
+        return sorted(shards)
+
+    # ---------------------------------------------------------------- writes
+
+    def set_bit(self, row: int, column: int, timestamp: dt.datetime | None = None) -> bool:
+        """Set (row, column); mutex/bool clear the column's previous row
+        first. Timestamped writes also land in quantum time views."""
+        if self.options.type == TYPE_INT:
+            raise ValueError("set_bit on int field; use set_value")
+        if self.options.type == TYPE_BOOL and row not in (0, 1):
+            raise ValueError("bool field rows must be 0 (false) or 1 (true)")
+        shard, pos = shard_of(column), position(column)
+        frag = self.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
+        if self.options.type in (TYPE_MUTEX, TYPE_BOOL):
+            for other in frag.row_ids():
+                if other != row and frag.contains(other, pos):
+                    frag.clear_bit(other, pos)
+        changed = frag.set_bit(row, pos)
+        if timestamp is not None:
+            if self.options.type != TYPE_TIME:
+                raise ValueError("timestamped write on non-time field")
+            for vname in views_for_time(VIEW_STANDARD, self.options.time_quantum, timestamp):
+                self.view(vname, create=True).fragment(shard, create=True).set_bit(row, pos)
+        return changed
+
+    def clear_bit(self, row: int, column: int) -> bool:
+        shard, pos = shard_of(column), position(column)
+        changed = False
+        for v in self.views.values():
+            if v.name == self.bsi_view_name():
+                continue
+            frag = v.fragment(shard)
+            if frag is not None:
+                changed |= frag.clear_bit(row, pos)
+        return changed
+
+    def set_value(self, column: int, value: int) -> bool:
+        """BSI write (reference field.SetValue): offset-encode and write the
+        exists bit + magnitude bit planes."""
+        if self.options.type != TYPE_INT:
+            raise ValueError("set_value on non-int field")
+        if not self.options.min <= value <= self.options.max:
+            raise ValueError(
+                f"value {value} outside field range "
+                f"[{self.options.min}, {self.options.max}]"
+            )
+        stored = value - self.options.base
+        shard, pos = shard_of(column), position(column)
+        frag = self.view(self.bsi_view_name(), create=True).fragment(shard, create=True)
+        changed = frag.set_bit(BSI_EXISTS_ROW, pos)
+        for i in range(self.options.bit_depth):
+            if (stored >> i) & 1:
+                changed |= frag.set_bit(BSI_OFFSET_ROW + i, pos)
+            else:
+                changed |= frag.clear_bit(BSI_OFFSET_ROW + i, pos)
+        return changed
+
+    def value(self, column: int) -> tuple[int, bool]:
+        """Read one column's BSI value host-side (reference field.Value)."""
+        if self.options.type != TYPE_INT:
+            raise ValueError("value on non-int field")
+        shard, pos = shard_of(column), position(column)
+        view = self.view(self.bsi_view_name())
+        frag = view.fragment(shard) if view else None
+        if frag is None or not frag.contains(BSI_EXISTS_ROW, pos):
+            return 0, False
+        stored = 0
+        for i in range(self.options.bit_depth):
+            if frag.contains(BSI_OFFSET_ROW + i, pos):
+                stored |= 1 << i
+        return stored + self.options.base, True
+
+    def clear_value(self, column: int) -> bool:
+        if self.options.type != TYPE_INT:
+            raise ValueError("clear_value on non-int field")
+        shard, pos = shard_of(column), position(column)
+        view = self.view(self.bsi_view_name())
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            return False
+        changed = frag.clear_bit(BSI_EXISTS_ROW, pos)
+        for i in range(self.options.bit_depth):
+            frag.clear_bit(BSI_OFFSET_ROW + i, pos)
+        return changed

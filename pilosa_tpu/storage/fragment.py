@@ -1,0 +1,287 @@
+"""Fragment: one (index, field, view, shard) slice of the bitmap matrix.
+
+Reference: fragment.go (SURVEY.md §2 #3, §3.2–3.3) — the hot storage unit.
+Row ``r`` of the matrix occupies bit positions [r·2^20, (r+1)·2^20) of the
+fragment bitmap. Durability model preserved from the reference: a roaring
+snapshot file plus an append-only op log, compacted once the op count
+crosses a threshold; crash recovery = snapshot + replay (torn tails
+dropped).
+
+TPU divergence (SURVEY.md §7.1): reads are served from dense bit-packed
+rows decoded on demand and cached in device HBM (residency.DeviceRowCache),
+so query kernels see uniform uint32[32768] vectors instead of container
+trees. The roaring form never reaches the device.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from pilosa_tpu.roaring import RoaringBitmap, OP_ADD, OP_REMOVE
+from pilosa_tpu.roaring.format import deserialize, encode_op, replay_ops, serialize
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
+from pilosa_tpu.storage import residency
+
+# Snapshot (compact) once this many op records have accumulated
+# (reference fragment.go opN threshold; exact upstream value unverifiable —
+# SURVEY.md Appendix B).
+DEFAULT_SNAPSHOT_OP_THRESHOLD = 2048
+
+# Anti-entropy checksum granularity: rows per block (reference
+# fragment.go Blocks(), 100 rows per block — SURVEY.md §2 #3).
+BLOCK_ROWS = 100
+
+
+class Fragment:
+    def __init__(
+        self,
+        path: str,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        cache_type: str = CACHE_TYPE_RANKED,
+        cache_size: int = DEFAULT_CACHE_SIZE,
+        snapshot_threshold: int = DEFAULT_SNAPSHOT_OP_THRESHOLD,
+    ):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.frag_id = (index, field, view, shard)
+        self.bitmap = RoaringBitmap()
+        self.op_n = 0
+        self.snapshot_threshold = snapshot_threshold
+        self.row_cache = new_row_cache(cache_type, cache_size)
+        self._file = None
+        self._open = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def open(self) -> "Fragment":
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                buf = f.read()
+            if buf:
+                self.bitmap, ops_at = deserialize(buf)
+                self.op_n = replay_ops(self.bitmap, buf, ops_at)
+        else:
+            with open(self.path, "wb") as f:
+                f.write(serialize(self.bitmap))
+        self.row_cache.load(self._cache_path())
+        self._file = open(self.path, "ab")
+        self._open = True
+        if self.op_n > self.snapshot_threshold:
+            self.snapshot()
+        return self
+
+    def close(self) -> None:
+        if not self._open:
+            return
+        self.row_cache.save(self._cache_path())
+        if self._file:
+            self._file.close()
+            self._file = None
+        residency.global_row_cache().invalidate_fragment(self.frag_id)
+        self._open = False
+
+    def _cache_path(self) -> str:
+        return self.path + ".cache"
+
+    # ----------------------------------------------------------------- reads
+
+    def max_row_id(self) -> int:
+        if not self.bitmap.keys:
+            return 0
+        return self.bitmap.keys[-1] >> 4  # key = bit >> 16; row = key >> 4
+
+    def row_ids(self) -> list[int]:
+        """Rows with at least one container present (superset of non-empty
+        rows; exact after compaction since empty containers are dropped)."""
+        return sorted({k >> 4 for k in self.bitmap.keys})
+
+    def row_words(self, row: int) -> np.ndarray:
+        """Dense uint32[32768] for one row (host side)."""
+        base = row << 20
+        return self.bitmap.dense_range_words32(base, base + SHARD_WIDTH)
+
+    def device_row(self, row: int):
+        """Device-resident dense row, decoded through the residency cache."""
+        return residency.global_row_cache().get_row(
+            self.frag_id + (row,), lambda: self.row_words(row)
+        )
+
+    def row_columns(self, row: int) -> np.ndarray:
+        """Sorted in-shard column positions set in ``row``."""
+        base = row << 20
+        ids = self.bitmap.to_ids()
+        sel = ids[(ids >= base) & (ids < base + SHARD_WIDTH)]
+        return (sel - np.uint64(base)).astype(np.uint64)
+
+    def count_row(self, row: int) -> int:
+        base = row << 20
+        return self.bitmap.count_range(base, base + SHARD_WIDTH)
+
+    def count(self) -> int:
+        return self.bitmap.count()
+
+    def contains(self, row: int, pos: int) -> bool:
+        return (row << 20) + pos in self.bitmap
+
+    # ---------------------------------------------------------------- writes
+
+    def set_bit(self, row: int, pos: int) -> bool:
+        self._check_pos(pos)
+        changed = self.bitmap.add_ids([(row << 20) + pos]) > 0
+        if changed:
+            self._log_op(OP_ADD, [(row << 20) + pos])
+            self._after_row_write(row)
+        return changed
+
+    def clear_bit(self, row: int, pos: int) -> bool:
+        self._check_pos(pos)
+        changed = self.bitmap.remove_ids([(row << 20) + pos]) > 0
+        if changed:
+            self._log_op(OP_REMOVE, [(row << 20) + pos])
+            self._after_row_write(row)
+        return changed
+
+    def clear_row(self, row: int) -> int:
+        """Remove every bit in a row (mutex fields, Store). Returns #cleared."""
+        cols = self.row_columns(row)
+        if cols.size == 0:
+            return 0
+        ids = cols + np.uint64(row << 20)
+        removed = self.bitmap.remove_ids(ids)
+        self._log_op(OP_REMOVE, ids)
+        self._after_row_write(row)
+        return removed
+
+    def write_row_words(self, row: int, words: np.ndarray) -> None:
+        """Replace a row wholesale from a dense word vector (Store(),
+        anti-entropy block repair). Logged as clear+add."""
+        from pilosa_tpu.ops.packing import unpack_bits
+
+        old = self.row_columns(row) + np.uint64(row << 20)
+        new = unpack_bits(words) + np.uint64(row << 20)
+        if old.size:
+            self.bitmap.remove_ids(old)
+            self._log_op(OP_REMOVE, old)
+        if new.size:
+            self.bitmap.add_ids(new)
+            self._log_op(OP_ADD, new)
+        self._after_row_write(row)
+
+    def bulk_import(self, rows, positions) -> int:
+        """Batched import of (row, position) pairs (reference
+        fragment.bulkImport — SURVEY.md §3.3). Returns #bits changed."""
+        rows = np.asarray(rows, dtype=np.uint64)
+        positions = np.asarray(positions, dtype=np.uint64)
+        if rows.shape != positions.shape:
+            raise ValueError("rows and positions must have identical shape")
+        if positions.size and positions.max() >= SHARD_WIDTH:
+            raise ValueError("position out of shard range")
+        ids = (rows << np.uint64(20)) + positions
+        changed = self.bitmap.add_ids(ids)
+        if changed:
+            self._log_op(OP_ADD, ids)
+            for row in np.unique(rows).tolist():
+                self._after_row_write(int(row))
+        return changed
+
+    def import_roaring(self, data: bytes) -> int:
+        """Union a serialized roaring bitmap into this fragment (reference
+        api.ImportRoaring fast path)."""
+        other, ops_at = deserialize(data)
+        replay_ops(other, data, ops_at)
+        ids = other.to_ids()
+        changed = self.bitmap.add_ids(ids)
+        if changed:
+            self._log_op(OP_ADD, ids)
+            for row in sorted({int(i) >> 20 for i in ids.tolist()}):
+                self._after_row_write(row)
+        return changed
+
+    # ------------------------------------------------------------ durability
+
+    def _log_op(self, op: int, ids) -> None:
+        if self._file is None:
+            return
+        self._file.write(encode_op(op, ids))
+        self._file.flush()
+        self.op_n += 1
+        if self.op_n > self.snapshot_threshold:
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        """Compact: rewrite the file as a clean snapshot, dropping the log
+        (reference fragment.snapshot — SURVEY.md §3.3)."""
+        if self._file:
+            self._file.close()
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            f.write(serialize(self.bitmap))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self.op_n = 0
+        if self._open:
+            self._file = open(self.path, "ab")
+
+    def _after_row_write(self, row: int) -> None:
+        residency.global_row_cache().invalidate(self.frag_id + (row,))
+        self.row_cache.add(row, self.count_row(row))
+
+    def _check_pos(self, pos: int) -> None:
+        if not 0 <= pos < SHARD_WIDTH:
+            raise ValueError(f"position {pos} outside shard width {SHARD_WIDTH}")
+
+    # ---------------------------------------------------- anti-entropy blocks
+
+    def blocks(self) -> list[tuple[int, str]]:
+        """Checksums of BLOCK_ROWS-row blocks for replica diffing
+        (reference fragment.Blocks — SURVEY.md §3.5)."""
+        out = []
+        ids = self.bitmap.to_ids()
+        if ids.size == 0:
+            return out
+        block_of = (ids >> np.uint64(20)) // BLOCK_ROWS
+        boundaries = np.concatenate(
+            ([0], np.nonzero(np.diff(block_of))[0] + 1, [ids.size])
+        )
+        for i in range(boundaries.size - 1):
+            lo, hi = int(boundaries[i]), int(boundaries[i + 1])
+            digest = hashlib.blake2b(
+                ids[lo:hi].astype("<u8").tobytes(), digest_size=16
+            ).hexdigest()
+            out.append((int(block_of[lo]), digest))
+        return out
+
+    def block_ids(self, block: int) -> np.ndarray:
+        """All bit ids in one checksum block (for block repair)."""
+        ids = self.bitmap.to_ids()
+        lo = np.uint64(block * BLOCK_ROWS) << np.uint64(20)
+        hi = np.uint64((block + 1) * BLOCK_ROWS) << np.uint64(20)
+        return ids[(ids >= lo) & (ids < hi)]
+
+    # -------------------------------------------------------------- TopN feed
+
+    def top(self, n: int = 10, row_ids=None):
+        """Local TopN candidates: (row, count) pairs from the ranked cache,
+        counts exact (recomputed) — phase 1 of the reference's two-phase
+        TopN (SURVEY.md §3.4)."""
+        if row_ids is not None:
+            pairs = [(r, self.count_row(r)) for r in row_ids]
+        else:
+            pairs = self.row_cache.top()
+            if not pairs:  # cold/none cache: fall back to exact scan
+                pairs = [(r, self.count_row(r)) for r in self.row_ids()]
+        pairs = [(r, c) for r, c in pairs if c > 0]
+        pairs.sort(key=lambda rc: (-rc[1], rc[0]))
+        return pairs[:n] if n else pairs

@@ -1,0 +1,58 @@
+"""Holder: root of the storage tree, owns the data directory.
+
+Reference: holder.go (SURVEY.md §2 #8): opens/walks ``<data-dir>/`` on
+startup (restart == checkpoint resume: every fragment reloads snapshot +
+op log — SURVEY.md §5.4), caches open fragments, exposes the schema.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from pilosa_tpu.storage.index import Index, _validate_name
+
+
+class Holder:
+    def __init__(self, data_dir: str):
+        self.data_dir = os.path.expanduser(data_dir)
+        self.indexes: dict[str, Index] = {}
+        self._open = False
+
+    def open(self) -> "Holder":
+        os.makedirs(self.data_dir, exist_ok=True)
+        for entry in sorted(os.listdir(self.data_dir)):
+            p = os.path.join(self.data_dir, entry)
+            if os.path.isdir(p) and not entry.startswith("."):
+                self.indexes[entry] = Index(p, entry).open()
+        self._open = True
+        return self
+
+    def close(self) -> None:
+        for idx in self.indexes.values():
+            idx.close()
+        self._open = False
+
+    def create_index(self, name: str, keys: bool = False, track_existence: bool = True) -> Index:
+        if name in self.indexes:
+            raise ValueError(f"index {name!r} already exists")
+        _validate_name(name)
+        idx = Index(
+            os.path.join(self.data_dir, name), name, keys=keys,
+            track_existence=track_existence,
+        ).open()
+        self.indexes[name] = idx
+        return idx
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def delete_index(self, name: str) -> None:
+        idx = self.indexes.pop(name, None)
+        if idx is None:
+            raise KeyError(f"index {name!r} not found")
+        idx.close()
+        shutil.rmtree(idx.path, ignore_errors=True)
+
+    def schema(self) -> list[dict]:
+        return [idx.schema() for _, idx in sorted(self.indexes.items())]

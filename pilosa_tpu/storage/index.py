@@ -1,0 +1,120 @@
+"""Index: a named database of fields + existence tracking.
+
+Reference: index.go (SURVEY.md §2 #7): owns fields, the ``keys`` option
+(string column keys via the translate store), and ``trackExistence`` — an
+internal ``_exists`` field recording which columns exist so ``Not``/``All``
+have a universe to complement against.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from pilosa_tpu.shardwidth import position, shard_of
+from pilosa_tpu.storage.field import Field, FieldOptions, TYPE_SET
+from pilosa_tpu.storage.view import VIEW_STANDARD
+
+EXISTENCE_FIELD = "_exists"
+
+
+class Index:
+    def __init__(self, path: str, name: str, keys: bool = False, track_existence: bool = True):
+        self.path = path
+        self.name = name
+        self.keys = keys
+        self.track_existence = track_existence
+        self.fields: dict[str, Field] = {}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def open(self) -> "Index":
+        os.makedirs(self.path, exist_ok=True)
+        meta = os.path.join(self.path, ".meta")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                d = json.load(f)
+            self.keys = d.get("keys", False)
+            self.track_existence = d.get("trackExistence", True)
+        else:
+            self._save_meta()
+        for entry in sorted(os.listdir(self.path)):
+            p = os.path.join(self.path, entry)
+            if os.path.isdir(p) and not entry.startswith("."):
+                self.fields[entry] = Field(p, self.name, entry).open()
+        if self.track_existence and EXISTENCE_FIELD not in self.fields:
+            self.create_field(EXISTENCE_FIELD, FieldOptions(type=TYPE_SET, cache_type="none"))
+        return self
+
+    def close(self) -> None:
+        for f in self.fields.values():
+            f.close()
+
+    def _save_meta(self) -> None:
+        with open(os.path.join(self.path, ".meta"), "w") as f:
+            json.dump({"keys": self.keys, "trackExistence": self.track_existence}, f)
+
+    # ---------------------------------------------------------------- fields
+
+    def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        if name in self.fields:
+            raise ValueError(f"field {name!r} already exists")
+        _validate_name(name, allow_internal=name == EXISTENCE_FIELD)
+        field = Field(os.path.join(self.path, name), self.name, name, options).open()
+        self.fields[name] = field
+        return field
+
+    def field(self, name: str) -> Field | None:
+        return self.fields.get(name)
+
+    def delete_field(self, name: str) -> None:
+        field = self.fields.pop(name, None)
+        if field is None:
+            raise KeyError(f"field {name!r} not found")
+        field.close()
+        shutil.rmtree(field.path, ignore_errors=True)
+
+    def public_fields(self) -> list[Field]:
+        return [f for n, f in sorted(self.fields.items()) if not n.startswith("_")]
+
+    # ------------------------------------------------------------- existence
+
+    def mark_columns_exist(self, columns) -> None:
+        if not self.track_existence:
+            return
+        ex = self.fields[EXISTENCE_FIELD]
+        for col in columns:
+            ex.set_bit(0, int(col))
+
+    def existence_fragment(self, shard: int):
+        if not self.track_existence:
+            return None
+        view = self.fields[EXISTENCE_FIELD].view(VIEW_STANDARD)
+        return view.fragment(shard) if view else None
+
+    # ----------------------------------------------------------------- info
+
+    def available_shards(self) -> list[int]:
+        shards: set[int] = set()
+        for f in self.fields.values():
+            shards.update(f.available_shards())
+        return sorted(shards)
+
+    def schema(self) -> dict:
+        return {
+            "name": self.name,
+            "options": {"keys": self.keys, "trackExistence": self.track_existence},
+            "fields": [
+                {"name": f.name, "options": f.options.to_dict()}
+                for f in self.public_fields()
+            ],
+        }
+
+
+def _validate_name(name: str, allow_internal: bool = False) -> None:
+    ok_first = name[:1].isalpha() or (allow_internal and name[:1] == "_")
+    if not name or len(name) > 230 or not ok_first or not all(
+        c.isalnum() or c in "-_" for c in name
+    ):
+        raise ValueError(f"invalid name {name!r}")

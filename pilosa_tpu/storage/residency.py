@@ -1,0 +1,93 @@
+"""Device residency manager: which fragment rows live in HBM.
+
+The reference mmaps every fragment file and lets the OS page cache decide
+residency (fragment.go + syswrap/ — SURVEY.md §2 #3, #26). HBM is orders of
+magnitude smaller than a disk page cache, so residency is explicit here: a
+byte-budgeted LRU of decoded dense rows (uint32[32768] each = 128 KiB) keyed
+by (fragment id, row). Eviction is free — the host roaring file remains the
+source of truth and rows are re-decoded on demand (SURVEY.md §7.3 hard part
+#1).
+
+Writes invalidate the affected row; queries call ``get_row`` and receive a
+device array ready for the bitwise kernels.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable
+
+import jax
+import numpy as np
+
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+
+ROW_BYTES = WORDS_PER_SHARD * 4  # 128 KiB per resident row
+
+# Default budget: 4 GiB of HBM for row residency (v5e has 16 GiB; the rest
+# is headroom for query intermediates + XLA workspace). Tests override.
+DEFAULT_BUDGET_BYTES = 4 << 30
+
+
+class DeviceRowCache:
+    """Byte-budgeted LRU of device-resident dense rows."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES, device=None):
+        self.budget_bytes = budget_bytes
+        self.device = device
+        self._rows: OrderedDict[tuple, jax.Array] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    @property
+    def bytes_used(self) -> int:
+        return len(self._rows) * ROW_BYTES
+
+    def get_row(self, key: tuple, decode: Callable[[], np.ndarray]) -> jax.Array:
+        """Return the device row for ``key``, decoding+uploading on miss."""
+        row = self._rows.get(key)
+        if row is not None:
+            self.hits += 1
+            self._rows.move_to_end(key)
+            return row
+        self.misses += 1
+        host = decode()
+        arr = jax.device_put(host, self.device)
+        self._rows[key] = arr
+        self._evict()
+        return arr
+
+    def invalidate(self, key: tuple) -> None:
+        self._rows.pop(key, None)
+
+    def invalidate_fragment(self, frag_id: tuple) -> None:
+        doomed = [k for k in self._rows if k[: len(frag_id)] == frag_id]
+        for k in doomed:
+            del self._rows[k]
+
+    def clear(self) -> None:
+        self._rows.clear()
+
+    def _evict(self) -> None:
+        while len(self._rows) * ROW_BYTES > self.budget_bytes and len(self._rows) > 1:
+            self._rows.popitem(last=False)
+            self.evictions += 1
+
+
+_global_cache: DeviceRowCache | None = None
+
+
+def global_row_cache() -> DeviceRowCache:
+    global _global_cache
+    if _global_cache is None:
+        _global_cache = DeviceRowCache()
+    return _global_cache
+
+
+def set_global_row_cache(cache: DeviceRowCache) -> None:
+    global _global_cache
+    _global_cache = cache

@@ -1,0 +1,146 @@
+"""Host roaring codec tests: container kinds, serialization round-trips,
+op-log replay (modeled on the reference's roaring_test.go coverage —
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import (
+    OP_ADD,
+    OP_REMOVE,
+    RoaringBitmap,
+    deserialize,
+    serialize,
+)
+from pilosa_tpu.roaring.bitmap import ARRAY, BITMAP, RUN, Container
+from pilosa_tpu.roaring.format import encode_op, load, replay_ops
+
+
+def make_ids(rng, kind):
+    if kind == "sparse":
+        return rng.choice(1 << 22, 300, replace=False).astype(np.uint64)
+    if kind == "dense":
+        base = rng.choice(1 << 18, 60_000, replace=False)
+        return base.astype(np.uint64)
+    if kind == "runs":
+        out = []
+        for start in rng.choice(1 << 22, 20, replace=False):
+            out.extend(range(int(start), int(start) + int(rng.integers(100, 3000))))
+        return np.array(sorted(set(out)), dtype=np.uint64)
+    if kind == "mixed":
+        a = make_ids(rng, "sparse")
+        b = make_ids(rng, "runs")
+        return np.unique(np.concatenate([a, b]))
+    raise ValueError(kind)
+
+
+@pytest.mark.parametrize("kind", ["sparse", "dense", "runs", "mixed"])
+def test_roundtrip_ids(kind):
+    rng = np.random.default_rng(hash(kind) % (1 << 31))
+    ids = make_ids(rng, kind)
+    b = RoaringBitmap.from_ids(ids)
+    assert b.count() == ids.size
+    np.testing.assert_array_equal(b.to_ids(), np.sort(ids))
+
+
+def test_container_kind_selection():
+    # few scattered values -> array
+    assert Container.from_lows(np.array([1, 5, 900], np.uint16)).kind == ARRAY
+    # long run -> run container
+    assert Container.from_lows(np.arange(10_000, dtype=np.uint16)).kind == RUN
+    # dense random -> bitmap
+    rng = np.random.default_rng(0)
+    lows = np.unique(rng.choice(65536, 30_000, replace=False)).astype(np.uint16)
+    assert Container.from_lows(lows).kind == BITMAP
+
+
+@pytest.mark.parametrize("kind", ["sparse", "dense", "runs", "mixed"])
+def test_serialize_roundtrip(kind):
+    rng = np.random.default_rng(hash(kind) % (1 << 30) + 1)
+    ids = make_ids(rng, kind)
+    b = RoaringBitmap.from_ids(ids)
+    buf = serialize(b)
+    b2, ops_at = deserialize(buf)
+    assert ops_at == len(buf)
+    assert b2 == b
+    np.testing.assert_array_equal(b2.to_ids(), np.sort(ids))
+
+
+def test_empty_bitmap():
+    b = RoaringBitmap.from_ids([])
+    assert b.count() == 0
+    b2, _ = deserialize(serialize(b))
+    assert b2.count() == 0
+    assert b2.to_ids().size == 0
+
+
+def test_add_remove_oracle():
+    rng = np.random.default_rng(42)
+    oracle = set()
+    b = RoaringBitmap.from_ids([])
+    for _ in range(20):
+        batch = rng.choice(1 << 20, 500, replace=False).astype(np.uint64)
+        if rng.random() < 0.6:
+            expected_change = len(set(batch.tolist()) - oracle)
+            assert b.add_ids(batch) == expected_change
+            oracle |= set(batch.tolist())
+        else:
+            expected_change = len(set(batch.tolist()) & oracle)
+            assert b.remove_ids(batch) == expected_change
+            oracle -= set(batch.tolist())
+        assert b.count() == len(oracle)
+    np.testing.assert_array_equal(b.to_ids(), np.array(sorted(oracle), np.uint64))
+
+
+def test_op_log_replay_and_torn_tail():
+    base_ids = np.arange(0, 5000, 3, dtype=np.uint64)
+    b = RoaringBitmap.from_ids(base_ids)
+    buf = serialize(b)
+    buf += encode_op(OP_ADD, [1, 2, 100_000])
+    buf += encode_op(OP_REMOVE, [0, 3, 6])
+    full, n_ops = load(buf)
+    assert n_ops == 2
+    expected = (set(base_ids.tolist()) | {1, 2, 100_000}) - {0, 3, 6}
+    np.testing.assert_array_equal(full.to_ids(), np.array(sorted(expected), np.uint64))
+
+    # torn final record: truncated mid-ids — must be ignored
+    torn = buf + encode_op(OP_ADD, list(range(64)))[:-7]
+    full2, n_ops2 = load(torn)
+    assert n_ops2 == 2
+    assert full2 == full
+
+    # corrupt crc in the tail record — ignored as well
+    bad = bytearray(buf + encode_op(OP_ADD, [7]))
+    bad[-1] ^= 0xFF
+    full3, n_ops3 = load(bytes(bad))
+    assert n_ops3 == 2
+
+
+def test_count_range():
+    ids = np.array([0, 100, 65535, 65536, 70000, 200_000, (1 << 20) - 1], np.uint64)
+    b = RoaringBitmap.from_ids(ids)
+    assert b.count_range(0, 1 << 20) == len(ids)
+    assert b.count_range(100, 65537) == 3
+    assert b.count_range(65536, 65537) == 1
+    assert b.count_range(5, 5) == 0
+    assert b.count_range(200_001, 1 << 20) == 1
+
+
+def test_dense_range_words_matches_pack():
+    from pilosa_tpu.ops.packing import pack_bits, unpack_bits
+
+    rng = np.random.default_rng(9)
+    # ids within "row 3" of a fragment: [3*2^20, 4*2^20)
+    row_base = 3 << 20
+    ids = np.sort(rng.choice(1 << 20, 5000, replace=False)).astype(np.uint64)
+    b = RoaringBitmap.from_ids(ids + np.uint64(row_base))
+    words = b.dense_range_words32(row_base, row_base + (1 << 20))
+    np.testing.assert_array_equal(words, pack_bits(ids, 1 << 20))
+    np.testing.assert_array_equal(unpack_bits(words), ids)
+
+
+def test_contains():
+    b = RoaringBitmap.from_ids([5, 65536 * 3 + 2])
+    assert 5 in b
+    assert 65536 * 3 + 2 in b
+    assert 6 not in b
