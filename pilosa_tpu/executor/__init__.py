@@ -1,0 +1,14 @@
+"""Query executor: PQL AST → fused XLA kernels per shard → reduced results.
+
+Reference: executor.go (SURVEY.md §2 #12, §3.2): per-call dispatch with a
+mapReduce core over shards. TPU re-design: instead of walking containers
+per call, the whole bitmap expression tree of a query is compiled
+(pilosa_tpu.executor.expr) into ONE jitted function per tree shape, so
+``Count(Intersect(Union(a,b), Not(c)))`` runs as a single fused
+bitwise+popcount pass over each shard's resident rows. Shard mapping is a
+host loop on one chip (M2) and a shard_map over the mesh axis in the
+distributed path (pilosa_tpu.parallel).
+"""
+
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.executor.result import RowResult

@@ -1,0 +1,676 @@
+"""The query executor: dispatch, shard mapReduce, result reduction.
+
+Reference: executor.go (SURVEY.md §2 #12, §3.2–3.4). Shape preserved:
+``execute<CallName>`` dispatch, a map phase over shards and a reduce phase
+merging partials (rows union, counts add, TopN pair-merge + exact recount,
+GroupBy group-merge). TPU re-design: the map phase evaluates ONE fused
+compiled kernel per query shape per shard (expr.py) against HBM-resident
+rows; the single-chip path loops shards on the host, and the mesh path
+(pilosa_tpu.parallel) shard_maps the same kernels with psum reduces.
+
+BSI semantics (Sum/Min/Max/Range): values are offset-encoded against the
+field base (storage.field); kernels work on stored magnitudes and the
+host adds ``base·count`` back (Sum) or ``base`` (Min/Max). Predicates are
+base-shifted and range-clamped at compile time so out-of-range compares
+reduce to const-empty / all-existing without touching the device.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+
+import numpy as np
+
+from pilosa_tpu.executor import expr
+from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
+from pilosa_tpu.pql import Call, Condition, parse
+from pilosa_tpu.pql.ast import Query
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD, position, shard_of
+from pilosa_tpu.storage import residency
+from pilosa_tpu.storage.field import (
+    BSI_EXISTS_ROW,
+    TYPE_INT,
+    TYPE_TIME,
+)
+from pilosa_tpu.storage.index import EXISTENCE_FIELD, Index
+from pilosa_tpu.storage.view import VIEW_STANDARD, views_by_time_range
+
+# TopN phase-1 candidate overfetch per shard (reference uses a similar
+# superset factor before the exact recount — SURVEY.md §3.4; exact upstream
+# value unverifiable, Appendix B).
+TOPN_CANDIDATE_FACTOR = 4
+
+_RESERVED_ARGS = {"_field", "_col", "from", "to", "n", "limit", "offset",
+                  "previous", "column", "filter", "field", "ids", "timestamp",
+                  "excludeColumns", "shards"}
+
+
+class PQLError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------- leaf specs
+
+
+class _RowSpec:
+    """Device leaf: OR of one row across a set of views (time ranges span
+    multiple views; missing fragments contribute zeros)."""
+
+    __slots__ = ("field", "views", "row")
+
+    def __init__(self, field: str, views: tuple[str, ...], row: int):
+        self.field = field
+        self.views = views
+        self.row = row
+
+    def resolve(self, idx: Index, shard: int):
+        field = idx.field(self.field)
+        acc = None
+        for vname in self.views:
+            view = field.view(vname) if field else None
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                continue
+            row = frag.device_row(self.row)
+            acc = row if acc is None else acc | row
+        return acc if acc is not None else _zeros_words()
+
+
+class _PlanesSpec:
+    """Device leaf: the stacked BSI plane matrix uint32[2+depth, words]."""
+
+    __slots__ = ("field",)
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def resolve(self, idx: Index, shard: int):
+        field = idx.field(self.field)
+        depth = field.options.bit_depth
+        view = field.view(field.bsi_view_name())
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            return _zeros_planes(2 + depth)
+
+        def decode():
+            rows = [frag.row_words(r) for r in range(2 + depth)]
+            return np.stack(rows)
+
+        return residency.global_row_cache().get_row(
+            frag.frag_id + ("__planes__", 2 + depth), decode
+        )
+
+
+class _ZeroSpec:
+    __slots__ = ()
+
+    def resolve(self, idx: Index, shard: int):
+        return _zeros_words()
+
+
+_zeros = {}
+
+
+def _zeros_words():
+    z = _zeros.get(WORDS_PER_SHARD)
+    if z is None:
+        import jax
+
+        z = jax.device_put(np.zeros(WORDS_PER_SHARD, np.uint32))
+        _zeros[WORDS_PER_SHARD] = z
+    return z
+
+
+def _zeros_planes(rows: int):
+    key = ("planes", rows)
+    z = _zeros.get(key)
+    if z is None:
+        import jax
+
+        z = jax.device_put(np.zeros((rows, WORDS_PER_SHARD), np.uint32))
+        _zeros[key] = z
+    return z
+
+
+class _Compiled:
+    """A bitmap call compiled to (structure, leaf specs, scalars)."""
+
+    def __init__(self, node, specs, scalars):
+        self.node = node
+        self.specs = specs
+        self.scalars = scalars
+
+    def eval(self, idx: Index, shard: int):
+        leaves = [s.resolve(idx, shard) for s in self.specs]
+        if not leaves:
+            leaves = [_zeros_words()]
+        return expr.evaluate(self.node, leaves, self.scalars)
+
+
+# ----------------------------------------------------------------- executor
+
+
+class Executor:
+    def __init__(self, holder):
+        self.holder = holder
+
+    # ------------------------------------------------------------ top level
+
+    def execute(self, index_name: str, query, shards=None):
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise PQLError(f"index {index_name!r} not found")
+        if isinstance(query, str):
+            query = parse(query)
+        elif isinstance(query, Call):
+            query = Query([query])
+        return [self._execute_call(idx, call, shards) for call in query.calls]
+
+    def _execute_call(self, idx: Index, call: Call, shards=None):
+        name = call.name
+        if name == "Options":
+            return self._execute_options(idx, call, shards)
+        if name in ("Set",):
+            return self._execute_set(idx, call)
+        if name == "Clear":
+            return self._execute_clear(idx, call)
+        if name == "ClearRow":
+            return self._execute_clear_row(idx, call, shards)
+        if name == "Store":
+            return self._execute_store(idx, call, shards)
+        if name == "Count":
+            return self._execute_count(idx, call, shards)
+        if name == "TopN":
+            return self._execute_topn(idx, call, shards)
+        if name in ("Sum", "Min", "Max"):
+            return self._execute_bsi_aggregate(idx, call, shards)
+        if name == "Rows":
+            return self._execute_rows(idx, call, shards)
+        if name == "GroupBy":
+            return self._execute_groupby(idx, call, shards)
+        if name == "IncludesColumn":
+            return self._execute_includes_column(idx, call)
+        if name in _BITMAP_CALLS:
+            return self._execute_bitmap(idx, call, shards)
+        raise PQLError(f"unsupported call {name!r}")
+
+    # --------------------------------------------------------------- shards
+
+    def _shards(self, idx: Index, shards=None) -> list[int]:
+        if shards is not None:
+            return list(shards)
+        return idx.available_shards()
+
+    # --------------------------------------------------------- bitmap calls
+
+    def _execute_bitmap(self, idx: Index, call: Call, shards=None) -> RowResult:
+        compiled = self._compile(idx, call)
+        segments = {}
+        for shard in self._shards(idx, shards):
+            words = np.asarray(compiled.eval(idx, shard))
+            if words.any():
+                segments[shard] = words
+        return RowResult(segments)
+
+    def _execute_count(self, idx: Index, call: Call, shards=None) -> int:
+        if len(call.children) != 1:
+            raise PQLError("Count requires exactly one child call")
+        compiled = self._compile(idx, call.children[0], wrap="count")
+        total = 0
+        for shard in self._shards(idx, shards):
+            total += int(compiled.eval(idx, shard))
+        return total
+
+    def _execute_includes_column(self, idx: Index, call: Call) -> bool:
+        col = call.arg("column")
+        if col is None:
+            raise PQLError("IncludesColumn requires column=")
+        if len(call.children) != 1:
+            raise PQLError("IncludesColumn requires one child call")
+        shard, pos = shard_of(col), position(col)
+        compiled = self._compile(idx, call.children[0])
+        words = np.asarray(compiled.eval(idx, shard))
+        return bool((words[pos // 32] >> np.uint32(pos % 32)) & np.uint32(1))
+
+    def _execute_options(self, idx: Index, call: Call, shards=None):
+        if len(call.children) != 1:
+            raise PQLError("Options requires one child call")
+        opt_shards = call.arg("shards")
+        if opt_shards is not None:
+            shards = [int(s) for s in opt_shards]
+        res = self._execute_call(idx, call.children[0], shards)
+        if call.arg("excludeColumns") and isinstance(res, RowResult):
+            return RowResult({})
+        return res
+
+    # -------------------------------------------------------------- compile
+
+    def _compile(self, idx: Index, call: Call, wrap: str | None = None) -> _Compiled:
+        specs: list = []
+        scalars: list = []
+        node = self._compile_node(idx, call, specs, scalars)
+        if wrap == "count":
+            node = ("count", node)
+        return _Compiled(node, specs, scalars)
+
+    def _compile_node(self, idx: Index, call: Call, specs, scalars):
+        name = call.name
+        if name == "Row" or name == "Range":
+            return self._compile_row(idx, call, specs, scalars)
+        if name in ("Union", "Intersect", "Xor"):
+            if not call.children:
+                return ("const0",)
+            tag = {"Union": "or", "Intersect": "and", "Xor": "xor"}[name]
+            node = self._compile_node(idx, call.children[0], specs, scalars)
+            for child in call.children[1:]:
+                node = (tag, node, self._compile_node(idx, child, specs, scalars))
+            return node
+        if name == "Difference":
+            if not call.children:
+                return ("const0",)
+            node = self._compile_node(idx, call.children[0], specs, scalars)
+            for child in call.children[1:]:
+                node = ("diff", node, self._compile_node(idx, child, specs, scalars))
+            return node
+        if name == "Not":
+            if len(call.children) != 1:
+                raise PQLError("Not requires exactly one child call")
+            exists = self._existence_node(idx, specs)
+            return ("diff", exists, self._compile_node(idx, call.children[0], specs, scalars))
+        if name == "All":
+            return self._existence_node(idx, specs)
+        if name == "Shift":
+            if len(call.children) != 1:
+                raise PQLError("Shift requires exactly one child call")
+            n = call.arg("n", 1)
+            scalars.append(int(n))
+            return (
+                "shift",
+                self._compile_node(idx, call.children[0], specs, scalars),
+                len(scalars) - 1,
+            )
+        raise PQLError(f"call {name!r} is not a bitmap (row-producing) call")
+
+    def _compile_row(self, idx: Index, call: Call, specs, scalars):
+        cond_field, cond = call.condition_field()
+        if cond is not None:
+            return self._compile_bsi_compare(idx, cond_field, cond, specs, scalars)
+        field_name, row = self._row_field_and_value(call)
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        if not isinstance(row, int):
+            raise PQLError(
+                f"row key {row!r} requires key translation (field keys)"
+            )
+        views: tuple[str, ...]
+        t_from, t_to = call.arg("from"), call.arg("to")
+        if t_from is not None or t_to is not None:
+            if field.options.type != TYPE_TIME:
+                raise PQLError("from/to args require a time field")
+            views = tuple(
+                views_by_time_range(
+                    VIEW_STANDARD,
+                    field.options.time_quantum,
+                    _parse_time(t_from),
+                    _parse_time(t_to),
+                )
+            )
+        else:
+            views = (VIEW_STANDARD,)
+        specs.append(_RowSpec(field_name, views, row))
+        return ("leaf", len(specs) - 1)
+
+    def _compile_bsi_compare(self, idx: Index, field_name: str, cond: Condition,
+                             specs, scalars):
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        if field.options.type != TYPE_INT:
+            raise PQLError(f"comparison on non-int field {field_name!r}")
+        if cond.op == "><":
+            lo, hi = cond.value
+            if lo > hi:
+                return ("const0",)
+            ge = self._compile_bsi_compare(
+                idx, field_name, Condition(">=", lo), specs, scalars
+            )
+            le = self._compile_bsi_compare(
+                idx, field_name, Condition("<=", hi), specs, scalars
+            )
+            return ("and", ge, le)
+
+        base = field.options.base
+        depth = field.options.bit_depth
+        max_stored = (1 << depth) - 1
+        pred = int(cond.value) - base
+        exists = self._bsi_exists_node(field, specs)
+        # range-clamp: out-of-range predicates degenerate to empty/universe
+        if pred < 0:
+            if cond.op in ("<", "<=", "=="):
+                return ("const0",)
+            return exists  # >, >=, != of anything stored
+        if pred > max_stored:
+            if cond.op in (">", ">=", "=="):
+                return ("const0",)
+            return exists
+        planes_i = self._planes_index(field, specs)
+        scalars.append(pred)
+        return ("bsicmp", cond.op, planes_i, exists, len(scalars) - 1)
+
+    def _planes_index(self, field, specs) -> int:
+        for i, s in enumerate(specs):
+            if isinstance(s, _PlanesSpec) and s.field == field.name:
+                return i
+        specs.append(_PlanesSpec(field.name))
+        return len(specs) - 1
+
+    def _bsi_exists_node(self, field, specs):
+        specs.append(_RowSpec(field.name, (field.bsi_view_name(),), BSI_EXISTS_ROW))
+        return ("leaf", len(specs) - 1)
+
+    def _existence_node(self, idx: Index, specs):
+        if not idx.track_existence:
+            raise PQLError("Not/All require trackExistence on the index")
+        specs.append(_RowSpec(EXISTENCE_FIELD, (VIEW_STANDARD,), 0))
+        return ("leaf", len(specs) - 1)
+
+    @staticmethod
+    def _row_field_and_value(call: Call):
+        for k, v in call.args.items():
+            if k not in _RESERVED_ARGS and not isinstance(v, Condition):
+                return k, v
+        raise PQLError(f"{call.name} requires a field=row argument")
+
+    # ------------------------------------------------------- BSI aggregates
+
+    def _execute_bsi_aggregate(self, idx: Index, call: Call, shards=None) -> ValCount:
+        field_name = call.arg("field") or call.arg("_field")
+        if field_name is None:
+            raise PQLError(f"{call.name} requires field=")
+        field = idx.field(field_name)
+        if field is None or field.options.type != TYPE_INT:
+            raise PQLError(f"{call.name} requires an int field")
+        filt_call = call.children[0] if call.children else None
+
+        specs: list = []
+        scalars: list = []
+        planes_i = self._planes_index(field, specs)
+        filt_node = (
+            self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
+        )
+        base = field.options.base
+
+        if call.name == "Sum":
+            node = ("bsisum", planes_i, filt_node)
+            compiled = _Compiled(node, specs, scalars)
+            total, count = 0, 0
+            for shard in self._shards(idx, shards):
+                plane_counts, n = compiled.eval(idx, shard)
+                plane_counts = np.asarray(plane_counts)
+                total += int(
+                    sum(c << i for i, c in enumerate(plane_counts.tolist()))
+                )
+                count += int(n)
+            return ValCount(total + base * count, count)
+
+        want_max = call.name == "Max"
+        node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
+        compiled = _Compiled(node, specs, scalars)
+        best, count = None, 0
+        for shard in self._shards(idx, shards):
+            value, n = compiled.eval(idx, shard)
+            value, n = int(value), int(n)
+            if n == 0:
+                continue
+            if best is None or (value > best if want_max else value < best):
+                best, count = value, n
+            elif value == best:
+                count += n
+        if best is None:
+            return ValCount(0, 0)
+        return ValCount(best + base, count)
+
+    # ----------------------------------------------------------------- TopN
+
+    def _execute_topn(self, idx: Index, call: Call, shards=None) -> list[Pair]:
+        field_name = call.arg("_field") or call.arg("field")
+        if field_name is None:
+            raise PQLError("TopN requires a field")
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        n = call.arg("n", 10)
+        filt_call = call.children[0] if call.children else None
+        shard_list = self._shards(idx, shards)
+        view = field.view(VIEW_STANDARD)
+
+        explicit_ids = call.arg("ids")
+        if explicit_ids is not None:
+            candidates = sorted(int(i) for i in explicit_ids)
+        else:
+            # phase 1: per-shard candidates from the ranked caches
+            overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
+            cand: set[int] = set()
+            for shard in shard_list:
+                frag = view.fragment(shard) if view else None
+                if frag is None:
+                    continue
+                cand.update(r for r, _ in frag.top(overfetch))
+            candidates = sorted(cand)
+        if not candidates:
+            return []
+
+        # phase 2: exact recount of every candidate across all shards
+        specs: list = []
+        scalars: list = []
+        filt_node = (
+            self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
+        )
+        matrix_i = len(specs)  # matrix appended per shard below
+        node = ("countrows", matrix_i, filt_node)
+        import jax.numpy as jnp
+
+        totals = np.zeros(len(candidates), np.int64)
+        for shard in shard_list:
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                continue
+            rows = [frag.device_row(r) for r in candidates]
+            matrix = jnp.stack(rows)
+            leaves = [s.resolve(idx, shard) for s in specs] + [matrix]
+            counts = expr.evaluate(node, leaves, scalars)
+            totals += np.asarray(counts, np.int64)
+        order = sorted(
+            (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
+        )
+        return [Pair(r, -negc) for negc, r in order[:n]]
+
+    # ----------------------------------------------------------------- Rows
+
+    def _execute_rows(self, idx: Index, call: Call, shards=None) -> list[int]:
+        field_name = call.arg("_field") or call.arg("field")
+        if field_name is None:
+            raise PQLError("Rows requires a field")
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        limit = call.arg("limit", 0)
+        previous = call.arg("previous")
+        column = call.arg("column")
+        view = field.view(VIEW_STANDARD)
+        if view is None:
+            return []
+        rows: set[int] = set()
+        if column is not None:
+            shard = shard_of(int(column))
+            pos = position(int(column))
+            frag = view.fragment(shard)
+            if frag is not None:
+                rows.update(r for r in frag.row_ids() if frag.contains(r, pos))
+        else:
+            for shard in self._shards(idx, shards):
+                frag = view.fragment(shard)
+                if frag is not None:
+                    rows.update(r for r in frag.row_ids() if frag.count_row(r) > 0)
+        out = sorted(rows)
+        if previous is not None:
+            out = [r for r in out if r > int(previous)]
+        if limit:
+            out = out[: int(limit)]
+        return out
+
+    # -------------------------------------------------------------- GroupBy
+
+    def _execute_groupby(self, idx: Index, call: Call, shards=None) -> list[GroupCount]:
+        if not call.children or any(c.name != "Rows" for c in call.children):
+            raise PQLError("GroupBy requires Rows(...) children")
+        limit = call.arg("limit", 0)
+        filt_call = call.arg("filter")
+        shard_list = self._shards(idx, shards)
+
+        dims = []
+        for child in call.children:
+            fname = child.arg("_field") or child.arg("field")
+            row_ids = self._execute_rows(idx, child, shards)
+            if not row_ids:
+                return []
+            dims.append((fname, row_ids))
+
+        specs: list = []
+        scalars: list = []
+        filt_node = (
+            self._compile_node(idx, filt_call, specs, scalars)
+            if isinstance(filt_call, Call)
+            else None
+        )
+
+        import jax.numpy as jnp
+        from pilosa_tpu.ops import bitops
+
+        counts: dict[tuple, int] = {}
+        last_field, last_rows = dims[-1]
+        node = ("countrows", len(specs), filt_node)
+        for shard in shard_list:
+            matrices = []
+            missing = False
+            for fname, row_ids in dims:
+                view = idx.field(fname).view(VIEW_STANDARD)
+                frag = view.fragment(shard) if view else None
+                if frag is None:
+                    missing = True
+                    break
+                matrices.append(
+                    jnp.stack([frag.device_row(r) for r in row_ids])
+                )
+            if missing:
+                continue
+
+            def recurse(level: int, mask, prefix: tuple):
+                if level == len(dims) - 1:
+                    matrix = matrices[-1]
+                    if mask is not None:
+                        matrix = matrix & mask[None, :]
+                    leaves = [s.resolve(idx, shard) for s in specs] + [matrix]
+                    got = np.asarray(expr.evaluate(node, leaves, scalars))
+                    for row_id, c in zip(last_rows, got.tolist()):
+                        if c > 0:
+                            key = prefix + (row_id,)
+                            counts[key] = counts.get(key, 0) + int(c)
+                    return
+                fname, row_ids = dims[level]
+                for i, row_id in enumerate(row_ids):
+                    sub = matrices[level][i]
+                    new_mask = sub if mask is None else (mask & sub)
+                    if not bool(bitops.any_set(new_mask)):
+                        continue
+                    recurse(level + 1, new_mask, prefix + (row_id,))
+
+            recurse(0, None, ())
+
+        out = [
+            GroupCount(
+                [
+                    {"field": dims[i][0], "rowID": row}
+                    for i, row in enumerate(key)
+                ],
+                c,
+            )
+            for key, c in sorted(counts.items())
+        ]
+        if limit:
+            out = out[: int(limit)]
+        return out
+
+    # ---------------------------------------------------------------- writes
+
+    def _execute_set(self, idx: Index, call: Call) -> bool:
+        col = call.arg("_col")
+        if col is None:
+            raise PQLError("Set requires a column")
+        if not isinstance(col, int):
+            raise PQLError("column keys require key translation (index keys)")
+        field_name, row = self._row_field_and_value(call)
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        if field.options.type == TYPE_INT:
+            changed = field.set_value(col, int(row))
+        else:
+            ts = call.arg("timestamp")
+            timestamp = _parse_time(ts) if ts is not None else None
+            changed = field.set_bit(int(row), col, timestamp=timestamp)
+        idx.mark_columns_exist([col])
+        return changed
+
+    def _execute_clear(self, idx: Index, call: Call) -> bool:
+        col = call.arg("_col")
+        if col is None:
+            raise PQLError("Clear requires a column")
+        field_name, row = self._row_field_and_value(call)
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        if field.options.type == TYPE_INT:
+            return field.clear_value(col)
+        return field.clear_bit(int(row), col)
+
+    def _execute_clear_row(self, idx: Index, call: Call, shards=None) -> bool:
+        field_name, row = self._row_field_and_value(call)
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        view = field.view(VIEW_STANDARD)
+        changed = False
+        if view is not None:
+            for shard in self._shards(idx, shards):
+                frag = view.fragment(shard)
+                if frag is not None:
+                    changed |= frag.clear_row(int(row)) > 0
+        return changed
+
+    def _execute_store(self, idx: Index, call: Call, shards=None) -> bool:
+        if len(call.children) != 1:
+            raise PQLError("Store requires one child call")
+        field_name, row = self._row_field_and_value(call)
+        field = idx.field(field_name)
+        if field is None:
+            field = idx.create_field(field_name)
+        compiled = self._compile(idx, call.children[0])
+        for shard in self._shards(idx, shards):
+            words = np.asarray(compiled.eval(idx, shard))
+            frag = field.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
+            frag.write_row_words(int(row), words)
+        return True
+
+
+_BITMAP_CALLS = {
+    "Row", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift",
+    "Range",
+}
+
+
+def _parse_time(value) -> dt.datetime:
+    if isinstance(value, dt.datetime):
+        return value
+    return dt.datetime.fromisoformat(str(value))

@@ -1,0 +1,106 @@
+"""Result types for query execution.
+
+Reference: row.go (SURVEY.md §2 #2) — a Row is per-shard segments each
+wrapping a bitmap, so cross-shard merges are cheap concatenation; plus the
+pair/group shapes the executor reduces (Pairs for TopN, GroupCounts for
+GroupBy).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pilosa_tpu.ops.packing import popcount_words, unpack_bits
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class RowResult:
+    """Query-result set of columns: shard → dense uint32 words (host)."""
+
+    def __init__(self, segments: dict[int, np.ndarray] | None = None, attrs=None, keys=None):
+        self.segments = segments or {}
+        self.attrs = attrs or {}
+        self.keys = keys  # translated column keys, when the index uses keys
+
+    def columns(self) -> np.ndarray:
+        parts = [
+            unpack_bits(words, offset=shard * SHARD_WIDTH)
+            for shard, words in sorted(self.segments.items())
+        ]
+        if not parts:
+            return np.empty(0, np.uint64)
+        return np.concatenate(parts)
+
+    def count(self) -> int:
+        return sum(popcount_words(w) for w in self.segments.values())
+
+    def merge(self, other: "RowResult") -> "RowResult":
+        """Cross-node reduce: union segments (shards are disjoint across
+        owners, so collisions only appear with replication — union is
+        correct either way)."""
+        out = dict(self.segments)
+        for shard, words in other.segments.items():
+            if shard in out:
+                out[shard] = np.bitwise_or(out[shard], words)
+            else:
+                out[shard] = words
+        return RowResult(out, {**other.attrs, **self.attrs})
+
+    def to_json(self) -> dict:
+        if self.keys is not None:
+            return {"attrs": self.attrs, "keys": self.keys}
+        return {"attrs": self.attrs, "columns": self.columns().tolist()}
+
+
+class Pair:
+    """TopN result element (reference Pair{ID, Count})."""
+
+    __slots__ = ("id", "count", "key")
+
+    def __init__(self, id: int, count: int, key: str | None = None):
+        self.id = id
+        self.count = count
+        self.key = key
+
+    def to_json(self) -> dict:
+        d = {"id": self.id, "count": self.count}
+        if self.key is not None:
+            d["key"] = self.key
+        return d
+
+
+class ValCount:
+    """Sum/Min/Max result (reference ValCount{Val, Count})."""
+
+    __slots__ = ("value", "count")
+
+    def __init__(self, value: int, count: int):
+        self.value = value
+        self.count = count
+
+    def to_json(self) -> dict:
+        return {"value": self.value, "count": self.count}
+
+
+class GroupCount:
+    """GroupBy result element (reference GroupCount)."""
+
+    __slots__ = ("group", "count")
+
+    def __init__(self, group: list[dict], count: int):
+        self.group = group  # [{"field": name, "rowID": id}, ...]
+        self.count = count
+
+    def to_json(self) -> dict:
+        return {"group": self.group, "count": self.count}
+
+
+def result_to_json(res):
+    """Serialize any executor result for the HTTP response envelope."""
+    if isinstance(res, (RowResult, Pair, ValCount, GroupCount)):
+        return res.to_json()
+    if isinstance(res, list):
+        return [result_to_json(r) for r in res]
+    if isinstance(res, np.integer):
+        return int(res)
+    return res

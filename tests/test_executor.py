@@ -1,0 +1,352 @@
+"""Executor behavioral tests: PQL strings against a single in-process node
+(the bulk of the reference's coverage — executor_test.go style per
+SURVEY.md §4), with numpy/python set oracles."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.executor import PQLError
+from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.storage import FieldOptions, Holder
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    yield holder, Executor(holder)
+    holder.close()
+
+
+def setup_stars(holder):
+    """Star-Trace-like dataset (BASELINE config #1): stargazer rows over
+    repo columns, language as second field, spanning two shards."""
+    idx = holder.create_index("repos")
+    stargazer = idx.create_field("stargazer")
+    language = idx.create_field("language")
+    s2 = SHARD_WIDTH  # a column in shard 1
+    data = {
+        1: [10, 20, 30, s2 + 1],
+        2: [20, 30, 40],
+        3: [s2 + 1, s2 + 2],
+    }
+    for row, cols in data.items():
+        for c in cols:
+            stargazer.set_bit(row, c)
+    langs = {5: [10, 20, s2 + 1], 6: [30, 40, s2 + 2]}
+    for row, cols in langs.items():
+        for c in cols:
+            language.set_bit(row, c)
+    all_cols = {c for cols in data.values() for c in cols} | {
+        c for cols in langs.values() for c in cols
+    }
+    idx.mark_columns_exist(sorted(all_cols))
+    return idx, data, langs
+
+
+class TestBitmapCalls:
+    def test_row(self, env):
+        holder, ex = env
+        _, data, _ = setup_stars(holder)
+        (res,) = ex.execute("repos", "Row(stargazer=1)")
+        assert res.columns().tolist() == data[1]
+
+    def test_union_intersect_difference_xor(self, env):
+        holder, ex = env
+        _, data, _ = setup_stars(holder)
+        s1, s2, s3 = (set(data[i]) for i in (1, 2, 3))
+        cases = {
+            "Union(Row(stargazer=1), Row(stargazer=2))": s1 | s2,
+            "Intersect(Row(stargazer=1), Row(stargazer=2))": s1 & s2,
+            "Difference(Row(stargazer=1), Row(stargazer=2))": s1 - s2,
+            "Xor(Row(stargazer=1), Row(stargazer=2))": s1 ^ s2,
+            "Union(Row(stargazer=1), Row(stargazer=2), Row(stargazer=3))": s1 | s2 | s3,
+        }
+        for pql, want in cases.items():
+            (res,) = ex.execute("repos", pql)
+            assert res.columns().tolist() == sorted(want), pql
+
+    def test_count_fused(self, env):
+        holder, ex = env
+        _, data, langs = setup_stars(holder)
+        (n,) = ex.execute(
+            "repos", "Count(Intersect(Row(stargazer=1), Row(language=5)))"
+        )
+        assert n == len(set(data[1]) & set(langs[5]))
+
+    def test_not_and_all(self, env):
+        holder, ex = env
+        _, data, langs = setup_stars(holder)
+        universe = {c for cols in data.values() for c in cols} | {
+            c for cols in langs.values() for c in cols
+        }
+        (res,) = ex.execute("repos", "Not(Row(stargazer=1))")
+        assert res.columns().tolist() == sorted(universe - set(data[1]))
+        (res,) = ex.execute("repos", "All()")
+        assert res.columns().tolist() == sorted(universe)
+
+    def test_shift(self, env):
+        holder, ex = env
+        _, data, _ = setup_stars(holder)
+        (res,) = ex.execute("repos", "Shift(Row(stargazer=2), n=3)")
+        assert res.columns().tolist() == [c + 3 for c in data[2]]
+
+    def test_empty_row(self, env):
+        holder, ex = env
+        setup_stars(holder)
+        (res,) = ex.execute("repos", "Row(stargazer=99)")
+        assert res.columns().size == 0
+        (n,) = ex.execute("repos", "Count(Row(stargazer=99))")
+        assert n == 0
+
+
+class TestWrites:
+    def test_set_clear(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        assert ex.execute("i", "Set(10, f=1)") == [True]
+        assert ex.execute("i", "Set(10, f=1)") == [False]
+        (res,) = ex.execute("i", "Row(f=1)")
+        assert res.columns().tolist() == [10]
+        assert ex.execute("i", "Clear(10, f=1)") == [True]
+        (res,) = ex.execute("i", "Row(f=1)")
+        assert res.columns().size == 0
+
+    def test_set_marks_existence(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        ex.execute("i", "Set(7, f=1) Set(9, f=2)")
+        (res,) = ex.execute("i", "All()")
+        assert res.columns().tolist() == [7, 9]
+
+    def test_clear_row_and_store(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        ex.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=2)")
+        ex.execute("i", "Store(Row(f=1), f=9)")
+        (res,) = ex.execute("i", "Row(f=9)")
+        assert res.columns().tolist() == [1, 2]
+        assert ex.execute("i", "ClearRow(f=1)") == [True]
+        (res,) = ex.execute("i", "Row(f=1)")
+        assert res.columns().size == 0
+        # stored row unaffected
+        (res,) = ex.execute("i", "Row(f=9)")
+        assert res.columns().tolist() == [1, 2]
+
+    def test_v0_aliases_execute(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        assert ex.execute("i", "SetBit(5, f=1)") == [True]
+        (res,) = ex.execute("i", "Bitmap(f=1)")
+        assert res.columns().tolist() == [5]
+
+
+class TestBSI:
+    def setup_fares(self, holder):
+        idx = holder.create_index("taxi")
+        fare = idx.create_field(
+            "fare", FieldOptions(type="int", min=-50, max=500)
+        )
+        self.values = {0: -50, 1: 0, 2: 10, 3: 11, 4: 499, 5: 500,
+                       SHARD_WIDTH + 7: 42}
+        for col, v in self.values.items():
+            fare.set_value(col, v)
+        idx.mark_columns_exist(sorted(self.values))
+        return idx
+
+    @pytest.mark.parametrize(
+        "op,py",
+        [("<", lambda v, p: v < p), ("<=", lambda v, p: v <= p),
+         (">", lambda v, p: v > p), (">=", lambda v, p: v >= p),
+         ("==", lambda v, p: v == p), ("!=", lambda v, p: v != p)],
+    )
+    @pytest.mark.parametrize("pred", [-51, -50, 0, 10, 42, 500, 501])
+    def test_range_ops(self, env, op, py, pred):
+        holder, ex = env
+        self.setup_fares(holder)
+        (res,) = ex.execute("taxi", f"Range(fare {op} {pred})")
+        want = sorted(c for c, v in self.values.items() if py(v, pred))
+        assert res.columns().tolist() == want, f"fare {op} {pred}"
+
+    def test_between(self, env):
+        holder, ex = env
+        self.setup_fares(holder)
+        (res,) = ex.execute("taxi", "Range(fare >< [0, 42])")
+        want = sorted(c for c, v in self.values.items() if 0 <= v <= 42)
+        assert res.columns().tolist() == want
+
+    def test_row_condition_alias(self, env):
+        holder, ex = env
+        self.setup_fares(holder)
+        # v1.3+ allows Row(fare > 10) as alias for Range
+        (res,) = ex.execute("taxi", "Row(fare > 10)")
+        want = sorted(c for c, v in self.values.items() if v > 10)
+        assert res.columns().tolist() == want
+
+    def test_sum_min_max(self, env):
+        holder, ex = env
+        self.setup_fares(holder)
+        vals = self.values
+        (s,) = ex.execute("taxi", 'Sum(field="fare")')
+        assert (s.value, s.count) == (sum(vals.values()), len(vals))
+        (mn,) = ex.execute("taxi", 'Min(field="fare")')
+        assert (mn.value, mn.count) == (-50, 1)
+        (mx,) = ex.execute("taxi", 'Max(field="fare")')
+        assert (mx.value, mx.count) == (500, 1)
+
+    def test_sum_with_filter(self, env):
+        holder, ex = env
+        self.setup_fares(holder)
+        (s,) = ex.execute("taxi", 'Sum(Range(fare > 0), field="fare")')
+        want = [v for v in self.values.values() if v > 0]
+        assert (s.value, s.count) == (sum(want), len(want))
+
+    def test_min_max_tie_counts(self, env):
+        holder, ex = env
+        idx = holder.create_index("t2")
+        f = idx.create_field("v", FieldOptions(type="int", min=0, max=10))
+        for col, v in [(0, 3), (1, 3), (2, 7)]:
+            f.set_value(col, v)
+        (mn,) = ex.execute("t2", 'Min(field="v")')
+        assert (mn.value, mn.count) == (3, 2)
+
+    def test_empty_aggregate(self, env):
+        holder, ex = env
+        idx = holder.create_index("t3")
+        idx.create_field("v", FieldOptions(type="int", min=0, max=10))
+        (s,) = ex.execute("t3", 'Sum(field="v")')
+        assert (s.value, s.count) == (0, 0)
+        (mn,) = ex.execute("t3", 'Min(field="v")')
+        assert (mn.value, mn.count) == (0, 0)
+
+
+class TestTopNRowsGroupBy:
+    def setup_ranked(self, holder):
+        idx = holder.create_index("r")
+        f = idx.create_field("f")
+        g = idx.create_field("g")
+        counts = {1: 5, 2: 50, 3: 20, 4: 35}
+        for row, n in counts.items():
+            for c in range(n):
+                f.set_bit(row, c)
+        # second shard contribution for row 3
+        for c in range(15):
+            f.set_bit(3, SHARD_WIDTH + c)
+        for c in range(0, 60, 2):
+            g.set_bit(7, c)
+        cols = set(range(60)) | {SHARD_WIDTH + c for c in range(15)}
+        idx.mark_columns_exist(sorted(cols))
+        return idx
+
+    def test_topn(self, env):
+        holder, ex = env
+        self.setup_ranked(holder)
+        (pairs,) = ex.execute("r", "TopN(f, n=3)")
+        assert [(p.id, p.count) for p in pairs] == [(2, 50), (3, 35), (4, 35)]
+
+    def test_topn_with_filter(self, env):
+        holder, ex = env
+        self.setup_ranked(holder)
+        (pairs,) = ex.execute("r", "TopN(f, Row(g=7), n=2)")
+        # row2 ∩ evens<60: 25; row4 ∩ evens<60 (g covers 0..58): 18
+        assert (pairs[0].id, pairs[0].count) == (2, 25)
+
+    def test_topn_explicit_ids(self, env):
+        holder, ex = env
+        self.setup_ranked(holder)
+        (pairs,) = ex.execute("r", "TopN(f, ids=[1, 3], n=5)")
+        assert [(p.id, p.count) for p in pairs] == [(3, 35), (1, 5)]
+
+    def test_rows(self, env):
+        holder, ex = env
+        self.setup_ranked(holder)
+        assert ex.execute("r", "Rows(f)") == [[1, 2, 3, 4]]
+        assert ex.execute("r", "Rows(f, limit=2)") == [[1, 2]]
+        assert ex.execute("r", "Rows(f, previous=2)") == [[3, 4]]
+        assert ex.execute("r", "Rows(f, column=40)") == [[2]]  # only row2 ⊇ 40
+
+    def test_groupby(self, env):
+        holder, ex = env
+        self.setup_ranked(holder)
+        (groups,) = ex.execute("r", "GroupBy(Rows(f), Rows(g))")
+        got = {
+            tuple(e["rowID"] for e in g.group): g.count for g in groups
+        }
+        # row1 (0..4) ∩ evens<60 = {0,2,4} → 3; row2 (0..49) ∩ evens → 25
+        assert got[(1, 7)] == 3
+        assert got[(2, 7)] == 25
+        assert got[(4, 7)] == 18
+        (groups,) = ex.execute("r", "GroupBy(Rows(f), Rows(g), limit=2)")
+        assert len(groups) == 2
+
+    def test_groupby_filter(self, env):
+        holder, ex = env
+        self.setup_ranked(holder)
+        (groups,) = ex.execute(
+            "r", "GroupBy(Rows(f), filter=Row(g=7))"
+        )
+        got = {g.group[0]["rowID"]: g.count for g in groups}
+        assert got[1] == 3 and got[2] == 25
+
+
+class TestTimeViews:
+    def test_row_time_range(self, env):
+        holder, ex = env
+        idx = holder.create_index("ev")
+        idx.create_field(
+            "t", FieldOptions(type="time", time_quantum="YMD")
+        )
+        ex.execute("ev", "Set(1, t=1, timestamp='2019-01-15T00:00')")
+        ex.execute("ev", "Set(2, t=1, timestamp='2019-03-02T00:00')")
+        ex.execute("ev", "Set(3, t=1, timestamp='2020-01-01T00:00')")
+        (res,) = ex.execute(
+            "ev", "Row(t=1, from='2019-01-01T00:00', to='2019-12-31T00:00')"
+        )
+        assert res.columns().tolist() == [1, 2]
+        (res,) = ex.execute(
+            "ev", "Row(t=1, from='2019-03-01T00:00', to='2020-06-01T00:00')"
+        )
+        assert res.columns().tolist() == [2, 3]
+        # no time bounds → standard view has all
+        (res,) = ex.execute("ev", "Row(t=1)")
+        assert res.columns().tolist() == [1, 2, 3]
+
+
+class TestErrors:
+    def test_unknown_index_field(self, env):
+        holder, ex = env
+        with pytest.raises(PQLError):
+            ex.execute("nope", "Row(f=1)")
+        holder.create_index("i")
+        with pytest.raises(PQLError):
+            ex.execute("i", "Row(f=1)")
+
+    def test_range_on_set_field(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        with pytest.raises(PQLError):
+            ex.execute("i", "Range(f > 3)")
+
+    def test_options_shards(self, env):
+        holder, ex = env
+        _, data, _ = setup_stars(holder)
+        (res,) = ex.execute(
+            "repos", "Options(Row(stargazer=1), shards=[0])"
+        )
+        assert res.columns().tolist() == [c for c in data[1] if c < SHARD_WIDTH]
+
+    def test_includes_column(self, env):
+        holder, ex = env
+        _, data, _ = setup_stars(holder)
+        assert ex.execute(
+            "repos", "IncludesColumn(Row(stargazer=1), column=10)"
+        ) == [True]
+        assert ex.execute(
+            "repos", "IncludesColumn(Row(stargazer=1), column=11)"
+        ) == [False]

@@ -1,0 +1,118 @@
+"""PQL parser tests: parse → AST golden comparisons incl. errors
+(reference pql/pql_test.go — SURVEY.md §4)."""
+
+import pytest
+
+from pilosa_tpu.pql import Call, Condition, ParseError, parse
+
+
+def test_row_simple():
+    q = parse("Row(stargazer=1)")
+    assert q.calls == [Call("Row", {"stargazer": 1})]
+
+
+def test_nested_set_ops():
+    q = parse("Count(Intersect(Row(a=1), Row(b=2)))")
+    (count,) = q.calls
+    assert count.name == "Count"
+    (inter,) = count.children
+    assert inter.name == "Intersect"
+    assert inter.children == [Call("Row", {"a": 1}), Call("Row", {"b": 2})]
+
+
+def test_v0_aliases():
+    q = parse("SetBit(10, f=1) Bitmap(f=1) ClearBit(10, f=1)")
+    assert [c.name for c in q.calls] == ["Set", "Row", "Clear"]
+
+
+def test_set_with_positional_column():
+    q = parse("Set(10, stargazer=44)")
+    assert q.calls[0].args == {"_col": 10, "stargazer": 44}
+
+
+def test_string_keys_and_escapes():
+    q = parse("Set('col\\'key', f=\"row key\")")
+    assert q.calls[0].args == {"_col": "col'key", "f": "row key"}
+
+
+def test_topn_positional_field():
+    q = parse("TopN(stargazer, n=5)")
+    assert q.calls[0].args == {"_field": "stargazer", "n": 5}
+
+
+def test_topn_with_filter_child():
+    q = parse("TopN(lang, Row(stargazer=1), n=3)")
+    c = q.calls[0]
+    assert c.args["_field"] == "lang"
+    assert c.children == [Call("Row", {"stargazer": 1})]
+
+
+def test_conditions():
+    q = parse("Range(fare > 10)")
+    assert q.calls[0].args == {"fare": Condition(">", 10)}
+    for op in ("<", "<=", ">", ">=", "==", "!="):
+        q = parse(f"Range(fare {op} -3)")
+        assert q.calls[0].args["fare"] == Condition(op, -3)
+
+
+def test_between_condition():
+    q = parse("Range(fare >< [5, 10])")
+    assert q.calls[0].args == {"fare": Condition("><", [5, 10])}
+
+
+def test_row_time_range_args():
+    q = parse("Row(f=3, from='2019-01-01T00:00', to='2019-02-01T00:00')")
+    assert q.calls[0].args == {
+        "f": 3, "from": "2019-01-01T00:00", "to": "2019-02-01T00:00",
+    }
+
+
+def test_groupby():
+    q = parse("GroupBy(Rows(a), Rows(b), limit=10, filter=Row(c=1))")
+    c = q.calls[0]
+    assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+    assert c.args["limit"] == 10
+    assert c.args["filter"] == Call("Row", {"c": 1})
+
+
+def test_sum_with_field_arg():
+    q = parse('Sum(Row(a=1), field="fare")')
+    c = q.calls[0]
+    assert c.args == {"field": "fare"}
+    assert c.children == [Call("Row", {"a": 1})]
+    # bare identifier also accepted as value
+    assert parse("Sum(field=fare)").calls[0].args == {"field": "fare"}
+
+
+def test_bool_and_float_values():
+    q = parse("Options(Row(f=1), excludeColumns=true) Range(fare > 1.5)")
+    assert q.calls[0].args == {"excludeColumns": True}
+    assert q.calls[1].args["fare"] == Condition(">", 1.5)
+
+
+def test_multiple_calls_whitespace():
+    q = parse("  Set(1, f=2)\n\tSet(3, f=4)  ")
+    assert len(q.calls) == 2
+    assert q.write_calls() == q.calls
+
+
+def test_shift_and_not():
+    q = parse("Shift(Row(f=1), n=2) Not(Row(f=1)) All()")
+    assert q.calls[0].args == {"n": 2}
+    assert q.calls[1].children == [Call("Row", {"f": 1})]
+    assert q.calls[2] == Call("All")
+
+
+def test_parse_errors():
+    for bad in (
+        "", "Row(", "Bogus(f=1)", "Row(f=)", "Row(f=1", "Row(f==)",
+        "Set(1 2, f=1)", "Row('unterminated)",
+    ):
+        with pytest.raises(ParseError):
+            parse(bad)
+
+
+def test_negative_and_list_values():
+    q = parse("Range(fare >< [-10, -5]) Row(f=-1)")
+    assert q.calls[0].args["fare"] == Condition("><", [-10, -5])
+    assert q.calls[1].args == {"f": -1}
