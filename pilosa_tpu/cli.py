@@ -1,0 +1,332 @@
+"""CLI: server / import / export / config / inspect / check / version.
+
+Reference: cmd/pilosa + ctl/ (SURVEY.md §2 #28–30) — cobra subcommands with
+TOML-config < env < flag precedence. Here: argparse with the same
+precedence (PILOSA_TPU_* env vars), talking either to a running server
+over HTTP (--host) or directly to a data dir in-process (--data-dir),
+which is the TPU-friendly path for bulk imports (no HTTP hop).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+from pilosa_tpu import __version__
+
+DEFAULT_HOST = "http://localhost:10101"
+
+_DEFAULT_TOML = """\
+data-dir = "~/.pilosa_tpu"
+bind = "localhost"
+port = 10101
+anti-entropy-interval = 600.0
+replica-n = 1
+verbose = false
+"""
+
+
+def _load_config(path: str | None) -> dict:
+    cfg: dict = {}
+    if path:
+        import tomllib
+
+        with open(path, "rb") as f:
+            cfg = tomllib.load(f)
+    # env overrides file: PILOSA_TPU_DATA_DIR → data-dir
+    for key, val in os.environ.items():
+        if key.startswith("PILOSA_TPU_"):
+            cfg[key[len("PILOSA_TPU_"):].lower().replace("_", "-")] = val
+    return cfg
+
+
+def _http(method: str, url: str, data: bytes | None = None,
+          content_type: str = "application/json"):
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", content_type)
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _parse_csv_bits(files):
+    rows, cols, timestamps = [], [], []
+    any_ts = False
+    for path in files:
+        fh = sys.stdin if path == "-" else open(path)
+        try:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = [p.strip() for p in line.split(",")]
+                rows.append(int(parts[0]))
+                cols.append(int(parts[1]))
+                ts = parts[2] if len(parts) > 2 else None
+                timestamps.append(ts)
+                any_ts = any_ts or ts is not None
+        finally:
+            if fh is not sys.stdin:
+                fh.close()
+    return rows, cols, (timestamps if any_ts else None)
+
+
+def _parse_csv_values(files):
+    cols, vals = [], []
+    for path in files:
+        fh = sys.stdin if path == "-" else open(path)
+        try:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = [p.strip() for p in line.split(",")]
+                cols.append(int(parts[0]))
+                vals.append(int(parts[1]))
+        finally:
+            if fh is not sys.stdin:
+                fh.close()
+    return cols, vals
+
+
+def cmd_server(args) -> int:
+    from pilosa_tpu.server import Server, ServerConfig
+
+    cfg_dict = _load_config(args.config)
+    config = ServerConfig.from_dict(cfg_dict)
+    if args.data_dir:
+        config.data_dir = args.data_dir
+    if args.bind:
+        config.bind = args.bind
+    if args.port is not None:
+        config.port = args.port
+    if args.verbose:
+        config.verbose = True
+    server = Server(config).open()
+    try:
+        import signal
+        import threading
+
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        stop.wait()
+    finally:
+        server.close()
+    return 0
+
+
+def _in_process_api(data_dir: str):
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.storage import Holder
+
+    return API(Holder(data_dir).open())
+
+
+def cmd_import(args) -> int:
+    batch = 100_000
+    if args.data_dir:
+        api = _in_process_api(args.data_dir)
+        if args.create:
+            if api.holder.index(args.index) is None:
+                api.create_index(args.index)
+            if api.holder.index(args.index).field(args.field) is None:
+                opts = {"type": "int", "min": args.min, "max": args.max} if args.values else {}
+                api.create_field(args.index, args.field, opts)
+        if args.values:
+            cols, vals = _parse_csv_values(args.files)
+            n = api.import_values(args.index, args.field, cols, vals, clear=args.clear)
+        else:
+            rows, cols, ts = _parse_csv_bits(args.files)
+            n = api.import_bits(args.index, args.field, rows, cols,
+                                timestamps=ts, clear=args.clear)
+        api.holder.close()
+        print(f"imported: {n} bits changed")
+        return 0
+    # HTTP mode: batch into import endpoints
+    host = args.host.rstrip("/")
+    try:
+        if args.create:
+            _http_create(host, args)
+        total = 0
+        if args.values:
+            cols, vals = _parse_csv_values(args.files)
+            for i in range(0, len(cols), batch):
+                body = json.dumps(
+                    {"columns": cols[i : i + batch], "values": vals[i : i + batch],
+                     "clear": args.clear}
+                ).encode()
+                out = _http("POST", f"{host}/index/{args.index}/field/{args.field}/import-value", body)
+                total += out.get("changed", 0)
+        else:
+            rows, cols, ts = _parse_csv_bits(args.files)
+            for i in range(0, len(rows), batch):
+                payload = {"rows": rows[i : i + batch], "columns": cols[i : i + batch],
+                           "clear": args.clear}
+                if ts:
+                    payload["timestamps"] = ts[i : i + batch]
+                out = _http("POST", f"{host}/index/{args.index}/field/{args.field}/import", json.dumps(payload).encode())
+                total += out.get("changed", 0)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        print(f"error: HTTP {e.code}: {body}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"error: cannot reach {host}: {e.reason}", file=sys.stderr)
+        return 1
+    print(f"imported: {total} bits changed")
+    return 0
+
+
+def _http_create(host: str, args) -> None:
+    """Best-effort schema creation for --create in HTTP mode (409 = exists)."""
+    for url, body in (
+        (f"{host}/index/{args.index}", {}),
+        (
+            f"{host}/index/{args.index}/field/{args.field}",
+            {"options": {"type": "int", "min": args.min, "max": args.max}}
+            if args.values
+            else {},
+        ),
+    ):
+        try:
+            _http("POST", url, json.dumps(body).encode())
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                raise
+
+
+def cmd_export(args) -> int:
+    if args.data_dir:
+        api = _in_process_api(args.data_dir)
+        sys.stdout.write(api.export_csv(args.index, args.field))
+        api.holder.close()
+        return 0
+    host = args.host.rstrip("/")
+    url = f"{host}/export?index={args.index}&field={args.field}"
+    with urllib.request.urlopen(url) as resp:
+        sys.stdout.write(resp.read().decode())
+    return 0
+
+
+def cmd_config(args) -> int:
+    cfg = _load_config(args.config)
+    from pilosa_tpu.server import ServerConfig
+
+    print(json.dumps(ServerConfig.from_dict(cfg).to_dict(), indent=2))
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    print(_DEFAULT_TOML, end="")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """Dump fragment/container statistics from a data dir (reference
+    ctl/inspect.go)."""
+    from pilosa_tpu.roaring.bitmap import ARRAY, BITMAP, RUN
+    from pilosa_tpu.storage import Holder
+
+    holder = Holder(args.data_dir).open()
+    kind_names = {ARRAY: "array", BITMAP: "bitmap", RUN: "run"}
+    for iname, idx in sorted(holder.indexes.items()):
+        for fname, field in sorted(idx.fields.items()):
+            for vname, view in sorted(field.views.items()):
+                for shard, frag in sorted(view.fragments.items()):
+                    kinds = {"array": 0, "bitmap": 0, "run": 0}
+                    for key in frag.bitmap.keys:
+                        kinds[kind_names[frag.bitmap.container(key).kind]] += 1
+                    print(
+                        f"{iname}/{fname}/{vname}/{shard}: "
+                        f"bits={frag.count()} rows={len(frag.row_ids())} "
+                        f"containers={len(frag.bitmap.keys)} {kinds} "
+                        f"ops={frag.op_n}"
+                    )
+    holder.close()
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Verify fragment files parse cleanly (reference ctl/check.go)."""
+    import glob
+
+    from pilosa_tpu.roaring.format import load
+
+    bad = 0
+    pattern = os.path.join(os.path.expanduser(args.data_dir), "**", "fragments", "*")
+    for path in glob.glob(pattern, recursive=True):
+        if not os.path.isfile(path) or path.endswith(".cache"):
+            continue
+        try:
+            with open(path, "rb") as f:
+                bitmap, n_ops = load(f.read())
+            print(f"ok: {path} bits={bitmap.count()} ops={n_ops}")
+        except Exception as e:
+            bad += 1
+            print(f"CORRUPT: {path}: {e}", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="pilosa-tpu", description="TPU-native distributed bitmap index"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("server", help="run a server node")
+    p.add_argument("-c", "--config", help="TOML config file")
+    p.add_argument("-d", "--data-dir")
+    p.add_argument("-b", "--bind")
+    p.add_argument("--port", type=int)
+    p.add_argument("--verbose", action="store_true")
+    p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("import", help="bulk-import CSV (row,col[,ts] or col,value)")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--field", required=True)
+    p.add_argument("--host", default=DEFAULT_HOST)
+    p.add_argument("-d", "--data-dir", help="import in-process against a data dir")
+    p.add_argument("--values", action="store_true", help="CSV is col,value (int field)")
+    p.add_argument("--clear", action="store_true")
+    p.add_argument("--create", action="store_true", help="create index/field if missing")
+    p.add_argument("--min", type=int, default=0)
+    p.add_argument("--max", type=int, default=1 << 32)
+    p.add_argument("files", nargs="+", help="CSV files ('-' for stdin)")
+    p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("export", help="export field as CSV")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--field", required=True)
+    p.add_argument("--host", default=DEFAULT_HOST)
+    p.add_argument("-d", "--data-dir")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("config", help="echo resolved config")
+    p.add_argument("-c", "--config")
+    p.set_defaults(fn=cmd_config)
+
+    p = sub.add_parser("generate-config", help="print default TOML config")
+    p.set_defaults(fn=cmd_generate_config)
+
+    p = sub.add_parser("inspect", help="dump fragment statistics")
+    p.add_argument("-d", "--data-dir", required=True)
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("check", help="verify fragment files")
+    p.add_argument("-d", "--data-dir", required=True)
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("version", help="print version")
+    p.set_defaults(fn=lambda a: (print(__version__), 0)[1])
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -303,6 +303,8 @@ class Executor:
             raise PQLError(
                 f"row key {row!r} requires key translation (field keys)"
             )
+        if row < 0:
+            return ("const0",)  # negative rows cannot exist
         views: tuple[str, ...]
         t_from, t_to = call.arg("from"), call.arg("to")
         if t_from is not None or t_to is not None:
@@ -610,13 +612,19 @@ class Executor:
             raise PQLError("Set requires a column")
         if not isinstance(col, int):
             raise PQLError("column keys require key translation (index keys)")
+        if col < 0:
+            raise PQLError(f"column {col} is negative")
         field_name, row = self._row_field_and_value(call)
         field = idx.field(field_name)
         if field is None:
             raise PQLError(f"field {field_name!r} not found")
         if field.options.type == TYPE_INT:
-            changed = field.set_value(col, int(row))
+            try:
+                changed = field.set_value(col, int(row))
+            except ValueError as e:
+                raise PQLError(str(e)) from e
         else:
+            _check_row(row)
             ts = call.arg("timestamp")
             timestamp = _parse_time(ts) if ts is not None else None
             changed = field.set_bit(int(row), col, timestamp=timestamp)
@@ -627,16 +635,20 @@ class Executor:
         col = call.arg("_col")
         if col is None:
             raise PQLError("Clear requires a column")
+        if not isinstance(col, int) or col < 0:
+            raise PQLError(f"invalid column {col!r}")
         field_name, row = self._row_field_and_value(call)
         field = idx.field(field_name)
         if field is None:
             raise PQLError(f"field {field_name!r} not found")
         if field.options.type == TYPE_INT:
             return field.clear_value(col)
+        _check_row(row)
         return field.clear_bit(int(row), col)
 
     def _execute_clear_row(self, idx: Index, call: Call, shards=None) -> bool:
         field_name, row = self._row_field_and_value(call)
+        _check_row(row)
         field = idx.field(field_name)
         if field is None:
             raise PQLError(f"field {field_name!r} not found")
@@ -653,6 +665,7 @@ class Executor:
         if len(call.children) != 1:
             raise PQLError("Store requires one child call")
         field_name, row = self._row_field_and_value(call)
+        _check_row(row)
         field = idx.field(field_name)
         if field is None:
             field = idx.create_field(field_name)
@@ -668,6 +681,13 @@ _BITMAP_CALLS = {
     "Row", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift",
     "Range",
 }
+
+
+def _check_row(row) -> None:
+    if not isinstance(row, int):
+        raise PQLError(f"row key {row!r} requires key translation (field keys)")
+    if row < 0:
+        raise PQLError(f"row {row} is negative")
 
 
 def _parse_time(value) -> dt.datetime:

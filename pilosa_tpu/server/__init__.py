@@ -1,0 +1,8 @@
+"""Serving layer: transport-neutral API façade + HTTP handler + lifecycle.
+
+Reference: api.go, http/handler.go, server.go (SURVEY.md §2 #18–20).
+"""
+
+from pilosa_tpu.server.api import API, ApiError
+from pilosa_tpu.server.http import HTTPHandler, make_http_server
+from pilosa_tpu.server.server import Server, ServerConfig

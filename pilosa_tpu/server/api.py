@@ -1,0 +1,257 @@
+"""Transport-neutral API façade.
+
+Reference: api.go (SURVEY.md §2 #18) — validates, resolves index/field,
+calls executor/holder; used by both the HTTP handler and the CLI so
+in-process imports skip the network entirely.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+
+import numpy as np
+
+from pilosa_tpu import __version__
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.result import result_to_json
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.storage.field import TYPE_INT, TYPE_TIME
+from pilosa_tpu.storage.view import VIEW_STANDARD
+
+
+class ApiError(Exception):
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message)
+        self.status = status
+
+
+class API:
+    def __init__(self, holder: Holder, cluster=None, stats=None):
+        self.holder = holder
+        self.executor = Executor(holder)
+        self.cluster = cluster  # pilosa_tpu.parallel.cluster (M4+); may be None
+        self.stats = stats
+        self.started_at = dt.datetime.now(dt.timezone.utc)
+
+    # ---------------------------------------------------------------- query
+
+    def query(self, index: str, pql: str, shards=None) -> dict:
+        from pilosa_tpu.executor.executor import PQLError
+        from pilosa_tpu.pql import ParseError
+
+        try:
+            results = self.executor.execute(index, pql, shards=shards)
+        except (ParseError, PQLError) as e:
+            raise ApiError(str(e)) from e
+        return {"results": [result_to_json(r) for r in results]}
+
+    # --------------------------------------------------------------- schema
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True) -> dict:
+        try:
+            idx = self.holder.create_index(
+                name, keys=keys, track_existence=track_existence
+            )
+        except ValueError as e:
+            status = 409 if "already exists" in str(e) else 400
+            raise ApiError(str(e), status) from e
+        return idx.schema()
+
+    def delete_index(self, name: str) -> None:
+        try:
+            self.holder.delete_index(name)
+        except KeyError as e:
+            raise ApiError(str(e), 404) from e
+
+    def create_field(self, index: str, name: str, options: dict | None = None) -> dict:
+        idx = self._index(index)
+        try:
+            opts = FieldOptions.from_dict(options or {})
+            field = idx.create_field(name, opts)
+        except ValueError as e:
+            status = 409 if "already exists" in str(e) else 400
+            raise ApiError(str(e), status) from e
+        return {"name": field.name, "options": field.options.to_dict()}
+
+    def delete_field(self, index: str, name: str) -> None:
+        idx = self._index(index)
+        try:
+            idx.delete_field(name)
+        except KeyError as e:
+            raise ApiError(str(e), 404) from e
+
+    def schema(self) -> dict:
+        return {"indexes": self.holder.schema()}
+
+    # --------------------------------------------------------------- import
+
+    def import_bits(self, index: str, field: str, rows, columns,
+                    timestamps=None, clear: bool = False) -> int:
+        """Bulk bit import (reference api.Import / fragment.bulkImport):
+        batches are grouped by shard and written fragment-wise."""
+        idx = self._index(index)
+        fld = self._field(idx, field)
+        rows_i = np.asarray(rows, dtype=np.int64)
+        columns_i = np.asarray(columns, dtype=np.int64)
+        if rows_i.size and (rows_i.min() < 0 or columns_i.min() < 0):
+            raise ApiError("rows and columns must be non-negative")
+        rows = rows_i.astype(np.uint64)
+        columns = columns_i.astype(np.uint64)
+        if rows.shape != columns.shape:
+            raise ApiError("rows and columns must be the same length")
+        if timestamps is not None and len(timestamps) != rows.size:
+            raise ApiError("timestamps must match rows length")
+        if rows.size == 0:
+            return 0
+        changed = 0
+        shards = (columns >> np.uint64(SHARD_WIDTH_EXP)).astype(np.int64)
+        order = np.argsort(shards, kind="stable")
+        rows, columns = rows[order], columns[order]
+        shards_sorted = shards[order]
+        ts_sorted = [timestamps[i] for i in order] if timestamps is not None else None
+        boundaries = np.concatenate(
+            ([0], np.nonzero(np.diff(shards_sorted))[0] + 1, [rows.size])
+        )
+        for i in range(boundaries.size - 1):
+            lo, hi = int(boundaries[i]), int(boundaries[i + 1])
+            shard = int(shards_sorted[lo])
+            pos = columns[lo:hi] & np.uint64(SHARD_WIDTH - 1)
+            if clear:
+                for r, p in zip(rows[lo:hi].tolist(), pos.tolist()):
+                    changed += fld.clear_bit(
+                        int(r), (shard << SHARD_WIDTH_EXP) + int(p)
+                    )
+                continue
+            frag = fld.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
+            changed += frag.bulk_import(rows[lo:hi], pos)
+            if ts_sorted is not None and fld.options.type == TYPE_TIME:
+                for j, ts in enumerate(ts_sorted[lo:hi]):
+                    if ts:
+                        fld.set_bit(
+                            int(rows[lo + j]),
+                            int(columns[lo + j]),
+                            timestamp=_parse_ts(ts),
+                        )
+        if not clear:
+            idx.mark_columns_exist(columns.tolist())
+        return int(changed)
+
+    def import_values(self, index: str, field: str, columns, values,
+                      clear: bool = False) -> int:
+        idx = self._index(index)
+        fld = self._field(idx, field)
+        if fld.options.type != TYPE_INT:
+            raise ApiError(f"field {field!r} is not an int field")
+        if len(columns) != len(values):
+            raise ApiError("columns and values must be the same length")
+        changed = 0
+        for col, val in zip(columns, values):
+            if int(col) < 0:
+                raise ApiError(f"column {col} is negative")
+            try:
+                if clear:
+                    changed += fld.clear_value(int(col))
+                else:
+                    changed += fld.set_value(int(col), int(val))
+            except ValueError as e:
+                raise ApiError(str(e)) from e
+        if not clear:
+            idx.mark_columns_exist([int(c) for c in columns])
+        return int(changed)
+
+    def import_roaring(self, index: str, field: str, shard: int, data: bytes,
+                       view: str = VIEW_STANDARD) -> int:
+        idx = self._index(index)
+        fld = self._field(idx, field)
+        frag = fld.view(view, create=True).fragment(shard, create=True)
+        try:
+            changed = frag.import_roaring(data)
+        except ValueError as e:
+            raise ApiError(str(e)) from e
+        from pilosa_tpu.roaring.format import load as load_roaring
+
+        bitmap, _ = load_roaring(data)
+        positions = np.unique(bitmap.to_ids() & np.uint64(SHARD_WIDTH - 1))
+        idx.mark_columns_exist(
+            ((shard << SHARD_WIDTH_EXP) + positions.astype(np.int64)).tolist()
+        )
+        return changed
+
+    # --------------------------------------------------------------- export
+
+    def export_csv(self, index: str, field: str) -> str:
+        """CSV of row,column over the standard view (reference api.ExportCSV)."""
+        idx = self._index(index)
+        fld = self._field(idx, field)
+        view = fld.view(VIEW_STANDARD)
+        lines = []
+        if view is not None:
+            for shard in sorted(view.fragments):
+                frag = view.fragment(shard)
+                for row in frag.row_ids():
+                    base = shard << SHARD_WIDTH_EXP
+                    for pos in frag.row_columns(row).tolist():
+                        lines.append(f"{row},{base + int(pos)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    # ---------------------------------------------------------------- info
+
+    def status(self) -> dict:
+        nodes = self.cluster.nodes_json() if self.cluster else [
+            {"id": "local", "uri": "localhost", "isCoordinator": True,
+             "state": "READY"}
+        ]
+        return {"state": "NORMAL", "nodes": nodes, "localID": nodes[0]["id"]}
+
+    def info(self) -> dict:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "shardWidth": SHARD_WIDTH,
+            "cpuPhysicalCores": 0,
+            "version": __version__,
+            "devices": [
+                {"id": d.id, "platform": d.platform, "kind": getattr(d, "device_kind", "")}
+                for d in devices
+            ],
+        }
+
+    def version(self) -> dict:
+        return {"version": __version__}
+
+    def max_shards(self) -> dict:
+        return {
+            "standard": {
+                name: (idx.available_shards() or [0])[-1]
+                for name, idx in self.holder.indexes.items()
+            }
+        }
+
+    def shard_nodes(self, index: str, shard: int) -> list[dict]:
+        if self.cluster:
+            return self.cluster.shard_nodes_json(index, shard)
+        return [{"id": "local", "uri": "localhost"}]
+
+    # -------------------------------------------------------------- helpers
+
+    def _index(self, name: str):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise ApiError(f"index {name!r} not found", 404)
+        return idx
+
+    @staticmethod
+    def _field(idx, name: str):
+        fld = idx.field(name)
+        if fld is None:
+            raise ApiError(f"field {name!r} not found", 404)
+        return fld
+
+
+def _parse_ts(value):
+    if isinstance(value, dt.datetime):
+        return value
+    return dt.datetime.fromisoformat(str(value))

@@ -1,0 +1,265 @@
+"""HTTP handler: the reference's REST surface on the stdlib http server.
+
+Reference: http/handler.go (SURVEY.md §2 #19). External routes:
+
+  POST   /index/{index}/query                 PQL → {"results": [...]}
+  POST   /index/{index}                       create index
+  GET    /index/{index}                       index schema
+  DELETE /index/{index}
+  POST   /index/{index}/field/{field}         create field
+  DELETE /index/{index}/field/{field}
+  POST   /index/{i}/field/{f}/import          JSON bit batches
+  POST   /index/{i}/field/{f}/import-value    JSON value batches
+  POST   /index/{i}/field/{f}/import-roaring/{shard}  roaring bytes
+  GET    /export?index=&field=                CSV
+  GET    /schema | /status | /info | /version | /metrics
+  GET    /internal/shards/max
+  POST   /internal/cluster/message            (cluster control — M4+)
+  GET    /internal/fragment/blocks|data       (anti-entropy / resize)
+
+Responses are JSON (the reference also negotiates protobuf; JSON is the
+wire format here — the serving tier is host-side control plane, never on
+the TPU hot path).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.server.api import API, ApiError
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("POST", re.compile(r"^/index/([^/]+)/query$"), "post_query"),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)/import$"), "post_import"),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)/import-value$"), "post_import_value"),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)/import-roaring/(\d+)$"), "post_import_roaring"),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "post_field"),
+    ("DELETE", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "delete_field"),
+    ("POST", re.compile(r"^/index/([^/]+)$"), "post_index"),
+    ("GET", re.compile(r"^/index/([^/]+)$"), "get_index"),
+    ("DELETE", re.compile(r"^/index/([^/]+)$"), "delete_index"),
+    ("GET", re.compile(r"^/schema$"), "get_schema"),
+    ("GET", re.compile(r"^/status$"), "get_status"),
+    ("GET", re.compile(r"^/info$"), "get_info"),
+    ("GET", re.compile(r"^/version$"), "get_version"),
+    ("GET", re.compile(r"^/export$"), "get_export"),
+    ("GET", re.compile(r"^/metrics$"), "get_metrics"),
+    ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
+    ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
+    ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
+    ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
+    ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
+]
+
+
+class HTTPHandler(BaseHTTPRequestHandler):
+    api: API = None  # set by make_http_server
+    protocol_version = "HTTP/1.1"
+
+    # quiet logging; the server wires its own logger
+    def log_message(self, fmt, *args):
+        pass
+
+    def _dispatch(self, method: str):
+        parsed = urlparse(self.path)
+        for m, pattern, handler in _ROUTES:
+            if m != method:
+                continue
+            match = pattern.match(parsed.path)
+            if match:
+                try:
+                    getattr(self, handler)(*match.groups(), query=parse_qs(parsed.query))
+                except ApiError as e:
+                    self._json({"error": str(e)}, status=e.status)
+                except Exception as e:  # internal error → 500, not a crash
+                    self._json({"error": f"internal: {e}"}, status=500)
+                return
+        self._json({"error": "not found"}, status=404)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # -------------------------------------------------------------- helpers
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _json_body(self) -> dict:
+        raw = self._body()
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"invalid JSON body: {e}") from e
+
+    def _json(self, obj, status: int = 200) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _text(self, text: str, content_type: str = "text/plain") -> None:
+        data = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # --------------------------------------------------------------- routes
+
+    def post_query(self, index, query=None):
+        body = self._body().decode()
+        shards = None
+        if query and "shards" in query:
+            shards = [_int_param(s, "shards") for s in query["shards"][0].split(",")]
+        self._json(self.api.query(index, body, shards=shards))
+
+    def post_index(self, index, query=None):
+        body = self._json_body()
+        opts = body.get("options", {})
+        self._json(
+            self.api.create_index(
+                index,
+                keys=opts.get("keys", False),
+                track_existence=opts.get("trackExistence", True),
+            )
+        )
+
+    def get_index(self, index, query=None):
+        idx = self.api._index(index)
+        self._json(idx.schema())
+
+    def delete_index(self, index, query=None):
+        self.api.delete_index(index)
+        self._json({})
+
+    def post_field(self, index, field, query=None):
+        body = self._json_body()
+        self._json(self.api.create_field(index, field, body.get("options", {})))
+
+    def delete_field(self, index, field, query=None):
+        self.api.delete_field(index, field)
+        self._json({})
+
+    def post_import(self, index, field, query=None):
+        body = self._json_body()
+        changed = self.api.import_bits(
+            index, field,
+            body.get("rows", []), body.get("columns", []),
+            timestamps=body.get("timestamps"),
+            clear=bool(body.get("clear", False)),
+        )
+        self._json({"changed": changed})
+
+    def post_import_value(self, index, field, query=None):
+        body = self._json_body()
+        changed = self.api.import_values(
+            index, field, body.get("columns", []), body.get("values", []),
+            clear=bool(body.get("clear", False)),
+        )
+        self._json({"changed": changed})
+
+    def post_import_roaring(self, index, field, shard, query=None):
+        changed = self.api.import_roaring(index, field, int(shard), self._body())
+        self._json({"changed": changed})
+
+    def get_schema(self, query=None):
+        self._json(self.api.schema())
+
+    def get_status(self, query=None):
+        self._json(self.api.status())
+
+    def get_info(self, query=None):
+        self._json(self.api.info())
+
+    def get_version(self, query=None):
+        self._json(self.api.version())
+
+    def get_metrics(self, query=None):
+        from pilosa_tpu.utils.stats import global_stats
+
+        self._text(global_stats().prometheus_text(), "text/plain; version=0.0.4")
+
+    def get_export(self, query=None):
+        index = (query.get("index") or [""])[0]
+        field = (query.get("field") or [""])[0]
+        if not index or not field:
+            raise ApiError("export requires index= and field=")
+        self._text(self.api.export_csv(index, field), "text/csv")
+
+    def get_shards_max(self, query=None):
+        self._json(self.api.max_shards())
+
+    def get_fragment_blocks(self, query=None):
+        index = (query.get("index") or [""])[0]
+        field = (query.get("field") or [""])[0]
+        view = (query.get("view") or ["standard"])[0]
+        shard = _int_param((query.get("shard") or ["0"])[0], "shard")
+        idx = self.api._index(index)
+        fld = self.api._field(idx, field)
+        v = fld.view(view)
+        frag = v.fragment(shard) if v else None
+        blocks = frag.blocks() if frag else []
+        self._json({"blocks": [{"block": b, "checksum": c} for b, c in blocks]})
+
+    def get_fragment_data(self, query=None):
+        from pilosa_tpu.roaring.format import serialize
+
+        index = (query.get("index") or [""])[0]
+        field = (query.get("field") or [""])[0]
+        view = (query.get("view") or ["standard"])[0]
+        shard = _int_param((query.get("shard") or ["0"])[0], "shard")
+        idx = self.api._index(index)
+        fld = self.api._field(idx, field)
+        v = fld.view(view)
+        frag = v.fragment(shard) if v else None
+        data = serialize(frag.bitmap) if frag else b""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def post_cluster_message(self, query=None):
+        body = self._json_body()
+        if self.api.cluster is None:
+            self._json({})
+            return
+        self._json(self.api.cluster.handle_message(body))
+
+
+def _int_param(value: str, name: str) -> int:
+    try:
+        return int(value)
+    except ValueError as e:
+        raise ApiError(f"invalid {name} parameter {value!r}") from e
+
+
+def make_http_server(api: API, bind: str = "localhost", port: int = 10101):
+    handler = type("BoundHandler", (HTTPHandler,), {"api": api})
+    server = ThreadingHTTPServer((bind, port), handler)
+    return server
+
+
+def serve_in_thread(api: API, bind: str = "localhost", port: int = 0):
+    """Start a server on an ephemeral port; returns (server, port, thread).
+    The in-process equivalent of the reference's test.MustRunCluster node."""
+    server = make_http_server(api, bind, port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1], thread

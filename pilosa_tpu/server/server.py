@@ -1,0 +1,138 @@
+"""Server lifecycle: composition + tickers.
+
+Reference: server.go (SURVEY.md §2 #20) — functional options compose the
+holder, cluster, listeners, and background tickers (anti-entropy,
+diagnostics, stats flush). Here ServerConfig plays the role of the option
+set (populated from TOML/env/flags by pilosa_tpu.cli — SURVEY.md §5.6),
+and tickers are daemon threads.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import make_http_server
+from pilosa_tpu.storage import Holder
+from pilosa_tpu.utils.logger import new_standard_logger
+
+
+class ServerConfig:
+    def __init__(
+        self,
+        data_dir: str = "~/.pilosa_tpu",
+        bind: str = "localhost",
+        port: int = 10101,
+        anti_entropy_interval: float = 600.0,
+        replica_n: int = 1,
+        verbose: bool = False,
+        device_budget_bytes: int | None = None,
+    ):
+        self.data_dir = data_dir
+        self.bind = bind
+        self.port = port
+        self.anti_entropy_interval = anti_entropy_interval
+        self.replica_n = replica_n
+        self.verbose = verbose
+        self.device_budget_bytes = device_budget_bytes
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServerConfig":
+        return cls(
+            data_dir=d.get("data-dir", d.get("data_dir", "~/.pilosa_tpu")),
+            bind=d.get("bind", "localhost"),
+            port=int(d.get("port", 10101)),
+            anti_entropy_interval=float(
+                d.get("anti-entropy-interval", d.get("anti_entropy_interval", 600.0))
+            ),
+            replica_n=int(d.get("replica-n", d.get("replica_n", 1))),
+            verbose=_parse_bool(d.get("verbose", False)),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "data-dir": self.data_dir,
+            "bind": self.bind,
+            "port": self.port,
+            "anti-entropy-interval": self.anti_entropy_interval,
+            "replica-n": self.replica_n,
+            "verbose": self.verbose,
+        }
+
+
+def _parse_bool(value) -> bool:
+    """TOML gives real bools; env vars give strings ('false', '0', ...)."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "t", "yes", "on")
+    return bool(value)
+
+
+class Server:
+    def __init__(self, config: ServerConfig | None = None):
+        self.config = config or ServerConfig()
+        self.logger = new_standard_logger(verbose=self.config.verbose)
+        self.holder = Holder(self.config.data_dir)
+        self.api = API(self.holder)
+        self._http = None
+        self._http_thread = None
+        self._anti_entropy_timer: threading.Timer | None = None
+        self._closed = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._http.server_address[1] if self._http else self.config.port
+
+    def open(self) -> "Server":
+        if self.config.device_budget_bytes:
+            from pilosa_tpu.storage import residency
+
+            residency.set_global_row_cache(
+                residency.DeviceRowCache(self.config.device_budget_bytes)
+            )
+        self.holder.open()
+        self._http = make_http_server(self.api, self.config.bind, self.config.port)
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+        self._http_thread.start()
+        self.logger.info(
+            "listening on http://%s:%d (data-dir %s)",
+            self.config.bind, self.port, self.holder.data_dir,
+        )
+        self._schedule_anti_entropy()
+        return self
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._anti_entropy_timer is not None:
+            self._anti_entropy_timer.cancel()
+        if self._http:
+            self._http.shutdown()
+            self._http.server_close()
+        self.holder.close()
+
+    def _schedule_anti_entropy(self) -> None:
+        interval = self.config.anti_entropy_interval
+        if interval <= 0:
+            return
+
+        def tick():
+            if self._closed.is_set():
+                return
+            try:
+                self.run_anti_entropy()
+            except Exception as e:  # ticker must not die
+                self.logger.warning("anti-entropy failed: %s", e)
+            self._schedule_anti_entropy()
+
+        timer = threading.Timer(interval, tick)
+        timer.daemon = True
+        timer.start()
+        self._anti_entropy_timer = timer
+
+    def run_anti_entropy(self) -> None:
+        """Replica repair pass (reference monitorAntiEntropy →
+        HolderSyncer.SyncHolder — SURVEY.md §3.5). With no cluster peers
+        configured this is a no-op."""
+        if self.api.cluster is not None:
+            self.api.cluster.sync_holder()

@@ -1,0 +1,1 @@
+"""Infra utilities: stats, tracing, logging (reference L1 — SURVEY.md §1)."""

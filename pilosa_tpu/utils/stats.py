@@ -1,0 +1,114 @@
+"""StatsClient: counters/gauges/timings threaded through the engine.
+
+Reference: stats/stats.go (SURVEY.md §2 #23) — a StatsClient interface
+(Count/Gauge/Histogram/Timing with tags) with statsd and nop backends and
+expvar always on. Here: an in-memory client that renders Prometheus text
+for GET /metrics (statsd export can be layered on the same interface),
+plus a Nop client for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+def _fmt_tags(tags: dict | None) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+class StatsClient:
+    """In-memory stats registry; thread-safe."""
+
+    def __init__(self, prefix: str = "pilosa_tpu"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        self._timings: dict[tuple, list] = defaultdict(lambda: [0, 0.0])
+
+    def count(self, name: str, value: float = 1, tags: dict | None = None) -> None:
+        with self._lock:
+            self._counters[(name, _fmt_tags(tags))] += value
+
+    def gauge(self, name: str, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            self._gauges[(name, _fmt_tags(tags))] = value
+
+    def timing(self, name: str, seconds: float, tags: dict | None = None) -> None:
+        with self._lock:
+            entry = self._timings[(name, _fmt_tags(tags))]
+            entry[0] += 1
+            entry[1] += seconds
+
+    def timer(self, name: str, tags: dict | None = None):
+        return _Timer(self, name, tags)
+
+    def histogram(self, name: str, value: float, tags: dict | None = None) -> None:
+        self.timing(name, value, tags)
+
+    def prometheus_text(self) -> str:
+        lines = []
+        with self._lock:
+            for (name, tags), v in sorted(self._counters.items()):
+                lines.append(f"{self.prefix}_{name}_total{tags} {v:g}")
+            for (name, tags), v in sorted(self._gauges.items()):
+                lines.append(f"{self.prefix}_{name}{tags} {v:g}")
+            for (name, tags), (n, total) in sorted(self._timings.items()):
+                lines.append(f"{self.prefix}_{name}_seconds_count{tags} {n:g}")
+                lines.append(f"{self.prefix}_{name}_seconds_sum{tags} {total:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {f"{n}{t}": v for (n, t), v in self._counters.items()},
+                "gauges": {f"{n}{t}": v for (n, t), v in self._gauges.items()},
+            }
+
+
+class _Timer:
+    def __init__(self, client: StatsClient, name: str, tags):
+        self.client = client
+        self.name = name
+        self.tags = tags
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.client.timing(self.name, time.perf_counter() - self._t0, self.tags)
+        return False
+
+
+class NopStatsClient(StatsClient):
+    """Discards everything (reference stats.NopStatsClient)."""
+
+    def count(self, *a, **k):
+        pass
+
+    def gauge(self, *a, **k):
+        pass
+
+    def timing(self, *a, **k):
+        pass
+
+
+_global: StatsClient | None = None
+
+
+def global_stats() -> StatsClient:
+    global _global
+    if _global is None:
+        _global = StatsClient()
+    return _global
+
+
+def set_global_stats(client: StatsClient) -> None:
+    global _global
+    _global = client

@@ -1,0 +1,89 @@
+"""CLI tests: import/export/inspect/check against a data dir in-process
+(reference ctl/ coverage — SURVEY.md §2 #29)."""
+
+import io
+import json
+import sys
+
+import pytest
+
+from pilosa_tpu.cli import main
+
+
+def run_cli(argv, stdin: str | None = None, capsys=None):
+    if stdin is not None:
+        old = sys.stdin
+        sys.stdin = io.StringIO(stdin)
+        try:
+            return main(argv)
+        finally:
+            sys.stdin = old
+    return main(argv)
+
+
+def test_import_export_roundtrip(tmp_path, capsys):
+    data_dir = str(tmp_path / "data")
+    csv = tmp_path / "bits.csv"
+    csv.write_text("1,10\n1,20\n2,10\n")
+    rc = main(["import", "-i", "i", "-f", "f", "-d", data_dir, "--create", str(csv)])
+    assert rc == 0
+    assert "3 bits changed" in capsys.readouterr().out
+
+    rc = main(["export", "-i", "i", "-f", "f", "-d", data_dir])
+    assert rc == 0
+    assert capsys.readouterr().out.splitlines() == ["1,10", "1,20", "2,10"]
+
+
+def test_import_values_and_check_inspect(tmp_path, capsys):
+    data_dir = str(tmp_path / "data")
+    csv = tmp_path / "vals.csv"
+    csv.write_text("0,5\n1,42\n")
+    rc = main(["import", "-i", "taxi", "-f", "fare", "-d", data_dir,
+               "--create", "--values", "--min", "0", "--max", "100", str(csv)])
+    assert rc == 0
+
+    rc = main(["inspect", "-d", data_dir])
+    out = capsys.readouterr().out
+    assert rc == 0 and "taxi/fare/bsig_fare/0" in out
+
+    rc = main(["check", "-d", data_dir])
+    out = capsys.readouterr().out
+    assert rc == 0 and "ok:" in out
+
+
+def test_import_clear(tmp_path, capsys):
+    data_dir = str(tmp_path / "data")
+    csv = tmp_path / "bits.csv"
+    csv.write_text("1,10\n")
+    main(["import", "-i", "i", "-f", "f", "-d", data_dir, "--create", str(csv)])
+    capsys.readouterr()
+    main(["import", "-i", "i", "-f", "f", "-d", data_dir, "--clear", str(csv)])
+    capsys.readouterr()
+    main(["export", "-i", "i", "-f", "f", "-d", data_dir])
+    assert capsys.readouterr().out == ""
+
+
+def test_config_commands(capsys):
+    rc = main(["generate-config"])
+    out = capsys.readouterr().out
+    assert rc == 0 and 'data-dir' in out
+
+    rc = main(["config"])
+    cfg = json.loads(capsys.readouterr().out)
+    assert rc == 0 and cfg["port"] == 10101
+
+
+def test_config_env_precedence(tmp_path, capsys, monkeypatch):
+    toml = tmp_path / "c.toml"
+    toml.write_text('port = 7777\nbind = "0.0.0.0"\n')
+    monkeypatch.setenv("PILOSA_TPU_PORT", "8888")
+    rc = main(["config", "-c", str(toml)])
+    cfg = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert cfg["port"] == 8888  # env beats file
+    assert cfg["bind"] == "0.0.0.0"  # file beats default
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert capsys.readouterr().out.strip()

@@ -326,6 +326,15 @@ class TestErrors:
         with pytest.raises(PQLError):
             ex.execute("i", "Row(f=1)")
 
+    def test_negative_column_rejected(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        with pytest.raises(PQLError):
+            ex.execute("i", "Set(-5, f=1)")
+        with pytest.raises(PQLError):
+            ex.execute("i", "Clear(-5, f=1)")
+
     def test_range_on_set_field(self, env):
         holder, ex = env
         idx = holder.create_index("i")

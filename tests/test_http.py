@@ -1,0 +1,177 @@
+"""HTTP integration tests: real listeners on ephemeral localhost ports
+(reference http/handler_test.go httptest style — SURVEY.md §4)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import serve_in_thread
+from pilosa_tpu.storage import Holder
+
+
+@pytest.fixture
+def node(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    api = API(holder)
+    server, port, _ = serve_in_thread(api)
+    yield f"http://localhost:{port}"
+    server.shutdown()
+    server.server_close()
+    holder.close()
+
+
+def req(method, url, body=None, content_type="application/json", raw=False):
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        r.add_header("Content-Type", content_type)
+    with urllib.request.urlopen(r) as resp:
+        payload = resp.read()
+        return payload if raw else json.loads(payload or b"{}")
+
+
+def test_full_lifecycle(node):
+    # create index + fields
+    req("POST", f"{node}/index/repos", {})
+    req("POST", f"{node}/index/repos/field/stargazer", {})
+    req("POST", f"{node}/index/repos/field/fare",
+        {"options": {"type": "int", "min": 0, "max": 1000}})
+
+    # schema surfaces both
+    schema = req("GET", f"{node}/schema")
+    names = {f["name"] for f in schema["indexes"][0]["fields"]}
+    assert names == {"stargazer", "fare"}
+
+    # writes via PQL query endpoint
+    out = req("POST", f"{node}/index/repos/query",
+              b"Set(10, stargazer=1) Set(20, stargazer=1)")
+    assert out["results"] == [True, True]
+
+    # read back
+    out = req("POST", f"{node}/index/repos/query", b"Row(stargazer=1)")
+    assert out["results"][0]["columns"] == [10, 20]
+
+    # count fused
+    out = req("POST", f"{node}/index/repos/query", b"Count(Row(stargazer=1))")
+    assert out["results"] == [2]
+
+    # BSI via import-value + Range/Sum
+    req("POST", f"{node}/index/repos/field/fare/import-value",
+        {"columns": [10, 20, 30], "values": [5, 10, 400]})
+    out = req("POST", f"{node}/index/repos/query", b"Count(Range(fare > 6))")
+    assert out["results"] == [2]
+    out = req("POST", f"{node}/index/repos/query", b'Sum(field="fare")')
+    assert out["results"][0] == {"value": 415, "count": 3}
+
+
+def test_import_endpoint_and_export(node):
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    out = req("POST", f"{node}/index/i/field/f/import",
+              {"rows": [1, 1, 2], "columns": [5, 9, 5]})
+    assert out["changed"] == 3
+    csv = req("GET", f"{node}/export?index=i&field=f", raw=True).decode()
+    assert csv.splitlines() == ["1,5", "1,9", "2,5"]
+
+
+def test_import_roaring_endpoint(node):
+    from pilosa_tpu.roaring import RoaringBitmap, serialize
+
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    # row 2, positions {1, 4} → fragment bits 2*2^20 + {1,4}
+    bm = RoaringBitmap.from_ids([(2 << 20) + 1, (2 << 20) + 4])
+    out = req("POST", f"{node}/index/i/field/f/import-roaring/0",
+              serialize(bm), content_type="application/octet-stream")
+    assert out["changed"] == 2
+    out = req("POST", f"{node}/index/i/query", b"Row(f=2)")
+    assert out["results"][0]["columns"] == [1, 4]
+
+
+def test_topn_groupby_over_http(node):
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    rows, cols = [], []
+    for row, n in [(1, 3), (2, 8), (3, 5)]:
+        rows += [row] * n
+        cols += list(range(n))
+    req("POST", f"{node}/index/i/field/f/import", {"rows": rows, "columns": cols})
+    out = req("POST", f"{node}/index/i/query", b"TopN(f, n=2)")
+    assert out["results"][0] == [{"id": 2, "count": 8}, {"id": 3, "count": 5}]
+    out = req("POST", f"{node}/index/i/query", b"GroupBy(Rows(f), limit=2)")
+    assert out["results"][0] == [
+        {"group": [{"field": "f", "rowID": 1}], "count": 3},
+        {"group": [{"field": "f", "rowID": 2}], "count": 8},
+    ]
+
+
+def test_status_info_version_metrics(node):
+    st = req("GET", f"{node}/status")
+    assert st["state"] == "NORMAL" and st["nodes"]
+    info = req("GET", f"{node}/info")
+    assert info["shardWidth"] == 1 << 20
+    v = req("GET", f"{node}/version")
+    assert v["version"]
+    # metrics endpoint serves prometheus text
+    text = req("GET", f"{node}/metrics", raw=True).decode()
+    assert isinstance(text, str)
+
+
+def test_error_statuses(node):
+    # query on missing index → 400 with error body
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("POST", f"{node}/index/nope/query", b"Row(f=1)")
+    assert e.value.code == 400
+    # delete missing index → 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("DELETE", f"{node}/index/nope")
+    assert e.value.code == 404
+    # duplicate create → 409
+    req("POST", f"{node}/index/i", {})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("POST", f"{node}/index/i", {})
+    assert e.value.code == 409
+    # bad PQL → 400 with parse error message
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("POST", f"{node}/index/i/query", b"Bogus(")
+    assert e.value.code == 400
+    assert "error" in json.loads(e.value.read())
+    # unknown route → 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("GET", f"{node}/definitely/not/a/route")
+    assert e.value.code == 404
+
+
+def test_delete_field_and_index(node):
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/query", b"Set(1, f=1)")
+    req("DELETE", f"{node}/index/i/field/f")
+    schema = req("GET", f"{node}/schema")
+    assert schema["indexes"][0]["fields"] == []
+    req("DELETE", f"{node}/index/i")
+    assert req("GET", f"{node}/schema") == {"indexes": []}
+
+
+def test_internal_fragment_blocks_and_data(node):
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/query", b"Set(1, f=1) Set(5, f=101)")
+    out = req("GET", f"{node}/internal/fragment/blocks?index=i&field=f&view=standard&shard=0")
+    assert {b["block"] for b in out["blocks"]} == {0, 1}
+    raw = req("GET", f"{node}/internal/fragment/data?index=i&field=f&view=standard&shard=0", raw=True)
+    from pilosa_tpu.roaring.format import load
+
+    bm, _ = load(raw)
+    assert bm.count() == 2
+
+
+def test_shards_max(node):
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/query", b"Set(1, f=1)")
+    out = req("GET", f"{node}/internal/shards/max")
+    assert out["standard"]["i"] == 0
