@@ -1,0 +1,95 @@
+"""Benchmark: the north-star metric on real hardware.
+
+BASELINE.json: "PQL Intersect+Count rows/sec/chip @ 1B cols" — a fused
+bitwise-AND + popcount over two 1-billion-column rows (954 shards of 2^20
+columns), the device kernel behind Count(Intersect(Row(a), Row(b))).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline compares against a single-CPU-node reference executing the
+same logical op with numpy (np.bitwise_and + np.bitwise_count), measured
+on this machine — the reference repo publishes no numbers and its mount
+is empty (BASELINE.md), so the CPU baseline is measured, not quoted.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_COLS = 1 << 30  # one billion columns
+DENSITY_BITS = 1 << 17  # bits set per shard-row (~12.5% density)
+
+
+def _make_rows(n_shards: int, words_per_shard: int, seed: int) -> np.ndarray:
+    """Random bit-packed [n_shards, words] rows, built without python loops."""
+    rng = np.random.default_rng(seed)
+    # random 32-bit words with ~12.5% bit density via AND of three randoms
+    a = rng.integers(0, 1 << 32, size=(n_shards, words_per_shard), dtype=np.uint64)
+    b = rng.integers(0, 1 << 32, size=(n_shards, words_per_shard), dtype=np.uint64)
+    c = rng.integers(0, 1 << 32, size=(n_shards, words_per_shard), dtype=np.uint64)
+    return (a & b & c).astype(np.uint32)
+
+
+def bench_tpu(a_host: np.ndarray, b_host: np.ndarray, iters: int = 20) -> tuple[float, int]:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def intersect_count(a, b):
+        return jnp.sum(lax.population_count(a & b).astype(jnp.uint32))
+
+    a = jax.device_put(a_host)
+    b = jax.device_put(b_host)
+    # warm up + compile
+    result = int(intersect_count(a, b))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = intersect_count(a, b)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    return dt, result
+
+
+def bench_cpu_reference(a: np.ndarray, b: np.ndarray, iters: int = 3) -> tuple[float, int]:
+    """Single-node CPU doing the same logical work (numpy vectorized —
+    generous to the baseline: the Go reference walks roaring containers)."""
+    result = int(np.bitwise_count(a & b).sum())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.bitwise_count(a & b).sum()
+    dt = (time.perf_counter() - t0) / iters
+    return dt, result
+
+
+def main() -> None:
+    from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+    n_shards = -(-N_COLS // SHARD_WIDTH)  # 1024 shards = 2^30 cols
+    a = _make_rows(n_shards, WORDS_PER_SHARD, seed=1)
+    b = _make_rows(n_shards, WORDS_PER_SHARD, seed=2)
+
+    tpu_dt, tpu_result = bench_tpu(a, b)
+    cpu_dt, cpu_result = bench_cpu_reference(a, b)
+    if tpu_result != cpu_result:
+        raise AssertionError(f"result mismatch tpu={tpu_result} cpu={cpu_result}")
+
+    cols_per_sec = N_COLS / tpu_dt
+    print(
+        json.dumps(
+            {
+                "metric": "intersect_count_cols_per_sec_1B",
+                "value": round(cols_per_sec, 1),
+                "unit": "columns/sec/chip",
+                "vs_baseline": round(cpu_dt / tpu_dt, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

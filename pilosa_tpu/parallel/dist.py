@@ -1,0 +1,326 @@
+"""Distributed executor: one SPMD program per query over the shard mesh.
+
+Reference counterpart: executor.go's remote branch — one HTTP sub-query
+per node carrying its shard list, partials reduced on the caller
+(SURVEY.md §3.2 ⇄NET hops). Here the whole map+reduce is a single
+``shard_map``-ped XLA program: each device evaluates the fused bitmap
+kernel over its resident block of shards (vmapped over the block), and
+``psum`` over the ``shards`` axis does the reduce on ICI. No
+serialization, no scatter/gather, no per-node re-dispatch.
+
+Leaves are mesh-sharded stacks ``uint32[S_padded, ...]`` built once per
+(query-leaf, shard-set, write-generation) and cached in device HBM via the
+residency LRU, so steady-state queries touch the host only for the final
+scalar/row materialization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pilosa_tpu.executor import expr
+from pilosa_tpu.executor.executor import (
+    Executor,
+    PQLError,
+    _Compiled,
+    _PlanesSpec,
+    _RowSpec,
+    _ZeroSpec,
+)
+from pilosa_tpu.executor.result import Pair, RowResult, ValCount
+from pilosa_tpu.parallel.mesh import SHARDS_AXIS, ShardAssignment, make_mesh
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+from pilosa_tpu.storage import residency
+from pilosa_tpu.storage.view import VIEW_STANDARD
+
+_DIST_JIT_CACHE: dict = {}
+
+
+def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
+    """Build (or fetch) the compiled SPMD evaluator for a query shape."""
+    key = (mesh, structure, reduce_kind, leaf_ranks, n_scalars)
+    fn = _DIST_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    leaf_specs = tuple(P(SHARDS_AXIS) for _ in leaf_ranks)
+    scalar_specs = tuple(P() for _ in range(n_scalars))
+    if reduce_kind in ("count", "countrows"):
+        out_specs = P()
+    elif reduce_kind == "bsisum":
+        out_specs = (P(), P())
+    elif reduce_kind == "minmax":
+        out_specs = (P(SHARDS_AXIS), P(SHARDS_AXIS))
+    else:  # row
+        out_specs = P(SHARDS_AXIS)
+
+    def body(*args):
+        leaves = args[: len(leaf_ranks)]
+        scalars = args[len(leaf_ranks):]
+
+        def per_shard(*ls):
+            return expr._go(structure, ls, scalars)
+
+        out = jax.vmap(per_shard)(*leaves)
+        if reduce_kind == "count":
+            return lax.psum(jnp.sum(out), SHARDS_AXIS)
+        if reduce_kind == "countrows":
+            return lax.psum(jnp.sum(out, axis=0), SHARDS_AXIS)
+        if reduce_kind == "bsisum":
+            plane_counts, n = out
+            return (
+                lax.psum(jnp.sum(plane_counts, axis=0), SHARDS_AXIS),
+                lax.psum(jnp.sum(n), SHARDS_AXIS),
+            )
+        return out  # row / minmax: stays shard-sharded
+
+    fn = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=leaf_specs + scalar_specs,
+            out_specs=out_specs,
+        )
+    )
+    _DIST_JIT_CACHE[key] = fn
+    return fn
+
+
+class DistExecutor(Executor):
+    """Executor whose shard map phase runs as one SPMD program on a mesh.
+
+    Used single-process over all local devices; over multiple hosts the
+    same code runs under jax.distributed with a global mesh (each host
+    feeds its addressable shards)."""
+
+    def __init__(self, holder, mesh=None):
+        super().__init__(holder)
+        self.mesh = mesh if mesh is not None else make_mesh()
+
+    # ------------------------------------------------------- sharded leaves
+
+    def _sharding(self):
+        return NamedSharding(self.mesh, P(SHARDS_AXIS))
+
+    def _stacked_leaf(self, idx, spec, assignment: ShardAssignment):
+        cache = residency.global_row_cache()
+        gen = cache.write_generation
+        if isinstance(spec, _RowSpec):
+            key = ("stack", gen, idx.name, spec.field, spec.views, spec.row,
+                   assignment.key())
+
+            def decode():
+                return assignment.stack(
+                    lambda shard: np.asarray(self._host_row(idx, spec, shard))
+                )
+        elif isinstance(spec, _PlanesSpec):
+            field = idx.field(spec.field)
+            depth = 2 + field.options.bit_depth
+            key = ("stackp", gen, idx.name, spec.field, depth, assignment.key())
+
+            def decode():
+                return assignment.stack(
+                    lambda shard: self._host_planes(idx, spec, shard, depth)
+                )
+        elif isinstance(spec, _ZeroSpec):
+            key = ("stackz", assignment.padded)
+
+            def decode():
+                return np.zeros((assignment.padded, WORDS_PER_SHARD), np.uint32)
+        else:
+            raise PQLError(f"unknown leaf spec {type(spec).__name__}")
+
+        sharding = self._sharding()
+        return cache.get_row(
+            key, decode, device_put=lambda host: jax.device_put(host, sharding)
+        )
+
+    @staticmethod
+    def _host_row(idx, spec: _RowSpec, shard: int) -> np.ndarray:
+        field = idx.field(spec.field)
+        acc = None
+        for vname in spec.views:
+            view = field.view(vname) if field else None
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                continue
+            words = frag.row_words(spec.row)
+            acc = words if acc is None else np.bitwise_or(acc, words)
+        return acc if acc is not None else np.zeros(WORDS_PER_SHARD, np.uint32)
+
+    @staticmethod
+    def _host_planes(idx, spec: _PlanesSpec, shard: int, depth: int) -> np.ndarray:
+        field = idx.field(spec.field)
+        view = field.view(field.bsi_view_name())
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            return np.zeros((depth, WORDS_PER_SHARD), np.uint32)
+        return np.stack([frag.row_words(r) for r in range(depth)])
+
+    def _dist_eval(self, idx, compiled: _Compiled, shards: list[int],
+                   reduce_kind: str, extra_leaves=()):
+        assignment = ShardAssignment(shards, self.mesh)
+        leaves = [
+            self._stacked_leaf(idx, spec, assignment) for spec in compiled.specs
+        ]
+        leaves.extend(extra_leaves)
+        if not leaves:
+            leaves = [self._stacked_leaf(idx, _ZeroSpec(), assignment)]
+        scalars = tuple(jnp.asarray(s, jnp.int32) for s in compiled.scalars)
+        fn = _dist_fn(
+            self.mesh, compiled.node, reduce_kind,
+            tuple(l.ndim - 1 for l in leaves), len(scalars),
+        )
+        return fn(*leaves, *scalars), assignment
+
+    # ---------------------------------------------------- overridden calls
+
+    def _execute_count(self, idx, call, shards=None) -> int:
+        if len(call.children) != 1:
+            raise PQLError("Count requires exactly one child call")
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return 0
+        compiled = self._compile(idx, call.children[0], wrap="count")
+        total, _ = self._dist_eval(idx, compiled, shard_list, "count")
+        return int(total)
+
+    def _execute_bitmap(self, idx, call, shards=None) -> RowResult:
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return RowResult({})
+        compiled = self._compile(idx, call)
+        stacked, assignment = self._dist_eval(idx, compiled, shard_list, "row")
+        host = np.asarray(stacked)
+        segments = {}
+        for i, shard in enumerate(assignment.shards):
+            if host[i].any():
+                segments[shard] = host[i]
+        return RowResult(segments)
+
+    def _execute_bsi_aggregate(self, idx, call, shards=None) -> ValCount:
+        from pilosa_tpu.storage.field import TYPE_INT
+
+        field_name = call.arg("field") or call.arg("_field")
+        if field_name is None:
+            raise PQLError(f"{call.name} requires field=")
+        field = idx.field(field_name)
+        if field is None or field.options.type != TYPE_INT:
+            raise PQLError(f"{call.name} requires an int field")
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return ValCount(0, 0)
+        filt_call = call.children[0] if call.children else None
+
+        specs: list = []
+        scalars: list = []
+        planes_i = self._planes_index(field, specs)
+        filt_node = (
+            self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
+        )
+        base = field.options.base
+
+        if call.name == "Sum":
+            node = ("bsisum", planes_i, filt_node)
+            (plane_counts, n), _ = self._dist_eval(
+                idx, _Compiled(node, specs, scalars), shard_list, "bsisum"
+            )
+            plane_counts = np.asarray(plane_counts).tolist()
+            count = int(n)
+            total = sum(c << i for i, c in enumerate(plane_counts))
+            return ValCount(total + base * count, count)
+
+        want_max = call.name == "Max"
+        node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
+        (values, counts), assignment = self._dist_eval(
+            idx, _Compiled(node, specs, scalars), shard_list, "minmax"
+        )
+        values = np.asarray(values)[: len(assignment.shards)]
+        counts = np.asarray(counts)[: len(assignment.shards)]
+        best, count = None, 0
+        for v, n in zip(values.tolist(), counts.tolist()):
+            if n == 0:
+                continue
+            if best is None or (v > best if want_max else v < best):
+                best, count = v, n
+            elif v == best:
+                count += n
+        if best is None:
+            return ValCount(0, 0)
+        return ValCount(best + base, count)
+
+    def _execute_topn(self, idx, call, shards=None) -> list[Pair]:
+        from pilosa_tpu.executor.executor import TOPN_CANDIDATE_FACTOR
+
+        field_name = call.arg("_field") or call.arg("field")
+        if field_name is None:
+            raise PQLError("TopN requires a field")
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        n = call.arg("n", 10)
+        filt_call = call.children[0] if call.children else None
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return []
+        view = field.view(VIEW_STANDARD)
+
+        explicit_ids = call.arg("ids")
+        if explicit_ids is not None:
+            candidates = sorted(int(i) for i in explicit_ids)
+        else:
+            overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
+            cand: set[int] = set()
+            for shard in shard_list:
+                frag = view.fragment(shard) if view else None
+                if frag is None:
+                    continue
+                cand.update(r for r, _ in frag.top(overfetch))
+            candidates = sorted(cand)
+        if not candidates:
+            return []
+
+        # phase 2 on the mesh: stacked [S, n_cand, words] + countrows psum
+        specs: list = []
+        scalars: list = []
+        filt_node = (
+            self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
+        )
+        node = ("countrows", len(specs), filt_node)
+        assignment = ShardAssignment(shard_list, self.mesh)
+        cache = residency.global_row_cache()
+        gen = cache.write_generation
+        key = ("stackm", gen, idx.name, field_name, tuple(candidates),
+               assignment.key())
+
+        def decode():
+            def per_shard(shard):
+                frag = view.fragment(shard) if view else None
+                if frag is None:
+                    return np.zeros(
+                        (len(candidates), WORDS_PER_SHARD), np.uint32
+                    )
+                return np.stack([frag.row_words(r) for r in candidates])
+
+            return assignment.stack(per_shard)
+
+        sharding = self._sharding()
+        matrix = cache.get_row(
+            key, decode, device_put=lambda host: jax.device_put(host, sharding)
+        )
+        compiled = _Compiled(node, specs, scalars)
+        counts, _ = self._dist_eval(
+            idx, compiled, shard_list, "countrows", extra_leaves=(matrix,)
+        )
+        totals = np.asarray(counts, np.int64)
+        order = sorted(
+            (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
+        )
+        return [Pair(r, -negc) for negc, r in order[:n]]

@@ -1,0 +1,95 @@
+"""Device mesh + shard→device assignment.
+
+The mesh axis ``"shards"`` is the TPU analog of the reference's hash
+partitioning (cluster.go: partition = hash(index, shard) % 256 → nodes —
+SURVEY.md §2 #13): a query's shard list is laid out as the leading axis of
+a global array sharded over the mesh, so each chip's HBM holds its slice
+of shards and XLA collectives do the reduce that the reference did over
+HTTP.
+
+Multi-host: ``initialize_distributed`` wires jax.distributed so the same
+mesh spans hosts over DCN; the shard axis simply gets longer. Nothing in
+the executor changes — that is the point of expressing the cluster as a
+mesh instead of porting the reference's gossip/RPC.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARDS_AXIS = "shards"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the shard axis. For 2-D topologies (e.g. v5e-64 as
+    8x8) the shard axis is simply the flattened device list — bitmap ops
+    have no second model axis to map."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARDS_AXIS,))
+
+
+def shards_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [n_shards_padded, ...] arrays: leading axis split over
+    the mesh."""
+    return NamedSharding(mesh, P(SHARDS_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(coordinator: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host bring-up over DCN (replaces the reference's
+    memberlist/gossip data-plane role; schema gossip stays HTTP —
+    parallel.cluster)."""
+    if coordinator is None:
+        return  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+class ShardAssignment:
+    """Maps a query's shard list onto mesh slots.
+
+    The global array rows are ordered by the (sorted) shard list, padded
+    to a multiple of the mesh size with empty slots; slot s lives on
+    device s // (S_padded / n_devices). Replication (the reference's
+    replicaN) is a host-side property of fragment *files*
+    (parallel.cluster); device residency is single-copy since HBM is a
+    cache, not the durable store.
+    """
+
+    def __init__(self, shards: list[int], mesh: Mesh):
+        self.shards = sorted(shards)
+        self.n_devices = mesh.size
+        n = max(len(self.shards), 1)
+        self.padded = -(-n // self.n_devices) * self.n_devices
+        self.mesh = mesh
+
+    @property
+    def slot_of(self) -> dict[int, int]:
+        return {s: i for i, s in enumerate(self.shards)}
+
+    def key(self) -> tuple:
+        return (tuple(self.shards), self.padded, self.n_devices)
+
+    def stack(self, per_shard_fn) -> np.ndarray:
+        """Build the [padded, ...] host array: per_shard_fn(shard) → row
+        block; empty slots are zeros."""
+        first = per_shard_fn(self.shards[0]) if self.shards else None
+        inner_shape = first.shape if first is not None else ()
+        out_shape = (self.padded,) + tuple(inner_shape)
+        out = np.zeros(out_shape, np.uint32)
+        for i, s in enumerate(self.shards):
+            out[i] = first if i == 0 else per_shard_fn(s)
+        return out

@@ -235,7 +235,10 @@ class Fragment:
             self._file = open(self.path, "ab")
 
     def _after_row_write(self, row: int) -> None:
-        residency.global_row_cache().invalidate(self.frag_id + (row,))
+        cache = residency.global_row_cache()
+        cache.invalidate(self.frag_id + (row,))
+        cache.invalidate_fragment(self.frag_id + ("__planes__",))
+        cache.bump_generation()
         self.row_cache.add(row, self.count_row(row))
 
     def _check_pos(self, pos: int) -> None:

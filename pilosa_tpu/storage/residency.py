@@ -30,25 +30,32 @@ DEFAULT_BUDGET_BYTES = 4 << 30
 
 
 class DeviceRowCache:
-    """Byte-budgeted LRU of device-resident dense rows."""
+    """Byte-budgeted LRU of device-resident arrays (dense rows, BSI plane
+    matrices, mesh-sharded shard stacks — sized by actual nbytes)."""
 
     def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES, device=None):
         self.budget_bytes = budget_bytes
         self.device = device
         self._rows: OrderedDict[tuple, jax.Array] = OrderedDict()
+        self._bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # bumped on every fragment write; coarse invalidation signal for
+        # derived entries (mesh-stacked arrays) whose keys embed it
+        self.write_generation = 0
 
     def __len__(self) -> int:
         return len(self._rows)
 
     @property
     def bytes_used(self) -> int:
-        return len(self._rows) * ROW_BYTES
+        return self._bytes
 
-    def get_row(self, key: tuple, decode: Callable[[], np.ndarray]) -> jax.Array:
-        """Return the device row for ``key``, decoding+uploading on miss."""
+    def get_row(self, key: tuple, decode: Callable[[], np.ndarray],
+                device_put: Callable | None = None) -> jax.Array:
+        """Return the device array for ``key``, decoding+uploading on miss.
+        ``device_put`` overrides placement (e.g. a NamedSharding put)."""
         row = self._rows.get(key)
         if row is not None:
             self.hits += 1
@@ -56,25 +63,36 @@ class DeviceRowCache:
             return row
         self.misses += 1
         host = decode()
-        arr = jax.device_put(host, self.device)
+        if device_put is not None:
+            arr = device_put(host)
+        else:
+            arr = jax.device_put(host, self.device)
         self._rows[key] = arr
+        self._bytes += arr.nbytes
         self._evict()
         return arr
 
     def invalidate(self, key: tuple) -> None:
-        self._rows.pop(key, None)
+        arr = self._rows.pop(key, None)
+        if arr is not None:
+            self._bytes -= arr.nbytes
 
     def invalidate_fragment(self, frag_id: tuple) -> None:
         doomed = [k for k in self._rows if k[: len(frag_id)] == frag_id]
         for k in doomed:
-            del self._rows[k]
+            self.invalidate(k)
+
+    def bump_generation(self) -> None:
+        self.write_generation += 1
 
     def clear(self) -> None:
         self._rows.clear()
+        self._bytes = 0
 
     def _evict(self) -> None:
-        while len(self._rows) * ROW_BYTES > self.budget_bytes and len(self._rows) > 1:
-            self._rows.popitem(last=False)
+        while self._bytes > self.budget_bytes and len(self._rows) > 1:
+            _, arr = self._rows.popitem(last=False)
+            self._bytes -= arr.nbytes
             self.evictions += 1
 
 
