@@ -190,9 +190,55 @@ class Executor:
             return self._execute_groupby(idx, call, shards)
         if name == "IncludesColumn":
             return self._execute_includes_column(idx, call)
+        if name == "SetRowAttrs":
+            return self._execute_set_row_attrs(idx, call)
+        if name == "SetColumnAttrs":
+            return self._execute_set_column_attrs(idx, call)
         if name in _BITMAP_CALLS:
             return self._execute_bitmap(idx, call, shards)
         raise PQLError(f"unsupported call {name!r}")
+
+    # ------------------------------------------------------ key translation
+
+    def _translate_col(self, idx: Index, col, create: bool = False):
+        from pilosa_tpu.storage.translate import column_namespace
+
+        if isinstance(col, int):
+            return col
+        if not idx.keys:
+            raise PQLError(
+                f"column key {col!r} on index {idx.name!r} without keys=true"
+            )
+        return self.holder.translate.translate_one(
+            column_namespace(idx.name), str(col), create=create
+        )
+
+    def _translate_row(self, idx: Index, field, row, create: bool = False):
+        from pilosa_tpu.storage.translate import row_namespace
+
+        if isinstance(row, int):
+            return row
+        if not field.options.keys:
+            raise PQLError(
+                f"row key {row!r} on field {field.name!r} without keys=true"
+            )
+        return self.holder.translate.translate_one(
+            row_namespace(idx.name, field.name), str(row), create=create
+        )
+
+    def _column_keys(self, idx: Index, columns):
+        from pilosa_tpu.storage.translate import column_namespace
+
+        return self.holder.translate.keys_of(
+            column_namespace(idx.name), [int(c) for c in columns]
+        )
+
+    def _row_keys(self, idx: Index, field, rows):
+        from pilosa_tpu.storage.translate import row_namespace
+
+        return self.holder.translate.keys_of(
+            row_namespace(idx.name, field.name), [int(r) for r in rows]
+        )
 
     # --------------------------------------------------------------- shards
 
@@ -210,7 +256,26 @@ class Executor:
             words = np.asarray(compiled.eval(idx, shard))
             if words.any():
                 segments[shard] = words
-        return RowResult(segments)
+        return self._finish_row_result(idx, call, RowResult(segments))
+
+    def _finish_row_result(self, idx: Index, call: Call, res: RowResult) -> RowResult:
+        """Attach row attrs (plain Row calls) and translated column keys."""
+        if call.name == "Row" and call.condition_field()[0] is None:
+            try:
+                field_name, row = self._row_field_and_value(call)
+                field = idx.field(field_name)
+                if field is not None and field.row_attrs is not None:
+                    row_id = self._translate_row(idx, field, row, create=False)
+                    if row_id is not None:
+                        res.attrs = field.row_attrs.attrs(row_id)
+            except PQLError:
+                pass
+        if idx.keys:
+            res.keys = [
+                k for k in self._column_keys(idx, res.columns().tolist())
+                if k is not None
+            ]
+        return res
 
     def _execute_count(self, idx: Index, call: Call, shards=None) -> int:
         if len(call.children) != 1:
@@ -300,9 +365,9 @@ class Executor:
         if field is None:
             raise PQLError(f"field {field_name!r} not found")
         if not isinstance(row, int):
-            raise PQLError(
-                f"row key {row!r} requires key translation (field keys)"
-            )
+            row = self._translate_row(idx, field, row, create=False)
+            if row is None:
+                return ("const0",)  # unknown key → empty row
         if row < 0:
             return ("const0",)  # negative rows cannot exist
         views: tuple[str, ...]
@@ -486,11 +551,27 @@ class Executor:
         order = sorted(
             (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
         )
-        return [Pair(r, -negc) for negc, r in order[:n]]
+        return self._finish_pairs(idx, field, [Pair(r, -negc) for negc, r in order[:n]])
+
+    def _finish_pairs(self, idx: Index, field, pairs: list[Pair]) -> list[Pair]:
+        """Attach row keys to TopN pairs for keyed fields."""
+        if field.options.keys and pairs:
+            keys = self._row_keys(idx, field, [p.id for p in pairs])
+            for p, k in zip(pairs, keys):
+                p.key = k
+        return pairs
 
     # ----------------------------------------------------------------- Rows
 
-    def _execute_rows(self, idx: Index, call: Call, shards=None) -> list[int]:
+    def _execute_rows(self, idx: Index, call: Call, shards=None):
+        field_name = call.arg("_field") or call.arg("field")
+        field = idx.field(field_name) if field_name else None
+        ids = self._rows_ids(idx, call, shards)
+        if field is not None and field.options.keys:
+            return [k for k in self._row_keys(idx, field, ids) if k is not None]
+        return ids
+
+    def _rows_ids(self, idx: Index, call: Call, shards=None) -> list[int]:
         field_name = call.arg("_field") or call.arg("field")
         if field_name is None:
             raise PQLError("Rows requires a field")
@@ -534,7 +615,7 @@ class Executor:
         dims = []
         for child in call.children:
             fname = child.arg("_field") or child.arg("field")
-            row_ids = self._execute_rows(idx, child, shards)
+            row_ids = self._rows_ids(idx, child, shards)
             if not row_ids:
                 return []
             dims.append((fname, row_ids))
@@ -610,8 +691,7 @@ class Executor:
         col = call.arg("_col")
         if col is None:
             raise PQLError("Set requires a column")
-        if not isinstance(col, int):
-            raise PQLError("column keys require key translation (index keys)")
+        col = self._translate_col(idx, col, create=True)
         if col < 0:
             raise PQLError(f"column {col} is negative")
         field_name, row = self._row_field_and_value(call)
@@ -624,6 +704,7 @@ class Executor:
             except ValueError as e:
                 raise PQLError(str(e)) from e
         else:
+            row = self._translate_row(idx, field, row, create=True)
             _check_row(row)
             ts = call.arg("timestamp")
             timestamp = _parse_time(ts) if ts is not None else None
@@ -635,14 +716,20 @@ class Executor:
         col = call.arg("_col")
         if col is None:
             raise PQLError("Clear requires a column")
-        if not isinstance(col, int) or col < 0:
-            raise PQLError(f"invalid column {col!r}")
+        col = self._translate_col(idx, col, create=False)
+        if col is None:
+            return False  # unknown column key: nothing to clear
+        if col < 0:
+            raise PQLError(f"column {col} is negative")
         field_name, row = self._row_field_and_value(call)
         field = idx.field(field_name)
         if field is None:
             raise PQLError(f"field {field_name!r} not found")
         if field.options.type == TYPE_INT:
             return field.clear_value(col)
+        row = self._translate_row(idx, field, row, create=False)
+        if row is None:
+            return False
         _check_row(row)
         return field.clear_bit(int(row), col)
 
@@ -661,6 +748,33 @@ class Executor:
                     changed |= frag.clear_row(int(row)) > 0
         return changed
 
+    def _execute_set_row_attrs(self, idx: Index, call: Call) -> None:
+        """SetRowAttrs(field, rowID, attr=value, ...) — reference
+        executor.executeSetRowAttrs (SURVEY.md §2 #12)."""
+        field_name = call.arg("_field")
+        if field_name is None:
+            raise PQLError("SetRowAttrs requires a field")
+        field = idx.field(field_name)
+        if field is None:
+            raise PQLError(f"field {field_name!r} not found")
+        row = call.arg("_col")
+        if row is None:
+            raise PQLError("SetRowAttrs requires a row id")
+        row = self._translate_row(idx, field, row, create=True)
+        attrs = _attr_args(call)
+        # the field-name arg can collide with an attr key; the reference
+        # disambiguates by position — we've already consumed _field
+        field.row_attrs.set_attrs(int(row), attrs)
+        return None
+
+    def _execute_set_column_attrs(self, idx: Index, call: Call) -> None:
+        col = call.arg("_col")
+        if col is None:
+            raise PQLError("SetColumnAttrs requires a column id")
+        col = self._translate_col(idx, col, create=True)
+        idx.column_attrs.set_attrs(int(col), _attr_args(call))
+        return None
+
     def _execute_store(self, idx: Index, call: Call, shards=None) -> bool:
         if len(call.children) != 1:
             raise PQLError("Store requires one child call")
@@ -675,6 +789,13 @@ class Executor:
             frag = field.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
             frag.write_row_words(int(row), words)
         return True
+
+
+def _attr_args(call: Call) -> dict:
+    """Named args of an attrs call, excluding reserved/positional ones."""
+    return {
+        k: v for k, v in call.args.items() if k not in _RESERVED_ARGS
+    }
 
 
 _BITMAP_CALLS = {

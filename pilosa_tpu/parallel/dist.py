@@ -203,7 +203,7 @@ class DistExecutor(Executor):
         for i, shard in enumerate(assignment.shards):
             if host[i].any():
                 segments[shard] = host[i]
-        return RowResult(segments)
+        return self._finish_row_result(idx, call, RowResult(segments))
 
     def _execute_bsi_aggregate(self, idx, call, shards=None) -> ValCount:
         from pilosa_tpu.storage.field import TYPE_INT
@@ -323,4 +323,6 @@ class DistExecutor(Executor):
         order = sorted(
             (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
         )
-        return [Pair(r, -negc) for negc, r in order[:n]]
+        return self._finish_pairs(
+            idx, field, [Pair(r, -negc) for negc, r in order[:n]]
+        )

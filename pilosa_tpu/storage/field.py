@@ -111,6 +111,7 @@ class Field:
         self.name = name
         self.options = options or FieldOptions()
         self.views: dict[str, View] = {}
+        self.row_attrs = None  # AttrStore, opened in open()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -133,11 +134,16 @@ class Field:
                     cache_type=self.options.cache_type,
                     cache_size=self.options.cache_size,
                 ).open()
+        from pilosa_tpu.storage.attrs import AttrStore
+
+        self.row_attrs = AttrStore(os.path.join(self.path, ".rowattrs.db")).open()
         return self
 
     def close(self) -> None:
         for v in self.views.values():
             v.close()
+        if self.row_attrs is not None:
+            self.row_attrs.close()
 
     def _save_meta(self) -> None:
         with open(os.path.join(self.path, ".meta"), "w") as f:

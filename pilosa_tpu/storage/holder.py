@@ -11,16 +11,21 @@ import os
 import shutil
 
 from pilosa_tpu.storage.index import Index, _validate_name
+from pilosa_tpu.storage.translate import TranslateStore
 
 
 class Holder:
     def __init__(self, data_dir: str):
         self.data_dir = os.path.expanduser(data_dir)
         self.indexes: dict[str, Index] = {}
+        self.translate: TranslateStore | None = None
         self._open = False
 
     def open(self) -> "Holder":
         os.makedirs(self.data_dir, exist_ok=True)
+        self.translate = TranslateStore(
+            os.path.join(self.data_dir, ".translate.log")
+        ).open()
         for entry in sorted(os.listdir(self.data_dir)):
             p = os.path.join(self.data_dir, entry)
             if os.path.isdir(p) and not entry.startswith("."):
@@ -31,6 +36,8 @@ class Holder:
     def close(self) -> None:
         for idx in self.indexes.values():
             idx.close()
+        if self.translate:
+            self.translate.close()
         self._open = False
 
     def create_index(self, name: str, keys: bool = False, track_existence: bool = True) -> Index:

@@ -26,6 +26,7 @@ class Index:
         self.keys = keys
         self.track_existence = track_existence
         self.fields: dict[str, Field] = {}
+        self.column_attrs = None  # AttrStore, opened in open()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -45,11 +46,16 @@ class Index:
                 self.fields[entry] = Field(p, self.name, entry).open()
         if self.track_existence and EXISTENCE_FIELD not in self.fields:
             self.create_field(EXISTENCE_FIELD, FieldOptions(type=TYPE_SET, cache_type="none"))
+        from pilosa_tpu.storage.attrs import AttrStore
+
+        self.column_attrs = AttrStore(os.path.join(self.path, ".colattrs.db")).open()
         return self
 
     def close(self) -> None:
         for f in self.fields.values():
             f.close()
+        if self.column_attrs is not None:
+            self.column_attrs.close()
 
     def _save_meta(self) -> None:
         with open(os.path.join(self.path, ".meta"), "w") as f:

@@ -1,0 +1,149 @@
+"""Key translation: string keys ↔ sequential uint64 IDs.
+
+Reference: translate.go (SURVEY.md §2 #9) — indexes translate column keys,
+fields translate row keys; the store is an append-only log replayed on
+open, and replicas tail the primary's log (the tailing endpoint is served
+by the cluster layer at /internal/translate/data).
+
+Implementation: one log file per holder; each record is
+(namespace, key) — the assigned ID is implicit in per-namespace append
+order, which makes the log trivially replayable and the replica protocol
+"send me bytes from offset N".
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+_REC = struct.Struct("<HI")  # namespace-length, key-length
+
+
+class TranslateStore:
+    """Bidirectional key↔ID maps per namespace, backed by an append log.
+
+    Namespaces: ``c/<index>`` for column keys, ``r/<index>/<field>`` for
+    row keys (IDs in both spaces start at 0 and increment densely).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.RLock()
+        self._key_to_id: dict[str, dict[str, int]] = {}
+        self._id_to_key: dict[str, list[str]] = {}
+        self._file = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def open(self) -> "TranslateStore":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                buf = f.read()
+            pos = 0
+            while pos + _REC.size <= len(buf):
+                ns_len, key_len = _REC.unpack_from(buf, pos)
+                end = pos + _REC.size + ns_len + key_len
+                if end > len(buf):
+                    break  # torn tail
+                ns = buf[pos + _REC.size : pos + _REC.size + ns_len].decode()
+                key = buf[pos + _REC.size + ns_len : end].decode()
+                self._assign(ns, key)
+                pos = end
+        self._file = open(self.path, "ab")
+        return self
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+    # ------------------------------------------------------------ translate
+
+    def translate(self, namespace: str, keys, create: bool = False) -> list[int | None]:
+        """Keys → IDs. With create=False unknown keys map to None."""
+        out = []
+        with self._lock:
+            for key in keys:
+                ids = self._key_to_id.setdefault(namespace, {})
+                id_ = ids.get(key)
+                if id_ is None and create:
+                    id_ = self._assign(namespace, key)
+                    self._append(namespace, key)
+                out.append(id_)
+        return out
+
+    def translate_one(self, namespace: str, key: str, create: bool = False) -> int | None:
+        return self.translate(namespace, [key], create=create)[0]
+
+    def keys_of(self, namespace: str, ids) -> list[str | None]:
+        """IDs → keys (None for never-assigned IDs)."""
+        with self._lock:
+            table = self._id_to_key.get(namespace, [])
+            return [
+                table[i] if 0 <= int(i) < len(table) else None for i in ids
+            ]
+
+    # --------------------------------------------------------- replication
+
+    def log_size(self) -> int:
+        with self._lock:
+            if self._file:
+                self._file.flush()
+            return os.path.getsize(self.path) if os.path.exists(self.path) else 0
+
+    def read_log(self, offset: int) -> bytes:
+        """Raw log bytes from offset (primary side of replica tailing)."""
+        with self._lock:
+            if self._file:
+                self._file.flush()
+            with open(self.path, "rb") as f:
+                f.seek(offset)
+                return f.read()
+
+    def apply_log(self, data: bytes) -> int:
+        """Replica side: append+replay bytes received from the primary."""
+        applied = 0
+        pos = 0
+        with self._lock:
+            while pos + _REC.size <= len(data):
+                ns_len, key_len = _REC.unpack_from(data, pos)
+                end = pos + _REC.size + ns_len + key_len
+                if end > len(data):
+                    break
+                ns = data[pos + _REC.size : pos + _REC.size + ns_len].decode()
+                key = data[pos + _REC.size + ns_len : end].decode()
+                if self._key_to_id.get(ns, {}).get(key) is None:
+                    self._assign(ns, key)
+                    self._append(ns, key)
+                applied += 1
+                pos = end
+        return applied
+
+    # -------------------------------------------------------------- helpers
+
+    def _assign(self, namespace: str, key: str) -> int:
+        ids = self._key_to_id.setdefault(namespace, {})
+        if key in ids:
+            return ids[key]
+        table = self._id_to_key.setdefault(namespace, [])
+        id_ = len(table)
+        ids[key] = id_
+        table.append(key)
+        return id_
+
+    def _append(self, namespace: str, key: str) -> None:
+        if self._file is None:
+            return
+        ns_b, key_b = namespace.encode(), key.encode()
+        self._file.write(_REC.pack(len(ns_b), len(key_b)) + ns_b + key_b)
+        self._file.flush()
+
+
+def column_namespace(index: str) -> str:
+    return f"c/{index}"
+
+
+def row_namespace(index: str, field: str) -> str:
+    return f"r/{index}/{field}"

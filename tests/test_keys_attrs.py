@@ -1,0 +1,168 @@
+"""Key translation + attribute storage tests (reference translate.go /
+attr.go behavior — SURVEY.md §2 #9–10)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.executor import PQLError
+from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.storage.attrs import AttrStore
+from pilosa_tpu.storage.translate import TranslateStore
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    yield holder, Executor(holder)
+    holder.close()
+
+
+class TestTranslateStore:
+    def test_assign_and_lookup(self, tmp_path):
+        ts = TranslateStore(str(tmp_path / "t.log")).open()
+        assert ts.translate("c/i", ["a", "b", "a"], create=True) == [0, 1, 0]
+        assert ts.translate("c/i", ["b", "z"]) == [1, None]
+        assert ts.translate("r/i/f", ["a"], create=True) == [0]  # separate ns
+        assert ts.keys_of("c/i", [0, 1, 5]) == ["a", "b", None]
+        ts.close()
+
+    def test_persistence(self, tmp_path):
+        ts = TranslateStore(str(tmp_path / "t.log")).open()
+        ts.translate("c/i", ["x", "y"], create=True)
+        ts.close()
+        ts2 = TranslateStore(str(tmp_path / "t.log")).open()
+        assert ts2.translate("c/i", ["y"]) == [1]
+        assert ts2.translate("c/i", ["z"], create=True) == [2]
+        ts2.close()
+
+    def test_replication_log(self, tmp_path):
+        primary = TranslateStore(str(tmp_path / "p.log")).open()
+        replica = TranslateStore(str(tmp_path / "r.log")).open()
+        primary.translate("c/i", ["a", "b"], create=True)
+        replica.apply_log(primary.read_log(0))
+        assert replica.translate("c/i", ["a", "b"]) == [0, 1]
+        # incremental tail
+        offset = primary.log_size()
+        primary.translate("c/i", ["c"], create=True)
+        replica.apply_log(primary.read_log(offset))
+        assert replica.translate("c/i", ["c"]) == [2]
+        primary.close(); replica.close()
+
+
+class TestAttrStore:
+    def test_merge_and_null_delete(self, tmp_path):
+        s = AttrStore(str(tmp_path / "a.db")).open()
+        assert s.set_attrs(5, {"name": "x", "stars": 3}) == {"name": "x", "stars": 3}
+        assert s.set_attrs(5, {"stars": 4}) == {"name": "x", "stars": 4}
+        assert s.set_attrs(5, {"name": None}) == {"stars": 4}
+        assert s.attrs(5) == {"stars": 4}
+        assert s.attrs(99) == {}
+        s.close()
+
+    def test_blocks_diffing(self, tmp_path):
+        a = AttrStore(str(tmp_path / "a.db")).open()
+        b = AttrStore(str(tmp_path / "b.db")).open()
+        for i in (1, 2, 150):
+            a.set_attrs(i, {"v": i})
+        b.set_attrs(1, {"v": 1})
+        b.set_attrs(2, {"v": 2})
+        blocks_a, blocks_b = dict(a.blocks()), dict(b.blocks())
+        assert blocks_a[0] == blocks_b[0]  # block 0 identical
+        assert 1 in blocks_a and 1 not in blocks_b  # block 1 differs
+        b.merge_block(a.block_data(1))
+        assert dict(b.blocks()) == blocks_a
+        a.close(); b.close()
+
+
+class TestKeyedQueries:
+    def test_column_and_row_keys_end_to_end(self, env):
+        holder, ex = env
+        holder.create_index("users", keys=True).create_field(
+            "likes", FieldOptions(keys=True)
+        )
+        ex.execute("users", 'Set("alice", likes="pizza")')
+        ex.execute("users", 'Set("bob", likes="pizza")')
+        ex.execute("users", 'Set("alice", likes="sushi")')
+        (res,) = ex.execute("users", 'Row(likes="pizza")')
+        assert sorted(res.keys) == ["alice", "bob"]
+        assert res.to_json() == {"attrs": {}, "keys": res.keys}
+        (n,) = ex.execute(
+            "users", 'Count(Intersect(Row(likes="pizza"), Row(likes="sushi")))'
+        )
+        assert n == 1
+
+    def test_unknown_key_reads_empty(self, env):
+        holder, ex = env
+        holder.create_index("users", keys=True).create_field(
+            "likes", FieldOptions(keys=True)
+        )
+        ex.execute("users", 'Set("alice", likes="pizza")')
+        (res,) = ex.execute("users", 'Row(likes="nothing")')
+        assert res.columns().size == 0
+        assert ex.execute("users", 'Clear("ghost", likes="pizza")') == [False]
+
+    def test_keys_without_option_rejected(self, env):
+        holder, ex = env
+        holder.create_index("i").create_field("f")
+        with pytest.raises(PQLError):
+            ex.execute("i", 'Set("key", f=1)')
+        with pytest.raises(PQLError):
+            ex.execute("i", 'Set(1, f="key")')
+
+    def test_topn_rows_with_keys(self, env):
+        holder, ex = env
+        holder.create_index("users", keys=True).create_field(
+            "likes", FieldOptions(keys=True)
+        )
+        for who in ("a", "b", "c"):
+            ex.execute("users", f'Set("{who}", likes="pizza")')
+        ex.execute("users", 'Set("a", likes="sushi")')
+        (pairs,) = ex.execute("users", "TopN(likes, n=2)")
+        assert [(p.key, p.count) for p in pairs] == [("pizza", 3), ("sushi", 1)]
+        assert pairs[0].to_json()["key"] == "pizza"
+        (rows,) = ex.execute("users", "Rows(likes)")
+        assert rows == ["pizza", "sushi"]
+
+    def test_keys_persist(self, env, tmp_path):
+        holder, ex = env
+        holder.create_index("users", keys=True).create_field(
+            "likes", FieldOptions(keys=True)
+        )
+        ex.execute("users", 'Set("alice", likes="pizza")')
+        holder.close()
+        h2 = Holder(holder.data_dir).open()
+        ex2 = Executor(h2)
+        (res,) = ex2.execute("users", 'Row(likes="pizza")')
+        assert res.keys == ["alice"]
+        h2.close()
+
+
+class TestAttrCalls:
+    def test_set_row_attrs_and_result_attachment(self, env):
+        holder, ex = env
+        holder.create_index("repos").create_field("stargazer")
+        ex.execute("repos", "Set(10, stargazer=1)")
+        assert ex.execute(
+            "repos", 'SetRowAttrs(stargazer, 1, name="alice", active=true)'
+        ) == [None]
+        (res,) = ex.execute("repos", "Row(stargazer=1)")
+        assert res.attrs == {"name": "alice", "active": True}
+        assert res.to_json()["attrs"] == {"name": "alice", "active": True}
+
+    def test_set_column_attrs(self, env):
+        holder, ex = env
+        idx = holder.create_index("repos")
+        idx.create_field("f")
+        ex.execute("repos", 'SetColumnAttrs(7, owner="bob")')
+        assert idx.column_attrs.attrs(7) == {"owner": "bob"}
+
+    def test_row_attrs_with_keyed_field(self, env):
+        holder, ex = env
+        holder.create_index("users", keys=True).create_field(
+            "likes", FieldOptions(keys=True)
+        )
+        ex.execute("users", 'Set("a", likes="pizza")')
+        ex.execute("users", 'SetRowAttrs(likes, "pizza", cuisine="italian")')
+        (res,) = ex.execute("users", 'Row(likes="pizza")')
+        assert res.attrs == {"cuisine": "italian"}
