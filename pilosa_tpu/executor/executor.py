@@ -551,7 +551,9 @@ class Executor:
         order = sorted(
             (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
         )
-        return self._finish_pairs(idx, field, [Pair(r, -negc) for negc, r in order[:n]])
+        if n:
+            order = order[:n]
+        return self._finish_pairs(idx, field, [Pair(r, -negc) for negc, r in order])
 
     def _finish_pairs(self, idx: Index, field, pairs: list[Pair]) -> list[Pair]:
         """Attach row keys to TopN pairs for keyed fields."""

@@ -1,0 +1,137 @@
+"""Internal node-to-node HTTP client.
+
+Reference: http/client.go InternalClient (SURVEY.md §2 #17) — remote
+query, routed imports, fragment block lists / block data for anti-entropy,
+fragment data for resize, schema fetch, cluster messages. JSON bodies
+(the reference uses protobuf; this wire is host-control-plane only).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+class ClientError(Exception):
+    pass
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    # -------------------------------------------------------------- helpers
+
+    def _call(self, method: str, url: str, body: bytes | None = None,
+              content_type: str = "application/json", raw: bool = False):
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise ClientError(f"{method} {url}: HTTP {e.code}: {detail}") from e
+        except urllib.error.URLError as e:
+            raise ClientError(f"{method} {url}: {e.reason}") from e
+        return data if raw else json.loads(data or b"{}")
+
+    # ---------------------------------------------------------------- query
+
+    def query_node(self, uri: str, index: str, pql: str, shards: list[int],
+                   remote: bool = True) -> dict:
+        """One sub-query carrying an explicit shard list (reference
+        QueryRequest{Remote: true, Shards: [...]} — SURVEY.md §3.2)."""
+        qs = f"?shards={','.join(map(str, shards))}"
+        if remote:
+            qs += "&remote=true"
+        return self._call(
+            "POST", f"{uri}/index/{index}/query{qs}", pql.encode(),
+            content_type="text/plain",
+        )
+
+    # --------------------------------------------------------------- import
+
+    def import_bits(self, uri: str, index: str, field: str, rows, columns,
+                    timestamps=None, clear: bool = False) -> int:
+        payload: dict = {"rows": list(map(int, rows)),
+                         "columns": list(map(int, columns)), "clear": clear}
+        if timestamps is not None:
+            payload["timestamps"] = timestamps
+        out = self._call(
+            "POST", f"{uri}/index/{index}/field/{field}/import?remote=true",
+            json.dumps(payload).encode(),
+        )
+        return out.get("changed", 0)
+
+    def import_values(self, uri: str, index: str, field: str, columns, values,
+                      clear: bool = False) -> int:
+        out = self._call(
+            "POST", f"{uri}/index/{index}/field/{field}/import-value?remote=true",
+            json.dumps({"columns": list(map(int, columns)),
+                        "values": list(map(int, values)), "clear": clear}).encode(),
+        )
+        return out.get("changed", 0)
+
+    # ----------------------------------------------------- fragments / sync
+
+    def fragment_blocks(self, uri: str, index: str, field: str, view: str,
+                        shard: int) -> list[tuple[int, str]]:
+        out = self._call(
+            "GET",
+            f"{uri}/internal/fragment/blocks?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+        )
+        return [(b["block"], b["checksum"]) for b in out.get("blocks", [])]
+
+    def fragment_block_ids(self, uri: str, index: str, field: str, view: str,
+                           shard: int, block: int) -> list[int]:
+        out = self._call(
+            "GET",
+            f"{uri}/internal/fragment/block/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}&block={block}",
+        )
+        return out.get("ids", [])
+
+    def fragment_data(self, uri: str, index: str, field: str, view: str,
+                      shard: int) -> bytes:
+        return self._call(
+            "GET",
+            f"{uri}/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+            raw=True,
+        )
+
+    def fragment_catalog(self, uri: str, index: str) -> list[dict]:
+        out = self._call("GET", f"{uri}/internal/fragments?index={index}")
+        return out.get("fragments", [])
+
+    # ------------------------------------------------------ schema / cluster
+
+    def schema(self, uri: str) -> dict:
+        return self._call("GET", f"{uri}/internal/schema")
+
+    def send_message(self, uri: str, message: dict) -> dict:
+        return self._call(
+            "POST", f"{uri}/internal/cluster/message",
+            json.dumps(message).encode(),
+        )
+
+    def status(self, uri: str) -> dict:
+        return self._call("GET", f"{uri}/status")
+
+    def translate_keys(self, uri: str, namespace: str, keys: list[str],
+                       create: bool) -> list:
+        out = self._call(
+            "POST", f"{uri}/internal/translate/keys",
+            json.dumps({"namespace": namespace, "keys": keys,
+                        "create": create}).encode(),
+        )
+        return out.get("ids", [])
+
+    def translate_log(self, uri: str, offset: int) -> bytes:
+        return self._call(
+            "GET", f"{uri}/internal/translate/data?offset={offset}", raw=True
+        )

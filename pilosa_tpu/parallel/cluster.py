@@ -1,0 +1,321 @@
+"""Cluster topology, membership, anti-entropy, and resize.
+
+Reference: cluster.go + gossip/ + broadcast.go (SURVEY.md §2 #13–15,
+§3.5). Semantics preserved:
+
+- fixed 256 hash partitions; partition = hash(index, shard) % 256; each
+  partition maps to ``replica_n`` nodes by walking a ring ordered by node
+  id hash;
+- a coordinator (lowest node id) owns schema/translation primacy and
+  drives resize;
+- schema deltas broadcast synchronously to every node (SendSync); node
+  liveness via lightweight HTTP heartbeats instead of memberlist UDP
+  gossip (the data plane that made gossip latency-critical in the
+  reference is gone — intra-slice reduces ride ICI, and the control plane
+  tolerates HTTP);
+- anti-entropy: per replicated fragment, diff 100-row checksum blocks
+  against peers and union-merge differing blocks; attr stores diff their
+  own blocks the same way.
+
+The TPU division of labor: this layer decides which *host* owns which
+fragment files; inside a host, shards map onto the device mesh
+(pilosa_tpu.parallel.mesh) and queries reduce over ICI, so cluster fan-out
+only happens across hosts (DCN), exactly where the reference used HTTP.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.storage.view import VIEW_STANDARD
+
+PARTITION_N = 256
+
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_RESIZING = "RESIZING"
+STATE_DEGRADED = "DEGRADED"
+
+
+class Node:
+    def __init__(self, id: str, uri: str):
+        self.id = id
+        self.uri = uri.rstrip("/")
+        self.state = STATE_NORMAL
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "uri": self.uri, "state": self.state}
+
+    def __repr__(self):
+        return f"Node({self.id}, {self.uri})"
+
+
+def _hash64(data: str) -> int:
+    return int.from_bytes(hashlib.blake2b(data.encode(), digest_size=8).digest(), "big")
+
+
+class Cluster:
+    """Shard→node assignment + membership + schema broadcast."""
+
+    def __init__(self, local: Node, peers: list[Node] | None = None,
+                 replica_n: int = 1, holder=None, api=None):
+        self.local = local
+        self.nodes: dict[str, Node] = {local.id: local}
+        for p in peers or []:
+            self.nodes[p.id] = p
+        self.replica_n = replica_n
+        self.holder = holder
+        self.api = api  # set by Server after API construction
+        self.client = InternalClient()
+        self.state = STATE_NORMAL
+        self._lock = threading.RLock()
+
+    # ----------------------------------------------------------- membership
+
+    @property
+    def coordinator(self) -> Node:
+        return self.sorted_nodes()[0]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.coordinator.id == self.local.id
+
+    def sorted_nodes(self) -> list[Node]:
+        return [self.nodes[i] for i in sorted(self.nodes)]
+
+    def nodes_json(self) -> list[dict]:
+        out = []
+        for n in self.sorted_nodes():
+            d = n.to_json()
+            d["isCoordinator"] = n.id == self.coordinator.id
+            out.append(d)
+        return out
+
+    # ----------------------------------------------------------- assignment
+
+    def partition(self, index: str, shard: int) -> int:
+        return _hash64(f"{index}:{shard}") % PARTITION_N
+
+    def partition_nodes(self, partition: int) -> list[Node]:
+        """replica_n nodes for a partition: walk the ring of nodes ordered
+        by hash(node id), starting at the partition's point."""
+        ring = sorted(self.nodes.values(), key=lambda n: (_hash64(n.id), n.id))
+        if not ring:
+            return []
+        start = partition % len(ring)
+        n = min(self.replica_n, len(ring))
+        return [ring[(start + i) % len(ring)] for i in range(n)]
+
+    def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        return self.partition_nodes(self.partition(index, shard))
+
+    def owns_shard(self, index: str, shard: int) -> bool:
+        return any(n.id == self.local.id for n in self.shard_nodes(index, shard))
+
+    def primary_for_shard(self, index: str, shard: int) -> Node:
+        return self.shard_nodes(index, shard)[0]
+
+    def local_shards(self, index: str, shards: list[int]) -> list[int]:
+        return [s for s in shards if self.owns_shard(index, s)]
+
+    def shard_nodes_json(self, index: str, shard: int) -> list[dict]:
+        return [n.to_json() for n in self.shard_nodes(index, shard)]
+
+    # ------------------------------------------------------------ broadcast
+
+    def send_sync(self, message: dict) -> None:
+        """Deliver a schema delta to every peer (reference SendSync)."""
+        for node in self.sorted_nodes():
+            if node.id == self.local.id:
+                continue
+            try:
+                self.client.send_message(node.uri, message)
+            except ClientError:
+                node.state = STATE_DEGRADED
+
+    def handle_message(self, message: dict) -> dict:
+        """Apply a cluster message received from a peer (reference
+        broadcastHandler)."""
+        kind = message.get("type")
+        if kind == "create-index":
+            if self.holder.index(message["index"]) is None:
+                self.holder.create_index(
+                    message["index"],
+                    keys=message.get("keys", False),
+                    track_existence=message.get("trackExistence", True),
+                )
+        elif kind == "delete-index":
+            if self.holder.index(message["index"]) is not None:
+                self.holder.delete_index(message["index"])
+        elif kind == "create-field":
+            from pilosa_tpu.storage import FieldOptions
+
+            idx = self.holder.index(message["index"])
+            if idx is not None and idx.field(message["field"]) is None:
+                idx.create_field(
+                    message["field"], FieldOptions.from_dict(message.get("options", {}))
+                )
+        elif kind == "delete-field":
+            idx = self.holder.index(message["index"])
+            if idx is not None and idx.field(message["field"]) is not None:
+                idx.delete_field(message["field"])
+        elif kind == "forward-query":
+            # a write forwarded verbatim (attr calls); apply locally
+            if self.api is not None:
+                self.api.query(
+                    message["index"], message["pql"], remote=True
+                )
+        elif kind == "node-join":
+            node = Node(message["id"], message["uri"])
+            with self._lock:
+                self.nodes[node.id] = node
+        elif kind == "node-leave":
+            with self._lock:
+                self.nodes.pop(message["id"], None)
+        else:
+            return {"error": f"unknown message type {kind!r}"}
+        return {}
+
+    # ------------------------------------------------------------ heartbeat
+
+    def heartbeat(self) -> None:
+        """Liveness probe of peers (memberlist's role — SURVEY.md §2 #14)."""
+        for node in self.sorted_nodes():
+            if node.id == self.local.id:
+                continue
+            try:
+                self.client.status(node.uri)
+                node.state = STATE_NORMAL
+            except ClientError:
+                node.state = STATE_DEGRADED
+
+    # ----------------------------------------------------------- join/resize
+
+    def join(self, seed_uri: str) -> None:
+        """Join an existing cluster via any seed node: announce ourselves,
+        adopt the member list + schema, then fetch owned fragments
+        (reference: memberlist join + coordinator ResizeInstructions —
+        SURVEY.md §3.5)."""
+        status = self.client.status(seed_uri)
+        for n in status.get("nodes", []):
+            self.nodes[n["id"]] = Node(n["id"], n["uri"])
+        # announce to everyone (including seed)
+        for node in self.sorted_nodes():
+            if node.id == self.local.id:
+                continue
+            try:
+                self.client.send_message(
+                    node.uri,
+                    {"type": "node-join", "id": self.local.id, "uri": self.local.uri},
+                )
+            except ClientError:
+                pass
+        # adopt schema from the seed
+        schema = self.client.schema(seed_uri)
+        for idx_schema in schema.get("indexes", []):
+            self.handle_message(
+                {
+                    "type": "create-index",
+                    "index": idx_schema["name"],
+                    **idx_schema.get("options", {}),
+                }
+            )
+            for f in idx_schema.get("fields", []):
+                self.handle_message(
+                    {
+                        "type": "create-field",
+                        "index": idx_schema["name"],
+                        "field": f["name"],
+                        "options": f.get("options", {}),
+                    }
+                )
+        self.resize_fetch()
+
+    def resize_fetch(self) -> None:
+        """Fetch fragment data for every shard this node now owns but does
+        not yet have (the receiving half of a ResizeInstruction)."""
+        self.state = STATE_RESIZING
+        try:
+            for index_name, idx in list(self.holder.indexes.items()):
+                for node in self.sorted_nodes():
+                    if node.id == self.local.id:
+                        continue
+                    try:
+                        catalog = self.client.fragment_catalog(node.uri, index_name)
+                    except ClientError:
+                        continue
+                    for entry in catalog:
+                        shard = entry["shard"]
+                        if not self.owns_shard(index_name, shard):
+                            continue
+                        field = idx.field(entry["field"])
+                        if field is None:
+                            continue
+                        view = field.view(entry["view"], create=True)
+                        frag = view.fragment(shard, create=True)
+                        try:
+                            data = self.client.fragment_data(
+                                node.uri, index_name, entry["field"],
+                                entry["view"], shard,
+                            )
+                        except ClientError:
+                            continue
+                        if data:
+                            frag.import_roaring(data)
+        finally:
+            self.state = STATE_NORMAL
+
+    # --------------------------------------------------------- anti-entropy
+
+    def sync_holder(self) -> dict:
+        """One anti-entropy pass over every fragment this node replicates
+        (reference HolderSyncer.SyncHolder — SURVEY.md §3.5). Returns
+        repair counts for observability."""
+        import numpy as np
+
+        repaired = {"fragments": 0, "bits": 0, "attr_blocks": 0}
+        for index_name, idx in list(self.holder.indexes.items()):
+            for field_name, field in list(idx.fields.items()):
+                for view_name, view in list(field.views.items()):
+                    for shard, frag in list(view.fragments.items()):
+                        replicas = [
+                            n for n in self.shard_nodes(index_name, shard)
+                            if n.id != self.local.id
+                        ]
+                        if not self.owns_shard(index_name, shard):
+                            continue
+                        local_blocks = dict(frag.blocks())
+                        for node in replicas:
+                            try:
+                                peer_blocks = dict(
+                                    self.client.fragment_blocks(
+                                        node.uri, index_name, field_name,
+                                        view_name, shard,
+                                    )
+                                )
+                            except ClientError:
+                                continue
+                            for block, checksum in peer_blocks.items():
+                                if local_blocks.get(block) == checksum:
+                                    continue
+                                try:
+                                    ids = self.client.fragment_block_ids(
+                                        node.uri, index_name, field_name,
+                                        view_name, shard, block,
+                                    )
+                                except ClientError:
+                                    continue
+                                if ids:
+                                    added = frag.bitmap.add_ids(
+                                        np.asarray(ids, np.uint64)
+                                    )
+                                    if added:
+                                        frag._log_op(1, ids)  # OP_ADD
+                                        for r in {int(i) >> 20 for i in ids}:
+                                            frag._after_row_write(r)
+                                        repaired["bits"] += added
+                                        repaired["fragments"] += 1
+                            local_blocks = dict(frag.blocks())
+        return repaired

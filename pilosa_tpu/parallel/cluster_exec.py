@@ -1,0 +1,314 @@
+"""Cluster-aware executor: local mesh map + cross-node HTTP reduce.
+
+Reference: the remote branch of executor.mapReduce (SURVEY.md §3.2) —
+shards owned elsewhere are batched into ONE sub-query per node
+(``Remote=true`` + explicit shard list) and partial results are reduced on
+the requesting node: rows union, counts add, TopN pair-merge with an
+exact second pass, ValCount merge, group-merge.
+
+Local shards evaluate through the wrapped executor (DistExecutor when a
+mesh is available), so inside a host the reduce is an ICI psum and only
+the cross-host hop uses HTTP/DCN — the reference's topology with its
+data plane swapped out.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.executor import PQLError, TOPN_CANDIDATE_FACTOR
+from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
+from pilosa_tpu.ops.packing import pack_bits
+from pilosa_tpu.parallel.client import ClientError
+from pilosa_tpu.parallel.cluster import Cluster, Node
+from pilosa_tpu.pql import Call, parse
+from pilosa_tpu.pql.ast import Query
+from pilosa_tpu.shardwidth import SHARD_WIDTH, position, shard_of
+
+_WRITE_BROADCAST = {"SetRowAttrs", "SetColumnAttrs"}
+_SHARDS_TTL = 3.0
+
+
+class ClusterExecutor:
+    """Wraps a local executor with shard routing across cluster nodes."""
+
+    accepts_remote = True
+
+    def __init__(self, local_executor: Executor, cluster: Cluster):
+        self.local = local_executor
+        self.holder = local_executor.holder
+        self.cluster = cluster
+        self._shards_cache: dict[str, tuple[float, list[int]]] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ top level
+
+    def execute(self, index_name: str, query, shards=None, remote: bool = False):
+        if remote:
+            # sub-query from a peer: evaluate strictly locally on the given
+            # shards, no re-fan-out (reference Remote=true)
+            return self.local.execute(index_name, query, shards=shards)
+        if isinstance(query, str):
+            query = parse(query)
+        elif isinstance(query, Call):
+            query = Query([query])
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise PQLError(f"index {index_name!r} not found")
+        return [self._execute_call(idx, call, shards) for call in query.calls]
+
+    # -------------------------------------------------------- shard routing
+
+    def _all_shards(self, index_name: str) -> list[int]:
+        """Cluster-wide shard list: local + each live peer's, briefly cached
+        (the reference tracks max-shard via CreateShardMessage broadcasts;
+        a TTL poll keeps the control plane simpler)."""
+        with self._lock:
+            hit = self._shards_cache.get(index_name)
+            if hit and time.monotonic() - hit[0] < _SHARDS_TTL:
+                return hit[1]
+        shards = set(self.holder.index(index_name).available_shards())
+        for node in self.cluster.sorted_nodes():
+            if node.id == self.cluster.local.id:
+                continue
+            try:
+                out = self.cluster.client._call(
+                    "GET", f"{node.uri}/internal/shards/list?index={index_name}"
+                )
+                shards.update(out.get("shards", []))
+            except ClientError:
+                pass
+        result = sorted(shards)
+        with self._lock:
+            self._shards_cache[index_name] = (time.monotonic(), result)
+        return result
+
+    def _route(self, index_name: str, shards: list[int]):
+        """Group shards by executing node (primary live replica; self
+        preferred when we are any replica)."""
+        local: list[int] = []
+        remote: dict[str, tuple[Node, list[int]]] = {}
+        for shard in shards:
+            nodes = self.cluster.shard_nodes(index_name, shard)
+            if any(n.id == self.cluster.local.id for n in nodes):
+                local.append(shard)
+                continue
+            live = [n for n in nodes if n.state == "NORMAL"] or nodes
+            target = live[0]
+            remote.setdefault(target.id, (target, []))[1].append(shard)
+        return local, list(remote.values())
+
+    def _map_remote(self, index_name: str, call: Call, groups):
+        """One sub-query per remote node; returns raw JSON partials."""
+        partials = []
+        for node, shard_group in groups:
+            out = self.cluster.client.query_node(
+                node.uri, index_name, call.to_pql(), shard_group, remote=True
+            )
+            partials.append(out["results"][0])
+        return partials
+
+    # ----------------------------------------------------------- dispatch
+
+    def _execute_call(self, idx, call: Call, shards=None):
+        name = call.name
+        if name in ("Set", "Clear"):
+            return self._execute_routed_write(idx, call)
+        if name in _WRITE_BROADCAST:
+            res = self.local._execute_call(idx, call)
+            self.cluster.send_sync(
+                {"type": "forward-query", "index": idx.name, "pql": call.to_pql()}
+            )
+            return res
+        if name in ("Store", "ClearRow"):
+            # row-wide writes execute on every shard owner
+            shard_list = shards if shards is not None else self._all_shards(idx.name)
+            local, groups = self._route(idx.name, shard_list)
+            result = self.local._execute_call(idx, call, local) if local else False
+            for node, shard_group in groups:
+                out = self.cluster.client.query_node(
+                    node.uri, idx.name, call.to_pql(), shard_group, remote=True
+                )
+                result = result or out["results"][0]
+            return result
+
+        shard_list = shards if shards is not None else self._all_shards(idx.name)
+        local, groups = self._route(idx.name, shard_list)
+
+        if name == "TopN":
+            return self._execute_topn(idx, call, local, groups)
+        if name == "IncludesColumn":
+            return self._execute_includes(idx, call)
+
+        # Rows/GroupBy: limits must apply AFTER the cross-node merge, so
+        # strip them from the mapped call and re-apply in _reduce
+        mapped = call
+        if name in ("Rows", "GroupBy") and call.arg("limit"):
+            mapped = Call(
+                name,
+                {k: v for k, v in call.args.items() if k != "limit"},
+                call.children,
+            )
+        partials = self._map_remote(idx.name, mapped, groups) if groups else []
+        local_res = self.local._execute_call(idx, mapped, local)
+        return self._reduce(idx, call, local_res, partials)
+
+    # --------------------------------------------------------------- writes
+
+    def _execute_routed_write(self, idx, call: Call):
+        col = call.arg("_col")
+        if isinstance(col, str):
+            # keyed writes translate on the coordinator; after translation
+            # the call routes by the numeric column
+            col = self._translate_col_cluster(idx, col, create=call.name == "Set")
+            if col is None:
+                return False
+            call = Call(call.name, {**call.args, "_col": col}, call.children)
+        if col is None:
+            raise PQLError(f"{call.name} requires a column")
+        shard = shard_of(int(col))
+        owners = self.cluster.shard_nodes(idx.name, shard)
+        result = False
+        for node in owners:
+            if node.id == self.cluster.local.id:
+                result = bool(self.local._execute_call(idx, call)) or result
+            else:
+                try:
+                    out = self.cluster.client.query_node(
+                        node.uri, idx.name, call.to_pql(), [shard], remote=True
+                    )
+                    result = bool(out["results"][0]) or result
+                except ClientError:
+                    node.state = "DEGRADED"
+        return result
+
+    def _translate_col_cluster(self, idx, col: str, create: bool):
+        from pilosa_tpu.storage.translate import column_namespace
+
+        coord = self.cluster.coordinator
+        if coord.id == self.cluster.local.id:
+            return self.local._translate_col(idx, col, create=create)
+        ids = self.cluster.client.translate_keys(
+            coord.uri, column_namespace(idx.name), [col], create
+        )
+        return ids[0] if ids else None
+
+    # --------------------------------------------------------------- reduce
+
+    def _reduce(self, idx, call: Call, local_res, partials):
+        name = call.name
+        if name == "Count":
+            return int(local_res) + sum(int(p) for p in partials)
+        if name in ("Sum",):
+            total, count = local_res.value, local_res.count
+            for p in partials:
+                total += p["value"]
+                count += p["count"]
+            return ValCount(total, count)
+        if name in ("Min", "Max"):
+            want_max = name == "Max"
+            best, count = (local_res.value, local_res.count) if local_res.count else (None, 0)
+            for p in partials:
+                if p["count"] == 0:
+                    continue
+                v = p["value"]
+                if best is None or (v > best if want_max else v < best):
+                    best, count = v, p["count"]
+                elif v == best:
+                    count += p["count"]
+            return ValCount(best or 0, count)
+        if name == "Rows":
+            merged = set(local_res)
+            for p in partials:
+                merged.update(p)
+            out = sorted(merged)
+            limit = call.arg("limit", 0)
+            return out[: int(limit)] if limit else out
+        if name == "GroupBy":
+            counts: dict[tuple, int] = {}
+            fields: dict[tuple, list] = {}
+            for g in local_res:
+                key = tuple(e["rowID"] for e in g.group)
+                counts[key] = counts.get(key, 0) + g.count
+                fields[key] = g.group
+            for p in partials:
+                for g in p:
+                    key = tuple(e["rowID"] for e in g["group"])
+                    counts[key] = counts.get(key, 0) + g["count"]
+                    fields[key] = g["group"]
+            out = [GroupCount(fields[k], c) for k, c in sorted(counts.items())]
+            limit = call.arg("limit", 0)
+            return out[: int(limit)] if limit else out
+        # bitmap calls → RowResult union
+        if isinstance(local_res, RowResult):
+            merged = local_res
+            for p in partials:
+                merged = merged.merge(_row_from_json(p))
+            if idx.keys:
+                merged.keys = sorted(
+                    set(merged.keys or [])
+                    | {k for p in partials for k in p.get("keys", [])}
+                )
+            return merged
+        return local_res
+
+    # ----------------------------------------------------------------- TopN
+
+    def _execute_topn(self, idx, call: Call, local, groups):
+        n = call.arg("n", 10)
+        explicit_ids = call.arg("ids")
+        if explicit_ids is None:
+            # phase 1: overfetched candidates from every node
+            overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
+            phase1 = Call("TopN", {**call.args, "n": overfetch}, call.children)
+            candidates: set[int] = set()
+            local_pairs = self.local._execute_call(idx, phase1, local)
+            candidates.update(p.id for p in local_pairs)
+            for p in self._map_remote(idx.name, phase1, groups):
+                candidates.update(pair["id"] for pair in p)
+            if not candidates:
+                return []
+            ids = sorted(candidates)
+        else:
+            ids = sorted(int(i) for i in explicit_ids)
+        # phase 2: exact recount of the merged candidate set everywhere
+        phase2 = Call("TopN", {**call.args, "ids": ids, "n": 0}, call.children)
+        totals: dict[int, int] = {}
+        for p in self.local._execute_call(idx, phase2, local):
+            totals[p.id] = totals.get(p.id, 0) + p.count
+        for partial in self._map_remote(idx.name, phase2, groups):
+            for pair in partial:
+                totals[pair["id"]] = totals.get(pair["id"], 0) + pair["count"]
+        order = sorted((-c, r) for r, c in totals.items() if c > 0)
+        pairs = [Pair(r, -negc) for negc, r in order[: n or len(order)]]
+        field = idx.field(call.arg("_field") or call.arg("field"))
+        return self.local._finish_pairs(idx, field, pairs)
+
+    def _execute_includes(self, idx, call: Call):
+        col = call.arg("column")
+        if col is None:
+            raise PQLError("IncludesColumn requires column=")
+        shard = shard_of(int(col))
+        if self.cluster.owns_shard(idx.name, shard):
+            return self.local._execute_call(idx, call)
+        node = self.cluster.primary_for_shard(idx.name, shard)
+        out = self.cluster.client.query_node(
+            node.uri, idx.name, call.to_pql(), [shard], remote=True
+        )
+        return out["results"][0]
+
+
+def _row_from_json(p: dict) -> RowResult:
+    """Rebuild a RowResult from a peer's JSON columns."""
+    cols = np.asarray(p.get("columns", []), np.uint64)
+    segments: dict[int, np.ndarray] = {}
+    if cols.size:
+        shards = (cols >> np.uint64(20)).astype(np.int64)
+        for shard in np.unique(shards).tolist():
+            pos = cols[shards == shard] & np.uint64(SHARD_WIDTH - 1)
+            segments[int(shard)] = pack_bits(pos, SHARD_WIDTH)
+    return RowResult(segments, attrs=p.get("attrs") or {})

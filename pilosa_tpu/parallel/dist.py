@@ -323,6 +323,8 @@ class DistExecutor(Executor):
         order = sorted(
             (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
         )
+        if n:
+            order = order[:n]
         return self._finish_pairs(
-            idx, field, [Pair(r, -negc) for negc, r in order[:n]]
+            idx, field, [Pair(r, -negc) for negc, r in order]
         )

@@ -50,6 +50,25 @@ class Call:
         parts += [f"{k}={v!r}" for k, v in self.args.items()]
         return f"{self.name}({', '.join(parts)})"
 
+    def to_pql(self) -> str:
+        """Serialize back to PQL text (used to forward sub-queries to other
+        nodes — the reference ships the protobuf AST; PQL text is our
+        canonical wire form)."""
+        parts = [c.to_pql() for c in self.children]
+        for k, v in self.args.items():
+            if k == "_field":
+                parts.append(str(v))
+            elif k == "_col":
+                parts.append(_value_to_pql(v))
+            elif isinstance(v, Condition):
+                if v.op == "><":
+                    parts.append(f"{k} >< {_value_to_pql(v.value)}")
+                else:
+                    parts.append(f"{k} {v.op} {_value_to_pql(v.value)}")
+            else:
+                parts.append(f"{k}={_value_to_pql(v)}")
+        return f"{self.name}({', '.join(parts)})"
+
     def __eq__(self, other):
         return (
             isinstance(other, Call)
@@ -57,6 +76,21 @@ class Call:
             and self.args == other.args
             and self.children == other.children
         )
+
+
+def _value_to_pql(v) -> str:
+    if isinstance(v, Call):
+        return v.to_pql()
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, str):
+        escaped = v.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{escaped}"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_value_to_pql(x) for x in v) + "]"
+    return str(v)
 
 
 class Query:

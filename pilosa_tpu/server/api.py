@@ -36,12 +36,15 @@ class API:
 
     # ---------------------------------------------------------------- query
 
-    def query(self, index: str, pql: str, shards=None) -> dict:
+    def query(self, index: str, pql: str, shards=None, remote: bool = False) -> dict:
         from pilosa_tpu.executor.executor import PQLError
         from pilosa_tpu.pql import ParseError
 
         try:
-            results = self.executor.execute(index, pql, shards=shards)
+            kwargs = {"shards": shards}
+            if getattr(self.executor, "accepts_remote", False):
+                kwargs["remote"] = remote
+            results = self.executor.execute(index, pql, **kwargs)
         except (ParseError, PQLError) as e:
             raise ApiError(str(e)) from e
         return {"results": [result_to_json(r) for r in results]}
@@ -57,13 +60,20 @@ class API:
         except ValueError as e:
             status = 409 if "already exists" in str(e) else 400
             raise ApiError(str(e), status) from e
+        self._broadcast({"type": "create-index", "index": name, "keys": keys,
+                         "trackExistence": track_existence})
         return idx.schema()
+
+    def _broadcast(self, message: dict) -> None:
+        if self.cluster is not None:
+            self.cluster.send_sync(message)
 
     def delete_index(self, name: str) -> None:
         try:
             self.holder.delete_index(name)
         except KeyError as e:
             raise ApiError(str(e), 404) from e
+        self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index: str, name: str, options: dict | None = None) -> dict:
         idx = self._index(index)
@@ -73,6 +83,8 @@ class API:
         except ValueError as e:
             status = 409 if "already exists" in str(e) else 400
             raise ApiError(str(e), status) from e
+        self._broadcast({"type": "create-field", "index": index, "field": name,
+                         "options": field.options.to_dict()})
         return {"name": field.name, "options": field.options.to_dict()}
 
     def delete_field(self, index: str, name: str) -> None:
@@ -81,6 +93,7 @@ class API:
             idx.delete_field(name)
         except KeyError as e:
             raise ApiError(str(e), 404) from e
+        self._broadcast({"type": "delete-field", "index": index, "field": name})
 
     def schema(self) -> dict:
         return {"indexes": self.holder.schema()}
@@ -88,11 +101,17 @@ class API:
     # --------------------------------------------------------------- import
 
     def import_bits(self, index: str, field: str, rows, columns,
-                    timestamps=None, clear: bool = False) -> int:
+                    timestamps=None, clear: bool = False,
+                    remote: bool = False) -> int:
         """Bulk bit import (reference api.Import / fragment.bulkImport):
-        batches are grouped by shard and written fragment-wise."""
+        batches are grouped by shard and written fragment-wise; in a
+        cluster, each shard group is routed to every replica owner."""
         idx = self._index(index)
         fld = self._field(idx, field)
+        if not remote and self.cluster is not None and len(self.cluster.nodes) > 1:
+            return self._route_import(
+                index, field, rows, columns, timestamps, clear, values=None
+            )
         rows_i = np.asarray(rows, dtype=np.int64)
         columns_i = np.asarray(columns, dtype=np.int64)
         if rows_i.size and (rows_i.min() < 0 or columns_i.min() < 0):
@@ -138,10 +157,67 @@ class API:
             idx.mark_columns_exist(columns.tolist())
         return int(changed)
 
+    def _route_import(self, index, field, rows, columns, timestamps, clear,
+                      values=None) -> int:
+        """Split an import batch by shard owner and fan out (reference
+        api.Import routing — SURVEY.md §3.3). Local portions apply with
+        remote=True to stop recursion."""
+        import numpy as np
+
+        columns_arr = np.asarray(columns, dtype=np.int64)
+        shards = columns_arr >> SHARD_WIDTH_EXP
+        changed = 0
+        local_mask = np.zeros(columns_arr.size, bool)
+        remote_batches: dict[str, tuple[object, list[int]]] = {}
+        for shard in np.unique(shards).tolist():
+            owners = self.cluster.shard_nodes(index, int(shard))
+            sel = np.nonzero(shards == shard)[0]
+            for node in owners:
+                if node.id == self.cluster.local.id:
+                    local_mask[sel] = True
+                else:
+                    remote_batches.setdefault(node.id, (node, []))[1].extend(
+                        sel.tolist()
+                    )
+        pick = lambda seq, idxs: [seq[i] for i in idxs]
+        if values is None:
+            if local_mask.any():
+                li = np.nonzero(local_mask)[0].tolist()
+                changed += self.import_bits(
+                    index, field, pick(list(rows), li), pick(list(columns), li),
+                    timestamps=pick(list(timestamps), li) if timestamps else None,
+                    clear=clear, remote=True,
+                )
+            for node, idxs in remote_batches.values():
+                changed += self.cluster.client.import_bits(
+                    node.uri, index, field,
+                    pick(list(rows), idxs), pick(list(columns), idxs),
+                    timestamps=pick(list(timestamps), idxs) if timestamps else None,
+                    clear=clear,
+                )
+        else:
+            if local_mask.any():
+                li = np.nonzero(local_mask)[0].tolist()
+                changed += self.import_values(
+                    index, field, pick(list(columns), li), pick(list(values), li),
+                    clear=clear, remote=True,
+                )
+            for node, idxs in remote_batches.values():
+                changed += self.cluster.client.import_values(
+                    node.uri, index, field,
+                    pick(list(columns), idxs), pick(list(values), idxs),
+                    clear=clear,
+                )
+        return changed
+
     def import_values(self, index: str, field: str, columns, values,
-                      clear: bool = False) -> int:
+                      clear: bool = False, remote: bool = False) -> int:
         idx = self._index(index)
         fld = self._field(idx, field)
+        if not remote and self.cluster is not None and len(self.cluster.nodes) > 1:
+            return self._route_import(
+                index, field, None, columns, None, clear, values=values
+            )
         if fld.options.type != TYPE_INT:
             raise ApiError(f"field {field!r} is not an int field")
         if len(columns) != len(values):
@@ -199,11 +275,18 @@ class API:
     # ---------------------------------------------------------------- info
 
     def status(self) -> dict:
-        nodes = self.cluster.nodes_json() if self.cluster else [
-            {"id": "local", "uri": "localhost", "isCoordinator": True,
-             "state": "READY"}
-        ]
-        return {"state": "NORMAL", "nodes": nodes, "localID": nodes[0]["id"]}
+        if self.cluster is not None:
+            return {
+                "state": self.cluster.state,
+                "nodes": self.cluster.nodes_json(),
+                "localID": self.cluster.local.id,
+            }
+        return {
+            "state": "NORMAL",
+            "nodes": [{"id": "local", "uri": "localhost", "isCoordinator": True,
+                       "state": "NORMAL"}],
+            "localID": "local",
+        }
 
     def info(self) -> dict:
         import jax

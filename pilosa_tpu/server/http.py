@@ -49,9 +49,14 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/export$"), "get_export"),
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
+    ("GET", re.compile(r"^/internal/shards/list$"), "get_shards_list"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
+    ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
     ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
+    ("GET", re.compile(r"^/internal/fragments$"), "get_fragments_catalog"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
+    ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
+    ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
 ]
 
@@ -127,7 +132,8 @@ class HTTPHandler(BaseHTTPRequestHandler):
         shards = None
         if query and "shards" in query:
             shards = [_int_param(s, "shards") for s in query["shards"][0].split(",")]
-        self._json(self.api.query(index, body, shards=shards))
+        remote = bool(query and query.get("remote", ["false"])[0] == "true")
+        self._json(self.api.query(index, body, shards=shards, remote=remote))
 
     def post_index(self, index, query=None):
         body = self._json_body()
@@ -158,19 +164,23 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
     def post_import(self, index, field, query=None):
         body = self._json_body()
+        remote = bool(query and query.get("remote", ["false"])[0] == "true")
         changed = self.api.import_bits(
             index, field,
             body.get("rows", []), body.get("columns", []),
             timestamps=body.get("timestamps"),
             clear=bool(body.get("clear", False)),
+            remote=remote,
         )
         self._json({"changed": changed})
 
     def post_import_value(self, index, field, query=None):
         body = self._json_body()
+        remote = bool(query and query.get("remote", ["false"])[0] == "true")
         changed = self.api.import_values(
             index, field, body.get("columns", []), body.get("values", []),
             clear=bool(body.get("clear", False)),
+            remote=remote,
         )
         self._json({"changed": changed})
 
@@ -229,6 +239,53 @@ class HTTPHandler(BaseHTTPRequestHandler):
         v = fld.view(view)
         frag = v.fragment(shard) if v else None
         data = serialize(frag.bitmap) if frag else b""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def get_shards_list(self, query=None):
+        index = (query.get("index") or [""])[0]
+        idx = self.api._index(index)
+        self._json({"shards": idx.available_shards()})
+
+    def get_fragment_block_data(self, query=None):
+        index = (query.get("index") or [""])[0]
+        field = (query.get("field") or [""])[0]
+        view = (query.get("view") or ["standard"])[0]
+        shard = _int_param((query.get("shard") or ["0"])[0], "shard")
+        block = _int_param((query.get("block") or ["0"])[0], "block")
+        idx = self.api._index(index)
+        fld = self.api._field(idx, field)
+        v = fld.view(view)
+        frag = v.fragment(shard) if v else None
+        ids = frag.block_ids(block).tolist() if frag else []
+        self._json({"ids": [int(i) for i in ids]})
+
+    def get_fragments_catalog(self, query=None):
+        """Every (field, view, shard) fragment of an index — drives resize
+        fetches and anti-entropy enumeration."""
+        index = (query.get("index") or [""])[0]
+        idx = self.api._index(index)
+        out = []
+        for fname, fld in sorted(idx.fields.items()):
+            for vname, view in sorted(fld.views.items()):
+                for shard in sorted(view.fragments):
+                    out.append({"field": fname, "view": vname, "shard": shard})
+        self._json({"fragments": out})
+
+    def post_translate_keys(self, query=None):
+        body = self._json_body()
+        ids = self.api.holder.translate.translate(
+            body.get("namespace", ""), body.get("keys", []),
+            create=bool(body.get("create", False)),
+        )
+        self._json({"ids": ids})
+
+    def get_translate_data(self, query=None):
+        offset = _int_param((query.get("offset") or ["0"])[0], "offset")
+        data = self.api.holder.translate.read_log(offset)
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(data)))

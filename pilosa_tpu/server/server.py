@@ -27,6 +27,11 @@ class ServerConfig:
         replica_n: int = 1,
         verbose: bool = False,
         device_budget_bytes: int | None = None,
+        name: str = "",
+        advertise: str = "",
+        seeds: list[str] | None = None,
+        heartbeat_interval: float = 5.0,
+        use_mesh: bool | None = None,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -35,6 +40,11 @@ class ServerConfig:
         self.replica_n = replica_n
         self.verbose = verbose
         self.device_budget_bytes = device_budget_bytes
+        self.name = name
+        self.advertise = advertise
+        self.seeds = seeds or []
+        self.heartbeat_interval = heartbeat_interval
+        self.use_mesh = use_mesh  # None = auto (mesh when >1 device)
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServerConfig":
@@ -47,6 +57,10 @@ class ServerConfig:
             ),
             replica_n=int(d.get("replica-n", d.get("replica_n", 1))),
             verbose=_parse_bool(d.get("verbose", False)),
+            name=d.get("name", ""),
+            advertise=d.get("advertise", ""),
+            seeds=_parse_list(d.get("seeds", d.get("gossip-seeds", []))),
+            heartbeat_interval=float(d.get("heartbeat-interval", 5.0)),
         )
 
     def to_dict(self) -> dict:
@@ -67,6 +81,12 @@ def _parse_bool(value) -> bool:
     return bool(value)
 
 
+def _parse_list(value) -> list[str]:
+    if isinstance(value, str):
+        return [v.strip() for v in value.split(",") if v.strip()]
+    return list(value)
+
+
 class Server:
     def __init__(self, config: ServerConfig | None = None):
         self.config = config or ServerConfig()
@@ -76,6 +96,7 @@ class Server:
         self._http = None
         self._http_thread = None
         self._anti_entropy_timer: threading.Timer | None = None
+        self._heartbeat_timer: threading.Timer | None = None
         self._closed = threading.Event()
 
     @property
@@ -95,17 +116,57 @@ class Server:
             target=self._http.serve_forever, daemon=True
         )
         self._http_thread.start()
+        self._wire_cluster()
         self.logger.info(
-            "listening on http://%s:%d (data-dir %s)",
+            "listening on http://%s:%d (data-dir %s, node %s)",
             self.config.bind, self.port, self.holder.data_dir,
+            self.api.cluster.local.id,
         )
         self._schedule_anti_entropy()
+        self._schedule_heartbeat()
         return self
+
+    def _wire_cluster(self) -> None:
+        """Build the cluster + executor stack: local mesh executor wrapped
+        by the cluster router (reference server.go composition)."""
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.parallel.cluster import Cluster, Node
+        from pilosa_tpu.parallel.cluster_exec import ClusterExecutor
+
+        name = self.config.name or f"node-{self.port}"
+        uri = self.config.advertise or f"http://{self.config.bind}:{self.port}"
+        cluster = Cluster(
+            Node(name, uri), replica_n=self.config.replica_n, holder=self.holder,
+        )
+        cluster.api = self.api
+        self.api.cluster = cluster
+
+        use_mesh = self.config.use_mesh
+        if use_mesh is None:
+            import jax
+
+            use_mesh = len(jax.devices()) > 1
+        if use_mesh:
+            from pilosa_tpu.parallel.dist import DistExecutor
+
+            local = DistExecutor(self.holder)
+        else:
+            local = Executor(self.holder)
+        self.api.executor = ClusterExecutor(local, cluster)
+
+        for seed in self.config.seeds:
+            try:
+                cluster.join(seed)
+                break
+            except Exception as e:
+                self.logger.warning("join via %s failed: %s", seed, e)
 
     def close(self) -> None:
         self._closed.set()
         if self._anti_entropy_timer is not None:
             self._anti_entropy_timer.cancel()
+        if self._heartbeat_timer is not None:
+            self._heartbeat_timer.cancel()
         if self._http:
             self._http.shutdown()
             self._http.server_close()
@@ -129,6 +190,26 @@ class Server:
         timer.daemon = True
         timer.start()
         self._anti_entropy_timer = timer
+
+    def _schedule_heartbeat(self) -> None:
+        interval = self.config.heartbeat_interval
+        if interval <= 0:
+            return
+
+        def tick():
+            if self._closed.is_set():
+                return
+            try:
+                if self.api.cluster is not None and len(self.api.cluster.nodes) > 1:
+                    self.api.cluster.heartbeat()
+            except Exception as e:
+                self.logger.warning("heartbeat failed: %s", e)
+            self._schedule_heartbeat()
+
+        timer = threading.Timer(interval, tick)
+        timer.daemon = True
+        timer.start()
+        self._heartbeat_timer = timer
 
     def run_anti_entropy(self) -> None:
         """Replica repair pass (reference monitorAntiEntropy →
