@@ -157,6 +157,9 @@ class Executor:
     # ------------------------------------------------------------ top level
 
     def execute(self, index_name: str, query, shards=None):
+        from pilosa_tpu.utils.stats import global_stats
+        from pilosa_tpu.utils.tracing import global_tracer
+
         idx = self.holder.index(index_name)
         if idx is None:
             raise PQLError(f"index {index_name!r} not found")
@@ -164,7 +167,16 @@ class Executor:
             query = parse(query)
         elif isinstance(query, Call):
             query = Query([query])
-        return [self._execute_call(idx, call, shards) for call in query.calls]
+        stats = global_stats()
+        out = []
+        with global_tracer().span("executor.Execute", index=index_name):
+            for call in query.calls:
+                with global_tracer().span(f"execute{call.name}"), stats.timer(
+                    "query", {"call": call.name}
+                ):
+                    out.append(self._execute_call(idx, call, shards))
+                stats.count("queries", 1, {"call": call.name})
+        return out
 
     def _execute_call(self, idx: Index, call: Call, shards=None):
         name = call.name

@@ -1,0 +1,36 @@
+"""Build the fastbits native library (g++, no external deps)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "fastbits.cpp")
+LIB = os.path.join(_DIR, "libfastbits.so")
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the library if needed; returns the .so path or None when no
+    toolchain is available (callers fall back to numpy)."""
+    if not force and os.path.exists(LIB) and (
+        os.path.getmtime(LIB) >= os.path.getmtime(SRC)
+    ):
+        return LIB
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None:
+        return None
+    tmp = LIB + ".tmp"
+    cmd = [gxx, "-O3", "-fPIC", "-shared", "-o", tmp, SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+        return None
+    os.replace(tmp, LIB)
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(path or "build failed / no compiler")

@@ -1,0 +1,81 @@
+// Native host-path kernels: bit pack/unpack/popcount.
+//
+// Role in the architecture: the TPU executes all query math
+// (pilosa_tpu/ops via XLA); the *host* feeds it — decoding roaring
+// containers into dense bit-packed rows for device_put, packing result
+// bitmaps, and counting during imports. Those feeds are python/numpy hot
+// spots (np.bitwise_or.at is an order of magnitude off peak), so they get
+// a small C++ library. This mirrors the division of labor the driver
+// expects: XLA for compute, native code for the runtime around it. The
+// reference itself is pure Go (SURVEY.md §2.2); its equivalents are
+// roaring.go's container codecs.
+//
+// Build: see build.py (g++ -O3 -shared). ABI: plain C, loaded via ctypes.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Set bits at `positions[0..n)` in a zeroed word vector of `n_words`
+// uint32 words. Positions beyond the vector are ignored (caller checks).
+void pack_positions(const uint64_t* positions, int64_t n,
+                    uint32_t* words, int64_t n_words) {
+    const uint64_t limit = static_cast<uint64_t>(n_words) * 32u;
+    for (int64_t i = 0; i < n; ++i) {
+        const uint64_t p = positions[i];
+        if (p < limit) {
+            words[p >> 5] |= (1u << (p & 31u));
+        }
+    }
+}
+
+// Extract sorted bit positions (+offset) from a word vector.
+// Returns the number written; writes at most `cap` entries.
+int64_t unpack_positions(const uint32_t* words, int64_t n_words,
+                         uint64_t offset, uint64_t* out, int64_t cap) {
+    int64_t written = 0;
+    for (int64_t w = 0; w < n_words; ++w) {
+        uint32_t v = words[w];
+        const uint64_t base = offset + (static_cast<uint64_t>(w) << 5);
+        while (v != 0 && written < cap) {
+            const int bit = __builtin_ctz(v);
+            out[written++] = base + static_cast<uint64_t>(bit);
+            v &= v - 1;
+        }
+        if (written >= cap && v != 0) return written;  // caller re-sizes
+    }
+    return written;
+}
+
+// Total set bits in a word vector.
+uint64_t popcount_words(const uint32_t* words, int64_t n_words) {
+    uint64_t total = 0;
+    int64_t i = 0;
+    // bulk as uint64 for throughput
+    const int64_t pairs = n_words / 2;
+    const uint64_t* w64 = reinterpret_cast<const uint64_t*>(words);
+    for (int64_t j = 0; j < pairs; ++j) total += __builtin_popcountll(w64[j]);
+    for (i = pairs * 2; i < n_words; ++i) total += __builtin_popcount(words[i]);
+    return total;
+}
+
+// OR src into dst (n_words each) — fragment row union on host.
+void or_words(uint32_t* dst, const uint32_t* src, int64_t n_words) {
+    for (int64_t i = 0; i < n_words; ++i) dst[i] |= src[i];
+}
+
+// Expand run intervals [start,last] (inclusive, uint16 pairs) into a
+// 2048-word (65536-bit) container block.
+void runs_to_words(const uint16_t* runs, int64_t n_runs, uint32_t* words) {
+    for (int64_t i = 0; i < n_runs; ++i) {
+        uint32_t start = runs[2 * i];
+        uint32_t last = runs[2 * i + 1];
+        for (uint32_t b = start; b <= last; ++b) {
+            words[b >> 5] |= (1u << (b & 31u));
+            if (b == 65535u) break;  // avoid wrap
+        }
+    }
+}
+
+}  // extern "C"

@@ -17,8 +17,9 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 def pack_bits(bit_positions, n_bits: int = SHARD_WIDTH) -> np.ndarray:
     """Pack sorted (or unsorted) bit positions into a uint32 word vector.
 
-    Equivalent of building a roaring bitmap from an id list
-    (reference roaring.Bitmap Add / NewBitmap(ids...)).
+    Equivalent of building a roaring bitmap from an id list (reference
+    roaring.Bitmap Add / NewBitmap(ids...)). Uses the fastbits C++ library
+    when available (pilosa_tpu.native), numpy otherwise.
     """
     n_words = (n_bits + 31) // 32
     bit_positions = np.asarray(bit_positions, dtype=np.uint64)
@@ -28,6 +29,11 @@ def pack_bits(bit_positions, n_bits: int = SHARD_WIDTH) -> np.ndarray:
         raise ValueError(
             f"bit position {bit_positions.max()} out of range for {n_bits} bits"
         )
+    from pilosa_tpu import native
+
+    fast = native.pack_positions(bit_positions, n_words)
+    if fast is not None:
+        return fast
     bytes_ = np.zeros(n_words * 4, dtype=np.uint8)
     byte_idx = (bit_positions >> np.uint64(3)).astype(np.int64)
     bit_in_byte = (bit_positions & np.uint64(7)).astype(np.uint8)
@@ -42,6 +48,11 @@ def unpack_bits(words: np.ndarray, offset: int = 0) -> np.ndarray:
     equivalent of the reference's roaring OffsetRange used when a shard's
     rowSegment is materialized to absolute columns (row.go Columns()).
     """
+    from pilosa_tpu import native
+
+    fast = native.unpack_positions(np.asarray(words), offset)
+    if fast is not None:
+        return fast
     words = np.ascontiguousarray(words, dtype=np.uint32)
     bits = np.unpackbits(words.view(np.uint8), bitorder="little")
     return np.nonzero(bits)[0].astype(np.uint64) + np.uint64(offset)
@@ -53,6 +64,11 @@ def pack_shard_row(column_positions) -> np.ndarray:
 
 
 def popcount_words(words: np.ndarray) -> int:
-    """Host popcount oracle (numpy)."""
+    """Host popcount (native when available, numpy otherwise)."""
+    from pilosa_tpu import native
+
+    fast = native.popcount_words(np.asarray(words))
+    if fast is not None:
+        return fast
     words = np.ascontiguousarray(words, dtype=np.uint32)
     return int(np.unpackbits(words.view(np.uint8)).sum())

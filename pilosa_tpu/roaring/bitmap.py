@@ -79,9 +79,20 @@ class Container:
         return (out + np.arange(total)).astype(np.uint16)
 
     def dense_words32(self) -> np.ndarray:
-        """Container as 2048 uint32 words (65536 bits) — device format block."""
+        """Container as 2048 uint32 words (65536 bits) — device format block.
+        Host→device decode hot path: native fastbits when available."""
         if self.kind == BITMAP:
             return np.ascontiguousarray(self.data).view("<u4").copy()
+        from pilosa_tpu import native
+
+        if self.kind == RUN:
+            fast = native.runs_to_words(self.data)
+            if fast is not None:
+                return fast
+        else:
+            fast = native.pack_positions(self.data.astype(np.uint64), 2048)
+            if fast is not None:
+                return fast
         lows = self.lows()
         words = np.zeros(2048 * 4, np.uint8)
         if lows.size:

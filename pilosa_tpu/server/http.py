@@ -58,6 +58,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
+    ("GET", re.compile(r"^/debug/traces$"), "get_traces"),
+    ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
 ]
 
 
@@ -204,6 +206,17 @@ class HTTPHandler(BaseHTTPRequestHandler):
         from pilosa_tpu.utils.stats import global_stats
 
         self._text(global_stats().prometheus_text(), "text/plain; version=0.0.4")
+
+    def get_traces(self, query=None):
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        self._json({"enabled": global_tracer().enabled,
+                    "traces": global_tracer().recent()})
+
+    def get_debug_vars(self, query=None):
+        from pilosa_tpu.utils.stats import global_stats
+
+        self._json(global_stats().snapshot())
 
     def get_export(self, query=None):
         index = (query.get("index") or [""])[0]

@@ -32,6 +32,8 @@ class ServerConfig:
         seeds: list[str] | None = None,
         heartbeat_interval: float = 5.0,
         use_mesh: bool | None = None,
+        tracing: bool = False,
+        diagnostics_endpoint: str = "",
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -45,6 +47,8 @@ class ServerConfig:
         self.seeds = seeds or []
         self.heartbeat_interval = heartbeat_interval
         self.use_mesh = use_mesh  # None = auto (mesh when >1 device)
+        self.tracing = tracing
+        self.diagnostics_endpoint = diagnostics_endpoint
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServerConfig":
@@ -61,6 +65,8 @@ class ServerConfig:
             advertise=d.get("advertise", ""),
             seeds=_parse_list(d.get("seeds", d.get("gossip-seeds", []))),
             heartbeat_interval=float(d.get("heartbeat-interval", 5.0)),
+            tracing=_parse_bool(d.get("tracing", False)),
+            diagnostics_endpoint=d.get("diagnostics-endpoint", ""),
         )
 
     def to_dict(self) -> dict:
@@ -122,6 +128,16 @@ class Server:
             self.config.bind, self.port, self.holder.data_dir,
             self.api.cluster.local.id,
         )
+        if self.config.tracing:
+            from pilosa_tpu.utils.tracing import global_tracer
+
+            global_tracer().enabled = True
+        from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
+
+        self._diagnostics = DiagnosticsCollector(
+            self.api, self.config.diagnostics_endpoint
+        )
+        self._diagnostics.start()
         self._schedule_anti_entropy()
         self._schedule_heartbeat()
         return self
@@ -167,6 +183,8 @@ class Server:
             self._anti_entropy_timer.cancel()
         if self._heartbeat_timer is not None:
             self._heartbeat_timer.cancel()
+        if getattr(self, "_diagnostics", None) is not None:
+            self._diagnostics.close()
         if self._http:
             self._http.shutdown()
             self._http.server_close()

@@ -240,6 +240,9 @@ class Fragment:
         cache.invalidate_fragment(self.frag_id + ("__planes__",))
         cache.bump_generation()
         self.row_cache.add(row, self.count_row(row))
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats().count("fragment_row_writes", 1)
 
     def _check_pos(self, pos: int) -> None:
         if not 0 <= pos < SHARD_WIDTH:

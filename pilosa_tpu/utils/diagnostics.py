@@ -1,0 +1,67 @@
+"""Diagnostics phone-home (reference: diagnostics.go — SURVEY.md §2 #22).
+
+Hourly anonymized usage report (version, platform, node count) POSTed to a
+configurable endpoint. **Disabled by default** (the reference ships it on;
+we flip the default — and this environment has zero egress anyway, so the
+reporter also swallows network failures silently by design).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+DEFAULT_INTERVAL = 3600.0
+
+
+class DiagnosticsCollector:
+    def __init__(self, api, endpoint: str = "", interval: float = DEFAULT_INTERVAL):
+        self.api = api
+        self.endpoint = endpoint
+        self.interval = interval
+        self._timer: threading.Timer | None = None
+        self._closed = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.endpoint)
+
+    def payload(self) -> dict:
+        import platform
+
+        from pilosa_tpu import __version__
+
+        info = {
+            "version": __version__,
+            "os": platform.system(),
+            "arch": platform.machine(),
+            "numNodes": len(self.api.cluster.nodes) if self.api.cluster else 1,
+            "numIndexes": len(self.api.holder.indexes),
+        }
+        return info
+
+    def start(self) -> None:
+        if not self.enabled or self._closed:
+            return
+        self._timer = threading.Timer(self.interval, self._flush)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _flush(self) -> None:
+        try:
+            req = urllib.request.Request(
+                self.endpoint,
+                data=json.dumps(self.payload()).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=10)
+        except Exception:
+            pass  # diagnostics must never disturb the server
+        self.start()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._timer is not None:
+            self._timer.cancel()

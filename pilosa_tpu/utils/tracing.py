@@ -1,0 +1,103 @@
+"""Tracing: spans through handler → execute → per-shard map.
+
+Reference: tracing/tracing.go (SURVEY.md §2 #24) — a global tracer wrapper
+(OpenTracing + Jaeger upstream). Here: an in-process tracer recording span
+trees with wall times, exportable as JSON (and gated to zero overhead when
+disabled). On TPU the device-side story is the JAX profiler; start_jax_trace
+wraps ``jax.profiler`` so a query's XLA execution can be captured alongside
+host spans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class Span:
+    __slots__ = ("name", "start", "end", "tags", "children")
+
+    def __init__(self, name: str, tags: dict | None = None):
+        self.name = name
+        self.start = time.perf_counter()
+        self.end = None
+        self.tags = tags or {}
+        self.children: list[Span] = []
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.perf_counter()) - self.start
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "durationMs": round(self.duration * 1e3, 3),
+            "tags": self.tags,
+            "children": [c.to_json() for c in self.children],
+        }
+
+
+class Tracer:
+    """Per-thread span stacks; keeps the last N finished root spans."""
+
+    def __init__(self, enabled: bool = False, keep: int = 64):
+        self.enabled = enabled
+        self.keep = keep
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self.finished: list[Span] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, **tags):
+        if not self.enabled:
+            yield None
+            return
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        s = Span(name, tags)
+        if stack:
+            stack[-1].children.append(s)
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.perf_counter()
+            stack.pop()
+            if not stack:
+                with self._lock:
+                    self.finished.append(s)
+                    del self.finished[: -self.keep]
+
+    def recent(self) -> list[dict]:
+        with self._lock:
+            return [s.to_json() for s in self.finished]
+
+
+_global_tracer: Tracer | None = None
+
+
+def global_tracer() -> Tracer:
+    global _global_tracer
+    if _global_tracer is None:
+        _global_tracer = Tracer()
+    return _global_tracer
+
+
+def set_global_tracer(tracer: Tracer) -> None:
+    global _global_tracer
+    _global_tracer = tracer
+
+
+@contextlib.contextmanager
+def start_jax_trace(log_dir: str):
+    """Capture an XLA/JAX profiler trace around a block (TPU-side tracing;
+    view with xprof/tensorboard)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,69 @@
+"""Native fastbits library tests: parity with the numpy fallback."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+
+
+requires_native = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain in environment"
+)
+
+
+@requires_native
+def test_pack_unpack_popcount_parity():
+    rng = np.random.default_rng(5)
+    positions = np.unique(rng.choice(1 << 20, 50_000, replace=False)).astype(np.uint64)
+    n_words = (1 << 20) // 32
+
+    fast = native.pack_positions(positions, n_words)
+    # numpy oracle
+    bytes_ = np.zeros(n_words * 4, np.uint8)
+    np.bitwise_or.at(
+        bytes_,
+        (positions >> np.uint64(3)).astype(np.int64),
+        np.uint8(1) << (positions & np.uint64(7)).astype(np.uint8),
+    )
+    slow = bytes_.view("<u4")
+    np.testing.assert_array_equal(fast, slow)
+
+    assert native.popcount_words(fast) == positions.size
+    np.testing.assert_array_equal(
+        native.unpack_positions(fast, 0), positions
+    )
+    np.testing.assert_array_equal(
+        native.unpack_positions(fast, 1 << 30), positions + (1 << 30)
+    )
+
+
+@requires_native
+def test_runs_to_words():
+    runs = np.array([[0, 5], [100, 100], [65530, 65535]], np.uint16)
+    words = native.runs_to_words(runs)
+    got = native.unpack_positions(words, 0).tolist()
+    assert got == list(range(6)) + [100] + list(range(65530, 65536))
+
+
+@requires_native
+def test_empty_inputs():
+    assert native.popcount_words(np.zeros(8, np.uint32)) == 0
+    assert native.unpack_positions(np.zeros(8, np.uint32)).size == 0
+    out = native.pack_positions(np.empty(0, np.uint64), 8)
+    assert out.sum() == 0
+
+
+def test_packing_api_works_with_or_without_native(monkeypatch):
+    """pack_bits/unpack_bits give identical results on both paths."""
+    from pilosa_tpu.ops import packing
+
+    rng = np.random.default_rng(6)
+    ids = np.unique(rng.choice(1 << 14, 1000, replace=False))
+    with_native = packing.pack_bits(ids, 1 << 14)
+    monkeypatch.setenv("PILOSA_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_lib", None)
+    without = packing.pack_bits(ids, 1 << 14)
+    np.testing.assert_array_equal(with_native, without)
+    np.testing.assert_array_equal(
+        packing.unpack_bits(without), ids.astype(np.uint64)
+    )
