@@ -1,0 +1,244 @@
+"""Benchmark suite: the five BASELINE.json configs, end to end.
+
+Runs each config through the real engine (holder → executor → fused XLA
+kernels on the default JAX backend), checks results against a numpy
+oracle, and prints one JSON line per config:
+
+  {"config": i, "metric": ..., "value": N, "unit": ..., "ok": true}
+
+Scale: data sizes default to a laptop-friendly fraction; --full uses the
+billion-column scale on real hardware. bench.py (the driver's single-line
+contract) stays the headline kernel benchmark; this suite covers the
+query-level configs (SURVEY.md §6 / BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import time
+
+import numpy as np
+
+
+def _timed(fn, iters=5):
+    fn()  # warm (compile)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    return (time.perf_counter() - t0) / iters, out
+
+
+def _mk_env(tmp):
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage import Holder
+
+    holder = Holder(tmp).open()
+    return holder, Executor(holder)
+
+
+def config1_star_trace(n_shards: int) -> dict:
+    """Star-Trace: Row(stargazer) ∩ Row(language) → Count."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder, ex = _mk_env(tmp)
+        idx = holder.create_index("repos")
+        rng = np.random.default_rng(1)
+        expected = 0
+        for field_name, row, density in (("stargazer", 1, 0.10), ("language", 5, 0.20)):
+            f = idx.create_field(field_name)
+            for shard in range(n_shards):
+                n = int(SHARD_WIDTH * density)
+                cols = rng.choice(SHARD_WIDTH, n, replace=False)
+                f.view(VIEW_STANDARD, create=True).fragment(
+                    shard, create=True
+                ).bulk_import(np.full(n, row), cols)
+        # oracle on one query
+        pql = "Count(Intersect(Row(stargazer=1), Row(language=5)))"
+        dt, got = _timed(lambda: ex.execute("repos", pql)[0])
+        # numpy oracle
+        want = 0
+        for shard in range(n_shards):
+            a = idx.field("stargazer").view(VIEW_STANDARD).fragment(shard).row_words(1)
+            b = idx.field("language").view(VIEW_STANDARD).fragment(shard).row_words(5)
+            want += int(np.bitwise_count(a & b).sum())
+        holder.close()
+        return {
+            "config": 1, "metric": "star_trace_intersect_count_p50_ms",
+            "value": round(dt * 1e3, 3), "unit": "ms",
+            "cols": n_shards << 20, "ok": got == want,
+        }
+
+
+def config2_taxi_topn_groupby(n_shards: int) -> dict:
+    """NYC-taxi-like: TopN(cab_type) + GroupBy(passenger_count)."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder, ex = _mk_env(tmp)
+        idx = holder.create_index("taxi")
+        cab = idx.create_field("cab_type")
+        pc = idx.create_field("passenger_count")
+        rng = np.random.default_rng(2)
+        for shard in range(n_shards):
+            cols = np.arange(SHARD_WIDTH, dtype=np.uint64)
+            cab_rows = rng.choice(3, SHARD_WIDTH, p=[0.6, 0.3, 0.1])
+            pc_rows = rng.integers(1, 7, SHARD_WIDTH)
+            cab.view(VIEW_STANDARD, create=True).fragment(shard, create=True).bulk_import(cab_rows, cols)
+            pc.view(VIEW_STANDARD, create=True).fragment(shard, create=True).bulk_import(pc_rows, cols)
+        dt_topn, pairs = _timed(lambda: ex.execute("taxi", "TopN(cab_type, n=3)")[0])
+        dt_gb, groups = _timed(
+            lambda: ex.execute("taxi", "GroupBy(Rows(passenger_count))")[0], iters=3
+        )
+        total = sum(g.count for g in groups)
+        holder.close()
+        return {
+            "config": 2, "metric": "taxi_topn_p50_ms",
+            "value": round(dt_topn * 1e3, 3), "unit": "ms",
+            "groupby_ms": round(dt_gb * 1e3, 3),
+            "ok": pairs[0].id == 0 and total == n_shards << 20,
+        }
+
+
+def config3_bsi_range_sum(n_shards: int) -> dict:
+    """BSI: Range(fare > N) + Sum(fare)."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import FieldOptions
+    from pilosa_tpu.storage.field import BSI_OFFSET_ROW, BSI_EXISTS_ROW
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder, ex = _mk_env(tmp)
+        idx = holder.create_index("taxi")
+        fare = idx.create_field("fare", FieldOptions(type="int", min=0, max=4095))
+        rng = np.random.default_rng(3)
+        oracle_sum, oracle_gt = 0, 0
+        for shard in range(n_shards):
+            vals = rng.integers(0, 4096, SHARD_WIDTH, dtype=np.uint64)
+            oracle_sum += int(vals.sum())
+            oracle_gt += int((vals > 1000).sum())
+            # bulk plane import (bypasses per-column set_value for speed)
+            frag = fare.view(fare.bsi_view_name(), create=True).fragment(shard, create=True)
+            cols = np.arange(SHARD_WIDTH, dtype=np.uint64)
+            rows = [np.full(SHARD_WIDTH, BSI_EXISTS_ROW, np.uint64)]
+            pos = [cols]
+            for bit in range(12):
+                mask = (vals >> np.uint64(bit)) & np.uint64(1)
+                sel = cols[mask == 1]
+                rows.append(np.full(sel.size, BSI_OFFSET_ROW + bit, np.uint64))
+                pos.append(sel)
+            frag.bulk_import(np.concatenate(rows), np.concatenate(pos))
+        dt_range, got_gt = _timed(lambda: ex.execute("taxi", "Count(Range(fare > 1000))")[0])
+        dt_sum, got_sum = _timed(lambda: ex.execute("taxi", 'Sum(field="fare")')[0])
+        holder.close()
+        return {
+            "config": 3, "metric": "bsi_range_count_p50_ms",
+            "value": round(dt_range * 1e3, 3), "unit": "ms",
+            "sum_ms": round(dt_sum * 1e3, 3),
+            "ok": got_gt == oracle_gt and got_sum.value == oracle_sum,
+        }
+
+
+def config4_time_quantum(n_shards: int) -> dict:
+    """Time views: multi-view Union + Count over a 1-year window."""
+    import datetime as dt_
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import FieldOptions
+    from pilosa_tpu.storage.view import VIEW_STANDARD, views_for_time
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder, ex = _mk_env(tmp)
+        idx = holder.create_index("events")
+        t = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+        rng = np.random.default_rng(4)
+        per_day = 2000
+        days = [dt_.datetime(2019, 1, 1) + dt_.timedelta(days=i * 14) for i in range(26)]
+        days += [dt_.datetime(2020, 2, 1)]  # outside window
+        oracle = set()
+        for day in days:
+            for shard in range(n_shards):
+                cols = rng.choice(SHARD_WIDTH, per_day, replace=False)
+                for vname in views_for_time(VIEW_STANDARD, "YMD", day):
+                    t.view(vname, create=True).fragment(shard, create=True).bulk_import(
+                        np.full(per_day, 1, np.uint64), cols
+                    )
+                t.view(VIEW_STANDARD, create=True).fragment(shard, create=True).bulk_import(
+                    np.full(per_day, 1, np.uint64), cols
+                )
+                if day < dt_.datetime(2020, 1, 1):
+                    oracle.update((shard << 20) + int(c) for c in cols)
+        pql = "Count(Row(t=1, from='2019-01-01T00:00', to='2020-01-01T00:00'))"
+        dt_q, got = _timed(lambda: ex.execute("events", pql)[0])
+        holder.close()
+        return {
+            "config": 4, "metric": "time_union_count_p50_ms",
+            "value": round(dt_q * 1e3, 3), "unit": "ms", "ok": got == len(oracle),
+        }
+
+
+def config5_ssb_4way(n_shards: int) -> dict:
+    """SSB-style 4-way Intersect with the mesh (ICI-reduce) executor."""
+    from pilosa_tpu.parallel import DistExecutor, make_mesh
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import Holder
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp).open()
+        idx = holder.create_index("ssb")
+        rng = np.random.default_rng(5)
+        fields = ["year", "region", "category", "brand"]
+        densities = [0.5, 0.25, 0.2, 0.3]
+        words_oracle = None
+        for fname, d in zip(fields, densities):
+            f = idx.create_field(fname)
+            for shard in range(n_shards):
+                n = int(SHARD_WIDTH * d)
+                cols = rng.choice(SHARD_WIDTH, n, replace=False)
+                f.view(VIEW_STANDARD, create=True).fragment(shard, create=True).bulk_import(
+                    np.full(n, 1, np.uint64), cols
+                )
+        ex = DistExecutor(holder, make_mesh())
+        pql = ("Count(Intersect(Row(year=1), Row(region=1), "
+               "Row(category=1), Row(brand=1)))")
+        dt_q, got = _timed(lambda: ex.execute("ssb", pql)[0])
+        want = 0
+        for shard in range(n_shards):
+            acc = None
+            for fname in fields:
+                w = idx.field(fname).view(VIEW_STANDARD).fragment(shard).row_words(1)
+                acc = w if acc is None else (acc & w)
+            want += int(np.bitwise_count(acc).sum())
+        holder.close()
+        return {
+            "config": 5, "metric": "ssb_4way_intersect_count_p50_ms",
+            "value": round(dt_q * 1e3, 3), "unit": "ms",
+            "mesh_devices": make_mesh().size, "ok": got == want,
+        }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--full", action="store_true",
+                        help="billion-column scale (real TPU)")
+    parser.add_argument("--configs", default="1,2,3,4,5")
+    args = parser.parse_args()
+    n_shards = 954 if args.full else 4
+    small = 2 if not args.full else 64
+    runners = {
+        1: lambda: config1_star_trace(n_shards),
+        2: lambda: config2_taxi_topn_groupby(small),
+        3: lambda: config3_bsi_range_sum(small),
+        4: lambda: config4_time_quantum(1 if not args.full else 8),
+        5: lambda: config5_ssb_4way(n_shards),
+    }
+    for c in [int(x) for x in args.configs.split(",")]:
+        print(json.dumps(runners[c]()), flush=True)
+
+
+if __name__ == "__main__":
+    main()
