@@ -34,7 +34,9 @@ def _make_rows(n_shards: int, words_per_shard: int, seed: int) -> np.ndarray:
     return (a & b & c).astype(np.uint32)
 
 
-def bench_tpu(a_host: np.ndarray, b_host: np.ndarray, iters: int = 20) -> tuple[float, int]:
+def bench_tpu(a_host: np.ndarray, b_host: np.ndarray, iters: int = 20):
+    """Times both the XLA-fused path and the Pallas kernel; returns the
+    faster (dt, result, kernel_name)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -45,14 +47,27 @@ def bench_tpu(a_host: np.ndarray, b_host: np.ndarray, iters: int = 20) -> tuple[
 
     a = jax.device_put(a_host)
     b = jax.device_put(b_host)
-    # warm up + compile
-    result = int(intersect_count(a, b))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = intersect_count(a, b)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
-    return dt, result
+
+    def timeit(fn):
+        result = int(fn(a, b))  # warm up + compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(a, b)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters, result
+
+    xla_dt, result = timeit(intersect_count)
+    best = (xla_dt, result, "xla")
+    if jax.default_backend() == "tpu":
+        try:
+            from pilosa_tpu.ops.pallas_kernels import intersect_count_pallas
+
+            pallas_dt, pallas_result = timeit(intersect_count_pallas)
+            if pallas_result == result and pallas_dt < xla_dt:
+                best = (pallas_dt, result, "pallas")
+        except Exception:
+            pass  # Mosaic quirk → stay on the XLA path
+    return best
 
 
 def bench_cpu_reference(a: np.ndarray, b: np.ndarray, iters: int = 3) -> tuple[float, int]:
@@ -73,7 +88,7 @@ def main() -> None:
     a = _make_rows(n_shards, WORDS_PER_SHARD, seed=1)
     b = _make_rows(n_shards, WORDS_PER_SHARD, seed=2)
 
-    tpu_dt, tpu_result = bench_tpu(a, b)
+    tpu_dt, tpu_result, kernel = bench_tpu(a, b)
     cpu_dt, cpu_result = bench_cpu_reference(a, b)
     if tpu_result != cpu_result:
         raise AssertionError(f"result mismatch tpu={tpu_result} cpu={cpu_result}")
@@ -86,6 +101,7 @@ def main() -> None:
                 "value": round(cols_per_sec, 1),
                 "unit": "columns/sec/chip",
                 "vs_baseline": round(cpu_dt / tpu_dt, 2),
+                "kernel": kernel,
             }
         )
     )

@@ -1,0 +1,59 @@
+"""Pallas TPU kernels for the hottest fused ops.
+
+XLA already fuses bitwise chains with the final popcount (expr.py), and
+those ops are HBM-bandwidth-bound — so the win here is explicit tiling
+control on the very largest operands: a grid over row blocks streams
+uint32[rows, 32768] operands through VMEM in (8, 512)-word tiles and
+accumulates partial popcounts per grid cell, avoiding any intermediate
+materialization at shapes where XLA's default tiling can spill.
+
+Used by bench.py when a TPU backend is active; everywhere else the jnp
+path (ops.bitops) is the default. On CPU these kernels run in interpret
+mode (tests only).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+BLOCK_ROWS = 8
+BLOCK_WORDS = 4096  # 16 KiB/operand tile → well within VMEM with 3 operands
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def intersect_count_pallas(a, b, interpret: bool | None = None):
+    """sum(popcount(a & b)) over uint32[rows, words] via a Pallas grid.
+
+    Returns int32 (safe: ≤ rows·words·32 ≤ 2^31 for any single fragment
+    batch we feed — callers batch larger inputs).
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = _use_interpret()
+    rows, words = a.shape
+    grid = (pl.cdiv(rows, BLOCK_ROWS), pl.cdiv(words, BLOCK_WORDS))
+
+    def kernel(a_ref, b_ref, out_ref):
+        x = a_ref[...] & b_ref[...]
+        out_ref[0, 0] = jnp.sum(jax.lax.population_count(x).astype(jnp.int32))
+
+    partials = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, BLOCK_WORDS), lambda i, j: (i, j)),
+            pl.BlockSpec((BLOCK_ROWS, BLOCK_WORDS), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(grid, jnp.int32),
+        interpret=interpret,
+    )(a, b)
+    return jnp.sum(partials)
