@@ -42,7 +42,7 @@ TOPN_CANDIDATE_FACTOR = 4
 
 _RESERVED_ARGS = {"_field", "_col", "from", "to", "n", "limit", "offset",
                   "previous", "column", "filter", "field", "ids", "timestamp",
-                  "excludeColumns", "shards"}
+                  "excludeColumns", "shards", "aggregate", "columnAttrs"}
 
 
 class PQLError(ValueError):
@@ -153,6 +153,11 @@ class _Compiled:
 class Executor:
     def __init__(self, holder):
         self.holder = holder
+        # cluster hooks (set by ClusterExecutor): key_resolver translates
+        # unknown keys via the coordinator; key_backfill pulls the
+        # coordinator's translate log before reverse lookups
+        self.key_resolver = None
+        self.key_backfill = None
 
     # ------------------------------------------------------------ top level
 
@@ -212,6 +217,19 @@ class Executor:
 
     # ------------------------------------------------------ key translation
 
+    def _resolve_key(self, namespace: str, key: str, create: bool):
+        """Key → ID. Known keys resolve locally; unknown ones go through
+        key_resolver (the coordinator in a cluster — reference: translation
+        primary) when wired, else the local store."""
+        id_ = self.holder.translate.translate_one(namespace, key, create=False)
+        if id_ is not None:
+            return id_
+        if self.key_resolver is not None:
+            return self.key_resolver(namespace, key, create)
+        if create:
+            return self.holder.translate.translate_one(namespace, key, create=True)
+        return None
+
     def _translate_col(self, idx: Index, col, create: bool = False):
         from pilosa_tpu.storage.translate import column_namespace
 
@@ -221,9 +239,7 @@ class Executor:
             raise PQLError(
                 f"column key {col!r} on index {idx.name!r} without keys=true"
             )
-        return self.holder.translate.translate_one(
-            column_namespace(idx.name), str(col), create=create
-        )
+        return self._resolve_key(column_namespace(idx.name), str(col), create)
 
     def _translate_row(self, idx: Index, field, row, create: bool = False):
         from pilosa_tpu.storage.translate import row_namespace
@@ -234,21 +250,26 @@ class Executor:
             raise PQLError(
                 f"row key {row!r} on field {field.name!r} without keys=true"
             )
-        return self.holder.translate.translate_one(
-            row_namespace(idx.name, field.name), str(row), create=create
+        return self._resolve_key(
+            row_namespace(idx.name, field.name), str(row), create
         )
+
+    def _keys_of(self, namespace: str, ids):
+        keys = self.holder.translate.keys_of(namespace, ids)
+        if self.key_backfill is not None and any(k is None for k in keys):
+            self.key_backfill()
+            keys = self.holder.translate.keys_of(namespace, ids)
+        return keys
 
     def _column_keys(self, idx: Index, columns):
         from pilosa_tpu.storage.translate import column_namespace
 
-        return self.holder.translate.keys_of(
-            column_namespace(idx.name), [int(c) for c in columns]
-        )
+        return self._keys_of(column_namespace(idx.name), [int(c) for c in columns])
 
     def _row_keys(self, idx: Index, field, rows):
         from pilosa_tpu.storage.translate import row_namespace
 
-        return self.holder.translate.keys_of(
+        return self._keys_of(
             row_namespace(idx.name, field.name), [int(r) for r in rows]
         )
 
@@ -316,8 +337,18 @@ class Executor:
         if opt_shards is not None:
             shards = [int(s) for s in opt_shards]
         res = self._execute_call(idx, call.children[0], shards)
-        if call.arg("excludeColumns") and isinstance(res, RowResult):
-            return RowResult({})
+        if not isinstance(res, RowResult):
+            return res
+        if call.arg("columnAttrs"):
+            cols = res.columns().tolist()
+            attr_map = idx.column_attrs.bulk(cols) if cols else {}
+            res.column_attrs = [
+                {"id": c, "attrs": attr_map[c]} for c in cols if c in attr_map
+            ]
+        if call.arg("excludeColumns"):
+            out = RowResult({}, attrs=res.attrs, keys=res.keys)
+            out.column_attrs = res.column_attrs
+            return out
         return res
 
     # -------------------------------------------------------------- compile
@@ -626,6 +657,17 @@ class Executor:
         filt_call = call.arg("filter")
         shard_list = self._shards(idx, shards)
 
+        # aggregate=Sum(field=...) (reference GroupBy aggregate, v1.4+)
+        agg_call = call.arg("aggregate")
+        agg_field = None
+        if isinstance(agg_call, Call):
+            if agg_call.name != "Sum":
+                raise PQLError("GroupBy aggregate supports only Sum(...)")
+            agg_name = agg_call.arg("field") or agg_call.arg("_field")
+            agg_field = idx.field(agg_name) if agg_name else None
+            if agg_field is None or agg_field.options.type != TYPE_INT:
+                raise PQLError("GroupBy aggregate requires an int field")
+
         dims = []
         for child in call.children:
             fname = child.arg("_field") or child.arg("field")
@@ -646,8 +688,10 @@ class Executor:
         from pilosa_tpu.ops import bitops
 
         counts: dict[tuple, int] = {}
+        sums: dict[tuple, int] = {}
         last_field, last_rows = dims[-1]
         node = ("countrows", len(specs), filt_node)
+        sum_node = ("bsisum", 0, ("leaf", 1))
         for shard in shard_list:
             matrices = []
             missing = False
@@ -663,6 +707,14 @@ class Executor:
             if missing:
                 continue
 
+            filt_words = None
+            planes = None
+            if agg_field is not None:
+                leaves = [s.resolve(idx, shard) for s in specs]
+                if filt_node is not None:
+                    filt_words = expr.evaluate(filt_node, leaves, scalars)
+                planes = _PlanesSpec(agg_field.name).resolve(idx, shard)
+
             def recurse(level: int, mask, prefix: tuple):
                 if level == len(dims) - 1:
                     matrix = matrices[-1]
@@ -670,10 +722,25 @@ class Executor:
                         matrix = matrix & mask[None, :]
                     leaves = [s.resolve(idx, shard) for s in specs] + [matrix]
                     got = np.asarray(expr.evaluate(node, leaves, scalars))
-                    for row_id, c in zip(last_rows, got.tolist()):
-                        if c > 0:
-                            key = prefix + (row_id,)
-                            counts[key] = counts.get(key, 0) + int(c)
+                    for i, (row_id, c) in enumerate(zip(last_rows, got.tolist())):
+                        if c <= 0:
+                            continue
+                        key = prefix + (row_id,)
+                        counts[key] = counts.get(key, 0) + int(c)
+                        if agg_field is not None:
+                            g_mask = matrix[i]
+                            if filt_words is not None:
+                                g_mask = g_mask & filt_words
+                            plane_counts, _n = expr.evaluate(
+                                sum_node, [planes, g_mask], ()
+                            )
+                            pc = np.asarray(plane_counts).tolist()
+                            n = int(_n)
+                            sums[key] = (
+                                sums.get(key, 0)
+                                + sum(v << b for b, v in enumerate(pc))
+                                + agg_field.options.base * n
+                            )
                     return
                 fname, row_ids = dims[level]
                 for i, row_id in enumerate(row_ids):
@@ -692,6 +759,7 @@ class Executor:
                     for i, row in enumerate(key)
                 ],
                 c,
+                sum=sums.get(key) if agg_field is not None else None,
             )
             for key, c in sorted(counts.items())
         ]

@@ -21,6 +21,7 @@ class RowResult:
         self.segments = segments or {}
         self.attrs = attrs or {}
         self.keys = keys  # translated column keys, when the index uses keys
+        self.column_attrs = None  # [{"id": col, "attrs": {...}}] via Options(columnAttrs=true)
 
     def columns(self) -> np.ndarray:
         parts = [
@@ -48,8 +49,12 @@ class RowResult:
 
     def to_json(self) -> dict:
         if self.keys is not None:
-            return {"attrs": self.attrs, "keys": self.keys}
-        return {"attrs": self.attrs, "columns": self.columns().tolist()}
+            out = {"attrs": self.attrs, "keys": self.keys}
+        else:
+            out = {"attrs": self.attrs, "columns": self.columns().tolist()}
+        if self.column_attrs is not None:
+            out["columnAttrs"] = self.column_attrs
+        return out
 
 
 class Pair:
@@ -83,16 +88,21 @@ class ValCount:
 
 
 class GroupCount:
-    """GroupBy result element (reference GroupCount)."""
+    """GroupBy result element (reference GroupCount; ``sum`` set when the
+    call carries aggregate=Sum(...))."""
 
-    __slots__ = ("group", "count")
+    __slots__ = ("group", "count", "sum")
 
-    def __init__(self, group: list[dict], count: int):
+    def __init__(self, group: list[dict], count: int, sum: int | None = None):
         self.group = group  # [{"field": name, "rowID": id}, ...]
         self.count = count
+        self.sum = sum
 
     def to_json(self) -> dict:
-        return {"group": self.group, "count": self.count}
+        out = {"group": self.group, "count": self.count}
+        if self.sum is not None:
+            out["sum"] = self.sum
+        return out
 
 
 def result_to_json(res):

@@ -71,6 +71,9 @@ class Cluster:
         self.client = InternalClient()
         self.state = STATE_NORMAL
         self._lock = threading.RLock()
+        # bytes of the coordinator's translate log already applied locally;
+        # resets on restart (re-apply is idempotent)
+        self._translate_offset = 0
 
     # ----------------------------------------------------------- membership
 
@@ -174,6 +177,12 @@ class Cluster:
         elif kind == "node-leave":
             with self._lock:
                 self.nodes.pop(message["id"], None)
+            # ownership moved: pull newly-owned shards from surviving
+            # replicas (reference: coordinator resize on node death)
+            try:
+                self.resize_fetch()
+            except Exception:
+                pass
         else:
             return {"error": f"unknown message type {kind!r}"}
         return {}
@@ -267,6 +276,39 @@ class Cluster:
         finally:
             self.state = STATE_NORMAL
 
+    def leave(self) -> None:
+        """Graceful departure: announce node-leave so peers re-own our
+        shards (they repair from replicas; with replica_n == 1 data must be
+        drained beforehand — same caveat as the reference)."""
+        for node in self.sorted_nodes():
+            if node.id == self.local.id:
+                continue
+            try:
+                self.client.send_message(
+                    node.uri, {"type": "node-leave", "id": self.local.id}
+                )
+            except ClientError:
+                pass
+
+    # ----------------------------------------------------- translate tailing
+
+    def sync_translate(self) -> int:
+        """Replica side of key-translation replication: tail the
+        coordinator's append log from our current offset (reference
+        translate.go Reader — SURVEY.md §2 #9)."""
+        if self.is_coordinator or self.holder.translate is None:
+            return 0
+        coord = self.coordinator
+        try:
+            data = self.client.translate_log(coord.uri, self._translate_offset)
+        except ClientError:
+            return 0
+        if not data:
+            return 0
+        applied = self.holder.translate.apply_log(data)
+        self._translate_offset += len(data)
+        return applied
+
     # --------------------------------------------------------- anti-entropy
 
     def sync_holder(self) -> dict:
@@ -276,6 +318,8 @@ class Cluster:
         import numpy as np
 
         repaired = {"fragments": 0, "bits": 0, "attr_blocks": 0}
+        repaired["translate_ops"] = self.sync_translate()
+        repaired["attr_blocks"] = self._sync_attrs()
         for index_name, idx in list(self.holder.indexes.items()):
             for field_name, field in list(idx.fields.items()):
                 for view_name, view in list(field.views.items()):
@@ -319,3 +363,48 @@ class Cluster:
                                         repaired["fragments"] += 1
                             local_blocks = dict(frag.blocks())
         return repaired
+
+    def _sync_attrs(self) -> int:
+        """Diff + union attr-store blocks against every peer (reference
+        attr-block sync — SURVEY.md §3.5). Attrs are replicated everywhere
+        (they are tiny), matching the reference's attr stores living beside
+        every fragment owner."""
+        merged = 0
+        for index_name, idx in list(self.holder.indexes.items()):
+            stores = [("", idx.column_attrs)]
+            stores += [
+                (fname, f.row_attrs)
+                for fname, f in idx.fields.items()
+                if f.row_attrs is not None
+            ]
+            for node in self.sorted_nodes():
+                if node.id == self.local.id:
+                    continue
+                for field_name, store in stores:
+                    if store is None:
+                        continue
+                    try:
+                        peer = self.client._call(
+                            "GET",
+                            f"{node.uri}/internal/attrs/blocks?index={index_name}"
+                            f"&field={field_name}",
+                        )
+                    except ClientError:
+                        continue
+                    local = dict(store.blocks())
+                    for entry in peer.get("blocks", []):
+                        block, checksum = entry["block"], entry["checksum"]
+                        if local.get(block) == checksum:
+                            continue
+                        try:
+                            data = self.client._call(
+                                "GET",
+                                f"{node.uri}/internal/attrs/block/data"
+                                f"?index={index_name}&field={field_name}"
+                                f"&block={block}",
+                            )
+                        except ClientError:
+                            continue
+                        store.merge_block(data.get("attrs", {}))
+                        merged += 1
+        return merged

@@ -44,6 +44,22 @@ class ClusterExecutor:
         self.cluster = cluster
         self._shards_cache: dict[str, tuple[float, list[int]]] = {}
         self._lock = threading.Lock()
+        # key translation goes through the coordinator (reference:
+        # translation primary); reverse lookups backfill from its log
+        local_executor.key_resolver = self._resolve_key_via_coordinator
+        local_executor.key_backfill = cluster.sync_translate
+
+    def _resolve_key_via_coordinator(self, namespace: str, key: str, create: bool):
+        coord = self.cluster.coordinator
+        if coord.id == self.cluster.local.id:
+            if create:
+                return self.holder.translate.translate_one(namespace, key, create=True)
+            return None
+        ids = self.cluster.client.translate_keys(coord.uri, namespace, [key], create)
+        id_ = ids[0] if ids else None
+        if id_ is not None:
+            self.cluster.sync_translate()  # mirror the assignment locally
+        return id_
 
     # ------------------------------------------------------------ top level
 
@@ -162,9 +178,9 @@ class ClusterExecutor:
     def _execute_routed_write(self, idx, call: Call):
         col = call.arg("_col")
         if isinstance(col, str):
-            # keyed writes translate on the coordinator; after translation
-            # the call routes by the numeric column
-            col = self._translate_col_cluster(idx, col, create=call.name == "Set")
+            # keyed writes translate on the coordinator (via the resolver
+            # hook); after translation the call routes by numeric column
+            col = self.local._translate_col(idx, col, create=call.name == "Set")
             if col is None:
                 return False
             call = Call(call.name, {**call.args, "_col": col}, call.children)
@@ -185,17 +201,6 @@ class ClusterExecutor:
                 except ClientError:
                     node.state = "DEGRADED"
         return result
-
-    def _translate_col_cluster(self, idx, col: str, create: bool):
-        from pilosa_tpu.storage.translate import column_namespace
-
-        coord = self.cluster.coordinator
-        if coord.id == self.cluster.local.id:
-            return self.local._translate_col(idx, col, create=create)
-        ids = self.cluster.client.translate_keys(
-            coord.uri, column_namespace(idx.name), [col], create
-        )
-        return ids[0] if ids else None
 
     # --------------------------------------------------------------- reduce
 

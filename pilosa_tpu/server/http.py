@@ -55,6 +55,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
     ("GET", re.compile(r"^/internal/fragments$"), "get_fragments_catalog"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
+    ("GET", re.compile(r"^/internal/attrs/blocks$"), "get_attr_blocks"),
+    ("GET", re.compile(r"^/internal/attrs/block/data$"), "get_attr_block_data"),
     ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
@@ -287,6 +289,25 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 for shard in sorted(view.fragments):
                     out.append({"field": fname, "view": vname, "shard": shard})
         self._json({"fragments": out})
+
+    def _attr_store(self, query):
+        index = (query.get("index") or [""])[0]
+        field = (query.get("field") or [""])[0]
+        idx = self.api._index(index)
+        if not field:
+            return idx.column_attrs
+        return self.api._field(idx, field).row_attrs
+
+    def get_attr_blocks(self, query=None):
+        store = self._attr_store(query)
+        self._json({"blocks": [
+            {"block": b, "checksum": c} for b, c in (store.blocks() if store else [])
+        ]})
+
+    def get_attr_block_data(self, query=None):
+        store = self._attr_store(query)
+        block = _int_param((query.get("block") or ["0"])[0], "block")
+        self._json({"attrs": store.block_data(block) if store else {}})
 
     def post_translate_keys(self, query=None):
         body = self._json_body()

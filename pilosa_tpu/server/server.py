@@ -34,6 +34,7 @@ class ServerConfig:
         use_mesh: bool | None = None,
         tracing: bool = False,
         diagnostics_endpoint: str = "",
+        statsd: str = "",
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -49,6 +50,7 @@ class ServerConfig:
         self.use_mesh = use_mesh  # None = auto (mesh when >1 device)
         self.tracing = tracing
         self.diagnostics_endpoint = diagnostics_endpoint
+        self.statsd = statsd
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServerConfig":
@@ -67,6 +69,7 @@ class ServerConfig:
             heartbeat_interval=float(d.get("heartbeat-interval", 5.0)),
             tracing=_parse_bool(d.get("tracing", False)),
             diagnostics_endpoint=d.get("diagnostics-endpoint", ""),
+            statsd=d.get("statsd", ""),
         )
 
     def to_dict(self) -> dict:
@@ -132,6 +135,13 @@ class Server:
             from pilosa_tpu.utils.tracing import global_tracer
 
             global_tracer().enabled = True
+        if self.config.statsd:
+            from pilosa_tpu.utils.stats import StatsdStatsClient, set_global_stats
+
+            host, _, port = self.config.statsd.partition(":")
+            set_global_stats(
+                StatsdStatsClient(host or "127.0.0.1", int(port or 8125))
+            )
         from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 
         self._diagnostics = DiagnosticsCollector(

@@ -86,6 +86,43 @@ class _Timer:
         return False
 
 
+class StatsdStatsClient(StatsClient):
+    """StatsClient that additionally emits statsd UDP datagrams (reference
+    stats/statsd/ backend; datadog-style |#tag:value extension)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "pilosa_tpu"):
+        super().__init__(prefix)
+        import socket
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._addr = (host, port)
+
+    def _emit(self, name: str, value, kind: str, tags: dict | None) -> None:
+        tag_part = ""
+        if tags:
+            tag_part = "|#" + ",".join(f"{k}:{v}" for k, v in sorted(tags.items()))
+        try:
+            self._sock.sendto(
+                f"{self.prefix}.{name}:{value}|{kind}{tag_part}".encode(),
+                self._addr,
+            )
+        except OSError:
+            pass  # stats must never disturb the engine
+
+    def count(self, name, value=1, tags=None):
+        super().count(name, value, tags)
+        self._emit(name, value, "c", tags)
+
+    def gauge(self, name, value, tags=None):
+        super().gauge(name, value, tags)
+        self._emit(name, value, "g", tags)
+
+    def timing(self, name, seconds, tags=None):
+        super().timing(name, seconds, tags)
+        self._emit(name, round(seconds * 1e3, 3), "ms", tags)
+
+
 class NopStatsClient(StatsClient):
     """Discards everything (reference stats.NopStatsClient)."""
 

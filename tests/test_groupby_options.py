@@ -1,0 +1,81 @@
+"""GroupBy aggregate=Sum and Options columnAttrs/excludeColumns tests."""
+
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.executor import PQLError
+from pilosa_tpu.storage import FieldOptions, Holder
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    yield holder, Executor(holder)
+    holder.close()
+
+
+def seed(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    amount = idx.create_field("amount", FieldOptions(type="int", min=-10, max=100))
+    values = {0: 5, 1: 10, 2: -10, 3: 100, 4: 7}
+    rows = {1: [0, 1, 2], 2: [3, 4]}
+    for row, cols in rows.items():
+        for c in cols:
+            f.set_bit(row, c)
+    for col, v in values.items():
+        amount.set_value(col, v)
+    idx.mark_columns_exist(sorted(values))
+    return idx, rows, values
+
+
+class TestGroupByAggregate:
+    def test_sum_per_group(self, env):
+        holder, ex = env
+        _, rows, values = seed(holder)
+        (groups,) = ex.execute(
+            "i", 'GroupBy(Rows(f), aggregate=Sum(field="amount"))'
+        )
+        got = {g.group[0]["rowID"]: (g.count, g.sum) for g in groups}
+        assert got[1] == (3, 5 + 10 - 10)
+        assert got[2] == (2, 107)
+        assert groups[0].to_json()["sum"] == 5
+
+    def test_sum_with_filter(self, env):
+        holder, ex = env
+        seed(holder)
+        (groups,) = ex.execute(
+            "i",
+            'GroupBy(Rows(f), filter=Row(amount > 6), aggregate=Sum(field="amount"))',
+        )
+        got = {g.group[0]["rowID"]: (g.count, g.sum) for g in groups}
+        assert got[1] == (1, 10)
+        assert got[2] == (2, 107)
+
+    def test_aggregate_requires_int_field(self, env):
+        holder, ex = env
+        seed(holder)
+        with pytest.raises(PQLError):
+            ex.execute("i", 'GroupBy(Rows(f), aggregate=Sum(field="f"))')
+
+
+class TestOptions:
+    def test_column_attrs(self, env):
+        holder, ex = env
+        idx, rows, _ = seed(holder)
+        idx.column_attrs.set_attrs(0, {"city": "sf"})
+        idx.column_attrs.set_attrs(2, {"city": "nyc"})
+        (res,) = ex.execute("i", "Options(Row(f=1), columnAttrs=true)")
+        assert res.column_attrs == [
+            {"id": 0, "attrs": {"city": "sf"}},
+            {"id": 2, "attrs": {"city": "nyc"}},
+        ]
+        assert res.to_json()["columnAttrs"] == res.column_attrs
+
+    def test_exclude_columns_keeps_attrs(self, env):
+        holder, ex = env
+        idx, _, _ = seed(holder)
+        idx.field("f").row_attrs.set_attrs(1, {"label": "x"})
+        (res,) = ex.execute("i", "Options(Row(f=1), excludeColumns=true)")
+        assert res.columns().size == 0
+        assert res.attrs == {"label": "x"}
