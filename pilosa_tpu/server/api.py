@@ -36,7 +36,8 @@ class API:
 
     # ---------------------------------------------------------------- query
 
-    def query(self, index: str, pql: str, shards=None, remote: bool = False) -> dict:
+    def query_raw(self, index: str, pql: str, shards=None, remote: bool = False):
+        """Execute and return raw result objects (serializer-agnostic)."""
         from pilosa_tpu.executor.executor import PQLError
         from pilosa_tpu.pql import ParseError
 
@@ -44,9 +45,12 @@ class API:
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
                 kwargs["remote"] = remote
-            results = self.executor.execute(index, pql, **kwargs)
+            return self.executor.execute(index, pql, **kwargs)
         except (ParseError, PQLError) as e:
             raise ApiError(str(e)) from e
+
+    def query(self, index: str, pql: str, shards=None, remote: bool = False) -> dict:
+        results = self.query_raw(index, pql, shards=shards, remote=remote)
         return {"results": [result_to_json(r) for r in results]}
 
     # --------------------------------------------------------------- schema

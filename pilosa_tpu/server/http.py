@@ -132,12 +132,48 @@ class HTTPHandler(BaseHTTPRequestHandler):
     # --------------------------------------------------------------- routes
 
     def post_query(self, index, query=None):
-        body = self._body().decode()
-        shards = None
-        if query and "shards" in query:
-            shards = [_int_param(s, "shards") for s in query["shards"][0].split(",")]
-        remote = bool(query and query.get("remote", ["false"])[0] == "true")
-        self._json(self.api.query(index, body, shards=shards, remote=remote))
+        raw = self._body()
+        content_type = self.headers.get("Content-Type", "")
+        accept = self.headers.get("Accept", "")
+        proto_in = "application/x-protobuf" in content_type
+        proto_out = "application/x-protobuf" in accept
+
+        if proto_in or proto_out:
+            from pilosa_tpu import wire
+
+            if not wire.available():
+                raise ApiError("protobuf wire format unavailable", 406)
+
+        if proto_in:
+            from pilosa_tpu.wire.serializer import decode_query_request
+
+            pql, shards, remote = decode_query_request(raw)
+        else:
+            pql = raw.decode()
+            shards = None
+            if query and "shards" in query:
+                shards = [
+                    _int_param(s, "shards") for s in query["shards"][0].split(",")
+                ]
+            remote = bool(query and query.get("remote", ["false"])[0] == "true")
+
+        if not proto_out:
+            self._json(self.api.query(index, pql, shards=shards, remote=remote))
+            return
+        from pilosa_tpu.wire.serializer import encode_error, encode_results
+
+        try:
+            results = self.api.query_raw(index, pql, shards=shards, remote=remote)
+            payload = encode_results(results)
+            status = 200
+        except ApiError as e:
+            payload = encode_error(str(e))
+            status = e.status
+        self.send_response(status)
+        self.send_header("Content-Type", "application/x-protobuf")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
 
     def post_index(self, index, query=None):
         body = self._json_body()
@@ -167,24 +203,34 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self._json({})
 
     def post_import(self, index, field, query=None):
-        body = self._json_body()
         remote = bool(query and query.get("remote", ["false"])[0] == "true")
+        if "application/x-protobuf" in self.headers.get("Content-Type", ""):
+            from pilosa_tpu.wire.serializer import decode_import_request
+
+            rows, columns, timestamps, clear = decode_import_request(self._body())
+        else:
+            body = self._json_body()
+            rows, columns = body.get("rows", []), body.get("columns", [])
+            timestamps = body.get("timestamps")
+            clear = bool(body.get("clear", False))
         changed = self.api.import_bits(
-            index, field,
-            body.get("rows", []), body.get("columns", []),
-            timestamps=body.get("timestamps"),
-            clear=bool(body.get("clear", False)),
+            index, field, rows, columns, timestamps=timestamps, clear=clear,
             remote=remote,
         )
         self._json({"changed": changed})
 
     def post_import_value(self, index, field, query=None):
-        body = self._json_body()
         remote = bool(query and query.get("remote", ["false"])[0] == "true")
+        if "application/x-protobuf" in self.headers.get("Content-Type", ""):
+            from pilosa_tpu.wire.serializer import decode_import_value_request
+
+            columns, values, clear = decode_import_value_request(self._body())
+        else:
+            body = self._json_body()
+            columns, values = body.get("columns", []), body.get("values", [])
+            clear = bool(body.get("clear", False))
         changed = self.api.import_values(
-            index, field, body.get("columns", []), body.get("values", []),
-            clear=bool(body.get("clear", False)),
-            remote=remote,
+            index, field, columns, values, clear=clear, remote=remote,
         )
         self._json({"changed": changed})
 

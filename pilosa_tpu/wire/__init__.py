@@ -1,0 +1,56 @@
+"""Protobuf wire format (generated on demand via protoc).
+
+``pb2()`` returns the generated module, compiling internal.proto on first
+use; returns None when protoc or the protobuf runtime is unavailable, in
+which case the HTTP layer serves JSON only (content negotiation degrades
+gracefully).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_PROTO = os.path.join(_DIR, "internal.proto")
+_GEN = os.path.join(_DIR, "internal_pb2.py")
+
+_pb2 = None
+_tried = False
+
+
+def pb2():
+    global _pb2, _tried
+    if _pb2 is not None or _tried:
+        return _pb2
+    _tried = True
+    try:
+        import google.protobuf  # noqa: F401
+    except ImportError:
+        return None
+    if not os.path.exists(_GEN) or (
+        os.path.getmtime(_GEN) < os.path.getmtime(_PROTO)
+    ):
+        protoc = shutil.which("protoc")
+        if protoc is None:
+            return None
+        try:
+            subprocess.run(
+                [protoc, f"--python_out={_DIR}", f"--proto_path={_DIR}",
+                 "internal.proto"],
+                check=True, capture_output=True, timeout=60,
+            )
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+            return None
+    try:
+        from pilosa_tpu.wire import internal_pb2
+
+        _pb2 = internal_pb2
+    except Exception:
+        _pb2 = None
+    return _pb2
+
+
+def available() -> bool:
+    return pb2() is not None

@@ -1,0 +1,140 @@
+"""Serializer between executor results and protobuf wire messages.
+
+Reference: encoding/proto Serializer (SURVEY.md §2 #16). The JSON path
+(result_to_json) stays canonical; this maps the same result objects to
+QueryResponse protos for clients negotiating application/x-protobuf.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
+from pilosa_tpu.wire import pb2
+
+RESULT_NIL = 0
+RESULT_ROW = 1
+RESULT_PAIRS = 2
+RESULT_COUNT = 3
+RESULT_CHANGED = 4
+RESULT_VALCOUNT = 5
+RESULT_GROUPS = 6
+RESULT_ROW_IDS = 7
+RESULT_ROW_KEYS = 8
+
+
+def _attrs_to_proto(m, attrs: dict) -> None:
+    for k, v in sorted(attrs.items()):
+        a = m.add()
+        a.key = k
+        if isinstance(v, bool):
+            a.type, a.bool_value = 3, v
+        elif isinstance(v, int):
+            a.type, a.int_value = 2, v
+        elif isinstance(v, float):
+            a.type, a.float_value = 4, v
+        else:
+            a.type, a.string_value = 1, str(v)
+
+
+def attrs_from_proto(attrs) -> dict:
+    out = {}
+    for a in attrs:
+        out[a.key] = {
+            1: a.string_value, 2: a.int_value, 3: a.bool_value, 4: a.float_value,
+        }.get(a.type, a.string_value)
+    return out
+
+
+def encode_results(results) -> bytes:
+    p = pb2()
+    resp = p.QueryResponse()
+    for res in results:
+        qr = resp.results.add()
+        _encode_result(qr, res)
+    return resp.SerializeToString()
+
+
+def _encode_result(qr, res) -> None:
+    if res is None:
+        qr.type = RESULT_NIL
+    elif isinstance(res, RowResult):
+        qr.type = RESULT_ROW
+        if res.keys is not None:
+            qr.row.keys.extend(res.keys)
+        else:
+            qr.row.columns.extend(int(c) for c in res.columns().tolist())
+        _attrs_to_proto(qr.row.attrs, res.attrs)
+    elif isinstance(res, bool):
+        qr.type = RESULT_CHANGED
+        qr.changed = res
+    elif isinstance(res, int):
+        qr.type = RESULT_COUNT
+        qr.n = res
+    elif isinstance(res, ValCount):
+        qr.type = RESULT_VALCOUNT
+        qr.val_count.value = res.value
+        qr.val_count.count = res.count
+    elif isinstance(res, list) and res and isinstance(res[0], Pair):
+        qr.type = RESULT_PAIRS
+        for pair in res:
+            pp = qr.pairs.add()
+            pp.id = pair.id
+            pp.count = pair.count
+            if pair.key is not None:
+                pp.key = pair.key
+    elif isinstance(res, list) and res and isinstance(res[0], GroupCount):
+        qr.type = RESULT_GROUPS
+        for g in res:
+            gg = qr.groups.add()
+            gg.count = g.count
+            if g.sum is not None:
+                gg.has_sum = True
+                gg.sum = g.sum
+            for entry in g.group:
+                fr = gg.group.add()
+                fr.field = entry["field"]
+                fr.row_id = entry["rowID"]
+    elif isinstance(res, list) and res and isinstance(res[0], str):
+        qr.type = RESULT_ROW_KEYS
+        qr.row_keys.extend(res)
+    elif isinstance(res, list):
+        qr.type = RESULT_ROW_IDS
+        qr.row_ids.extend(int(r) for r in res)
+    else:
+        qr.type = RESULT_NIL
+
+
+def encode_error(message: str) -> bytes:
+    p = pb2()
+    resp = p.QueryResponse()
+    resp.err = message
+    return resp.SerializeToString()
+
+
+def decode_query_request(data: bytes):
+    p = pb2()
+    req = p.QueryRequest()
+    req.ParseFromString(data)
+    return (
+        req.query,
+        list(req.shards) if req.shards else None,
+        req.remote,
+    )
+
+
+def decode_import_request(data: bytes):
+    p = pb2()
+    req = p.ImportRequest()
+    req.ParseFromString(data)
+    return (
+        list(req.row_ids),
+        list(req.column_ids),
+        list(req.timestamps) or None,
+        req.clear,
+    )
+
+
+def decode_import_value_request(data: bytes):
+    p = pb2()
+    req = p.ImportValueRequest()
+    req.ParseFromString(data)
+    return list(req.column_ids), list(req.values), req.clear

@@ -1,0 +1,145 @@
+"""Protobuf wire-format tests: content negotiation on /query and imports
+(reference encoding/proto + handler negotiation — SURVEY.md §2 #16)."""
+
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import wire
+from tests.test_http import node, req  # fixture reuse
+
+requires_proto = pytest.mark.skipif(
+    not wire.available(), reason="protoc/protobuf runtime unavailable"
+)
+
+
+def praw(method, url, body=None, content_type=None, accept=None):
+    r = urllib.request.Request(url, data=body, method=method)
+    if content_type:
+        r.add_header("Content-Type", content_type)
+    if accept:
+        r.add_header("Accept", accept)
+    with urllib.request.urlopen(r) as resp:
+        return resp.read(), resp.headers.get("Content-Type")
+
+
+@requires_proto
+def test_query_protobuf_roundtrip(node):
+    from pilosa_tpu.wire import pb2
+    from pilosa_tpu.wire.serializer import (
+        RESULT_CHANGED, RESULT_COUNT, RESULT_PAIRS, RESULT_ROW, RESULT_VALCOUNT,
+    )
+
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/field/v",
+        {"options": {"type": "int", "min": 0, "max": 100}})
+
+    p = pb2()
+    # protobuf request body + protobuf response
+    qr = p.QueryRequest(query="Set(3, f=1) Set(5, f=1)")
+    raw, ct = praw(
+        "POST", f"{node}/index/i/query", qr.SerializeToString(),
+        content_type="application/x-protobuf", accept="application/x-protobuf",
+    )
+    assert ct == "application/x-protobuf"
+    resp = p.QueryResponse(); resp.ParseFromString(raw)
+    assert [r.type for r in resp.results] == [RESULT_CHANGED] * 2
+    assert all(r.changed for r in resp.results)
+
+    req("POST", f"{node}/index/i/field/v/import-value",
+        {"columns": [3, 5], "values": [10, 20]})
+
+    qr = p.QueryRequest(
+        query='Row(f=1) Count(Row(f=1)) TopN(f, n=1) Sum(field="v")'
+    )
+    raw, _ = praw(
+        "POST", f"{node}/index/i/query", qr.SerializeToString(),
+        content_type="application/x-protobuf", accept="application/x-protobuf",
+    )
+    resp = p.QueryResponse(); resp.ParseFromString(raw)
+    row, count, topn, vc = resp.results
+    assert row.type == RESULT_ROW and list(row.row.columns) == [3, 5]
+    assert count.type == RESULT_COUNT and count.n == 2
+    assert topn.type == RESULT_PAIRS and topn.pairs[0].count == 2
+    assert vc.type == RESULT_VALCOUNT and (vc.val_count.value, vc.val_count.count) == (30, 2)
+
+
+@requires_proto
+def test_protobuf_request_json_response(node):
+    from pilosa_tpu.wire import pb2
+
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    p = pb2()
+    qr = p.QueryRequest(query="Count(Row(f=1))")
+    raw, ct = praw(
+        "POST", f"{node}/index/i/query", qr.SerializeToString(),
+        content_type="application/x-protobuf",
+    )
+    assert ct == "application/json"
+    import json
+
+    assert json.loads(raw) == {"results": [0]}
+
+
+@requires_proto
+def test_protobuf_import(node):
+    from pilosa_tpu.wire import pb2
+
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    p = pb2()
+    imp = p.ImportRequest(row_ids=[1, 1, 2], column_ids=[10, 11, 10])
+    out, _ = praw(
+        "POST", f"{node}/index/i/field/f/import", imp.SerializeToString(),
+        content_type="application/x-protobuf",
+    )
+    import json
+
+    assert json.loads(out)["changed"] == 3
+    assert req("POST", f"{node}/index/i/query", b"Count(Row(f=1))")["results"] == [2]
+
+    vimp = p.ImportValueRequest(column_ids=[7], values=[42])
+    req("POST", f"{node}/index/i/field/vv",
+        {"options": {"type": "int", "min": 0, "max": 100}})
+    out, _ = praw(
+        "POST", f"{node}/index/i/field/vv/import-value", vimp.SerializeToString(),
+        content_type="application/x-protobuf",
+    )
+    assert json.loads(out)["changed"] == 1
+
+
+@requires_proto
+def test_protobuf_error_response(node):
+    from pilosa_tpu.wire import pb2
+
+    req("POST", f"{node}/index/i", {})
+    p = pb2()
+    qr = p.QueryRequest(query="Row(missing=1)")
+    r = urllib.request.Request(
+        f"{node}/index/i/query", data=qr.SerializeToString(), method="POST")
+    r.add_header("Content-Type", "application/x-protobuf")
+    r.add_header("Accept", "application/x-protobuf")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(r)
+    resp = p.QueryResponse(); resp.ParseFromString(e.value.read())
+    assert "missing" in resp.err
+
+
+@requires_proto
+def test_groupby_and_keys_over_protobuf(node):
+    from pilosa_tpu.wire import pb2
+    from pilosa_tpu.wire.serializer import RESULT_GROUPS, RESULT_ROW
+
+    req("POST", f"{node}/index/k", {"options": {"keys": True}})
+    req("POST", f"{node}/index/k/field/likes", {"options": {"keys": True}})
+    req("POST", f"{node}/index/k/query", b'Set("a", likes="x") Set("b", likes="x")')
+    p = pb2()
+    qr = p.QueryRequest(query='Row(likes="x")')
+    raw, _ = praw("POST", f"{node}/index/k/query", qr.SerializeToString(),
+                  content_type="application/x-protobuf",
+                  accept="application/x-protobuf")
+    resp = p.QueryResponse(); resp.ParseFromString(raw)
+    assert resp.results[0].type == RESULT_ROW
+    assert sorted(resp.results[0].row.keys) == ["a", "b"]
