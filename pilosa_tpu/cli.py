@@ -251,6 +251,35 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_backup(args) -> int:
+    """Archive a data dir to a tar.gz (reference ctl backup — v0.x era;
+    the holder is file-based so a snapshot of the tree is a full backup)."""
+    import tarfile
+
+    data_dir = os.path.expanduser(args.data_dir)
+    if not os.path.isdir(data_dir):
+        print(f"error: no data dir {data_dir}", file=sys.stderr)
+        return 1
+    with tarfile.open(args.output, "w:gz") as tar:
+        tar.add(data_dir, arcname=".")
+    print(f"backed up {data_dir} -> {args.output}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    import tarfile
+
+    data_dir = os.path.expanduser(args.data_dir)
+    if os.path.isdir(data_dir) and os.listdir(data_dir):
+        print(f"error: {data_dir} exists and is not empty", file=sys.stderr)
+        return 1
+    os.makedirs(data_dir, exist_ok=True)
+    with tarfile.open(args.input, "r:gz") as tar:
+        tar.extractall(data_dir, filter="data")
+    print(f"restored {args.input} -> {data_dir}")
+    return 0
+
+
 def cmd_check(args) -> int:
     """Verify fragment files parse cleanly (reference ctl/check.go)."""
     import glob
@@ -320,6 +349,16 @@ def main(argv=None) -> int:
     p = sub.add_parser("check", help="verify fragment files")
     p.add_argument("-d", "--data-dir", required=True)
     p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("backup", help="archive a data dir to tar.gz")
+    p.add_argument("-d", "--data-dir", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.set_defaults(fn=cmd_backup)
+
+    p = sub.add_parser("restore", help="restore a tar.gz backup into a data dir")
+    p.add_argument("-d", "--data-dir", required=True)
+    p.add_argument("-i", "--input", required=True)
+    p.set_defaults(fn=cmd_restore)
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(fn=lambda a: (print(__version__), 0)[1])

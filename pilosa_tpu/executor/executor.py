@@ -42,7 +42,8 @@ TOPN_CANDIDATE_FACTOR = 4
 
 _RESERVED_ARGS = {"_field", "_col", "from", "to", "n", "limit", "offset",
                   "previous", "column", "filter", "field", "ids", "timestamp",
-                  "excludeColumns", "shards", "aggregate", "columnAttrs"}
+                  "excludeColumns", "shards", "aggregate", "columnAttrs",
+                  "attrName", "attrValue", "like"}
 
 
 class PQLError(ValueError):
@@ -568,6 +569,7 @@ class Executor:
                     continue
                 cand.update(r for r, _ in frag.top(overfetch))
             candidates = sorted(cand)
+        candidates = self._filter_topn_candidates(field, call, candidates)
         if not candidates:
             return []
 
@@ -598,6 +600,19 @@ class Executor:
             order = order[:n]
         return self._finish_pairs(idx, field, [Pair(r, -negc) for negc, r in order])
 
+    @staticmethod
+    def _filter_topn_candidates(field, call: Call, candidates: list[int]) -> list[int]:
+        """TopN(attrName=, attrValue=): keep candidate rows whose attrs
+        match (reference TopN attribute filter)."""
+        attr_name = call.arg("attrName")
+        if attr_name is None or field.row_attrs is None:
+            return candidates
+        attr_value = call.arg("attrValue")
+        return [
+            r for r in candidates
+            if field.row_attrs.attrs(r).get(attr_name) == attr_value
+        ]
+
     def _finish_pairs(self, idx: Index, field, pairs: list[Pair]) -> list[Pair]:
         """Attach row keys to TopN pairs for keyed fields."""
         if field.options.keys and pairs:
@@ -611,9 +626,20 @@ class Executor:
     def _execute_rows(self, idx: Index, call: Call, shards=None):
         field_name = call.arg("_field") or call.arg("field")
         field = idx.field(field_name) if field_name else None
+        like = call.arg("like")
+        if like is not None and (field is None or not field.options.keys):
+            raise PQLError("Rows(like=) requires a field with keys=true")
         ids = self._rows_ids(idx, call, shards)
         if field is not None and field.options.keys:
-            return [k for k in self._row_keys(idx, field, ids) if k is not None]
+            keys = [k for k in self._row_keys(idx, field, ids) if k is not None]
+            if like is not None:
+                import re
+
+                pattern = re.compile(
+                    "^" + ".*".join(re.escape(p) for p in str(like).split("%")) + "$"
+                )
+                keys = [k for k in keys if pattern.match(k)]
+            return keys
         return ids
 
     def _rows_ids(self, idx: Index, call: Call, shards=None) -> list[int]:

@@ -284,6 +284,7 @@ class DistExecutor(Executor):
                     continue
                 cand.update(r for r, _ in frag.top(overfetch))
             candidates = sorted(cand)
+        candidates = self._filter_topn_candidates(field, call, candidates)
         if not candidates:
             return []
 

@@ -62,6 +62,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
     ("GET", re.compile(r"^/debug/traces$"), "get_traces"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
+    ("GET", re.compile(r"^/debug/pprof/?$"), "get_pprof"),
 ]
 
 
@@ -265,6 +266,19 @@ class HTTPHandler(BaseHTTPRequestHandler):
         from pilosa_tpu.utils.stats import global_stats
 
         self._json(global_stats().snapshot())
+
+    def get_pprof(self, query=None):
+        """Thread stack dump (the /debug/pprof role for a python server)."""
+        import sys
+        import threading
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            out.append(f"--- thread {names.get(ident, ident)} ---")
+            out.extend(line.rstrip() for line in traceback.format_stack(frame))
+        self._text("\n".join(out))
 
     def get_export(self, query=None):
         index = (query.get("index") or [""])[0]

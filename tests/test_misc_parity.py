@@ -1,0 +1,79 @@
+"""Backup/restore, TopN attr filters, Rows like=, /debug/pprof."""
+
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cli import main
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.executor import PQLError
+from pilosa_tpu.storage import FieldOptions, Holder
+
+
+def test_backup_restore_roundtrip(tmp_path, capsys):
+    data_dir = str(tmp_path / "data")
+    csv = tmp_path / "bits.csv"
+    csv.write_text("1,10\n2,20\n")
+    main(["import", "-i", "i", "-f", "f", "-d", data_dir, "--create", str(csv)])
+    tarball = str(tmp_path / "backup.tar.gz")
+    assert main(["backup", "-d", data_dir, "-o", tarball]) == 0
+    restored = str(tmp_path / "restored")
+    assert main(["restore", "-d", restored, "-i", tarball]) == 0
+    capsys.readouterr()
+    main(["export", "-i", "i", "-f", "f", "-d", restored])
+    assert capsys.readouterr().out.splitlines() == ["1,10", "2,20"]
+    # refuses to clobber a non-empty dir
+    assert main(["restore", "-d", data_dir, "-i", tarball]) == 1
+
+
+def test_topn_attr_filter(tmp_path):
+    holder = Holder(str(tmp_path / "d")).open()
+    ex = Executor(holder)
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    for row, n in [(1, 5), (2, 9), (3, 7)]:
+        for c in range(n):
+            f.set_bit(row, c)
+    f.row_attrs.set_attrs(1, {"cat": "a"})
+    f.row_attrs.set_attrs(2, {"cat": "b"})
+    f.row_attrs.set_attrs(3, {"cat": "a"})
+    (pairs,) = ex.execute("i", 'TopN(f, n=5, attrName="cat", attrValue="a")')
+    assert [(p.id, p.count) for p in pairs] == [(3, 7), (1, 5)]
+    holder.close()
+
+
+def test_rows_like(tmp_path):
+    holder = Holder(str(tmp_path / "d")).open()
+    ex = Executor(holder)
+    holder.create_index("i", keys=True).create_field(
+        "tags", FieldOptions(keys=True)
+    )
+    for key in ("apple", "apricot", "banana", "grape"):
+        ex.execute("i", f'Set("c1", tags="{key}")')
+    assert ex.execute("i", 'Rows(tags, like="ap%")') == [["apple", "apricot"]]
+    assert ex.execute("i", 'Rows(tags, like="%ap%")') == [
+        ["apple", "apricot", "grape"]
+    ]
+    assert ex.execute("i", 'Rows(tags, like="%e")') == [["apple", "grape"]]
+    holder.close()
+
+
+def test_rows_like_requires_keys(tmp_path):
+    holder = Holder(str(tmp_path / "d")).open()
+    ex = Executor(holder)
+    holder.create_index("i").create_field("f")
+    with pytest.raises(PQLError):
+        ex.execute("i", 'Rows(f, like="x%")')
+    holder.close()
+
+
+def test_debug_pprof(tmp_path):
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http import serve_in_thread
+
+    holder = Holder(str(tmp_path / "d")).open()
+    server, port, _ = serve_in_thread(API(holder))
+    with urllib.request.urlopen(f"http://localhost:{port}/debug/pprof") as r:
+        text = r.read().decode()
+    assert "--- thread" in text
+    server.shutdown(); server.server_close(); holder.close()
