@@ -778,12 +778,26 @@ class Executor:
 
             recurse(0, None, ())
 
+        # Per-dimension rowID→rowKey translation for keyed fields (reference
+        # GroupBy FieldRow carries RowKey when the field has keys).
+        dim_keys: list[dict[int, str] | None] = []
+        for fname, row_ids in dims:
+            field = idx.field(fname)
+            if field is not None and field.options.keys:
+                translated = self._row_keys(idx, field, row_ids)
+                dim_keys.append(dict(zip(row_ids, translated)))
+            else:
+                dim_keys.append(None)
+
+        def field_row(i: int, row: int) -> dict:
+            keys = dim_keys[i]
+            if keys is not None and keys.get(row) is not None:
+                return {"field": dims[i][0], "rowKey": keys[row]}
+            return {"field": dims[i][0], "rowID": row}
+
         out = [
             GroupCount(
-                [
-                    {"field": dims[i][0], "rowID": row}
-                    for i, row in enumerate(key)
-                ],
+                [field_row(i, row) for i, row in enumerate(key)],
                 c,
                 sum=sums.get(key) if agg_field is not None else None,
             )

@@ -234,18 +234,41 @@ class ClusterExecutor:
             limit = call.arg("limit", 0)
             return out[: int(limit)] if limit else out
         if name == "GroupBy":
+            # Merge key per element: rowKey when the dim field is keyed,
+            # rowID otherwise (keyed dims emit rowKey from every node).
+            def gkey(group: list[dict]) -> tuple:
+                return tuple(
+                    e.get("rowKey", e.get("rowID")) for e in group
+                )
+
             counts: dict[tuple, int] = {}
+            sums: dict[tuple, int] = {}
             fields: dict[tuple, list] = {}
             for g in local_res:
-                key = tuple(e["rowID"] for e in g.group)
+                key = gkey(g.group)
                 counts[key] = counts.get(key, 0) + g.count
+                if g.sum is not None:
+                    sums[key] = sums.get(key, 0) + g.sum
                 fields[key] = g.group
             for p in partials:
                 for g in p:
-                    key = tuple(e["rowID"] for e in g["group"])
+                    key = gkey(g["group"])
                     counts[key] = counts.get(key, 0) + g["count"]
+                    if g.get("sum") is not None:
+                        sums[key] = sums.get(key, 0) + g["sum"]
                     fields[key] = g["group"]
-            out = [GroupCount(fields[k], c) for k, c in sorted(counts.items())]
+            # Type-aware ordering: numeric rowIDs sort numerically (matching
+            # the single-node executor), rowKeys lexicographically after.
+            def order(kv):
+                return tuple(
+                    (1, e) if isinstance(e, str) else (0, int(e))
+                    for e in kv[0]
+                )
+
+            out = [
+                GroupCount(fields[k], c, sum=sums.get(k))
+                for k, c in sorted(counts.items(), key=order)
+            ]
             limit = call.arg("limit", 0)
             return out[: int(limit)] if limit else out
         # bitmap calls → RowResult union

@@ -92,7 +92,10 @@ def _encode_result(qr, res) -> None:
             for entry in g.group:
                 fr = gg.group.add()
                 fr.field = entry["field"]
-                fr.row_id = entry["rowID"]
+                if "rowKey" in entry:
+                    fr.row_key = entry["rowKey"]
+                else:
+                    fr.row_id = entry["rowID"]
     elif isinstance(res, list) and res and isinstance(res[0], str):
         qr.type = RESULT_ROW_KEYS
         qr.row_keys.extend(res)

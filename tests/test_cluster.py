@@ -163,6 +163,28 @@ class TestDistributedQueries:
         ]
 
 
+    def test_groupby_aggregate_sum_across_nodes(self, cluster3):
+        req("POST", f"{uri(cluster3[0])}/index/i", {})
+        req("POST", f"{uri(cluster3[0])}/index/i/field/a", {})
+        req("POST", f"{uri(cluster3[0])}/index/i/field/amt",
+            {"options": {"type": "int", "min": 0, "max": 1000}})
+        cols, vals = [], []
+        for shard in range(4):
+            base = shard * SHARD_WIDTH
+            req("POST", f"{uri(cluster3[0])}/index/i/field/a/import",
+                {"rows": [1, 1], "columns": [base, base + 1]})
+            cols += [base, base + 1]
+            vals += [10 * (shard + 1), 1]
+        req("POST", f"{uri(cluster3[1])}/index/i/field/amt/import-value",
+            {"columns": cols, "values": vals})
+        out = req("POST", f"{uri(cluster3[2])}/index/i/query",
+                  b'GroupBy(Rows(a), aggregate=Sum(field="amt"))')
+        (g,) = out["results"][0]
+        assert g["group"] == [{"field": "a", "rowID": 1}]
+        assert g["count"] == 8
+        assert g["sum"] == sum(vals)
+
+
 class TestReplication:
     def test_replica_writes_land_on_two_nodes(self, tmp_path):
         servers = make_cluster(tmp_path, 3, replica_n=2)

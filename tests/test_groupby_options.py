@@ -79,3 +79,23 @@ class TestOptions:
         (res,) = ex.execute("i", "Options(Row(f=1), excludeColumns=true)")
         assert res.columns().size == 0
         assert res.attrs == {"label": "x"}
+
+
+class TestGroupByKeyedRows:
+    def test_keyed_dimension_emits_row_key(self, env):
+        holder, ex = env
+        idx = holder.create_index("k")
+        lang = idx.create_field("lang", FieldOptions(keys=True))
+        plain = idx.create_field("plain")
+        for key, cols in {"go": [0, 1], "py": [1, 2]}.items():
+            for c in cols:
+                ex.execute("k", f'Set({c}, lang="{key}")')
+        for c in range(3):
+            plain.set_bit(5, c)
+        (groups,) = ex.execute("k", "GroupBy(Rows(lang), Rows(plain))")
+        got = {
+            (g.group[0].get("rowKey"), g.group[1].get("rowID")): g.count
+            for g in groups
+        }
+        assert got == {("go", 5): 2, ("py", 5): 2}
+        assert all("rowKey" not in g.group[1] for g in groups)

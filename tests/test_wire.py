@@ -143,3 +143,24 @@ def test_groupby_and_keys_over_protobuf(node):
     resp = p.QueryResponse(); resp.ParseFromString(raw)
     assert resp.results[0].type == RESULT_ROW
     assert sorted(resp.results[0].row.keys) == ["a", "b"]
+
+
+@requires_proto
+def test_keyed_groupby_over_protobuf(node):
+    from pilosa_tpu.wire import pb2
+    from pilosa_tpu.wire.serializer import RESULT_GROUPS
+
+    req("POST", f"{node}/index/g", {})
+    req("POST", f"{node}/index/g/field/lang", {"options": {"keys": True}})
+    req("POST", f"{node}/index/g/query",
+        b'Set(1, lang="go") Set(2, lang="go") Set(2, lang="py")')
+    p = pb2()
+    qr = p.QueryRequest(query="GroupBy(Rows(lang))")
+    raw, _ = praw("POST", f"{node}/index/g/query", qr.SerializeToString(),
+                  content_type="application/x-protobuf",
+                  accept="application/x-protobuf")
+    resp = p.QueryResponse(); resp.ParseFromString(raw)
+    assert resp.results[0].type == RESULT_GROUPS
+    got = {g.group[0].row_key: g.count for g in resp.results[0].groups}
+    assert got == {"go": 2, "py": 1}
+    assert all(g.group[0].field == "lang" for g in resp.results[0].groups)
