@@ -676,12 +676,17 @@ class Executor:
 
     # -------------------------------------------------------------- GroupBy
 
-    def _execute_groupby(self, idx: Index, call: Call, shards=None) -> list[GroupCount]:
+    def _groupby_prelude(self, idx: Index, call: Call, shards=None):
+        """Shared GroupBy argument parsing/validation: returns
+        (limit, filter call|None, aggregate int field|None, dims) where
+        dims is [(field_name, row_ids), ...]; dims is empty when any
+        dimension has no rows (→ empty result)."""
         if not call.children or any(c.name != "Rows" for c in call.children):
             raise PQLError("GroupBy requires Rows(...) children")
         limit = call.arg("limit", 0)
         filt_call = call.arg("filter")
-        shard_list = self._shards(idx, shards)
+        if not isinstance(filt_call, Call):
+            filt_call = None
 
         # aggregate=Sum(field=...) (reference GroupBy aggregate, v1.4+)
         agg_call = call.arg("aggregate")
@@ -699,14 +704,74 @@ class Executor:
             fname = child.arg("_field") or child.arg("field")
             row_ids = self._rows_ids(idx, child, shards)
             if not row_ids:
-                return []
+                return limit, filt_call, agg_field, []
             dims.append((fname, row_ids))
+        return limit, filt_call, agg_field, dims
+
+    def _groupby_result(
+        self, idx: Index, dims, counts: dict, sums: dict, agg_field, limit
+    ) -> list[GroupCount]:
+        """Shared GroupBy result construction: rowID→rowKey translation for
+        keyed dimension fields (reference GroupBy FieldRow carries RowKey
+        when the field has keys), ordering, limit."""
+        dim_keys: list[dict[int, str] | None] = []
+        for fname, row_ids in dims:
+            field = idx.field(fname)
+            if field is not None and field.options.keys:
+                translated = self._row_keys(idx, field, row_ids)
+                dim_keys.append(dict(zip(row_ids, translated)))
+            else:
+                dim_keys.append(None)
+
+        def field_row(i: int, row: int) -> dict:
+            keys = dim_keys[i]
+            if keys is not None and keys.get(row) is not None:
+                return {"field": dims[i][0], "rowKey": keys[row]}
+            return {"field": dims[i][0], "rowID": row}
+
+        # Order by the emitted representation — numeric rowIDs first
+        # (numerically), then rowKeys (lexicographically) — so every
+        # execution path (single-node, SPMD, cluster merge) agrees on
+        # ordering and limit truncation.
+        def order(key: tuple) -> tuple:
+            return tuple(
+                (1, keys[row]) if (keys := dim_keys[i]) is not None
+                and keys.get(row) is not None else (0, row)
+                for i, row in enumerate(key)
+            )
+
+        out = [
+            GroupCount(
+                [field_row(i, row) for i, row in enumerate(key)],
+                c,
+                sum=sums.get(key) if agg_field is not None else None,
+            )
+            for key, c in sorted(counts.items(), key=lambda kv: order(kv[0]))
+        ]
+        if limit:
+            out = out[: int(limit)]
+        return out
+
+    def _execute_groupby(self, idx: Index, call: Call, shards=None) -> list[GroupCount]:
+        limit, filt_call, agg_field, dims = self._groupby_prelude(idx, call, shards)
+        if not dims:
+            return []
+        return self._groupby_host(
+            idx, shards, limit, filt_call, agg_field, dims
+        )
+
+    def _groupby_host(
+        self, idx: Index, shards, limit, filt_call, agg_field, dims
+    ) -> list[GroupCount]:
+        """Per-shard host loop with cross-product pruning (the reference's
+        executeGroupByShard recursion)."""
+        shard_list = self._shards(idx, shards)
 
         specs: list = []
         scalars: list = []
         filt_node = (
             self._compile_node(idx, filt_call, specs, scalars)
-            if isinstance(filt_call, Call)
+            if filt_call is not None
             else None
         )
 
@@ -778,34 +843,7 @@ class Executor:
 
             recurse(0, None, ())
 
-        # Per-dimension rowID→rowKey translation for keyed fields (reference
-        # GroupBy FieldRow carries RowKey when the field has keys).
-        dim_keys: list[dict[int, str] | None] = []
-        for fname, row_ids in dims:
-            field = idx.field(fname)
-            if field is not None and field.options.keys:
-                translated = self._row_keys(idx, field, row_ids)
-                dim_keys.append(dict(zip(row_ids, translated)))
-            else:
-                dim_keys.append(None)
-
-        def field_row(i: int, row: int) -> dict:
-            keys = dim_keys[i]
-            if keys is not None and keys.get(row) is not None:
-                return {"field": dims[i][0], "rowKey": keys[row]}
-            return {"field": dims[i][0], "rowID": row}
-
-        out = [
-            GroupCount(
-                [field_row(i, row) for i, row in enumerate(key)],
-                c,
-                sum=sums.get(key) if agg_field is not None else None,
-            )
-            for key, c in sorted(counts.items())
-        ]
-        if limit:
-            out = out[: int(limit)]
-        return out
+        return self._groupby_result(idx, dims, counts, sums, agg_field, limit)
 
     # ---------------------------------------------------------------- writes
 

@@ -234,8 +234,28 @@ class ClusterExecutor:
             limit = call.arg("limit", 0)
             return out[: int(limit)] if limit else out
         if name == "GroupBy":
+            # Normalize each element to rowKey for keyed dim fields before
+            # merging: a node whose translate replica lags emits rowID for
+            # a row others report by key, which must not split the group.
+            keyed: dict[str, bool] = {}
+
+            def normalize(group) -> list[dict]:
+                out = []
+                for e in group:
+                    fname = e["field"]
+                    if fname not in keyed:
+                        f = idx.field(fname)
+                        keyed[fname] = bool(f and f.options.keys)
+                    if keyed[fname] and "rowKey" not in e:
+                        f = idx.field(fname)
+                        (key,) = self.local._row_keys(idx, f, [e["rowID"]])
+                        if key is not None:
+                            e = {"field": fname, "rowKey": key}
+                    out.append(e)
+                return out
+
             # Merge key per element: rowKey when the dim field is keyed,
-            # rowID otherwise (keyed dims emit rowKey from every node).
+            # rowID otherwise.
             def gkey(group: list[dict]) -> tuple:
                 return tuple(
                     e.get("rowKey", e.get("rowID")) for e in group
@@ -245,18 +265,20 @@ class ClusterExecutor:
             sums: dict[tuple, int] = {}
             fields: dict[tuple, list] = {}
             for g in local_res:
-                key = gkey(g.group)
+                group = normalize(g.group)
+                key = gkey(group)
                 counts[key] = counts.get(key, 0) + g.count
                 if g.sum is not None:
                     sums[key] = sums.get(key, 0) + g.sum
-                fields[key] = g.group
+                fields[key] = group
             for p in partials:
                 for g in p:
-                    key = gkey(g["group"])
+                    group = normalize(g["group"])
+                    key = gkey(group)
                     counts[key] = counts.get(key, 0) + g["count"]
                     if g.get("sum") is not None:
                         sums[key] = sums.get(key, 0) + g["sum"]
-                    fields[key] = g["group"]
+                    fields[key] = group
             # Type-aware ordering: numeric rowIDs sort numerically (matching
             # the single-node executor), rowKeys lexicographically after.
             def order(kv):

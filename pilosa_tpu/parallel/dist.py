@@ -42,6 +42,78 @@ from pilosa_tpu.storage.view import VIEW_STANDARD
 
 _DIST_JIT_CACHE: dict = {}
 
+# Cross-products larger than this fall back to the pruned host loop: the
+# dense on-device cross product evaluates every combination, which stops
+# paying off when most groups are empty.
+GROUPBY_DENSE_MAX_GROUPS = 4096
+
+
+def _groupby_fn(mesh, filt_structure, n_filt_leaves: int, n_scalars: int,
+                n_dims: int, has_agg: bool):
+    """SPMD GroupBy: per shard, AND the dimension row-matrices into a dense
+    cross-product mask tensor, popcount per group, and psum over the shard
+    axis. With an aggregate, per-group BSI plane counts ride the same
+    program (mirrors expr 'bsisum' semantics per group)."""
+    key = ("groupby", mesh, filt_structure, n_filt_leaves, n_scalars,
+           n_dims, has_agg)
+    fn = _DIST_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_leaves = n_filt_leaves + n_dims + (1 if has_agg else 0)
+    in_specs = tuple(P(SHARDS_AXIS) for _ in range(n_leaves)) + tuple(
+        P() for _ in range(n_scalars)
+    )
+    out_specs = (P(), P(), P()) if has_agg else P()
+
+    def body(*args):
+        leaves = args[:n_leaves]
+        scalars = args[n_leaves:]
+
+        def per_shard(*ls):
+            filt_leaves = ls[:n_filt_leaves]
+            dim_mats = ls[n_filt_leaves:n_filt_leaves + n_dims]
+            mask = dim_mats[0]  # [n_0, W]
+            for d in dim_mats[1:]:
+                mask = mask[..., None, :] & d  # → [n_0, …, n_i, W]
+            if filt_structure is not None:
+                f = expr._go(filt_structure, filt_leaves, scalars)
+                mask = mask & f
+            counts = jnp.sum(
+                lax.population_count(mask).astype(jnp.int32), axis=-1
+            )
+            if not has_agg:
+                return counts
+            planes = ls[n_filt_leaves + n_dims]
+            gmask = mask & planes[expr.PLANES_EXISTS]
+            n_g = jnp.sum(
+                lax.population_count(gmask).astype(jnp.int32), axis=-1
+            )
+            plane_counts = jnp.stack([
+                jnp.sum(
+                    lax.population_count(planes[b] & gmask).astype(jnp.int32),
+                    axis=-1,
+                )
+                for b in range(expr.PLANES_OFFSET, planes.shape[0])
+            ])  # [depth, n_0, …, n_k]
+            return counts, n_g, plane_counts
+
+        out = jax.vmap(per_shard)(*leaves)
+        if not has_agg:
+            return lax.psum(jnp.sum(out, axis=0), SHARDS_AXIS)
+        counts, n_g, plane_counts = out
+        return (
+            lax.psum(jnp.sum(counts, axis=0), SHARDS_AXIS),
+            lax.psum(jnp.sum(n_g, axis=0), SHARDS_AXIS),
+            lax.psum(jnp.sum(plane_counts, axis=0), SHARDS_AXIS),
+        )
+
+    fn = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    )
+    _DIST_JIT_CACHE[key] = fn
+    return fn
+
 
 def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
     """Build (or fetch) the compiled SPMD evaluator for a query shape."""
@@ -256,6 +328,104 @@ class DistExecutor(Executor):
             return ValCount(0, 0)
         return ValCount(best + base, count)
 
+    def _stacked_matrix(self, idx, field_name: str, view, row_ids, assignment):
+        """Mesh-sharded stack ``uint32[S_padded, len(row_ids), words]`` of
+        the given rows of one view, cached in HBM like other leaves."""
+        cache = residency.global_row_cache()
+        gen = cache.write_generation
+        key = ("stackm", gen, idx.name, field_name,
+               view.name if view is not None else None, tuple(row_ids),
+               assignment.key())
+
+        def decode():
+            def per_shard(shard):
+                frag = view.fragment(shard) if view else None
+                if frag is None:
+                    return np.zeros((len(row_ids), WORDS_PER_SHARD), np.uint32)
+                return np.stack([frag.row_words(r) for r in row_ids])
+
+            return assignment.stack(per_shard)
+
+        sharding = self._sharding()
+        return cache.get_row(
+            key, decode, device_put=lambda host: jax.device_put(host, sharding)
+        )
+
+    def _execute_groupby(self, idx, call, shards=None):
+        """GroupBy as ONE SPMD program: dense cross-product of dimension
+        rows evaluated per shard on its owning device, group counts (and
+        BSI plane counts for aggregate=Sum) psum-reduced over the mesh.
+
+        Replaces the reference's per-shard recursion with pruning
+        (executor.executeGroupByShard) by a dense batched evaluation —
+        the TPU-friendly shape — falling back to the pruned host loop when
+        the cross product is too large to pay for itself."""
+        limit, filt_call, agg_field, dims = self._groupby_prelude(
+            idx, call, shards
+        )
+        if not dims:
+            return []
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return []
+        n_groups = 1
+        for _, row_ids in dims:
+            n_groups *= len(row_ids)
+        if n_groups > GROUPBY_DENSE_MAX_GROUPS:
+            return self._groupby_host(
+                idx, shards, limit, filt_call, agg_field, dims
+            )
+
+        specs: list = []
+        scalars: list = []
+        filt_node = (
+            self._compile_node(idx, filt_call, specs, scalars)
+            if filt_call is not None
+            else None
+        )
+        assignment = ShardAssignment(shard_list, self.mesh)
+        leaves = [
+            self._stacked_leaf(idx, spec, assignment) for spec in specs
+        ]
+        for fname, row_ids in dims:
+            field = idx.field(fname)
+            view = field.view(VIEW_STANDARD) if field else None
+            leaves.append(
+                self._stacked_matrix(idx, fname, view, row_ids, assignment)
+            )
+        if agg_field is not None:
+            leaves.append(
+                self._stacked_leaf(
+                    idx, _PlanesSpec(agg_field.name), assignment
+                )
+            )
+        fn = _groupby_fn(
+            self.mesh, filt_node, len(specs), len(scalars),
+            len(dims), agg_field is not None,
+        )
+        jscalars = tuple(jnp.asarray(s, jnp.int32) for s in scalars)
+        out = fn(*leaves, *jscalars)
+
+        if agg_field is not None:
+            counts_nd, n_nd, pc_nd = (np.asarray(o) for o in out)
+        else:
+            counts_nd = np.asarray(out)
+            n_nd = pc_nd = None
+        counts: dict[tuple, int] = {}
+        sums: dict[tuple, int] = {}
+        base = agg_field.options.base if agg_field is not None else 0
+        for flat, c in enumerate(counts_nd.reshape(-1).tolist()):
+            if c <= 0:
+                continue
+            idxs = np.unravel_index(flat, counts_nd.shape)
+            gkey = tuple(dims[d][1][i] for d, i in enumerate(idxs))
+            counts[gkey] = int(c)
+            if agg_field is not None:
+                pc = pc_nd[(slice(None),) + idxs].tolist()
+                n = int(n_nd[idxs])
+                sums[gkey] = sum(v << b for b, v in enumerate(pc)) + base * n
+        return self._groupby_result(idx, dims, counts, sums, agg_field, limit)
+
     def _execute_topn(self, idx, call, shards=None) -> list[Pair]:
         from pilosa_tpu.executor.executor import TOPN_CANDIDATE_FACTOR
 
@@ -296,26 +466,7 @@ class DistExecutor(Executor):
         )
         node = ("countrows", len(specs), filt_node)
         assignment = ShardAssignment(shard_list, self.mesh)
-        cache = residency.global_row_cache()
-        gen = cache.write_generation
-        key = ("stackm", gen, idx.name, field_name, tuple(candidates),
-               assignment.key())
-
-        def decode():
-            def per_shard(shard):
-                frag = view.fragment(shard) if view else None
-                if frag is None:
-                    return np.zeros(
-                        (len(candidates), WORDS_PER_SHARD), np.uint32
-                    )
-                return np.stack([frag.row_words(r) for r in candidates])
-
-            return assignment.stack(per_shard)
-
-        sharding = self._sharding()
-        matrix = cache.get_row(
-            key, decode, device_put=lambda host: jax.device_put(host, sharding)
-        )
+        matrix = self._stacked_matrix(idx, field_name, view, candidates, assignment)
         compiled = _Compiled(node, specs, scalars)
         counts, _ = self._dist_eval(
             idx, compiled, shard_list, "countrows", extra_leaves=(matrix,)

@@ -135,3 +135,41 @@ class TestDistConsistency:
         (r1,) = base.execute("big", "Count(Row(f=1))")
         (r3,) = dist3.execute("big", "Count(Row(f=1))")
         assert r1 == r3
+
+
+class TestDistGroupBy:
+    def groups_json(self, res):
+        return [g.to_json() for g in res]
+
+    def test_groupby_matches_single(self, env):
+        r1, r2 = both(env, "GroupBy(Rows(f), Rows(g))")
+        assert self.groups_json(r1) == self.groups_json(r2)
+        assert r1  # non-empty
+
+    def test_groupby_with_filter(self, env):
+        r1, r2 = both(env, "GroupBy(Rows(f), Rows(g), filter=Row(fare > 100))")
+        assert self.groups_json(r1) == self.groups_json(r2)
+
+    def test_groupby_aggregate_sum(self, env):
+        r1, r2 = both(env, 'GroupBy(Rows(f), aggregate=Sum(field="fare"))')
+        assert self.groups_json(r1) == self.groups_json(r2)
+        assert any(g.sum is not None for g in r2)
+
+    def test_groupby_aggregate_sum_with_filter(self, env):
+        r1, r2 = both(
+            env,
+            'GroupBy(Rows(f), Rows(g), filter=Row(fare > 0), aggregate=Sum(field="fare"))',
+        )
+        assert self.groups_json(r1) == self.groups_json(r2)
+
+    def test_groupby_limit(self, env):
+        r1, r2 = both(env, "GroupBy(Rows(f), Rows(g), limit=1)")
+        assert self.groups_json(r1) == self.groups_json(r2)
+        assert len(r2) == 1
+
+    def test_groupby_dense_fallback_threshold(self, env, monkeypatch):
+        import pilosa_tpu.parallel.dist as dist_mod
+
+        monkeypatch.setattr(dist_mod, "GROUPBY_DENSE_MAX_GROUPS", 1)
+        r1, r2 = both(env, "GroupBy(Rows(f), Rows(g))")
+        assert self.groups_json(r1) == self.groups_json(r2)
