@@ -1,0 +1,341 @@
+"""Batched shard evaluation: one compiled program, one device sync per query.
+
+The reference executor maps shards with a goroutine pool and reduces
+partials on the host (executor.go mapReduce — SURVEY.md §3.2). A literal
+translation — one device dispatch + one host readback per shard — is
+hostile to TPU serving: a blocking device→host sync costs a full
+host↔device round trip, so per-shard syncs put the query floor at
+O(shards × RTT). Here the whole map+reduce phase is ONE XLA program over
+stacked leaves ``uint32[n_shards, ...]`` (vmapped per shard, reduced on
+device) and exactly ONE packed result array crosses back to the host.
+
+Leaves are built once per (query leaf, shard set, write generation) and
+cached in device HBM via the residency LRU (storage.residency), so
+steady-state queries touch the host only for the final packed result.
+
+``ShardBlock`` is the local (single-device) layout; parallel.mesh's
+``ShardAssignment`` extends it with mesh padding, and parallel.dist swaps
+the program builder for shard_map+psum versions of the same reductions.
+
+Reduce kinds and their packed results (all int32 unless noted):
+  'count'     → [2]: split-sum scalar (see below)
+  'countrows' → [2, n_rows] split sums
+  'bsisum'    → [2, depth + 1]: per-plane popcount split sums ++ [n]
+  'min'/'max' → [3]: [offset-encoded extremum, count_lo, count_hi]
+                (count==0 → empty)
+  'row'       → uint32[n_shards_padded, words] (stays dense; the only
+                multi-row readback)
+
+Split sums: device accumulators are int32 (no x64), and a per-shard
+popcount can reach 2^20, so a plain int32 sum wraps past ~2^11 full
+shards. Every cross-shard sum is therefore carried in two int32 channels
+— lo 15 bits and hi bits of each per-shard value summed separately —
+and recombined on the host as ``hi·2^15 + lo``, exact to 2^15 shards
+(32 billion columns) per query.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pilosa_tpu.executor import expr
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+from pilosa_tpu.storage import residency
+
+INT32_MIN = -(1 << 31)
+INT32_MAX = (1 << 31) - 1
+
+# Split-sum carry point: per-shard summands are ≤ 2^20, so the lo channel
+# (15 bits) sums safely over 2^16 shards and the hi channel (≤ 2^5 per
+# shard) even further.
+SPLIT_SHIFT = 15
+SPLIT_MASK = (1 << SPLIT_SHIFT) - 1
+
+
+def split_sum(x, axis=None):
+    """Sum int32 per-shard values in two overflow-safe int32 channels.
+    Returns stacked [2, ...]: (lo-bit sums, hi-bit sums)."""
+    lo = jnp.sum(x & SPLIT_MASK, axis=axis)
+    hi = jnp.sum(x >> SPLIT_SHIFT, axis=axis)
+    return jnp.stack([lo, hi])
+
+
+def merge_split(packed: np.ndarray) -> np.ndarray:
+    """Host-side recombination of split sums [2, ...] → int64 [...]."""
+    packed = np.asarray(packed, np.int64)
+    return (packed[1] << SPLIT_SHIFT) + packed[0]
+
+
+class ShardBlock:
+    """Orders a query's shard list as the leading axis of stacked leaves.
+
+    Local form: no padding beyond a floor of one slot. The mesh form
+    (parallel.mesh.ShardAssignment) pads to a multiple of the device count
+    so the leading axis shards evenly.
+    """
+
+    def __init__(self, shards: list[int]):
+        self.shards = sorted(shards)
+        self.padded = max(len(self.shards), 1)
+        self.n_devices = 1
+
+    def key(self) -> tuple:
+        return (tuple(self.shards), self.padded, self.n_devices)
+
+    def stack(self, per_shard_fn) -> np.ndarray:
+        """Build the [padded, ...] host array: per_shard_fn(shard) → row
+        block; empty slots are zeros."""
+        first = per_shard_fn(self.shards[0]) if self.shards else None
+        inner_shape = first.shape if first is not None else ()
+        out = np.zeros((self.padded,) + tuple(inner_shape), np.uint32)
+        for i, s in enumerate(self.shards):
+            out[i] = first if i == 0 else per_shard_fn(s)
+        return out
+
+
+# ------------------------------------------------------- host decode helpers
+
+
+def host_row(idx, spec, shard: int) -> np.ndarray:
+    """Dense uint32[words] for a _RowSpec leaf on one shard (host side)."""
+    field = idx.field(spec.field)
+    acc = None
+    for vname in spec.views:
+        view = field.view(vname) if field else None
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            continue
+        words = frag.row_words(spec.row)
+        acc = words if acc is None else np.bitwise_or(acc, words)
+    return acc if acc is not None else np.zeros(WORDS_PER_SHARD, np.uint32)
+
+
+def host_planes(idx, spec, shard: int, depth: int) -> np.ndarray:
+    """uint32[depth, words] BSI plane matrix for one shard (host side)."""
+    field = idx.field(spec.field)
+    view = field.view(field.bsi_view_name())
+    frag = view.fragment(shard) if view else None
+    if frag is None:
+        return np.zeros((depth, WORDS_PER_SHARD), np.uint32)
+    return np.stack([frag.row_words(r) for r in range(depth)])
+
+
+# ------------------------------------------------------ cached stacked leaves
+
+
+def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
+    """Device-resident stacked leaf for a compiled spec, via the residency
+    LRU. ``device_put`` overrides placement (mesh sharding)."""
+    from pilosa_tpu.executor.executor import (
+        PQLError,
+        _PlanesSpec,
+        _RowSpec,
+        _ZeroSpec,
+    )
+
+    cache = residency.global_row_cache()
+    gen = cache.write_generation
+    if isinstance(spec, _RowSpec):
+        key = ("stack", gen, idx.name, spec.field, spec.views, spec.row,
+               block.key())
+
+        def decode():
+            return block.stack(lambda shard: host_row(idx, spec, shard))
+    elif isinstance(spec, _PlanesSpec):
+        field = idx.field(spec.field)
+        depth = 2 + field.options.bit_depth
+        key = ("stackp", gen, idx.name, spec.field, depth, block.key())
+
+        def decode():
+            return block.stack(
+                lambda shard: host_planes(idx, spec, shard, depth)
+            )
+    elif isinstance(spec, _ZeroSpec):
+        key = ("stackz", block.padded)
+
+        def decode():
+            return np.zeros((block.padded, WORDS_PER_SHARD), np.uint32)
+    else:
+        raise PQLError(f"unknown leaf spec {type(spec).__name__}")
+
+    return cache.get_row(key, decode, device_put=device_put)
+
+
+def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
+                   device_put=None):
+    """Stacked row matrix ``uint32[padded, len(row_ids), words]`` of one
+    view (TopN phase-2 candidates, GroupBy dimensions), HBM-cached."""
+    cache = residency.global_row_cache()
+    gen = cache.write_generation
+    key = ("stackm", gen, idx.name, field_name,
+           view.name if view is not None else None, tuple(row_ids),
+           block.key())
+
+    def decode():
+        def per_shard(shard):
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                return np.zeros((len(row_ids), WORDS_PER_SHARD), np.uint32)
+            return np.stack([frag.row_words(r) for r in row_ids])
+
+        return block.stack(per_shard)
+
+    return cache.get_row(key, decode, device_put=device_put)
+
+
+# ------------------------------------------------------ local program builder
+
+_LOCAL_JIT_CACHE: dict = {}
+
+
+def minmax_mask(values, counts, want_max: bool):
+    """Per-shard masking for the Min/Max merge: shards with no candidates
+    (count 0 — including padded slots) are replaced by the opposite-extreme
+    sentinel so they lose every comparison. Returns (masked, valid)."""
+    valid = counts > 0
+    sentinel = INT32_MIN if want_max else INT32_MAX
+    return jnp.where(valid, values, sentinel), valid
+
+
+def minmax_at_best(values, counts, valid, best):
+    """Split-sum count of candidates holding the extremum (pre-reduction:
+    the SPMD builder psums this across the mesh before packing)."""
+    return split_sum(jnp.where(valid & (values == best), counts, 0))
+
+
+def minmax_finalize(best, n, any_valid):
+    """Pack [best, count_lo, count_hi] int32 (count 0 → empty result)."""
+    best = jnp.where(any_valid, best, 0)
+    return jnp.concatenate([best.astype(jnp.int32)[None], n])
+
+
+def minmax_merge(values, counts, want_max: bool):
+    """Device-side cross-shard Min/Max merge (single device: plain
+    reductions; the SPMD builder composes the same helpers with pmax/psum)."""
+    masked, valid = minmax_mask(values, counts, want_max)
+    best = jnp.max(masked) if want_max else jnp.min(masked)
+    n = minmax_at_best(values, counts, valid, best)
+    return minmax_finalize(best, n, jnp.any(valid))
+
+
+def local_fn(structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
+    """Build (or fetch) the single-device batched evaluator for a query
+    shape: vmap over the stacked shard axis + on-device reduction."""
+    key = ("local", structure, reduce_kind, leaf_ranks, n_scalars)
+    fn = _LOCAL_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_leaves = len(leaf_ranks)
+
+    def body(*args):
+        leaves = args[:n_leaves]
+        scalars = args[n_leaves:]
+
+        def per_shard(*ls):
+            return expr._go(structure, ls, scalars)
+
+        out = jax.vmap(per_shard)(*leaves)
+        if reduce_kind == "count":
+            return split_sum(out)
+        if reduce_kind == "countrows":
+            return split_sum(out, axis=0)
+        if reduce_kind == "bsisum":
+            plane_counts, n = out  # [S, depth], [S]
+            return jnp.concatenate(
+                [split_sum(plane_counts, axis=0),
+                 split_sum(n)[:, None]], axis=1
+            )
+        if reduce_kind in ("min", "max"):
+            values, counts = out
+            return minmax_merge(values, counts, reduce_kind == "max")
+        return out  # 'row': [padded, words]
+
+    fn = jax.jit(body)
+    _LOCAL_JIT_CACHE[key] = fn
+    return fn
+
+
+# HBM budget for the materialized per-level group masks ([C, words] per
+# gathered dimension per shard block). Chunks are sized so the gathered
+# intermediates stay under this even at full shard counts.
+GROUPBY_MASK_BUDGET_BYTES = 256 << 20
+
+
+def groupby_chunk_groups(block: ShardBlock, n_gather: int, depth: int) -> int:
+    """Max candidate groups per level chunk under the mask byte budget."""
+    s_per_dev = -(-block.padded // block.n_devices)
+    bytes_per_group = s_per_dev * WORDS_PER_SHARD * 4 * (n_gather + depth)
+    return max(1, GROUPBY_MASK_BUDGET_BYTES // max(bytes_per_group, 1))
+
+
+def groupby_level_body(ls, idxs, scalars, filt_structure, n_filt: int,
+                       n_gather: int, has_agg: bool):
+    """Per-shard GroupBy level kernel shared by the local and SPMD
+    builders: gather each candidate's row from every dimension matrix,
+    AND them into [C, words] group masks, popcount per candidate; with an
+    aggregate also per-candidate BSI plane counts (expr 'bsisum' semantics
+    per group)."""
+    filt_leaves = ls[:n_filt]
+    dim_mats = ls[n_filt:n_filt + n_gather]
+    mask = jnp.take(dim_mats[0], idxs[0], axis=0)  # [C, W]
+    for d, ii in zip(dim_mats[1:], idxs[1:]):
+        mask = mask & jnp.take(d, ii, axis=0)
+    if filt_structure is not None:
+        f = expr._go(filt_structure, filt_leaves, scalars)
+        mask = mask & f[None, :]
+    counts = jnp.sum(lax.population_count(mask).astype(jnp.int32), axis=-1)
+    if not has_agg:
+        return counts
+    planes = ls[n_filt + n_gather]
+    gmask = mask & planes[expr.PLANES_EXISTS][None, :]
+    n_g = jnp.sum(lax.population_count(gmask).astype(jnp.int32), axis=-1)
+    plane_counts = jnp.stack([
+        jnp.sum(lax.population_count(planes[b][None, :] & gmask)
+                .astype(jnp.int32), axis=-1)
+        for b in range(expr.PLANES_OFFSET, planes.shape[0])
+    ])  # [depth, C]
+    return counts, n_g, plane_counts
+
+
+def local_groupby_level_fn(filt_structure, n_filt: int, n_scalars: int,
+                           n_gather: int, has_agg: bool):
+    """Single-device GroupBy level program.
+
+    Args: filt leaves ++ dim matrices [S, n_i, W] ++ (planes
+    [S, depth+2, W] if agg) ++ candidate index arrays int32[C] (one per
+    gathered dim) ++ scalars. Packed result (split sums, [2, ·] raveled):
+    counts [2·C] without agg, else counts [2·C] ++ n_g [2·C] ++
+    plane_counts [2·depth·C].
+    """
+    key = ("localgbl", filt_structure, n_filt, n_scalars, n_gather, has_agg)
+    fn = _LOCAL_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_leaves = n_filt + n_gather + (1 if has_agg else 0)
+
+    def body(*args):
+        leaves = args[:n_leaves]
+        idxs = args[n_leaves:n_leaves + n_gather]
+        scalars = args[n_leaves + n_gather:]
+
+        def per_shard(*ls):
+            return groupby_level_body(
+                ls, idxs, scalars, filt_structure, n_filt, n_gather, has_agg
+            )
+
+        out = jax.vmap(per_shard)(*leaves)
+        if not has_agg:
+            return split_sum(out, axis=0).ravel()
+        counts, n_g, plane_counts = (split_sum(o, axis=0) for o in out)
+        return jnp.concatenate(
+            [counts.ravel(), n_g.ravel(), plane_counts.ravel()]
+        )
+
+    fn = jax.jit(body)
+    _LOCAL_JIT_CACHE[key] = fn
+    return fn

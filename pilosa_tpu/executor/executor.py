@@ -21,7 +21,7 @@ import datetime as dt
 
 import numpy as np
 
-from pilosa_tpu.executor import expr
+from pilosa_tpu.executor import batch, expr
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.pql import Call, Condition, parse
 from pilosa_tpu.pql.ast import Query
@@ -39,6 +39,12 @@ from pilosa_tpu.storage.view import VIEW_STANDARD, views_by_time_range
 # superset factor before the exact recount — SURVEY.md §3.4; exact upstream
 # value unverifiable, Appendix B).
 TOPN_CANDIDATE_FACTOR = 4
+
+# GroupBy cross-products at or below this size are evaluated in a single
+# level (one device sync); larger ones use per-dimension prefix pruning
+# (one sync per dimension). Memory is bounded separately, by
+# batch.GROUPBY_MASK_BUDGET_BYTES-based chunking, at any size.
+GROUPBY_DENSE_MAX_GROUPS = 4096
 
 _RESERVED_ARGS = {"_field", "_col", "from", "to", "n", "limit", "offset",
                   "previous", "column", "filter", "field", "ids", "timestamp",
@@ -142,6 +148,8 @@ class _Compiled:
         self.scalars = scalars
 
     def eval(self, idx: Index, shard: int):
+        """Single-shard evaluation (IncludesColumn); batched queries go
+        through Executor._batched_eval instead."""
         leaves = [s.resolve(idx, shard) for s in self.specs]
         if not leaves:
             leaves = [_zeros_words()]
@@ -281,15 +289,62 @@ class Executor:
             return list(shards)
         return idx.available_shards()
 
+    # ------------------------------------------------------ batched mapping
+    #
+    # One compiled program + one device sync per query (executor/batch.py).
+    # Subclasses override the three hooks to change placement/reduction:
+    # DistExecutor (parallel/dist.py) shards the stacked leaves over a mesh
+    # and swaps the program builders for shard_map+psum versions.
+
+    def _shard_block(self, shard_list: list[int]):
+        return batch.ShardBlock(shard_list)
+
+    def _leaf_put(self):
+        """Optional device_put override for stacked leaves (mesh sharding)."""
+        return None
+
+    def _program(self, structure, reduce_kind: str, leaf_ranks: tuple,
+                 n_scalars: int):
+        return batch.local_fn(structure, reduce_kind, leaf_ranks, n_scalars)
+
+    def _groupby_level_program(self, filt_structure, n_filt: int,
+                               n_scalars: int, n_gather: int, has_agg: bool):
+        return batch.local_groupby_level_fn(
+            filt_structure, n_filt, n_scalars, n_gather, has_agg
+        )
+
+    def _batched_eval(self, idx: Index, compiled: _Compiled, block,
+                      reduce_kind: str, extra_leaves=()):
+        import jax.numpy as jnp
+
+        put = self._leaf_put()
+        leaves = [
+            batch.stacked_leaf(idx, spec, block, put) for spec in compiled.specs
+        ]
+        leaves.extend(extra_leaves)
+        if not leaves:
+            leaves = [batch.stacked_leaf(idx, _ZeroSpec(), block, put)]
+        scalars = tuple(jnp.asarray(s, jnp.int32) for s in compiled.scalars)
+        fn = self._program(
+            compiled.node, reduce_kind,
+            tuple(l.ndim - 1 for l in leaves), len(scalars),
+        )
+        return fn(*leaves, *scalars)
+
     # --------------------------------------------------------- bitmap calls
 
     def _execute_bitmap(self, idx: Index, call: Call, shards=None) -> RowResult:
         compiled = self._compile(idx, call)
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return self._finish_row_result(idx, call, RowResult({}))
+        block = self._shard_block(shard_list)
+        stacked = self._batched_eval(idx, compiled, block, "row")
+        host = np.asarray(stacked)
         segments = {}
-        for shard in self._shards(idx, shards):
-            words = np.asarray(compiled.eval(idx, shard))
-            if words.any():
-                segments[shard] = words
+        for i, shard in enumerate(block.shards):
+            if host[i].any():
+                segments[shard] = host[i]
         return self._finish_row_result(idx, call, RowResult(segments))
 
     def _finish_row_result(self, idx: Index, call: Call, res: RowResult) -> RowResult:
@@ -315,10 +370,12 @@ class Executor:
         if len(call.children) != 1:
             raise PQLError("Count requires exactly one child call")
         compiled = self._compile(idx, call.children[0], wrap="count")
-        total = 0
-        for shard in self._shards(idx, shards):
-            total += int(compiled.eval(idx, shard))
-        return total
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return 0
+        block = self._shard_block(shard_list)
+        packed = np.asarray(self._batched_eval(idx, compiled, block, "count"))
+        return int(batch.merge_split(packed))
 
     def _execute_includes_column(self, idx: Index, call: Call) -> bool:
         col = call.arg("column")
@@ -512,33 +569,30 @@ class Executor:
         )
         base = field.options.base
 
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return ValCount(0, 0)
+        block = self._shard_block(shard_list)
+
         if call.name == "Sum":
             node = ("bsisum", planes_i, filt_node)
-            compiled = _Compiled(node, specs, scalars)
-            total, count = 0, 0
-            for shard in self._shards(idx, shards):
-                plane_counts, n = compiled.eval(idx, shard)
-                plane_counts = np.asarray(plane_counts)
-                total += int(
-                    sum(c << i for i, c in enumerate(plane_counts.tolist()))
-                )
-                count += int(n)
+            merged = batch.merge_split(np.asarray(
+                self._batched_eval(idx, _Compiled(node, specs, scalars),
+                                   block, "bsisum")
+            ))  # [depth + 1]: plane counts ++ n
+            count = int(merged[-1])
+            total = sum(int(c) << i for i, c in enumerate(merged[:-1].tolist()))
             return ValCount(total + base * count, count)
 
         want_max = call.name == "Max"
         node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
-        compiled = _Compiled(node, specs, scalars)
-        best, count = None, 0
-        for shard in self._shards(idx, shards):
-            value, n = compiled.eval(idx, shard)
-            value, n = int(value), int(n)
-            if n == 0:
-                continue
-            if best is None or (value > best if want_max else value < best):
-                best, count = value, n
-            elif value == best:
-                count += n
-        if best is None:
+        packed = np.asarray(
+            self._batched_eval(idx, _Compiled(node, specs, scalars),
+                               block, "max" if want_max else "min")
+        )  # [best, count_lo, count_hi]
+        best = int(packed[0])
+        count = int(batch.merge_split(packed[1:]))
+        if count == 0:
             return ValCount(0, 0)
         return ValCount(best + base, count)
 
@@ -554,6 +608,8 @@ class Executor:
         n = call.arg("n", 10)
         filt_call = call.children[0] if call.children else None
         shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return []
         view = field.view(VIEW_STANDARD)
 
         explicit_ids = call.arg("ids")
@@ -573,26 +629,23 @@ class Executor:
         if not candidates:
             return []
 
-        # phase 2: exact recount of every candidate across all shards
+        # phase 2: exact recount of every candidate across all shards —
+        # one batched program over the stacked candidate matrix
         specs: list = []
         scalars: list = []
         filt_node = (
             self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
         )
-        matrix_i = len(specs)  # matrix appended per shard below
-        node = ("countrows", matrix_i, filt_node)
-        import jax.numpy as jnp
-
-        totals = np.zeros(len(candidates), np.int64)
-        for shard in shard_list:
-            frag = view.fragment(shard) if view else None
-            if frag is None:
-                continue
-            rows = [frag.device_row(r) for r in candidates]
-            matrix = jnp.stack(rows)
-            leaves = [s.resolve(idx, shard) for s in specs] + [matrix]
-            counts = expr.evaluate(node, leaves, scalars)
-            totals += np.asarray(counts, np.int64)
+        node = ("countrows", len(specs), filt_node)
+        block = self._shard_block(shard_list)
+        matrix = batch.stacked_matrix(
+            idx, field_name, view, candidates, block, self._leaf_put()
+        )
+        counts = self._batched_eval(
+            idx, _Compiled(node, specs, scalars), block, "countrows",
+            extra_leaves=(matrix,),
+        )
+        totals = batch.merge_split(np.asarray(counts))
         order = sorted(
             (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
         )
@@ -753,19 +806,25 @@ class Executor:
         return out
 
     def _execute_groupby(self, idx: Index, call: Call, shards=None) -> list[GroupCount]:
+        """GroupBy as batched device programs with level pruning.
+
+        The reference recurses per shard over the dimension cross-product,
+        pruning prefixes whose intersection is empty
+        (executor.executeGroupByShard). Here each prefix level is ONE
+        batched program — candidate prefixes are gathered out of the
+        stacked dimension matrices, counted per shard, and reduced on
+        device — so the whole GroupBy costs one device sync per dimension
+        (and exactly one when the cross-product is small enough to skip
+        pruning). Chunking inside a level is byte-budgeted
+        (batch.GROUPBY_MASK_BUDGET_BYTES) so the dense group masks never
+        outgrow HBM.
+        """
         limit, filt_call, agg_field, dims = self._groupby_prelude(idx, call, shards)
         if not dims:
             return []
-        return self._groupby_host(
-            idx, shards, limit, filt_call, agg_field, dims
-        )
-
-    def _groupby_host(
-        self, idx: Index, shards, limit, filt_call, agg_field, dims
-    ) -> list[GroupCount]:
-        """Per-shard host loop with cross-product pruning (the reference's
-        executeGroupByShard recursion)."""
         shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return []
 
         specs: list = []
         scalars: list = []
@@ -774,76 +833,141 @@ class Executor:
             if filt_call is not None
             else None
         )
+        block = self._shard_block(shard_list)
+        put = self._leaf_put()
+        filt_leaves = [batch.stacked_leaf(idx, s, block, put) for s in specs]
+        dim_mats = []
+        for fname, row_ids in dims:
+            field = idx.field(fname)
+            view = field.view(VIEW_STANDARD) if field else None
+            dim_mats.append(
+                batch.stacked_matrix(idx, fname, view, row_ids, block, put)
+            )
+        planes = (
+            batch.stacked_leaf(idx, _PlanesSpec(agg_field.name), block, put)
+            if agg_field is not None
+            else None
+        )
 
-        import jax.numpy as jnp
-        from pilosa_tpu.ops import bitops
+        sizes = [len(row_ids) for _, row_ids in dims]
+        total_groups = 1
+        for n in sizes:
+            total_groups *= n
+
+        if total_groups <= GROUPBY_DENSE_MAX_GROUPS:
+            # small cross-product: evaluate every group in one level
+            cand = np.zeros((1, 0), np.int32)
+            for n in sizes:
+                cand = _index_cross(cand, n)
+            counts_arr, agg_arrs = self._groupby_eval_level(
+                idx, block, filt_leaves, filt_node, scalars, dim_mats,
+                cand, planes, agg_field,
+            )
+        else:
+            # prefix pruning: extend one dimension at a time, dropping
+            # empty prefixes after each level (AND only shrinks groups)
+            cand = np.zeros((1, 0), np.int32)
+            counts_arr, agg_arrs = None, None
+            for k in range(len(dims)):
+                cand = _index_cross(cand, sizes[k])
+                last = k == len(dims) - 1
+                counts_arr, agg_arrs = self._groupby_eval_level(
+                    idx, block, filt_leaves, filt_node, scalars,
+                    dim_mats[: k + 1], cand,
+                    planes if last else None,
+                    agg_field if last else None,
+                )
+                keep = counts_arr > 0
+                cand = cand[keep]
+                counts_arr = counts_arr[keep]
+                if agg_arrs is not None:
+                    agg_arrs = (agg_arrs[0][keep], agg_arrs[1][:, keep])
+                if cand.shape[0] == 0:
+                    return []
 
         counts: dict[tuple, int] = {}
         sums: dict[tuple, int] = {}
-        last_field, last_rows = dims[-1]
-        node = ("countrows", len(specs), filt_node)
-        sum_node = ("bsisum", 0, ("leaf", 1))
-        for shard in shard_list:
-            matrices = []
-            missing = False
-            for fname, row_ids in dims:
-                view = idx.field(fname).view(VIEW_STANDARD)
-                frag = view.fragment(shard) if view else None
-                if frag is None:
-                    missing = True
-                    break
-                matrices.append(
-                    jnp.stack([frag.device_row(r) for r in row_ids])
-                )
-            if missing:
+        base = agg_field.options.base if agg_field is not None else 0
+        for j in range(cand.shape[0]):
+            c = int(counts_arr[j])
+            if c <= 0:
                 continue
-
-            filt_words = None
-            planes = None
-            if agg_field is not None:
-                leaves = [s.resolve(idx, shard) for s in specs]
-                if filt_node is not None:
-                    filt_words = expr.evaluate(filt_node, leaves, scalars)
-                planes = _PlanesSpec(agg_field.name).resolve(idx, shard)
-
-            def recurse(level: int, mask, prefix: tuple):
-                if level == len(dims) - 1:
-                    matrix = matrices[-1]
-                    if mask is not None:
-                        matrix = matrix & mask[None, :]
-                    leaves = [s.resolve(idx, shard) for s in specs] + [matrix]
-                    got = np.asarray(expr.evaluate(node, leaves, scalars))
-                    for i, (row_id, c) in enumerate(zip(last_rows, got.tolist())):
-                        if c <= 0:
-                            continue
-                        key = prefix + (row_id,)
-                        counts[key] = counts.get(key, 0) + int(c)
-                        if agg_field is not None:
-                            g_mask = matrix[i]
-                            if filt_words is not None:
-                                g_mask = g_mask & filt_words
-                            plane_counts, _n = expr.evaluate(
-                                sum_node, [planes, g_mask], ()
-                            )
-                            pc = np.asarray(plane_counts).tolist()
-                            n = int(_n)
-                            sums[key] = (
-                                sums.get(key, 0)
-                                + sum(v << b for b, v in enumerate(pc))
-                                + agg_field.options.base * n
-                            )
-                    return
-                fname, row_ids = dims[level]
-                for i, row_id in enumerate(row_ids):
-                    sub = matrices[level][i]
-                    new_mask = sub if mask is None else (mask & sub)
-                    if not bool(bitops.any_set(new_mask)):
-                        continue
-                    recurse(level + 1, new_mask, prefix + (row_id,))
-
-            recurse(0, None, ())
-
+            gkey = tuple(
+                dims[d][1][int(cand[j, d])] for d in range(cand.shape[1])
+            )
+            counts[gkey] = c
+            if agg_arrs is not None:
+                n = int(agg_arrs[0][j])
+                pc = agg_arrs[1][:, j].tolist()
+                sums[gkey] = sum(int(v) << b for b, v in enumerate(pc)) + base * n
         return self._groupby_result(idx, dims, counts, sums, agg_field, limit)
+
+    def _groupby_eval_level(self, idx: Index, block, filt_leaves, filt_node,
+                            scalars, dim_mats, cand: np.ndarray, planes,
+                            agg_field):
+        """Evaluate one pruning level: per-candidate counts (plus BSI
+        aggregate partials on the final level), chunked to the mask byte
+        budget, all chunks concatenated on device → ONE readback."""
+        import jax.numpy as jnp
+
+        n_gather = len(dim_mats)
+        has_agg = planes is not None
+        depth = agg_field.options.bit_depth if has_agg else 0
+        c_total = cand.shape[0]
+        chunk = batch.groupby_chunk_groups(block, n_gather, depth)
+        fn = self._groupby_level_program(
+            filt_node, len(filt_leaves), len(scalars), n_gather, has_agg
+        )
+        jscalars = tuple(jnp.asarray(s, jnp.int32) for s in scalars)
+
+        packs = []
+        layout = []  # (padded, actual) per chunk
+        for lo in range(0, c_total, chunk):
+            ci = cand[lo: lo + chunk]
+            actual = ci.shape[0]
+            padded = min(chunk, _next_pow2(actual))
+            if padded > actual:
+                ci = np.concatenate(
+                    [ci, np.zeros((padded - actual, n_gather), np.int32)]
+                )
+            idx_arrays = tuple(
+                jnp.asarray(ci[:, d], jnp.int32) for d in range(n_gather)
+            )
+            args = list(filt_leaves) + list(dim_mats)
+            if has_agg:
+                args.append(planes)
+            args.extend(idx_arrays)
+            packs.append(fn(*args, *jscalars))
+            layout.append((padded, actual))
+
+        packed = jnp.concatenate(packs) if len(packs) > 1 else packs[0]
+        host = np.asarray(packed)
+
+        def take2(off: int, n: int, padded: int) -> np.ndarray:
+            """Merge one split-sum section [2·padded] → int64[n]."""
+            return batch.merge_split(
+                host[off:off + 2 * padded].reshape(2, padded)[:, :n]
+            )
+
+        counts = np.zeros(c_total, np.int64)
+        n_g = np.zeros(c_total, np.int64) if has_agg else None
+        pc = np.zeros((depth, c_total), np.int64) if has_agg else None
+        off = out_off = 0
+        for padded, actual in layout:
+            counts[out_off:out_off + actual] = take2(off, actual, padded)
+            if has_agg:
+                n_g[out_off:out_off + actual] = take2(
+                    off + 2 * padded, actual, padded
+                )
+                pc_flat = host[off + 4 * padded:off + (4 + 2 * depth) * padded]
+                pc[:, out_off:out_off + actual] = batch.merge_split(
+                    pc_flat.reshape(2, depth, padded)[:, :, :actual]
+                )
+                off += (4 + 2 * depth) * padded
+            else:
+                off += 2 * padded
+            out_off += actual
+        return counts, (n_g, pc) if has_agg else None
 
     # ---------------------------------------------------------------- writes
 
@@ -943,11 +1067,15 @@ class Executor:
         field = idx.field(field_name)
         if field is None:
             field = idx.create_field(field_name)
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return True
         compiled = self._compile(idx, call.children[0])
-        for shard in self._shards(idx, shards):
-            words = np.asarray(compiled.eval(idx, shard))
+        block = self._shard_block(shard_list)
+        host = np.asarray(self._batched_eval(idx, compiled, block, "row"))
+        for i, shard in enumerate(block.shards):
             frag = field.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
-            frag.write_row_words(int(row), words)
+            frag.write_row_words(int(row), host[i])
         return True
 
 
@@ -962,6 +1090,19 @@ _BITMAP_CALLS = {
     "Row", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift",
     "Range",
 }
+
+
+def _index_cross(cand: np.ndarray, n: int) -> np.ndarray:
+    """Extend candidate index tuples [P, k] by every index of the next
+    dimension → [P·n, k+1]."""
+    p = cand.shape[0]
+    left = np.repeat(cand, n, axis=0)
+    right = np.tile(np.arange(n, dtype=np.int32), p)[:, None]
+    return np.concatenate([left, right], axis=1)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
 
 
 def _check_row(row) -> None:

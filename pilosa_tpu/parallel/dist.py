@@ -5,18 +5,17 @@ per node carrying its shard list, partials reduced on the caller
 (SURVEY.md §3.2 ⇄NET hops). Here the whole map+reduce is a single
 ``shard_map``-ped XLA program: each device evaluates the fused bitmap
 kernel over its resident block of shards (vmapped over the block), and
-``psum`` over the ``shards`` axis does the reduce on ICI. No
+``psum``/``pmax`` over the ``shards`` axis does the reduce on ICI. No
 serialization, no scatter/gather, no per-node re-dispatch.
 
-Leaves are mesh-sharded stacks ``uint32[S_padded, ...]`` built once per
-(query-leaf, shard-set, write-generation) and cached in device HBM via the
-residency LRU, so steady-state queries touch the host only for the final
-scalar/row materialization.
+All mapping/result logic lives in the base Executor's batched path
+(executor/batch.py) — this class only swaps the three hooks: shard
+blocks pad to the mesh, stacked leaves are device_put with a
+NamedSharding over the shard axis, and the program builders wrap the
+same per-shard bodies in shard_map with collective reductions.
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -26,97 +25,17 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.executor import expr
-from pilosa_tpu.executor.executor import (
-    Executor,
-    PQLError,
-    _Compiled,
-    _PlanesSpec,
-    _RowSpec,
-    _ZeroSpec,
-)
-from pilosa_tpu.executor.result import Pair, RowResult, ValCount
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.executor import batch
 from pilosa_tpu.parallel.mesh import SHARDS_AXIS, ShardAssignment, make_mesh
-from pilosa_tpu.shardwidth import WORDS_PER_SHARD
-from pilosa_tpu.storage import residency
-from pilosa_tpu.storage.view import VIEW_STANDARD
 
 _DIST_JIT_CACHE: dict = {}
 
-# Cross-products larger than this fall back to the pruned host loop: the
-# dense on-device cross product evaluates every combination, which stops
-# paying off when most groups are empty.
-GROUPBY_DENSE_MAX_GROUPS = 4096
 
-
-def _groupby_fn(mesh, filt_structure, n_filt_leaves: int, n_scalars: int,
-                n_dims: int, has_agg: bool):
-    """SPMD GroupBy: per shard, AND the dimension row-matrices into a dense
-    cross-product mask tensor, popcount per group, and psum over the shard
-    axis. With an aggregate, per-group BSI plane counts ride the same
-    program (mirrors expr 'bsisum' semantics per group)."""
-    key = ("groupby", mesh, filt_structure, n_filt_leaves, n_scalars,
-           n_dims, has_agg)
-    fn = _DIST_JIT_CACHE.get(key)
-    if fn is not None:
-        return fn
-
-    n_leaves = n_filt_leaves + n_dims + (1 if has_agg else 0)
-    in_specs = tuple(P(SHARDS_AXIS) for _ in range(n_leaves)) + tuple(
-        P() for _ in range(n_scalars)
-    )
-    out_specs = (P(), P(), P()) if has_agg else P()
-
-    def body(*args):
-        leaves = args[:n_leaves]
-        scalars = args[n_leaves:]
-
-        def per_shard(*ls):
-            filt_leaves = ls[:n_filt_leaves]
-            dim_mats = ls[n_filt_leaves:n_filt_leaves + n_dims]
-            mask = dim_mats[0]  # [n_0, W]
-            for d in dim_mats[1:]:
-                mask = mask[..., None, :] & d  # → [n_0, …, n_i, W]
-            if filt_structure is not None:
-                f = expr._go(filt_structure, filt_leaves, scalars)
-                mask = mask & f
-            counts = jnp.sum(
-                lax.population_count(mask).astype(jnp.int32), axis=-1
-            )
-            if not has_agg:
-                return counts
-            planes = ls[n_filt_leaves + n_dims]
-            gmask = mask & planes[expr.PLANES_EXISTS]
-            n_g = jnp.sum(
-                lax.population_count(gmask).astype(jnp.int32), axis=-1
-            )
-            plane_counts = jnp.stack([
-                jnp.sum(
-                    lax.population_count(planes[b] & gmask).astype(jnp.int32),
-                    axis=-1,
-                )
-                for b in range(expr.PLANES_OFFSET, planes.shape[0])
-            ])  # [depth, n_0, …, n_k]
-            return counts, n_g, plane_counts
-
-        out = jax.vmap(per_shard)(*leaves)
-        if not has_agg:
-            return lax.psum(jnp.sum(out, axis=0), SHARDS_AXIS)
-        counts, n_g, plane_counts = out
-        return (
-            lax.psum(jnp.sum(counts, axis=0), SHARDS_AXIS),
-            lax.psum(jnp.sum(n_g, axis=0), SHARDS_AXIS),
-            lax.psum(jnp.sum(plane_counts, axis=0), SHARDS_AXIS),
-        )
-
-    fn = jax.jit(
-        shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    )
-    _DIST_JIT_CACHE[key] = fn
-    return fn
-
-
-def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
-    """Build (or fetch) the compiled SPMD evaluator for a query shape."""
+def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
+             n_scalars: int):
+    """Build (or fetch) the compiled SPMD evaluator for a query shape.
+    Packed results match batch.local_fn's contracts exactly."""
     key = (mesh, structure, reduce_kind, leaf_ranks, n_scalars)
     fn = _DIST_JIT_CACHE.get(key)
     if fn is not None:
@@ -124,14 +43,7 @@ def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: in
 
     leaf_specs = tuple(P(SHARDS_AXIS) for _ in leaf_ranks)
     scalar_specs = tuple(P() for _ in range(n_scalars))
-    if reduce_kind in ("count", "countrows"):
-        out_specs = P()
-    elif reduce_kind == "bsisum":
-        out_specs = (P(), P())
-    elif reduce_kind == "minmax":
-        out_specs = (P(SHARDS_AXIS), P(SHARDS_AXIS))
-    else:  # row
-        out_specs = P(SHARDS_AXIS)
+    out_specs = P(SHARDS_AXIS) if reduce_kind == "row" else P()
 
     def body(*args):
         leaves = args[: len(leaf_ranks)]
@@ -142,16 +54,35 @@ def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: in
 
         out = jax.vmap(per_shard)(*leaves)
         if reduce_kind == "count":
-            return lax.psum(jnp.sum(out), SHARDS_AXIS)
+            return lax.psum(batch.split_sum(out), SHARDS_AXIS)
         if reduce_kind == "countrows":
-            return lax.psum(jnp.sum(out, axis=0), SHARDS_AXIS)
+            return lax.psum(batch.split_sum(out, axis=0), SHARDS_AXIS)
         if reduce_kind == "bsisum":
-            plane_counts, n = out
-            return (
-                lax.psum(jnp.sum(plane_counts, axis=0), SHARDS_AXIS),
-                lax.psum(jnp.sum(n), SHARDS_AXIS),
+            plane_counts, n = out  # [S_loc, depth], [S_loc]
+            return lax.psum(
+                jnp.concatenate(
+                    [batch.split_sum(plane_counts, axis=0),
+                     batch.split_sum(n)[:, None]], axis=1
+                ),
+                SHARDS_AXIS,
             )
-        return out  # row / minmax: stays shard-sharded
+        if reduce_kind in ("min", "max"):
+            values, counts = out
+            want_max = reduce_kind == "max"
+            masked, valid = batch.minmax_mask(values, counts, want_max)
+            if want_max:
+                best = lax.pmax(jnp.max(masked), SHARDS_AXIS)
+            else:
+                best = lax.pmin(jnp.min(masked), SHARDS_AXIS)
+            any_valid = lax.pmax(
+                jnp.any(valid).astype(jnp.int32), SHARDS_AXIS
+            ) > 0
+            n = lax.psum(
+                batch.minmax_at_best(values, counts, valid, best),
+                SHARDS_AXIS,
+            )
+            return batch.minmax_finalize(best, n, any_valid)
+        return out  # 'row': stays shard-sharded
 
     fn = jax.jit(
         shard_map(
@@ -160,6 +91,53 @@ def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: in
             in_specs=leaf_specs + scalar_specs,
             out_specs=out_specs,
         )
+    )
+    _DIST_JIT_CACHE[key] = fn
+    return fn
+
+
+def _dist_groupby_level_fn(mesh, filt_structure, n_filt: int, n_scalars: int,
+                           n_gather: int, has_agg: bool):
+    """SPMD GroupBy level program (same per-shard body as the local
+    builder, psum-reduced over the mesh)."""
+    key = ("gbl", mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg)
+    fn = _DIST_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_leaves = n_filt + n_gather + (1 if has_agg else 0)
+    in_specs = (
+        tuple(P(SHARDS_AXIS) for _ in range(n_leaves))
+        + tuple(P() for _ in range(n_gather))  # candidate index arrays
+        + tuple(P() for _ in range(n_scalars))
+    )
+
+    def body(*args):
+        leaves = args[:n_leaves]
+        idxs = args[n_leaves:n_leaves + n_gather]
+        scalars = args[n_leaves + n_gather:]
+
+        def per_shard(*ls):
+            return batch.groupby_level_body(
+                ls, idxs, scalars, filt_structure, n_filt, n_gather, has_agg
+            )
+
+        out = jax.vmap(per_shard)(*leaves)
+        if not has_agg:
+            return lax.psum(
+                batch.split_sum(out, axis=0), SHARDS_AXIS
+            ).ravel()
+        counts, n_g, plane_counts = (
+            batch.split_sum(o, axis=0) for o in out
+        )
+        return jnp.concatenate([
+            lax.psum(counts, SHARDS_AXIS).ravel(),
+            lax.psum(n_g, SHARDS_AXIS).ravel(),
+            lax.psum(plane_counts, SHARDS_AXIS).ravel(),
+        ])
+
+    fn = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P())
     )
     _DIST_JIT_CACHE[key] = fn
     return fn
@@ -176,307 +154,19 @@ class DistExecutor(Executor):
         super().__init__(holder)
         self.mesh = mesh if mesh is not None else make_mesh()
 
-    # ------------------------------------------------------- sharded leaves
+    def _shard_block(self, shard_list):
+        return ShardAssignment(shard_list, self.mesh)
 
-    def _sharding(self):
-        return NamedSharding(self.mesh, P(SHARDS_AXIS))
+    def _leaf_put(self):
+        sharding = NamedSharding(self.mesh, P(SHARDS_AXIS))
+        return lambda host: jax.device_put(host, sharding)
 
-    def _stacked_leaf(self, idx, spec, assignment: ShardAssignment):
-        cache = residency.global_row_cache()
-        gen = cache.write_generation
-        if isinstance(spec, _RowSpec):
-            key = ("stack", gen, idx.name, spec.field, spec.views, spec.row,
-                   assignment.key())
+    def _program(self, structure, reduce_kind, leaf_ranks, n_scalars):
+        return _dist_fn(self.mesh, structure, reduce_kind, leaf_ranks,
+                        n_scalars)
 
-            def decode():
-                return assignment.stack(
-                    lambda shard: np.asarray(self._host_row(idx, spec, shard))
-                )
-        elif isinstance(spec, _PlanesSpec):
-            field = idx.field(spec.field)
-            depth = 2 + field.options.bit_depth
-            key = ("stackp", gen, idx.name, spec.field, depth, assignment.key())
-
-            def decode():
-                return assignment.stack(
-                    lambda shard: self._host_planes(idx, spec, shard, depth)
-                )
-        elif isinstance(spec, _ZeroSpec):
-            key = ("stackz", assignment.padded)
-
-            def decode():
-                return np.zeros((assignment.padded, WORDS_PER_SHARD), np.uint32)
-        else:
-            raise PQLError(f"unknown leaf spec {type(spec).__name__}")
-
-        sharding = self._sharding()
-        return cache.get_row(
-            key, decode, device_put=lambda host: jax.device_put(host, sharding)
-        )
-
-    @staticmethod
-    def _host_row(idx, spec: _RowSpec, shard: int) -> np.ndarray:
-        field = idx.field(spec.field)
-        acc = None
-        for vname in spec.views:
-            view = field.view(vname) if field else None
-            frag = view.fragment(shard) if view else None
-            if frag is None:
-                continue
-            words = frag.row_words(spec.row)
-            acc = words if acc is None else np.bitwise_or(acc, words)
-        return acc if acc is not None else np.zeros(WORDS_PER_SHARD, np.uint32)
-
-    @staticmethod
-    def _host_planes(idx, spec: _PlanesSpec, shard: int, depth: int) -> np.ndarray:
-        field = idx.field(spec.field)
-        view = field.view(field.bsi_view_name())
-        frag = view.fragment(shard) if view else None
-        if frag is None:
-            return np.zeros((depth, WORDS_PER_SHARD), np.uint32)
-        return np.stack([frag.row_words(r) for r in range(depth)])
-
-    def _dist_eval(self, idx, compiled: _Compiled, shards: list[int],
-                   reduce_kind: str, extra_leaves=()):
-        assignment = ShardAssignment(shards, self.mesh)
-        leaves = [
-            self._stacked_leaf(idx, spec, assignment) for spec in compiled.specs
-        ]
-        leaves.extend(extra_leaves)
-        if not leaves:
-            leaves = [self._stacked_leaf(idx, _ZeroSpec(), assignment)]
-        scalars = tuple(jnp.asarray(s, jnp.int32) for s in compiled.scalars)
-        fn = _dist_fn(
-            self.mesh, compiled.node, reduce_kind,
-            tuple(l.ndim - 1 for l in leaves), len(scalars),
-        )
-        return fn(*leaves, *scalars), assignment
-
-    # ---------------------------------------------------- overridden calls
-
-    def _execute_count(self, idx, call, shards=None) -> int:
-        if len(call.children) != 1:
-            raise PQLError("Count requires exactly one child call")
-        shard_list = self._shards(idx, shards)
-        if not shard_list:
-            return 0
-        compiled = self._compile(idx, call.children[0], wrap="count")
-        total, _ = self._dist_eval(idx, compiled, shard_list, "count")
-        return int(total)
-
-    def _execute_bitmap(self, idx, call, shards=None) -> RowResult:
-        shard_list = self._shards(idx, shards)
-        if not shard_list:
-            return RowResult({})
-        compiled = self._compile(idx, call)
-        stacked, assignment = self._dist_eval(idx, compiled, shard_list, "row")
-        host = np.asarray(stacked)
-        segments = {}
-        for i, shard in enumerate(assignment.shards):
-            if host[i].any():
-                segments[shard] = host[i]
-        return self._finish_row_result(idx, call, RowResult(segments))
-
-    def _execute_bsi_aggregate(self, idx, call, shards=None) -> ValCount:
-        from pilosa_tpu.storage.field import TYPE_INT
-
-        field_name = call.arg("field") or call.arg("_field")
-        if field_name is None:
-            raise PQLError(f"{call.name} requires field=")
-        field = idx.field(field_name)
-        if field is None or field.options.type != TYPE_INT:
-            raise PQLError(f"{call.name} requires an int field")
-        shard_list = self._shards(idx, shards)
-        if not shard_list:
-            return ValCount(0, 0)
-        filt_call = call.children[0] if call.children else None
-
-        specs: list = []
-        scalars: list = []
-        planes_i = self._planes_index(field, specs)
-        filt_node = (
-            self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
-        )
-        base = field.options.base
-
-        if call.name == "Sum":
-            node = ("bsisum", planes_i, filt_node)
-            (plane_counts, n), _ = self._dist_eval(
-                idx, _Compiled(node, specs, scalars), shard_list, "bsisum"
-            )
-            plane_counts = np.asarray(plane_counts).tolist()
-            count = int(n)
-            total = sum(c << i for i, c in enumerate(plane_counts))
-            return ValCount(total + base * count, count)
-
-        want_max = call.name == "Max"
-        node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
-        (values, counts), assignment = self._dist_eval(
-            idx, _Compiled(node, specs, scalars), shard_list, "minmax"
-        )
-        values = np.asarray(values)[: len(assignment.shards)]
-        counts = np.asarray(counts)[: len(assignment.shards)]
-        best, count = None, 0
-        for v, n in zip(values.tolist(), counts.tolist()):
-            if n == 0:
-                continue
-            if best is None or (v > best if want_max else v < best):
-                best, count = v, n
-            elif v == best:
-                count += n
-        if best is None:
-            return ValCount(0, 0)
-        return ValCount(best + base, count)
-
-    def _stacked_matrix(self, idx, field_name: str, view, row_ids, assignment):
-        """Mesh-sharded stack ``uint32[S_padded, len(row_ids), words]`` of
-        the given rows of one view, cached in HBM like other leaves."""
-        cache = residency.global_row_cache()
-        gen = cache.write_generation
-        key = ("stackm", gen, idx.name, field_name,
-               view.name if view is not None else None, tuple(row_ids),
-               assignment.key())
-
-        def decode():
-            def per_shard(shard):
-                frag = view.fragment(shard) if view else None
-                if frag is None:
-                    return np.zeros((len(row_ids), WORDS_PER_SHARD), np.uint32)
-                return np.stack([frag.row_words(r) for r in row_ids])
-
-            return assignment.stack(per_shard)
-
-        sharding = self._sharding()
-        return cache.get_row(
-            key, decode, device_put=lambda host: jax.device_put(host, sharding)
-        )
-
-    def _execute_groupby(self, idx, call, shards=None):
-        """GroupBy as ONE SPMD program: dense cross-product of dimension
-        rows evaluated per shard on its owning device, group counts (and
-        BSI plane counts for aggregate=Sum) psum-reduced over the mesh.
-
-        Replaces the reference's per-shard recursion with pruning
-        (executor.executeGroupByShard) by a dense batched evaluation —
-        the TPU-friendly shape — falling back to the pruned host loop when
-        the cross product is too large to pay for itself."""
-        limit, filt_call, agg_field, dims = self._groupby_prelude(
-            idx, call, shards
-        )
-        if not dims:
-            return []
-        shard_list = self._shards(idx, shards)
-        if not shard_list:
-            return []
-        n_groups = 1
-        for _, row_ids in dims:
-            n_groups *= len(row_ids)
-        if n_groups > GROUPBY_DENSE_MAX_GROUPS:
-            return self._groupby_host(
-                idx, shards, limit, filt_call, agg_field, dims
-            )
-
-        specs: list = []
-        scalars: list = []
-        filt_node = (
-            self._compile_node(idx, filt_call, specs, scalars)
-            if filt_call is not None
-            else None
-        )
-        assignment = ShardAssignment(shard_list, self.mesh)
-        leaves = [
-            self._stacked_leaf(idx, spec, assignment) for spec in specs
-        ]
-        for fname, row_ids in dims:
-            field = idx.field(fname)
-            view = field.view(VIEW_STANDARD) if field else None
-            leaves.append(
-                self._stacked_matrix(idx, fname, view, row_ids, assignment)
-            )
-        if agg_field is not None:
-            leaves.append(
-                self._stacked_leaf(
-                    idx, _PlanesSpec(agg_field.name), assignment
-                )
-            )
-        fn = _groupby_fn(
-            self.mesh, filt_node, len(specs), len(scalars),
-            len(dims), agg_field is not None,
-        )
-        jscalars = tuple(jnp.asarray(s, jnp.int32) for s in scalars)
-        out = fn(*leaves, *jscalars)
-
-        if agg_field is not None:
-            counts_nd, n_nd, pc_nd = (np.asarray(o) for o in out)
-        else:
-            counts_nd = np.asarray(out)
-            n_nd = pc_nd = None
-        counts: dict[tuple, int] = {}
-        sums: dict[tuple, int] = {}
-        base = agg_field.options.base if agg_field is not None else 0
-        for flat, c in enumerate(counts_nd.reshape(-1).tolist()):
-            if c <= 0:
-                continue
-            idxs = np.unravel_index(flat, counts_nd.shape)
-            gkey = tuple(dims[d][1][i] for d, i in enumerate(idxs))
-            counts[gkey] = int(c)
-            if agg_field is not None:
-                pc = pc_nd[(slice(None),) + idxs].tolist()
-                n = int(n_nd[idxs])
-                sums[gkey] = sum(v << b for b, v in enumerate(pc)) + base * n
-        return self._groupby_result(idx, dims, counts, sums, agg_field, limit)
-
-    def _execute_topn(self, idx, call, shards=None) -> list[Pair]:
-        from pilosa_tpu.executor.executor import TOPN_CANDIDATE_FACTOR
-
-        field_name = call.arg("_field") or call.arg("field")
-        if field_name is None:
-            raise PQLError("TopN requires a field")
-        field = idx.field(field_name)
-        if field is None:
-            raise PQLError(f"field {field_name!r} not found")
-        n = call.arg("n", 10)
-        filt_call = call.children[0] if call.children else None
-        shard_list = self._shards(idx, shards)
-        if not shard_list:
-            return []
-        view = field.view(VIEW_STANDARD)
-
-        explicit_ids = call.arg("ids")
-        if explicit_ids is not None:
-            candidates = sorted(int(i) for i in explicit_ids)
-        else:
-            overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
-            cand: set[int] = set()
-            for shard in shard_list:
-                frag = view.fragment(shard) if view else None
-                if frag is None:
-                    continue
-                cand.update(r for r, _ in frag.top(overfetch))
-            candidates = sorted(cand)
-        candidates = self._filter_topn_candidates(field, call, candidates)
-        if not candidates:
-            return []
-
-        # phase 2 on the mesh: stacked [S, n_cand, words] + countrows psum
-        specs: list = []
-        scalars: list = []
-        filt_node = (
-            self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
-        )
-        node = ("countrows", len(specs), filt_node)
-        assignment = ShardAssignment(shard_list, self.mesh)
-        matrix = self._stacked_matrix(idx, field_name, view, candidates, assignment)
-        compiled = _Compiled(node, specs, scalars)
-        counts, _ = self._dist_eval(
-            idx, compiled, shard_list, "countrows", extra_leaves=(matrix,)
-        )
-        totals = np.asarray(counts, np.int64)
-        order = sorted(
-            (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
-        )
-        if n:
-            order = order[:n]
-        return self._finish_pairs(
-            idx, field, [Pair(r, -negc) for negc, r in order]
+    def _groupby_level_program(self, filt_structure, n_filt, n_scalars,
+                               n_gather, has_agg):
+        return _dist_groupby_level_fn(
+            self.mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg
         )

@@ -19,6 +19,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pilosa_tpu.executor.batch import ShardBlock
+
 SHARDS_AXIS = "shards"
 
 
@@ -58,19 +60,19 @@ def initialize_distributed(coordinator: str | None = None,
     )
 
 
-class ShardAssignment:
+class ShardAssignment(ShardBlock):
     """Maps a query's shard list onto mesh slots.
 
-    The global array rows are ordered by the (sorted) shard list, padded
-    to a multiple of the mesh size with empty slots; slot s lives on
-    device s // (S_padded / n_devices). Replication (the reference's
-    replicaN) is a host-side property of fragment *files*
-    (parallel.cluster); device residency is single-copy since HBM is a
-    cache, not the durable store.
+    Extends the local ShardBlock layout (executor/batch.py): rows ordered
+    by the sorted shard list, padded to a multiple of the mesh size with
+    empty slots; slot s lives on device s // (S_padded / n_devices).
+    Replication (the reference's replicaN) is a host-side property of
+    fragment *files* (parallel.cluster); device residency is single-copy
+    since HBM is a cache, not the durable store.
     """
 
     def __init__(self, shards: list[int], mesh: Mesh):
-        self.shards = sorted(shards)
+        super().__init__(shards)
         self.n_devices = mesh.size
         n = max(len(self.shards), 1)
         self.padded = -(-n // self.n_devices) * self.n_devices
@@ -79,17 +81,3 @@ class ShardAssignment:
     @property
     def slot_of(self) -> dict[int, int]:
         return {s: i for i, s in enumerate(self.shards)}
-
-    def key(self) -> tuple:
-        return (tuple(self.shards), self.padded, self.n_devices)
-
-    def stack(self, per_shard_fn) -> np.ndarray:
-        """Build the [padded, ...] host array: per_shard_fn(shard) → row
-        block; empty slots are zeros."""
-        first = per_shard_fn(self.shards[0]) if self.shards else None
-        inner_shape = first.shape if first is not None else ()
-        out_shape = (self.padded,) + tuple(inner_shape)
-        out = np.zeros(out_shape, np.uint32)
-        for i, s in enumerate(self.shards):
-            out[i] = first if i == 0 else per_shard_fn(s)
-        return out

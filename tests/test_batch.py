@@ -1,0 +1,48 @@
+"""Batched-evaluation plumbing: split-sum overflow safety (executor/batch).
+
+A per-shard popcount can reach 2^20, so a plain int32 device sum wraps
+past ~2^11 full shards; the split lo/hi channels must stay exact there.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.executor import batch
+
+
+class TestSplitSum:
+    def test_round_trip_small(self):
+        x = jnp.asarray(np.array([1, 2, 3], np.int32))
+        assert int(batch.merge_split(np.asarray(batch.split_sum(x)))) == 6
+
+    def test_no_int32_wrap_at_shard_scale(self):
+        # 4096 shards × (2^20 - 1) per shard ≈ 2^32: wraps a plain int32
+        # sum, must be exact through the split channels
+        per_shard = (1 << 20) - 1
+        n_shards = 4096
+        x = jnp.full((n_shards,), per_shard, jnp.int32)
+        naive = int(jnp.sum(x))  # documents the wrap this guards against
+        got = int(batch.merge_split(np.asarray(batch.split_sum(x))))
+        want = per_shard * n_shards
+        assert got == want
+        assert naive != want  # if XLA ever promotes, revisit the design
+
+    def test_axis_split(self):
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.integers(0, 1 << 20, (1000, 5), dtype=np.int32))
+        got = batch.merge_split(np.asarray(batch.split_sum(x, axis=0)))
+        np.testing.assert_array_equal(got, np.asarray(x, np.int64).sum(0))
+
+    def test_minmax_merge_counts(self):
+        values = jnp.asarray(np.array([5, 9, 9, 0], np.int32))
+        counts = jnp.asarray(np.array([2, 3, 4, 0], np.int32))
+        packed = np.asarray(batch.minmax_merge(values, counts, want_max=True))
+        assert int(packed[0]) == 9
+        assert int(batch.merge_split(packed[1:])) == 7
+
+    def test_minmax_merge_empty(self):
+        values = jnp.asarray(np.array([7, 8], np.int32))
+        counts = jnp.asarray(np.zeros(2, np.int32))
+        packed = np.asarray(batch.minmax_merge(values, counts, want_max=False))
+        assert int(packed[0]) == 0
+        assert int(batch.merge_split(packed[1:])) == 0

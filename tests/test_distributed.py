@@ -167,9 +167,23 @@ class TestDistGroupBy:
         assert self.groups_json(r1) == self.groups_json(r2)
         assert len(r2) == 1
 
-    def test_groupby_dense_fallback_threshold(self, env, monkeypatch):
-        import pilosa_tpu.parallel.dist as dist_mod
+    def test_groupby_level_pruning_path(self, env, monkeypatch):
+        """Force the per-dimension prefix-pruning strategy (cross-product
+        'too big' for a single level) and check it matches the dense path."""
+        import pilosa_tpu.executor.executor as ex_mod
 
-        monkeypatch.setattr(dist_mod, "GROUPBY_DENSE_MAX_GROUPS", 1)
+        monkeypatch.setattr(ex_mod, "GROUPBY_DENSE_MAX_GROUPS", 1)
         r1, r2 = both(env, "GroupBy(Rows(f), Rows(g))")
+        assert self.groups_json(r1) == self.groups_json(r2)
+
+    def test_groupby_tiny_chunk_budget(self, env, monkeypatch):
+        """A mask byte budget so small every level runs one candidate per
+        chunk must still produce identical results (chunk concat + unpack)."""
+        from pilosa_tpu.executor import batch as batch_mod
+
+        monkeypatch.setattr(batch_mod, "GROUPBY_MASK_BUDGET_BYTES", 1)
+        r1, r2 = both(
+            env,
+            'GroupBy(Rows(f), Rows(g), aggregate=Sum(field="fare"))',
+        )
         assert self.groups_json(r1) == self.groups_json(r2)
