@@ -154,7 +154,7 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
                 lambda shard: host_planes(idx, spec, shard, depth)
             )
     elif isinstance(spec, _ZeroSpec):
-        key = ("stackz", block.padded)
+        key = ("stackz", block.key())
 
         def decode():
             return np.zeros((block.padded, WORDS_PER_SHARD), np.uint32)

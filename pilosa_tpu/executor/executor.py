@@ -344,7 +344,8 @@ class Executor:
         segments = {}
         for i, shard in enumerate(block.shards):
             if host[i].any():
-                segments[shard] = host[i]
+                # copy: a view would pin the whole [padded, words] readback
+                segments[shard] = host[i].copy()
         return self._finish_row_result(idx, call, RowResult(segments))
 
     def _finish_row_result(self, idx: Index, call: Call, res: RowResult) -> RowResult:

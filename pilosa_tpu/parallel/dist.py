@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
