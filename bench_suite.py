@@ -30,6 +30,29 @@ def _timed(fn, iters=5):
     return (time.perf_counter() - t0) / iters, out
 
 
+_DISPATCH_FLOOR_MS = None
+
+
+def dispatch_floor_ms() -> float:
+    """Median wall time of a trivial blocking device call. On a tunneled
+    backend this round-trip latency is the floor under every single-query
+    p50 below; the device compute is value - floor. Computed once."""
+    global _DISPATCH_FLOOR_MS
+    if _DISPATCH_FLOOR_MS is None:
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x, s: jnp.sum(x) + s)
+        x = jax.device_put(np.zeros(8, np.int32))
+        samples = []
+        for i in range(10):  # unique scalar: defeats execution-result caches
+            t0 = time.perf_counter()
+            int(f(x, i))
+            samples.append(time.perf_counter() - t0)
+        _DISPATCH_FLOOR_MS = round(float(np.median(samples)) * 1e3, 3)
+    return _DISPATCH_FLOOR_MS
+
+
 def _mk_env(tmp):
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.storage import Holder
@@ -236,8 +259,11 @@ def main() -> None:
         4: lambda: config4_time_quantum(1 if not args.full else 8),
         5: lambda: config5_ssb_4way(n_shards),
     }
+    floor = dispatch_floor_ms()
     for c in [int(x) for x in args.configs.split(",")]:
-        print(json.dumps(runners[c]()), flush=True)
+        out = runners[c]()
+        out["dispatch_floor_ms"] = floor
+        print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -9,12 +9,29 @@ fragment data for resize, schema fetch, cluster messages. JSON bodies
 from __future__ import annotations
 
 import json
+import ssl
 import urllib.error
 import urllib.request
 
 
 class ClientError(Exception):
     pass
+
+
+_SSL_CONTEXT: ssl.SSLContext | None = None
+
+
+def set_insecure_tls(insecure: bool) -> None:
+    """Accept self-signed node certificates cluster-wide (reference
+    tls.skip-verify). Applies to every InternalClient in the process."""
+    global _SSL_CONTEXT
+    if insecure:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        _SSL_CONTEXT = ctx
+    else:
+        _SSL_CONTEXT = None
 
 
 class InternalClient:
@@ -29,7 +46,9 @@ class InternalClient:
         if body is not None:
             req.add_header("Content-Type", content_type)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=_SSL_CONTEXT
+            ) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")

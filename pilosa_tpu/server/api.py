@@ -33,14 +33,22 @@ class API:
         self.cluster = cluster  # pilosa_tpu.parallel.cluster (M4+); may be None
         self.stats = stats
         self.started_at = dt.datetime.now(dt.timezone.utc)
+        # long-query log (reference long-query-time server knob): queries
+        # slower than the threshold are logged and kept in a ring buffer.
+        self.long_query_time: float = 0.0  # seconds; 0 = off
+        self.long_queries: list[dict] = []
+        self.logger = None
 
     # ---------------------------------------------------------------- query
 
     def query_raw(self, index: str, pql: str, shards=None, remote: bool = False):
         """Execute and return raw result objects (serializer-agnostic)."""
+        import time
+
         from pilosa_tpu.executor.executor import PQLError
         from pilosa_tpu.pql import ParseError
 
+        t0 = time.perf_counter()
         try:
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
@@ -48,6 +56,21 @@ class API:
             return self.executor.execute(index, pql, **kwargs)
         except (ParseError, PQLError) as e:
             raise ApiError(str(e)) from e
+        finally:
+            elapsed = time.perf_counter() - t0
+            if self.long_query_time > 0 and elapsed >= self.long_query_time:
+                entry = {
+                    "index": index, "pql": pql[:1024],
+                    "seconds": round(elapsed, 4),
+                    "at": dt.datetime.now(dt.timezone.utc).isoformat(),
+                }
+                self.long_queries.append(entry)
+                del self.long_queries[:-100]
+                if self.logger is not None:
+                    self.logger.warning(
+                        "long query (%.3fs > %.3fs) on %s: %s",
+                        elapsed, self.long_query_time, index, entry["pql"],
+                    )
 
     def query(self, index: str, pql: str, shards=None, remote: bool = False) -> dict:
         results = self.query_raw(index, pql, shards=shards, remote=remote)

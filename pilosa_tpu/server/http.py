@@ -61,6 +61,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
     ("GET", re.compile(r"^/debug/traces$"), "get_traces"),
+    ("GET", re.compile(r"^/debug/long-queries$"), "get_long_queries"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
     ("GET", re.compile(r"^/debug/pprof/?$"), "get_pprof"),
 ]
@@ -261,6 +262,10 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
         self._json({"enabled": global_tracer().enabled,
                     "traces": global_tracer().recent()})
+
+    def get_long_queries(self, query=None):
+        self._json({"threshold": self.api.long_query_time,
+                    "queries": self.api.long_queries})
 
     def get_debug_vars(self, query=None):
         from pilosa_tpu.utils.stats import global_stats

@@ -35,6 +35,10 @@ class ServerConfig:
         tracing: bool = False,
         diagnostics_endpoint: str = "",
         statsd: str = "",
+        long_query_time: float = 0.0,
+        tls_certificate: str = "",
+        tls_key: str = "",
+        tls_skip_verify: bool = False,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -51,9 +55,18 @@ class ServerConfig:
         self.tracing = tracing
         self.diagnostics_endpoint = diagnostics_endpoint
         self.statsd = statsd
+        self.long_query_time = long_query_time
+        self.tls_certificate = tls_certificate
+        self.tls_key = tls_key
+        self.tls_skip_verify = tls_skip_verify
+
+    @property
+    def tls_enabled(self) -> bool:
+        return bool(self.tls_certificate and self.tls_key)
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServerConfig":
+        tls = d.get("tls") if isinstance(d.get("tls"), dict) else {}
         return cls(
             data_dir=d.get("data-dir", d.get("data_dir", "~/.pilosa_tpu")),
             bind=d.get("bind", "localhost"),
@@ -70,6 +83,14 @@ class ServerConfig:
             tracing=_parse_bool(d.get("tracing", False)),
             diagnostics_endpoint=d.get("diagnostics-endpoint", ""),
             statsd=d.get("statsd", ""),
+            long_query_time=_parse_duration(
+                d.get("long-query-time", d.get("long_query_time", 0.0))
+            ),
+            tls_certificate=d.get("tls-certificate", tls.get("certificate", "")),
+            tls_key=d.get("tls-key", tls.get("key", "")),
+            tls_skip_verify=_parse_bool(
+                d.get("tls-skip-verify", tls.get("skip-verify", False))
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -81,6 +102,28 @@ class ServerConfig:
             "replica-n": self.replica_n,
             "verbose": self.verbose,
         }
+
+
+def _parse_duration(value) -> float:
+    """Seconds from a float or a Go-style duration string ('1m30s', '500ms',
+    '30s' — the reference's TOML uses Go durations). Raises ValueError on
+    malformed input rather than silently dropping trailing text."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip().lower()
+    if not s:
+        return 0.0
+    import re
+
+    if re.fullmatch(r"(?:[0-9.]+(?:ms|us|s|m|h))+", s):
+        total = 0.0
+        for num, unit in re.findall(r"([0-9.]+)(ms|us|s|m|h)", s):
+            total += float(num) * {"us": 1e-6, "ms": 1e-3, "s": 1, "m": 60, "h": 3600}[unit]
+        return total
+    try:
+        return float(s)
+    except ValueError:
+        raise ValueError(f"invalid duration: {value!r}") from None
 
 
 def _parse_bool(value) -> bool:
@@ -120,14 +163,40 @@ class Server:
                 residency.DeviceRowCache(self.config.device_budget_bytes)
             )
         self.holder.open()
+        self.api.long_query_time = self.config.long_query_time
+        self.api.logger = self.logger
         self._http = make_http_server(self.api, self.config.bind, self.config.port)
+        if self.config.tls_enabled:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.config.tls_certificate, self.config.tls_key)
+            # Wrap per-connection with the handshake deferred: accept() stays
+            # cheap in the single accept loop; the handshake runs on first
+            # read inside that connection's handler thread, so a stalled
+            # client can't block other connections.
+            plain_get_request = self._http.get_request
+
+            def tls_get_request():
+                conn, addr = plain_get_request()
+                conn = ctx.wrap_socket(
+                    conn, server_side=True, do_handshake_on_connect=False
+                )
+                return conn, addr
+
+            self._http.get_request = tls_get_request
+        if self.config.tls_skip_verify:
+            from pilosa_tpu.parallel.client import set_insecure_tls
+
+            set_insecure_tls(True)
         self._http_thread = threading.Thread(
             target=self._http.serve_forever, daemon=True
         )
         self._http_thread.start()
         self._wire_cluster()
         self.logger.info(
-            "listening on http://%s:%d (data-dir %s, node %s)",
+            "listening on %s://%s:%d (data-dir %s, node %s)",
+            "https" if self.config.tls_enabled else "http",
             self.config.bind, self.port, self.holder.data_dir,
             self.api.cluster.local.id,
         )
@@ -160,7 +229,8 @@ class Server:
         from pilosa_tpu.parallel.cluster_exec import ClusterExecutor
 
         name = self.config.name or f"node-{self.port}"
-        uri = self.config.advertise or f"http://{self.config.bind}:{self.port}"
+        scheme = "https" if self.config.tls_enabled else "http"
+        uri = self.config.advertise or f"{scheme}://{self.config.bind}:{self.port}"
         cluster = Cluster(
             Node(name, uri), replica_n=self.config.replica_n, holder=self.holder,
         )

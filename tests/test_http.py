@@ -13,14 +13,19 @@ from pilosa_tpu.storage import Holder
 
 
 @pytest.fixture
-def node(tmp_path):
+def node_api(tmp_path):
     holder = Holder(str(tmp_path / "data")).open()
     api = API(holder)
     server, port, _ = serve_in_thread(api)
-    yield f"http://localhost:{port}"
+    yield f"http://localhost:{port}", api
     server.shutdown()
     server.server_close()
     holder.close()
+
+
+@pytest.fixture
+def node(node_api):
+    return node_api[0]
 
 
 def req(method, url, body=None, content_type="application/json", raw=False):
@@ -175,3 +180,79 @@ def test_shards_max(node):
     req("POST", f"{node}/index/i/query", b"Set(1, f=1)")
     out = req("GET", f"{node}/internal/shards/max")
     assert out["standard"]["i"] == 0
+
+
+def test_long_query_log(node_api):
+    node, api = node_api
+    api.long_query_time = 0.0000001  # everything is "long"
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/query", b"Set(1, f=1)")
+    out = req("GET", f"{node}/debug/long-queries")
+    assert out["threshold"] == api.long_query_time
+    assert any(q["pql"] == "Set(1, f=1)" for q in out["queries"])
+    # threshold off -> nothing more recorded
+    api.long_query_time = 0.0
+    api.long_queries.clear()
+    req("POST", f"{node}/index/i/query", b"Count(Row(f=1))")
+    assert req("GET", f"{node}/debug/long-queries")["queries"] == []
+
+
+@pytest.mark.skipif(__import__("shutil").which("openssl") is None,
+                    reason="openssl binary not available")
+def test_tls_server(tmp_path):
+    import subprocess
+
+    from pilosa_tpu.parallel.client import InternalClient, set_insecure_tls
+    from pilosa_tpu.server.server import Server, ServerConfig
+
+    cert = tmp_path / "node.crt"
+    key = tmp_path / "node.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    cfg = ServerConfig(
+        data_dir=str(tmp_path / "data"), port=0, use_mesh=False,
+        anti_entropy_interval=0, heartbeat_interval=0,
+        tls_certificate=str(cert), tls_key=str(key), tls_skip_verify=True,
+    )
+    server = Server(cfg).open()
+    try:
+        uri = f"https://localhost:{server.port}"
+        assert server.api.cluster.local.uri.startswith("https://")
+        client = InternalClient()
+        client._call("POST", f"{uri}/index/i", json.dumps({}).encode())
+        client._call("POST", f"{uri}/index/i/field/f", json.dumps({}).encode())
+        out = client.query_node(uri, "i", "Set(3, f=1) Count(Row(f=1))",
+                                shards=[0], remote=False)
+        assert out["results"] == [True, 1]
+        # plain http against the TLS socket must fail (URLError or a
+        # straight connection reset depending on handshake timing)
+        with pytest.raises(OSError):
+            urllib.request.urlopen(f"http://localhost:{server.port}/schema", timeout=5)
+    finally:
+        server.close()
+        set_insecure_tls(False)
+
+
+def test_parse_duration():
+    from pilosa_tpu.server.server import _parse_duration
+
+    assert _parse_duration(1.5) == 1.5
+    assert _parse_duration("30s") == 30.0
+    assert _parse_duration("1m30s") == 90.0
+    assert _parse_duration("500ms") == 0.5
+    assert _parse_duration("2h") == 7200.0
+    assert _parse_duration("") == 0.0
+    assert _parse_duration("0.25") == 0.25
+
+
+def test_parse_duration_rejects_malformed():
+    from pilosa_tpu.server.server import _parse_duration
+
+    for bad in ("1m30", "abc", "10x", "s30"):
+        with pytest.raises(ValueError):
+            _parse_duration(bad)

@@ -6,7 +6,7 @@ import urllib.request
 import pytest
 
 from pilosa_tpu import wire
-from tests.test_http import node, req  # fixture reuse
+from tests.test_http import node, node_api, req  # fixture reuse
 
 requires_proto = pytest.mark.skipif(
     not wire.available(), reason="protoc/protobuf runtime unavailable"
