@@ -101,6 +101,17 @@ class ServerConfig:
             "anti-entropy-interval": self.anti_entropy_interval,
             "replica-n": self.replica_n,
             "verbose": self.verbose,
+            "name": self.name,
+            "advertise": self.advertise,
+            "seeds": self.seeds,
+            "heartbeat-interval": self.heartbeat_interval,
+            "tracing": self.tracing,
+            "diagnostics-endpoint": self.diagnostics_endpoint,
+            "statsd": self.statsd,
+            "long-query-time": self.long_query_time,
+            "tls-certificate": self.tls_certificate,
+            "tls-key": self.tls_key,
+            "tls-skip-verify": self.tls_skip_verify,
         }
 
 
@@ -115,9 +126,10 @@ def _parse_duration(value) -> float:
         return 0.0
     import re
 
-    if re.fullmatch(r"(?:[0-9.]+(?:ms|us|s|m|h))+", s):
+    number = r"[0-9]+(?:\.[0-9]+)?|\.[0-9]+"
+    if re.fullmatch(rf"(?:(?:{number})(?:ms|us|s|m|h))+", s):
         total = 0.0
-        for num, unit in re.findall(r"([0-9.]+)(ms|us|s|m|h)", s):
+        for num, unit in re.findall(rf"({number})(ms|us|s|m|h)", s):
             total += float(num) * {"us": 1e-6, "ms": 1e-3, "s": 1, "m": 60, "h": 3600}[unit]
         return total
     try:
@@ -189,6 +201,7 @@ class Server:
             from pilosa_tpu.parallel.client import set_insecure_tls
 
             set_insecure_tls(True)
+            self._set_insecure_tls = True
         self._http_thread = threading.Thread(
             target=self._http.serve_forever, daemon=True
         )
@@ -259,6 +272,11 @@ class Server:
 
     def close(self) -> None:
         self._closed.set()
+        if getattr(self, "_set_insecure_tls", False):
+            from pilosa_tpu.parallel.client import set_insecure_tls
+
+            set_insecure_tls(False)
+            self._set_insecure_tls = False
         if self._anti_entropy_timer is not None:
             self._anti_entropy_timer.cancel()
         if self._heartbeat_timer is not None:

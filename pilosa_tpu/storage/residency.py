@@ -4,20 +4,32 @@ The reference mmaps every fragment file and lets the OS page cache decide
 residency (fragment.go + syswrap/ — SURVEY.md §2 #3, #26). HBM is orders of
 magnitude smaller than a disk page cache, so residency is explicit here: a
 byte-budgeted LRU of decoded dense rows (uint32[32768] each = 128 KiB) keyed
-by (fragment id, row). Eviction is free — the host roaring file remains the
-source of truth and rows are re-decoded on demand (SURVEY.md §7.3 hard part
-#1).
+by (fragment id, row). The host roaring file remains the source of truth and
+rows are re-decoded on demand (SURVEY.md §7.3 hard part #1).
 
-Writes invalidate the affected row; queries call ``get_row`` and receive a
-device array ready for the bitwise kernels.
+Two tiers. Hot entries are dense, ready for the bitwise kernels. When the
+dense tier overflows its budget share, sparse entries are *demoted* instead
+of dropped: their nonzero 4 KiB blocks are gathered on device into a compact
+``uint32[nb, 1024]`` array (one jitted gather — no host round trip; block
+indices were computed from the host array at insert time, so demotion never
+blocks on a device→host sync). A hit on a demoted entry scatters the blocks
+back into a dense array (one jitted scatter) and promotes it. For bitmap
+data at real-world densities this multiplies effective HBM residency by the
+inverse block-occupancy, which matters because a re-upload over host↔device
+is the slowest path in the system.
+
+Writes invalidate the affected row in both tiers; queries call ``get_row``
+and receive a device array ready for the bitwise kernels.
 """
 
 from __future__ import annotations
 
 from collections import OrderedDict
+from functools import partial
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
@@ -28,72 +40,214 @@ ROW_BYTES = WORDS_PER_SHARD * 4  # 128 KiB per resident row
 # is headroom for query intermediates + XLA workspace). Tests override.
 DEFAULT_BUDGET_BYTES = 4 << 30
 
+# Compression granularity: 4 KiB device blocks. Row = 32 blocks.
+COMPRESS_BLOCK_WORDS = 1024
+
+# Demote-as-compressed only when it actually saves memory; denser entries
+# are simply dropped (host re-decode is the fallback, as before).
+COMPRESS_MAX_OCCUPANCY = 0.5
+
+
+def _pad_pow2(n: int) -> int:
+    """Bucket a block count to a power of two so the gather/scatter jit
+    cache stays logarithmic in entry size."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@partial(jax.jit, static_argnames=("block_words",))
+def _gather_blocks(arr, idx, block_words: int):
+    """Compact the nonzero blocks of a flattened array: uint32[nb, bw]."""
+    return arr.reshape(-1, block_words)[idx]
+
+
+@partial(jax.jit, static_argnames=("n_blocks", "block_words"))
+def _scatter_blocks(blocks, idx, n_blocks: int, block_words: int):
+    """Inverse of _gather_blocks. ``idx`` may contain duplicates (padding
+    repeats a real index with its real data — identical writes are safe)."""
+    out = jnp.zeros((n_blocks, block_words), jnp.uint32)
+    return out.at[idx].set(blocks).reshape(-1)
+
+
+class _DenseEntry:
+    __slots__ = ("arr", "block_idx")
+
+    def __init__(self, arr, block_idx):
+        self.arr = arr
+        self.block_idx = block_idx  # np.int32[nb] or None = incompressible
+
+
+class _CompressedEntry:
+    __slots__ = ("blocks", "idx", "shape", "n_blocks", "block_idx")
+
+    def __init__(self, blocks, idx, shape, n_blocks, block_idx):
+        self.blocks = blocks  # device uint32[nb_padded, bw]
+        self.idx = idx  # device int32[nb_padded]
+        self.shape = shape
+        self.n_blocks = n_blocks
+        self.block_idx = block_idx  # host copy, for re-demotion
+
+    @property
+    def nbytes(self) -> int:
+        return self.blocks.nbytes + self.idx.nbytes
+
 
 class DeviceRowCache:
-    """Byte-budgeted LRU of device-resident arrays (dense rows, BSI plane
-    matrices, mesh-sharded shard stacks — sized by actual nbytes)."""
+    """Byte-budgeted two-tier LRU of device-resident arrays (dense rows,
+    BSI plane matrices, mesh-sharded shard stacks — sized by actual
+    nbytes). Sparse entries compress on demotion instead of dropping."""
 
     def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES, device=None):
         self.budget_bytes = budget_bytes
         self.device = device
-        self._rows: OrderedDict[tuple, jax.Array] = OrderedDict()
+        self._rows: OrderedDict[tuple, _DenseEntry] = OrderedDict()
+        self._compressed: OrderedDict[tuple, _CompressedEntry] = OrderedDict()
         self._bytes = 0
+        self._compressed_bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.compressions = 0
+        self.decompressions = 0
         # bumped on every fragment write; coarse invalidation signal for
         # derived entries (mesh-stacked arrays) whose keys embed it
         self.write_generation = 0
 
     def __len__(self) -> int:
-        return len(self._rows)
+        return len(self._rows) + len(self._compressed)
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        return self._bytes + self._compressed_bytes
+
+    @property
+    def compressed_bytes(self) -> int:
+        return self._compressed_bytes
 
     def get_row(self, key: tuple, decode: Callable[[], np.ndarray],
                 device_put: Callable | None = None) -> jax.Array:
         """Return the device array for ``key``, decoding+uploading on miss.
-        ``device_put`` overrides placement (e.g. a NamedSharding put)."""
-        row = self._rows.get(key)
-        if row is not None:
+        ``device_put`` overrides placement (e.g. a NamedSharding put);
+        entries with custom placement are never compressed."""
+        entry = self._rows.get(key)
+        if entry is not None:
             self.hits += 1
             self._rows.move_to_end(key)
-            return row
+            return entry.arr
+        centry = self._compressed.pop(key, None)
+        if centry is not None:
+            self.hits += 1
+            self.decompressions += 1
+            self._compressed_bytes -= centry.nbytes
+            flat = _scatter_blocks(
+                centry.blocks, centry.idx, centry.n_blocks,
+                COMPRESS_BLOCK_WORDS,
+            )
+            arr = flat.reshape(centry.shape)
+            self._insert_dense(key, arr, centry.block_idx)
+            return arr
         self.misses += 1
         host = decode()
         if device_put is not None:
             arr = device_put(host)
+            block_idx = None  # custom placement (mesh sharding): keep dense
         else:
             arr = jax.device_put(host, self.device)
-        self._rows[key] = arr
-        self._bytes += arr.nbytes
-        self._evict()
+            block_idx = self._host_block_index(host)
+        self._insert_dense(key, arr, block_idx)
         return arr
 
+    @staticmethod
+    def _host_block_index(host: np.ndarray):
+        """Nonzero-block indices, computed from the host array at insert
+        time (free pass over data already in cache) so demotion later
+        needs no device→host sync. None = incompressible."""
+        if host.dtype != np.uint32 or host.size % COMPRESS_BLOCK_WORDS:
+            return None
+        mask = np.any(
+            host.reshape(-1, COMPRESS_BLOCK_WORDS) != 0, axis=1
+        )
+        if mask.mean() > COMPRESS_MAX_OCCUPANCY:
+            return None
+        return np.flatnonzero(mask).astype(np.int32)
+
+    def _insert_dense(self, key: tuple, arr, block_idx) -> None:
+        self._rows[key] = _DenseEntry(arr, block_idx)
+        self._bytes += arr.nbytes
+        self._evict()
+
     def invalidate(self, key: tuple) -> None:
-        arr = self._rows.pop(key, None)
-        if arr is not None:
-            self._bytes -= arr.nbytes
+        entry = self._rows.pop(key, None)
+        if entry is not None:
+            self._bytes -= entry.arr.nbytes
+        centry = self._compressed.pop(key, None)
+        if centry is not None:
+            self._compressed_bytes -= centry.nbytes
 
     def invalidate_fragment(self, frag_id: tuple) -> None:
-        doomed = [k for k in self._rows if k[: len(frag_id)] == frag_id]
-        for k in doomed:
-            self.invalidate(k)
+        for store in (self._rows, self._compressed):
+            doomed = [k for k in store if k[: len(frag_id)] == frag_id]
+            for k in doomed:
+                self.invalidate(k)
 
     def bump_generation(self) -> None:
+        """Invalidate generation-keyed derived entries. Keys of the form
+        ('stack*', gen, ...) can never be hit again after the bump, so
+        purge them now rather than letting them occupy either tier (or
+        waste a demotion gather on eviction)."""
         self.write_generation += 1
+
+        def stale(key: tuple) -> bool:
+            # ('stackz', block_key) carries no generation and stays valid
+            return (isinstance(key[0], str) and key[0].startswith("stack")
+                    and len(key) > 1 and isinstance(key[1], int)
+                    and key[1] != self.write_generation)
+
+        for store in (self._rows, self._compressed):
+            for k in [k for k in store if stale(k)]:
+                self.invalidate(k)
 
     def clear(self) -> None:
         self._rows.clear()
+        self._compressed.clear()
         self._bytes = 0
+        self._compressed_bytes = 0
 
     def _evict(self) -> None:
-        while self._bytes > self.budget_bytes and len(self._rows) > 1:
-            _, arr = self._rows.popitem(last=False)
-            self._bytes -= arr.nbytes
+        # Demotion only under real pressure: the dense tier may use the
+        # whole budget while it fits (a fully-resident working set stays
+        # fully resident, as in the single-tier cache). Over budget, LRU
+        # dense entries demote (compressible — shrinks usage) or drop;
+        # then LRU compressed entries drop.
+        while self.bytes_used > self.budget_bytes and len(self._rows) > 1:
+            key, entry = self._rows.popitem(last=False)
+            self._bytes -= entry.arr.nbytes
+            if entry.block_idx is not None:
+                self._demote(key, entry)
+            else:
+                self.evictions += 1
+        while self.bytes_used > self.budget_bytes and self._compressed:
+            _, centry = self._compressed.popitem(last=False)
+            self._compressed_bytes -= centry.nbytes
             self.evictions += 1
+
+    def _demote(self, key: tuple, entry: _DenseEntry) -> None:
+        """Dense → compressed: gather nonzero blocks on device."""
+        nb = len(entry.block_idx)
+        nb_padded = _pad_pow2(nb)
+        # pad by repeating a real index: scatter rewrites identical data
+        idx_host = np.full(nb_padded, entry.block_idx[0] if nb else 0,
+                           np.int32)
+        idx_host[:nb] = entry.block_idx
+        idx = jax.device_put(idx_host, self.device)
+        flat = entry.arr.reshape(-1)
+        blocks = _gather_blocks(flat, idx, COMPRESS_BLOCK_WORDS)
+        centry = _CompressedEntry(
+            blocks, idx, entry.arr.shape,
+            flat.shape[0] // COMPRESS_BLOCK_WORDS, entry.block_idx,
+        )
+        self._compressed[key] = centry
+        self._compressed_bytes += centry.nbytes
+        self.compressions += 1
 
 
 _global_cache: DeviceRowCache | None = None

@@ -256,3 +256,25 @@ def test_parse_duration_rejects_malformed():
     for bad in ("1m30", "abc", "10x", "s30"):
         with pytest.raises(ValueError):
             _parse_duration(bad)
+
+
+def test_parse_duration_rejects_double_dot():
+    from pilosa_tpu.server.server import _parse_duration
+
+    for bad in ("1.2.3s", "..5s", "1..s"):
+        with pytest.raises(ValueError):
+            _parse_duration(bad)
+    assert _parse_duration(".5s") == 0.5
+
+
+def test_config_to_dict_round_trips_new_keys():
+    from pilosa_tpu.server.server import ServerConfig
+
+    cfg = ServerConfig(long_query_time=1.5, tls_certificate="/c", tls_key="/k",
+                       tls_skip_verify=True)
+    d = cfg.to_dict()
+    assert d["long-query-time"] == 1.5
+    assert d["tls-certificate"] == "/c" and d["tls-key"] == "/k"
+    assert d["tls-skip-verify"] is True
+    back = ServerConfig.from_dict(d)
+    assert back.long_query_time == 1.5 and back.tls_enabled

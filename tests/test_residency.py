@@ -1,0 +1,175 @@
+"""Two-tier HBM residency: demote-compress on eviction, scatter-promote
+on hit (storage/residency.py; SURVEY.md §7.3 hard part #1)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+from pilosa_tpu.storage.residency import (
+    COMPRESS_BLOCK_WORDS,
+    ROW_BYTES,
+    DeviceRowCache,
+)
+
+
+def sparse_row(rng, n_blocks_set):
+    """Dense uint32[WORDS_PER_SHARD] with data in n_blocks_set blocks."""
+    row = np.zeros(WORDS_PER_SHARD, np.uint32)
+    total = WORDS_PER_SHARD // COMPRESS_BLOCK_WORDS
+    for b in rng.choice(total, n_blocks_set, replace=False):
+        lo = b * COMPRESS_BLOCK_WORDS
+        row[lo : lo + COMPRESS_BLOCK_WORDS] = rng.integers(
+            1, 1 << 32, COMPRESS_BLOCK_WORDS, dtype=np.uint32
+        )
+    return row
+
+
+class CountingDecoder:
+    def __init__(self, host):
+        self.host = host
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        return self.host
+
+
+def test_demote_compress_promote_roundtrip():
+    rng = np.random.default_rng(7)
+    # budget holds one 128 KiB row; the second insert forces demotion
+    cache = DeviceRowCache(budget_bytes=200 << 10)
+    a = CountingDecoder(sparse_row(rng, 3))
+    b = CountingDecoder(sparse_row(rng, 2))
+
+    cache.get_row(("a",), a)
+    cache.get_row(("b",), b)  # evicts a from dense -> compressed tier
+    assert cache.compressions == 1
+    assert cache.compressed_bytes < ROW_BYTES // 4  # 3/32 blocks + idx
+
+    got = np.asarray(cache.get_row(("a",), a))  # promote, no re-decode
+    assert a.calls == 1
+    assert cache.decompressions == 1
+    np.testing.assert_array_equal(got, a.host)
+    # and b was in turn demoted; its round trip is exact too
+    got_b = np.asarray(cache.get_row(("b",), b))
+    assert b.calls == 1
+    np.testing.assert_array_equal(got_b, b.host)
+
+
+def test_dense_rows_drop_instead_of_compress():
+    rng = np.random.default_rng(8)
+    cache = DeviceRowCache(budget_bytes=200 << 10)
+    full = CountingDecoder(
+        rng.integers(1, 1 << 32, WORDS_PER_SHARD, dtype=np.uint32)
+    )
+    other = CountingDecoder(sparse_row(rng, 1))
+    cache.get_row(("full",), full)
+    cache.get_row(("other",), other)
+    assert cache.compressions == 0  # >50% occupancy: dropped, not kept
+    assert cache.evictions == 1
+    cache.get_row(("full",), full)
+    assert full.calls == 2  # re-decoded from host
+
+
+def test_all_zero_row_roundtrip():
+    cache = DeviceRowCache(budget_bytes=200 << 10)
+    zero = CountingDecoder(np.zeros(WORDS_PER_SHARD, np.uint32))
+    filler = CountingDecoder(np.ones(WORDS_PER_SHARD, np.uint32))
+    cache.get_row(("z",), zero)
+    cache.get_row(("f",), filler)
+    assert cache.compressions == 1
+    got = np.asarray(cache.get_row(("z",), zero))
+    assert zero.calls == 1
+    assert not got.any()
+
+
+def test_invalidate_hits_both_tiers():
+    rng = np.random.default_rng(9)
+    cache = DeviceRowCache(budget_bytes=200 << 10)
+    a = CountingDecoder(sparse_row(rng, 2))
+    b = CountingDecoder(sparse_row(rng, 2))
+    cache.get_row(("frag", 1, "a"), a)
+    cache.get_row(("frag", 1, "b"), b)  # a now compressed
+    cache.invalidate_fragment(("frag", 1))
+    assert len(cache) == 0 and cache.bytes_used == 0
+    cache.get_row(("frag", 1, "a"), a)
+    assert a.calls == 2
+
+
+def test_compressed_tier_evicts_under_total_budget():
+    rng = np.random.default_rng(10)
+    # tiny budget: dense holds one row; compressed tier must stay under
+    # total - so repeated inserts eventually drop the oldest compressed
+    cache = DeviceRowCache(budget_bytes=160 << 10)
+    decoders = [CountingDecoder(sparse_row(rng, 14)) for _ in range(16)]
+    for i, d in enumerate(decoders):
+        cache.get_row((i,), d)
+    assert cache.bytes_used <= cache.budget_bytes + ROW_BYTES  # 1 dense floor
+    assert cache.evictions > 0  # compressed tier did overflow
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_randomized_roundtrip_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    cache = DeviceRowCache(budget_bytes=200 << 10)
+    hosts = {}
+    for i in range(6):
+        nb = int(rng.integers(0, 16))
+        hosts[i] = sparse_row(rng, nb)
+        cache.get_row((i,), CountingDecoder(hosts[i]))
+    for i in rng.permutation(6):
+        got = np.asarray(cache.get_row((int(i),), CountingDecoder(hosts[int(i)])))
+        np.testing.assert_array_equal(got, hosts[int(i)])
+
+
+def test_stacked_leaf_shapes_compress():
+    """Multi-dim uint32 arrays (stacked shard leaves, BSI planes) take the
+    same path."""
+    rng = np.random.default_rng(11)
+    cache = DeviceRowCache(budget_bytes=500 << 10)
+    stacked = np.stack([sparse_row(rng, 2) for _ in range(2)])
+    planes = np.zeros((2, 3, WORDS_PER_SHARD), np.uint32)
+    planes[0, 1, :COMPRESS_BLOCK_WORDS] = 5
+    big = CountingDecoder(
+        rng.integers(1, 1 << 32, (2, WORDS_PER_SHARD), dtype=np.uint32)
+    )
+    cache.get_row(("s",), CountingDecoder(stacked))
+    cache.get_row(("p",), CountingDecoder(planes))
+    cache.get_row(("big",), big)  # forces demotions
+    assert cache.compressions >= 1
+    np.testing.assert_array_equal(
+        np.asarray(cache.get_row(("s",), CountingDecoder(stacked))), stacked
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cache.get_row(("p",), CountingDecoder(planes))), planes
+    )
+
+
+def test_working_set_within_budget_stays_dense():
+    """No demotion while everything fits: full-budget dense residency
+    (regression guard: the two-tier split must not shrink the hot tier)."""
+    rng = np.random.default_rng(12)
+    cache = DeviceRowCache(budget_bytes=600 << 10)  # 4 rows fit
+    decs = [CountingDecoder(sparse_row(rng, 2)) for _ in range(4)]
+    for i, d in enumerate(decs):
+        cache.get_row((i,), d)
+    for _ in range(3):
+        for i, d in enumerate(decs):
+            cache.get_row((i,), d)
+    assert cache.compressions == 0 and cache.evictions == 0
+    assert all(d.calls == 1 for d in decs)
+
+
+def test_bump_generation_purges_stale_stack_entries():
+    rng = np.random.default_rng(13)
+    cache = DeviceRowCache(budget_bytes=1 << 20)
+    gen = cache.write_generation
+    cache.get_row(("stack", gen, "i", "f", ("standard",), 1, ((0,), 1, 1)),
+                  CountingDecoder(sparse_row(rng, 2)))
+    cache.get_row(("stackz", ((0,), 1, 1)),
+                  CountingDecoder(np.zeros(WORDS_PER_SHARD, np.uint32)))
+    assert len(cache) == 2
+    cache.bump_generation()
+    # gen-keyed entry gone; gen-less zeros entry survives
+    assert len(cache) == 1
+    assert ("stackz", ((0,), 1, 1)) in cache._rows
