@@ -42,7 +42,7 @@ import numpy as np
 from jax import lax
 
 from pilosa_tpu.executor import expr
-from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD, next_pow2
 from pilosa_tpu.storage import residency
 
 INT32_MIN = -(1 << 31)
@@ -72,14 +72,17 @@ def merge_split(packed: np.ndarray) -> np.ndarray:
 class ShardBlock:
     """Orders a query's shard list as the leading axis of stacked leaves.
 
-    Local form: no padding beyond a floor of one slot. The mesh form
-    (parallel.mesh.ShardAssignment) pads to a multiple of the device count
-    so the leading axis shards evenly.
+    The padded slot count buckets to the next power of two so a growing
+    index recompiles each query shape O(log shards) times instead of on
+    every new shard (XLA compiles are tens of seconds on TPU; the cost is
+    ≤2x zero slots on stacked leaves, which reduce to nothing). The mesh
+    form (parallel.mesh.ShardAssignment) additionally pads to a multiple
+    of the device count so the leading axis shards evenly.
     """
 
     def __init__(self, shards: list[int]):
         self.shards = sorted(shards)
-        self.padded = max(len(self.shards), 1)
+        self.padded = next_pow2(max(len(self.shards), 1))
         self.n_devices = 1
 
     def key(self) -> tuple:

@@ -25,7 +25,7 @@ from pilosa_tpu.executor import batch, expr
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.pql import Call, Condition, parse
 from pilosa_tpu.pql.ast import Query
-from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD, position, shard_of
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD, next_pow2, position, shard_of
 from pilosa_tpu.storage import residency
 from pilosa_tpu.storage.field import (
     BSI_EXISTS_ROW,
@@ -926,7 +926,7 @@ class Executor:
         for lo in range(0, c_total, chunk):
             ci = cand[lo: lo + chunk]
             actual = ci.shape[0]
-            padded = min(chunk, _next_pow2(actual))
+            padded = min(chunk, next_pow2(actual))
             if padded > actual:
                 ci = np.concatenate(
                     [ci, np.zeros((padded - actual, n_gather), np.int32)]
@@ -1100,10 +1100,6 @@ def _index_cross(cand: np.ndarray, n: int) -> np.ndarray:
     left = np.repeat(cand, n, axis=0)
     right = np.tile(np.arange(n, dtype=np.int32), p)[:, None]
     return np.concatenate([left, right], axis=1)
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
 
 
 def _check_row(row) -> None:

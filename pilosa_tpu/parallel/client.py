@@ -19,19 +19,26 @@ class ClientError(Exception):
 
 
 _SSL_CONTEXT: ssl.SSLContext | None = None
+_INSECURE_REFS = 0
 
 
 def set_insecure_tls(insecure: bool) -> None:
     """Accept self-signed node certificates cluster-wide (reference
-    tls.skip-verify). Applies to every InternalClient in the process."""
-    global _SSL_CONTEXT
+    tls.skip-verify). Applies to every InternalClient in the process and
+    is refcounted: each opener that enabled it must disable it on close,
+    and verification resumes only when the last one has."""
+    global _SSL_CONTEXT, _INSECURE_REFS
     if insecure:
-        ctx = ssl.create_default_context()
-        ctx.check_hostname = False
-        ctx.verify_mode = ssl.CERT_NONE
-        _SSL_CONTEXT = ctx
+        _INSECURE_REFS += 1
+        if _SSL_CONTEXT is None:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            _SSL_CONTEXT = ctx
     else:
-        _SSL_CONTEXT = None
+        _INSECURE_REFS = max(0, _INSECURE_REFS - 1)
+        if _INSECURE_REFS == 0:
+            _SSL_CONTEXT = None
 
 
 class InternalClient:

@@ -20,6 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.executor.batch import ShardBlock
+from pilosa_tpu.shardwidth import next_pow2
 
 SHARDS_AXIS = "shards"
 
@@ -75,7 +76,9 @@ class ShardAssignment(ShardBlock):
         super().__init__(shards)
         self.n_devices = mesh.size
         n = max(len(self.shards), 1)
-        self.padded = -(-n // self.n_devices) * self.n_devices
+        # bucketed per-device slot count (see ShardBlock): compile count
+        # stays O(log shards) as the index grows
+        self.padded = self.n_devices * next_pow2(-(-n // self.n_devices))
         self.mesh = mesh
 
     @property

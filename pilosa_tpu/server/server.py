@@ -91,6 +91,14 @@ class ServerConfig:
             tls_skip_verify=_parse_bool(
                 d.get("tls-skip-verify", tls.get("skip-verify", False))
             ),
+            device_budget_bytes=(
+                int(d["device-budget-bytes"])
+                if d.get("device-budget-bytes") not in (None, "") else None
+            ),
+            use_mesh=(
+                _parse_bool(d["use-mesh"])
+                if d.get("use-mesh") not in (None, "") else None
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -112,6 +120,8 @@ class ServerConfig:
             "tls-certificate": self.tls_certificate,
             "tls-key": self.tls_key,
             "tls-skip-verify": self.tls_skip_verify,
+            "device-budget-bytes": self.device_budget_bytes,
+            "use-mesh": self.use_mesh,
         }
 
 

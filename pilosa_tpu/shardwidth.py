@@ -22,3 +22,9 @@ def shard_of(column_id: int) -> int:
 def position(column_id: int) -> int:
     """Column position within its shard."""
     return column_id & (SHARD_WIDTH - 1)
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1). Shared padding/bucketing rule for
+    compiled-shape axes (shard blocks, GroupBy chunks, compressed blocks)."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD, next_pow2
 
 ROW_BYTES = WORDS_PER_SHARD * 4  # 128 KiB per resident row
 
@@ -46,12 +46,6 @@ COMPRESS_BLOCK_WORDS = 1024
 # Demote-as-compressed only when it actually saves memory; denser entries
 # are simply dropped (host re-decode is the fallback, as before).
 COMPRESS_MAX_OCCUPANCY = 0.5
-
-
-def _pad_pow2(n: int) -> int:
-    """Bucket a block count to a power of two so the gather/scatter jit
-    cache stays logarithmic in entry size."""
-    return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
 @partial(jax.jit, static_argnames=("block_words",))
@@ -233,7 +227,7 @@ class DeviceRowCache:
     def _demote(self, key: tuple, entry: _DenseEntry) -> None:
         """Dense → compressed: gather nonzero blocks on device."""
         nb = len(entry.block_idx)
-        nb_padded = _pad_pow2(nb)
+        nb_padded = next_pow2(nb)
         # pad by repeating a real index: scatter rewrites identical data
         idx_host = np.full(nb_padded, entry.block_idx[0] if nb else 0,
                            np.int32)

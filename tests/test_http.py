@@ -278,3 +278,17 @@ def test_config_to_dict_round_trips_new_keys():
     assert d["tls-skip-verify"] is True
     back = ServerConfig.from_dict(d)
     assert back.long_query_time == 1.5 and back.tls_enabled
+
+
+def test_insecure_tls_refcount():
+    from pilosa_tpu.parallel import client as pc
+
+    assert pc._SSL_CONTEXT is None
+    pc.set_insecure_tls(True)
+    pc.set_insecure_tls(True)
+    pc.set_insecure_tls(False)  # one opener closed; other still needs it
+    assert pc._SSL_CONTEXT is not None
+    pc.set_insecure_tls(False)
+    assert pc._SSL_CONTEXT is None
+    pc.set_insecure_tls(False)  # extra disables don't underflow
+    assert pc._INSECURE_REFS == 0
