@@ -73,6 +73,18 @@ class Pair:
             d["key"] = self.key
         return d
 
+    def __eq__(self, other):
+        if not isinstance(other, Pair):
+            return NotImplemented
+        return (self.id == other.id and self.count == other.count
+                and self.key == other.key)
+
+    def __hash__(self):
+        return hash((self.id, self.count, self.key))
+
+    def __repr__(self) -> str:
+        return f"Pair(id={self.id}, count={self.count}, key={self.key!r})"
+
 
 class ValCount:
     """Sum/Min/Max result (reference ValCount{Val, Count})."""
@@ -85,6 +97,17 @@ class ValCount:
 
     def to_json(self) -> dict:
         return {"value": self.value, "count": self.count}
+
+    def __eq__(self, other):
+        if not isinstance(other, ValCount):
+            return NotImplemented
+        return self.value == other.value and self.count == other.count
+
+    def __hash__(self):
+        return hash((self.value, self.count))
+
+    def __repr__(self) -> str:
+        return f"ValCount(value={self.value}, count={self.count})"
 
 
 class GroupCount:
@@ -103,6 +126,19 @@ class GroupCount:
         if self.sum is not None:
             out["sum"] = self.sum
         return out
+
+    def __eq__(self, other):
+        if not isinstance(other, GroupCount):
+            return NotImplemented
+        return (self.group == other.group and self.count == other.count
+                and self.sum == other.sum)
+
+    # value-equal but holds a list; deliberately unhashable
+    __hash__ = None
+
+    def __repr__(self) -> str:
+        return (f"GroupCount(group={self.group}, count={self.count}, "
+                f"sum={self.sum})")
 
 
 def result_to_json(res):
