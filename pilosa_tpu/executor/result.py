@@ -80,7 +80,9 @@ class Pair:
                 and self.key == other.key)
 
     def __hash__(self):
-        return hash((self.id, self.count, self.key))
+        # key is attached after construction for keyed fields; exclude it
+        # so the hash is stable over the Pair's lifetime
+        return hash((self.id, self.count))
 
     def __repr__(self) -> str:
         return f"Pair(id={self.id}, count={self.count}, key={self.key!r})"

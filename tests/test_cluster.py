@@ -269,3 +269,75 @@ class TestJoinResize:
         finally:
             for s in servers:
                 s.close()
+
+
+class TestFailureHandling:
+    def test_query_survives_replica_node_death(self, tmp_path):
+        """replicaN=2: killing one node must not lose query coverage —
+        routing falls back to the surviving replica (reference: memberlist
+        dead event -> DEGRADED, reads served from remaining owners)."""
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 7 for s in range(6)]
+            req("POST", f"{uri(servers[0])}/index/i/field/f/import",
+                {"rows": [1] * len(cols), "columns": cols})
+            out = req("POST", f"{uri(servers[0])}/index/i/query", b"Count(Row(f=1))")
+            assert out["results"] == [6]
+
+            victim = servers.pop(2)
+            victim.close()
+            # survivors notice on their next heartbeat pass
+            for s in servers:
+                s.api.cluster.heartbeat()
+                states = {n.id: n.state for n in s.api.cluster.nodes.values()}
+                assert states["n2"] == "DEGRADED", states
+
+            for s in servers:
+                out = req("POST", f"{uri(s)}/index/i/query", b"Count(Row(f=1))")
+                assert out["results"] == [6]
+                out = req("POST", f"{uri(s)}/index/i/query", b"Row(f=1)")
+                assert out["results"][0]["columns"] == cols
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_node_restart_recovers_data_and_membership(self, tmp_path):
+        """Kill + restart on the same data dir: fragments reload from the
+        roaring files + op logs (checkpoint/resume == holder.Open,
+        SURVEY.md §5.4) and the node rejoins the cluster."""
+        servers = make_cluster(tmp_path, 2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 1 for s in range(4)]
+            req("POST", f"{uri(servers[0])}/index/i/field/f/import",
+                {"rows": [1] * len(cols), "columns": cols})
+            # unsnapshotted single-bit writes must also survive (op log)
+            req("POST", f"{uri(servers[0])}/index/i/query", b"Set(123, f=9)")
+
+            victim = servers.pop(1)
+            victim_dir = victim.config.data_dir
+            victim.close()
+            servers[0].api.cluster.heartbeat()
+
+            reborn = Server(ServerConfig(
+                data_dir=victim_dir, port=0, name="n1",
+                seeds=[uri(servers[0])], anti_entropy_interval=0,
+                heartbeat_interval=0, use_mesh=False,
+            )).open()
+            servers.append(reborn)
+            servers[0].api.cluster.heartbeat()
+            st = req("GET", f"{uri(servers[0])}/status")
+            assert {n["id"]: n["state"] for n in st["nodes"]} == {
+                "n0": "NORMAL", "n1": "NORMAL"}
+
+            for s in servers:
+                out = req("POST", f"{uri(s)}/index/i/query", b"Count(Row(f=1))")
+                assert out["results"] == [4]
+                out = req("POST", f"{uri(s)}/index/i/query", b"Row(f=9)")
+                assert out["results"][0]["columns"] == [123]
+        finally:
+            for s in servers:
+                s.close()

@@ -8,6 +8,9 @@ writes go through the real storage tree (fragments, op logs, caches), the
 queries through the real compiled kernels, and nothing is mocked.
 """
 
+import functools
+import operator
+
 import numpy as np
 import pytest
 
@@ -76,8 +79,6 @@ def random_expr(rng, depth=0):
     n = 2 if op in ("Difference", "Xor") else int(rng.integers(2, 4))
     subs = [random_expr(rng, depth + 1) for _ in range(n)]
     pql = f"{op}({', '.join(p for p, _ in subs)})"
-    import functools
-    import operator
 
     def ev(o, op=op, subs=subs):
         vals = [e(o) for _, e in subs]
