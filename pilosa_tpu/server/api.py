@@ -38,6 +38,9 @@ class API:
         self.long_query_time: float = 0.0  # seconds; 0 = off
         self.long_queries: list[dict] = []
         self.logger = None
+        # reference max-writes-per-request server knob: reject queries
+        # carrying more write calls than this (0 = unlimited)
+        self.max_writes_per_request: int = 5000
 
     # ---------------------------------------------------------------- query
 
@@ -50,10 +53,22 @@ class API:
 
         t0 = time.perf_counter()
         try:
+            query = pql
+            if isinstance(pql, str) and self.max_writes_per_request > 0:
+                from pilosa_tpu.pql import parse
+                from pilosa_tpu.pql.parser import WRITE_CALLS
+
+                query = parse(pql)
+                writes = sum(1 for c in query.calls if c.name in WRITE_CALLS)
+                if writes > self.max_writes_per_request:
+                    raise ApiError(
+                        f"too many writes in request: {writes} > "
+                        f"max-writes-per-request {self.max_writes_per_request}"
+                    )
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
                 kwargs["remote"] = remote
-            return self.executor.execute(index, pql, **kwargs)
+            return self.executor.execute(index, query, **kwargs)
         except (ParseError, PQLError) as e:
             raise ApiError(str(e)) from e
         finally:

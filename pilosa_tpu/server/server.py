@@ -36,6 +36,7 @@ class ServerConfig:
         diagnostics_endpoint: str = "",
         statsd: str = "",
         long_query_time: float = 0.0,
+        max_writes_per_request: int = 5000,
         tls_certificate: str = "",
         tls_key: str = "",
         tls_skip_verify: bool = False,
@@ -56,6 +57,7 @@ class ServerConfig:
         self.diagnostics_endpoint = diagnostics_endpoint
         self.statsd = statsd
         self.long_query_time = long_query_time
+        self.max_writes_per_request = max_writes_per_request
         self.tls_certificate = tls_certificate
         self.tls_key = tls_key
         self.tls_skip_verify = tls_skip_verify
@@ -85,6 +87,10 @@ class ServerConfig:
             statsd=d.get("statsd", ""),
             long_query_time=_parse_duration(
                 d.get("long-query-time", d.get("long_query_time", 0.0))
+            ),
+            max_writes_per_request=int(
+                d.get("max-writes-per-request",
+                      d.get("max_writes_per_request", 5000))
             ),
             tls_certificate=d.get("tls-certificate", tls.get("certificate", "")),
             tls_key=d.get("tls-key", tls.get("key", "")),
@@ -117,6 +123,7 @@ class ServerConfig:
             "diagnostics-endpoint": self.diagnostics_endpoint,
             "statsd": self.statsd,
             "long-query-time": self.long_query_time,
+            "max-writes-per-request": self.max_writes_per_request,
             "tls-certificate": self.tls_certificate,
             "tls-key": self.tls_key,
             "tls-skip-verify": self.tls_skip_verify,
@@ -186,6 +193,7 @@ class Server:
             )
         self.holder.open()
         self.api.long_query_time = self.config.long_query_time
+        self.api.max_writes_per_request = self.config.max_writes_per_request
         self.api.logger = self.logger
         self._http = make_http_server(self.api, self.config.bind, self.config.port)
         if self.config.tls_enabled:

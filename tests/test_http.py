@@ -292,3 +292,19 @@ def test_insecure_tls_refcount():
     assert pc._SSL_CONTEXT is None
     pc.set_insecure_tls(False)  # extra disables don't underflow
     assert pc._INSECURE_REFS == 0
+
+
+def test_max_writes_per_request(node_api):
+    node, api = node_api
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    api.max_writes_per_request = 3
+    ok = " ".join(f"Set({c}, f=1)" for c in range(3))
+    assert req("POST", f"{node}/index/i/query", ok.encode())["results"] == [True] * 3
+    too_many = " ".join(f"Set({c}, f=1)" for c in range(10, 14))
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("POST", f"{node}/index/i/query", too_many.encode())
+    assert e.value.code == 400
+    assert "max-writes-per-request" in json.loads(e.value.read())["error"]
+    # reads are unaffected
+    assert req("POST", f"{node}/index/i/query", b"Count(Row(f=1))")["results"] == [3]
