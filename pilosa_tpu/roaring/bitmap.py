@@ -24,6 +24,15 @@ ARRAY_MAX = 4096
 BITMAP_N_WORDS = 1024  # uint64 words per container (65536 bits)
 
 
+def _scatter_bits(words8: np.ndarray, lows: np.ndarray) -> None:
+    """OR uint16 bit positions into a byte view of a bitmap container."""
+    np.bitwise_or.at(
+        words8,
+        (lows >> np.uint16(3)).astype(np.int64),
+        np.uint8(1) << (lows & np.uint16(7)).astype(np.uint8),
+    )
+
+
 class Container:
     __slots__ = ("kind", "data", "n")
 
@@ -51,11 +60,7 @@ class Container:
         if n <= ARRAY_MAX:
             return Container(ARRAY, np.ascontiguousarray(lows, np.uint16), n)
         words = np.zeros(BITMAP_N_WORDS * 8, np.uint8)
-        np.bitwise_or.at(
-            words,
-            (lows >> np.uint16(3)).astype(np.int64),
-            np.uint8(1) << (lows & np.uint16(7)).astype(np.uint8),
-        )
+        _scatter_bits(words, lows)
         return Container(BITMAP, words.view("<u8").copy(), n)
 
     # --- conversions ---
@@ -96,11 +101,7 @@ class Container:
         lows = self.lows()
         words = np.zeros(2048 * 4, np.uint8)
         if lows.size:
-            np.bitwise_or.at(
-                words,
-                (lows >> np.uint16(3)).astype(np.int64),
-                np.uint8(1) << (lows & np.uint16(7)).astype(np.uint8),
-            )
+            _scatter_bits(words, lows)
         return words.view("<u4").copy()
 
 
@@ -216,23 +217,70 @@ class RoaringBitmap:
             key = int(hi[lo_i])
             batch = lows[lo_i:hi_i]
             c = self._containers.get(key)
-            existing = c.lows() if c is not None else np.empty(0, np.uint16)
-            if remove:
-                new = np.setdiff1d(existing, batch, assume_unique=True)
-            else:
-                new = np.union1d(existing, batch)
-            delta = abs(int(new.size) - int(existing.size))
+            delta = None
+            # fast paths: scatter straight into a 1024-word bitmap instead
+            # of unpack + sort + rebuild — the bulk-import hot loop
+            if c is not None and c.kind == BITMAP:
+                delta = self._merge_bitmap_inplace(key, c, batch, remove)
+            elif (not remove and c is not None and c.kind == ARRAY
+                  and c.n + batch.size > ARRAY_MAX):
+                # promote via a temporary (not yet installed) bitmap; the
+                # merge helper swaps in the final consistent container
+                words = np.zeros(BITMAP_N_WORDS * 8, np.uint8)
+                _scatter_bits(words, c.data)
+                tmp = Container(BITMAP, words.view("<u8"), c.n)
+                delta = self._merge_bitmap_inplace(key, tmp, batch, remove)
+            elif not remove and c is None and batch.size > ARRAY_MAX:
+                self._containers[key] = Container.from_lows(batch)
+                delta = int(batch.size)
+            if delta is None:
+                existing = c.lows() if c is not None else np.empty(0, np.uint16)
+                if remove:
+                    new = np.setdiff1d(existing, batch, assume_unique=True)
+                else:
+                    new = np.union1d(existing, batch)
+                delta = abs(int(new.size) - int(existing.size))
+                if delta and new.size == 0:
+                    self._containers.pop(key, None)
+                elif delta:
+                    self._containers[key] = Container.from_lows(new)
             if delta == 0:
                 continue
             changed += delta
-            if new.size == 0:
-                self._containers.pop(key, None)
-            else:
-                self._containers[key] = Container.from_lows(new)
             dirty = True
         if dirty:
             self.keys = sorted(self._containers)
         return changed
+
+    def _merge_bitmap_inplace(self, key: int, c: Container, batch, remove: bool) -> int:
+        """Scatter a unique uint16 batch into a copy of a BITMAP container
+        and swap the new container in atomically (readers and snapshots
+        always see a self-consistent immutable container — no torn
+        data/cardinality under the threaded server). Returns the
+        cardinality delta (container removed when emptied)."""
+        words8 = np.array(c.data.view(np.uint8))  # 8 KiB copy, writable
+        if remove:
+            idx = (batch >> np.uint16(3)).astype(np.int64)
+            np.bitwise_and.at(
+                words8, idx,
+                np.uint8(0xFF) ^ (np.uint8(1) << (batch & np.uint16(7)).astype(np.uint8)),
+            )
+        else:
+            _scatter_bits(words8, batch)
+        new_n = int(np.bitwise_count(words8).sum(dtype=np.int64))
+        delta = abs(new_n - c.n)
+        if new_n == 0:
+            self._containers.pop(key, None)
+        elif delta == 0:
+            pass  # unchanged: keep the existing container
+        elif new_n <= ARRAY_MAX:
+            # shrunk (or overlap-heavy add) below the bitmap break-even:
+            # rebuild the optimal array/run form instead of keeping 8 KiB
+            new_c = Container(BITMAP, words8.view("<u8"), new_n)
+            self._containers[key] = Container.from_lows(new_c.lows())
+        else:
+            self._containers[key] = Container(BITMAP, words8.view("<u8"), new_n)
+        return delta
 
     def __contains__(self, id_: int) -> bool:
         c = self._containers.get(int(id_) >> 16)

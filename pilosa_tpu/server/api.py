@@ -56,10 +56,9 @@ class API:
             query = pql
             if isinstance(pql, str) and self.max_writes_per_request > 0:
                 from pilosa_tpu.pql import parse
-                from pilosa_tpu.pql.parser import WRITE_CALLS
 
                 query = parse(pql)
-                writes = sum(1 for c in query.calls if c.name in WRITE_CALLS)
+                writes = len(query.write_calls())
                 if writes > self.max_writes_per_request:
                     raise ApiError(
                         f"too many writes in request: {writes} > "
