@@ -132,6 +132,137 @@ def replay_ops(bitmap: RoaringBitmap, buf: bytes | memoryview, offset: int) -> i
     return n_ops
 
 
+# --------------------------------------------------------- upstream layout
+#
+# Best-effort reader/writer for the REFERENCE's own roaring file layout
+# (pilosa roaring.go, 64-bit variant), reconstructed from knowledge of the
+# upstream code because the reference mount was empty at survey time
+# (SURVEY.md EVIDENCE STATUS) — confidence MED, unverified byte-for-byte:
+#   cookie  uint32 = 12348 | storage_version<<16
+#   keyN    uint32
+#   descrs  keyN × (key uint64, container_type uint16 (1=array 2=bitmap
+#           3=run), cardinality-1 uint16)
+#   offsets keyN × uint32 (absolute file offset of container data)
+#   data    array: n×uint16 | bitmap: 1024×uint64 |
+#           run: run_count uint16, then run_count×(start,last) uint16
+#   ops     records: type uint8 (0=add 1=remove), value uint64,
+#           crc32(IEEE, first 9 bytes) uint32
+# import-roaring sniffs this cookie and falls back to our own layout.
+
+PILOSA_MAGIC = 12348
+_P_HEADER = struct.Struct("<II")
+_P_DESCR = struct.Struct("<QHH")
+_P_OFFSET = struct.Struct("<I")
+_P_OP = struct.Struct("<BQI")
+
+
+def serialize_pilosa(bitmap: RoaringBitmap) -> bytes:
+    """Write the upstream layout (export interop; confidence MED)."""
+    n = len(bitmap.keys)
+    header_len = _P_HEADER.size + n * (_P_DESCR.size + _P_OFFSET.size)
+    descrs, offsets, payloads = [], [], []
+    pos = header_len
+    for key in bitmap.keys:
+        c = bitmap.container(key)
+        if c.kind == RUN:
+            body = struct.pack("<H", len(c.data)) + np.ascontiguousarray(
+                c.data
+            ).astype("<u2", copy=False).tobytes()
+        else:
+            dtype = "<u2" if c.kind == ARRAY else "<u8"
+            body = np.ascontiguousarray(c.data).astype(dtype, copy=False).tobytes()
+        descrs.append(_P_DESCR.pack(key, c.kind, c.n - 1))
+        offsets.append(_P_OFFSET.pack(pos))
+        payloads.append(body)
+        pos += len(body)
+    return (_P_HEADER.pack(PILOSA_MAGIC, n) + b"".join(descrs)
+            + b"".join(offsets) + b"".join(payloads))
+
+
+def deserialize_pilosa(buf: bytes | memoryview) -> tuple[RoaringBitmap, int]:
+    """Parse the upstream layout; returns (bitmap, offset-where-ops-begin).
+    Truncated/malformed input raises ValueError (never struct.error)."""
+    try:
+        return _deserialize_pilosa(memoryview(buf))
+    except struct.error as e:
+        raise ValueError(f"roaring: truncated pilosa layout: {e}") from None
+
+
+def _deserialize_pilosa(buf: memoryview) -> tuple[RoaringBitmap, int]:
+    cookie, n = _P_HEADER.unpack_from(buf, 0)
+    if cookie & 0xFFFF != PILOSA_MAGIC:
+        raise ValueError(f"roaring: bad pilosa cookie 0x{cookie:08X}")
+    pos = _P_HEADER.size
+    descrs = []
+    for _ in range(n):
+        descrs.append(_P_DESCR.unpack_from(buf, pos))
+        pos += _P_DESCR.size
+    offsets = []
+    for _ in range(n):
+        offsets.append(_P_OFFSET.unpack_from(buf, pos)[0])
+        pos += _P_OFFSET.size
+    b = RoaringBitmap()
+    end = pos
+    for (key, kind, n_minus_1), off in zip(descrs, offsets):
+        card = n_minus_1 + 1
+        if kind == ARRAY:
+            data = np.frombuffer(buf, dtype="<u2", count=card, offset=off).copy()
+            end = max(end, off + 2 * card)
+        elif kind == BITMAP:
+            data = np.frombuffer(buf, dtype="<u8", count=1024, offset=off).copy()
+            end = max(end, off + 8192)
+        elif kind == RUN:
+            (run_count,) = struct.unpack_from("<H", buf, off)
+            data = np.frombuffer(
+                buf, dtype="<u2", count=2 * run_count, offset=off + 2
+            ).copy().reshape(-1, 2)
+            end = max(end, off + 2 + 4 * run_count)
+        else:
+            raise ValueError(f"roaring: unknown pilosa container kind {kind}")
+        b._containers[int(key)] = Container(int(kind), data, card)
+    b.keys = sorted(b._containers)
+    return b, end
+
+
+def replay_pilosa_ops(bitmap: RoaringBitmap, buf: bytes | memoryview,
+                      offset: int) -> int:
+    """Single-value add/remove op records (upstream op log; crc-checked,
+    torn tail tolerated)."""
+    buf = memoryview(buf)
+    pos, n_ops = offset, 0
+    pending_typ, pending = None, []
+
+    def flush():
+        if pending:
+            ids = np.asarray(pending, np.uint64)
+            (bitmap.add_ids if pending_typ == 0 else bitmap.remove_ids)(ids)
+            pending.clear()
+
+    while pos + _P_OP.size <= len(buf):
+        typ, value, crc = _P_OP.unpack_from(buf, pos)
+        if typ > 1 or zlib.crc32(bytes(buf[pos:pos + 9])) != crc:
+            break
+        if typ != pending_typ:  # batch consecutive same-type records
+            flush()
+            pending_typ = typ
+        pending.append(value)
+        n_ops += 1
+        pos += _P_OP.size
+    flush()
+    return n_ops
+
+
+def load_any(buf: bytes | memoryview) -> tuple[RoaringBitmap, int]:
+    """Sniff our layout vs the upstream layout; returns (bitmap, op count)."""
+    buf = memoryview(buf)
+    if len(buf) >= 4:
+        (magic,) = struct.unpack_from("<I", buf, 0)
+        if magic & 0xFFFF == PILOSA_MAGIC and magic != MAGIC:
+            bitmap, ops_at = deserialize_pilosa(buf)
+            return bitmap, replay_pilosa_ops(bitmap, buf, ops_at)
+    return load(buf)
+
+
 class OpLogWriter:
     """Appends op records to an open binary file and fsyncs."""
 

@@ -283,13 +283,13 @@ class API:
         idx = self._index(index)
         fld = self._field(idx, field)
         frag = fld.view(view, create=True).fragment(shard, create=True)
+        from pilosa_tpu.roaring.format import load_any
+
         try:
-            changed = frag.import_roaring(data)
+            bitmap, _ = load_any(data)
+            changed = frag.import_roaring_bitmap(bitmap)
         except ValueError as e:
             raise ApiError(str(e)) from e
-        from pilosa_tpu.roaring.format import load as load_roaring
-
-        bitmap, _ = load_roaring(data)
         positions = np.unique(bitmap.to_ids() & np.uint64(SHARD_WIDTH - 1))
         idx.mark_columns_exist(
             ((shard << SHARD_WIDTH_EXP) + positions.astype(np.int64)).tolist()

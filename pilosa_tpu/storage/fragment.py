@@ -21,7 +21,13 @@ import os
 import numpy as np
 
 from pilosa_tpu.roaring import RoaringBitmap, OP_ADD, OP_REMOVE
-from pilosa_tpu.roaring.format import deserialize, encode_op, replay_ops, serialize
+from pilosa_tpu.roaring.format import (
+    deserialize,
+    encode_op,
+    load_any,
+    replay_ops,
+    serialize,
+)
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
@@ -197,9 +203,14 @@ class Fragment:
 
     def import_roaring(self, data: bytes) -> int:
         """Union a serialized roaring bitmap into this fragment (reference
-        api.ImportRoaring fast path)."""
-        other, ops_at = deserialize(data)
-        replay_ops(other, data, ops_at)
+        api.ImportRoaring fast path). Accepts either this framework's
+        layout or the upstream pilosa layout (sniffed by cookie)."""
+        other, _ = load_any(data)
+        return self.import_roaring_bitmap(other)
+
+    def import_roaring_bitmap(self, other) -> int:
+        """Union an already-parsed RoaringBitmap into this fragment
+        (lets callers that also need the parsed ids avoid re-parsing)."""
         ids = other.to_ids()
         changed = self.bitmap.add_ids(ids)
         if changed:

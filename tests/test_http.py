@@ -308,3 +308,13 @@ def test_max_writes_per_request(node_api):
     assert "max-writes-per-request" in json.loads(e.value.read())["error"]
     # reads are unaffected
     assert req("POST", f"{node}/index/i/query", b"Count(Row(f=1))")["results"] == [3]
+
+
+def test_import_roaring_malformed_upstream_blob_is_400(node):
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    # pilosa cookie (12348) but truncated body: clean 400, not a 500
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("POST", f"{node}/index/i/field/f/import-roaring/0",
+            b"\x3c\x30\x00\x00\x01", content_type="application/octet-stream")
+    assert e.value.code == 400
