@@ -352,13 +352,10 @@ class Cluster:
                                 except ClientError:
                                     continue
                                 if ids:
-                                    added = frag.bitmap.add_ids(
+                                    added = frag.add_ids(
                                         np.asarray(ids, np.uint64)
                                     )
                                     if added:
-                                        frag._log_op(1, ids)  # OP_ADD
-                                        for r in {int(i) >> 20 for i in ids}:
-                                            frag._after_row_write(r)
                                         repaired["bits"] += added
                                         repaired["fragments"] += 1
                             local_blocks = dict(frag.blocks())

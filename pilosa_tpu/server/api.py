@@ -287,10 +287,11 @@ class API:
 
         try:
             bitmap, _ = load_any(data)
-            changed = frag.import_roaring_bitmap(bitmap)
+            ids = bitmap.to_ids()
+            changed = frag.add_ids(ids)
         except ValueError as e:
             raise ApiError(str(e)) from e
-        positions = np.unique(bitmap.to_ids() & np.uint64(SHARD_WIDTH - 1))
+        positions = np.unique(ids & np.uint64(SHARD_WIDTH - 1))
         idx.mark_columns_exist(
             ((shard << SHARD_WIDTH_EXP) + positions.astype(np.int64)).tolist()
         )

@@ -308,8 +308,6 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self._json({"blocks": [{"block": b, "checksum": c} for b, c in blocks]})
 
     def get_fragment_data(self, query=None):
-        from pilosa_tpu.roaring.format import serialize
-
         index = (query.get("index") or [""])[0]
         field = (query.get("field") or [""])[0]
         view = (query.get("view") or ["standard"])[0]
@@ -318,7 +316,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         fld = self.api._field(idx, field)
         v = fld.view(view)
         frag = v.fragment(shard) if v else None
-        data = serialize(frag.bitmap) if frag else b""
+        data = frag.serialize_snapshot() if frag else b""
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(data)))

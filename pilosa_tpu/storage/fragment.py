@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
 
 import numpy as np
 
@@ -66,6 +67,11 @@ class Fragment:
         self.row_cache = new_row_cache(cache_type, cache_size)
         self._file = None
         self._open = False
+        # One writer at a time per fragment (reference fragment.mu):
+        # mutators, snapshot, and consistent-view readers (blocks,
+        # serialize_snapshot) take this; row reads stay lock-free against
+        # atomic container swaps.
+        self.lock = threading.RLock()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -88,14 +94,15 @@ class Fragment:
         return self
 
     def close(self) -> None:
-        if not self._open:
-            return
-        self.row_cache.save(self._cache_path())
-        if self._file:
-            self._file.close()
-            self._file = None
-        residency.global_row_cache().invalidate_fragment(self.frag_id)
-        self._open = False
+        with self.lock:
+            if not self._open:
+                return
+            self.row_cache.save(self._cache_path())
+            if self._file:
+                self._file.close()
+                self._file = None
+            residency.global_row_cache().invalidate_fragment(self.frag_id)
+            self._open = False
 
     def _cache_path(self) -> str:
         return self.path + ".cache"
@@ -144,45 +151,49 @@ class Fragment:
 
     def set_bit(self, row: int, pos: int) -> bool:
         self._check_pos(pos)
-        changed = self.bitmap.add_ids([(row << 20) + pos]) > 0
-        if changed:
-            self._log_op(OP_ADD, [(row << 20) + pos])
-            self._after_row_write(row)
-        return changed
+        with self.lock:
+            changed = self.bitmap.add_ids([(row << 20) + pos]) > 0
+            if changed:
+                self._log_op(OP_ADD, [(row << 20) + pos])
+                self._after_row_write(row)
+            return changed
 
     def clear_bit(self, row: int, pos: int) -> bool:
         self._check_pos(pos)
-        changed = self.bitmap.remove_ids([(row << 20) + pos]) > 0
-        if changed:
-            self._log_op(OP_REMOVE, [(row << 20) + pos])
-            self._after_row_write(row)
-        return changed
+        with self.lock:
+            changed = self.bitmap.remove_ids([(row << 20) + pos]) > 0
+            if changed:
+                self._log_op(OP_REMOVE, [(row << 20) + pos])
+                self._after_row_write(row)
+            return changed
 
     def clear_row(self, row: int) -> int:
         """Remove every bit in a row (mutex fields, Store). Returns #cleared."""
-        cols = self.row_columns(row)
-        if cols.size == 0:
-            return 0
-        ids = cols + np.uint64(row << 20)
-        removed = self.bitmap.remove_ids(ids)
-        self._log_op(OP_REMOVE, ids)
-        self._after_row_write(row)
-        return removed
+        with self.lock:
+            cols = self.row_columns(row)
+            if cols.size == 0:
+                return 0
+            ids = cols + np.uint64(row << 20)
+            removed = self.bitmap.remove_ids(ids)
+            self._log_op(OP_REMOVE, ids)
+            self._after_row_write(row)
+            return removed
 
     def write_row_words(self, row: int, words: np.ndarray) -> None:
         """Replace a row wholesale from a dense word vector (Store(),
         anti-entropy block repair). Logged as clear+add."""
         from pilosa_tpu.ops.packing import unpack_bits
 
-        old = self.row_columns(row) + np.uint64(row << 20)
-        new = unpack_bits(words) + np.uint64(row << 20)
-        if old.size:
-            self.bitmap.remove_ids(old)
-            self._log_op(OP_REMOVE, old)
-        if new.size:
-            self.bitmap.add_ids(new)
-            self._log_op(OP_ADD, new)
-        self._after_row_write(row)
+        with self.lock:
+            old = self.row_columns(row) + np.uint64(row << 20)
+            new = unpack_bits(words) + np.uint64(row << 20)
+            if old.size:
+                self.bitmap.remove_ids(old)
+                self._log_op(OP_REMOVE, old)
+            if new.size:
+                self.bitmap.add_ids(new)
+                self._log_op(OP_ADD, new)
+            self._after_row_write(row)
 
     def bulk_import(self, rows, positions) -> int:
         """Batched import of (row, position) pairs (reference
@@ -194,12 +205,13 @@ class Fragment:
         if positions.size and positions.max() >= SHARD_WIDTH:
             raise ValueError("position out of shard range")
         ids = (rows << np.uint64(20)) + positions
-        changed = self.bitmap.add_ids(ids)
-        if changed:
-            self._log_op(OP_ADD, ids)
-            for row in np.unique(rows).tolist():
-                self._after_row_write(int(row))
-        return changed
+        with self.lock:
+            changed = self.bitmap.add_ids(ids)
+            if changed:
+                self._log_op(OP_ADD, ids)
+                for row in np.unique(rows).tolist():
+                    self._after_row_write(int(row))
+            return changed
 
     def import_roaring(self, data: bytes) -> int:
         """Union a serialized roaring bitmap into this fragment (reference
@@ -209,15 +221,20 @@ class Fragment:
         return self.import_roaring_bitmap(other)
 
     def import_roaring_bitmap(self, other) -> int:
-        """Union an already-parsed RoaringBitmap into this fragment
-        (lets callers that also need the parsed ids avoid re-parsing)."""
-        ids = other.to_ids()
-        changed = self.bitmap.add_ids(ids)
-        if changed:
-            self._log_op(OP_ADD, ids)
-            for row in sorted({int(i) >> 20 for i in ids.tolist()}):
-                self._after_row_write(row)
-        return changed
+        """Union an already-parsed RoaringBitmap into this fragment."""
+        return self.add_ids(other.to_ids())
+
+    def add_ids(self, ids) -> int:
+        """Union raw bit ids under the fragment lock (import-roaring,
+        anti-entropy block repair). Returns #bits changed."""
+        ids = np.asarray(ids, np.uint64)
+        with self.lock:
+            changed = self.bitmap.add_ids(ids)
+            if changed:
+                self._log_op(OP_ADD, ids)
+                for row in sorted({int(i) >> 20 for i in ids.tolist()}):
+                    self._after_row_write(row)
+            return changed
 
     # ------------------------------------------------------------ durability
 
@@ -233,6 +250,10 @@ class Fragment:
     def snapshot(self) -> None:
         """Compact: rewrite the file as a clean snapshot, dropping the log
         (reference fragment.snapshot — SURVEY.md §3.3)."""
+        with self.lock:
+            self._snapshot_locked()
+
+    def _snapshot_locked(self) -> None:
         if self._file:
             self._file.close()
         tmp = self.path + ".snapshotting"
@@ -261,11 +282,18 @@ class Fragment:
 
     # ---------------------------------------------------- anti-entropy blocks
 
+    def serialize_snapshot(self) -> bytes:
+        """Consistent serialized snapshot of the live bitmap (resize /
+        anti-entropy fragment-data fetch)."""
+        with self.lock:
+            return serialize(self.bitmap)
+
     def blocks(self) -> list[tuple[int, str]]:
         """Checksums of BLOCK_ROWS-row blocks for replica diffing
         (reference fragment.Blocks — SURVEY.md §3.5)."""
         out = []
-        ids = self.bitmap.to_ids()
+        with self.lock:
+            ids = self.bitmap.to_ids()
         if ids.size == 0:
             return out
         block_of = (ids >> np.uint64(20)) // BLOCK_ROWS
@@ -282,7 +310,8 @@ class Fragment:
 
     def block_ids(self, block: int) -> np.ndarray:
         """All bit ids in one checksum block (for block repair)."""
-        ids = self.bitmap.to_ids()
+        with self.lock:
+            ids = self.bitmap.to_ids()
         lo = np.uint64(block * BLOCK_ROWS) << np.uint64(20)
         hi = np.uint64((block + 1) * BLOCK_ROWS) << np.uint64(20)
         return ids[(ids >= lo) & (ids < hi)]
