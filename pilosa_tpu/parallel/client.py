@@ -18,32 +18,19 @@ class ClientError(Exception):
     pass
 
 
-_SSL_CONTEXT: ssl.SSLContext | None = None
-_INSECURE_REFS = 0
-
-
-def set_insecure_tls(insecure: bool) -> None:
-    """Accept self-signed node certificates cluster-wide (reference
-    tls.skip-verify). Applies to every InternalClient in the process and
-    is refcounted: each opener that enabled it must disable it on close,
-    and verification resumes only when the last one has."""
-    global _SSL_CONTEXT, _INSECURE_REFS
-    if insecure:
-        _INSECURE_REFS += 1
-        if _SSL_CONTEXT is None:
+class InternalClient:
+    def __init__(self, timeout: float = 30.0, insecure_tls: bool = False):
+        """insecure_tls accepts self-signed node certificates (reference
+        tls.skip-verify), scoped to THIS client only — plumbed from the
+        owning server's config so one skip-verify server can't disable
+        certificate verification for other servers in the same process."""
+        self.timeout = timeout
+        self._ssl_context: ssl.SSLContext | None = None
+        if insecure_tls:
             ctx = ssl.create_default_context()
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
-            _SSL_CONTEXT = ctx
-    else:
-        _INSECURE_REFS = max(0, _INSECURE_REFS - 1)
-        if _INSECURE_REFS == 0:
-            _SSL_CONTEXT = None
-
-
-class InternalClient:
-    def __init__(self, timeout: float = 30.0):
-        self.timeout = timeout
+            self._ssl_context = ctx
 
     # -------------------------------------------------------------- helpers
 
@@ -54,7 +41,7 @@ class InternalClient:
             req.add_header("Content-Type", content_type)
         try:
             with urllib.request.urlopen(
-                req, timeout=self.timeout, context=_SSL_CONTEXT
+                req, timeout=self.timeout, context=self._ssl_context
             ) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:

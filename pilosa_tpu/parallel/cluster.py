@@ -60,7 +60,8 @@ class Cluster:
     """Shard→node assignment + membership + schema broadcast."""
 
     def __init__(self, local: Node, peers: list[Node] | None = None,
-                 replica_n: int = 1, holder=None, api=None):
+                 replica_n: int = 1, holder=None, api=None,
+                 insecure_tls: bool = False):
         self.local = local
         self.nodes: dict[str, Node] = {local.id: local}
         for p in peers or []:
@@ -68,7 +69,7 @@ class Cluster:
         self.replica_n = replica_n
         self.holder = holder
         self.api = api  # set by Server after API construction
-        self.client = InternalClient()
+        self.client = InternalClient(insecure_tls=insecure_tls)
         self.state = STATE_NORMAL
         self._lock = threading.RLock()
         # bytes of the coordinator's translate log already applied locally;

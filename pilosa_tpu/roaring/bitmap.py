@@ -151,7 +151,13 @@ class RoaringBitmap:
     def to_ids(self) -> np.ndarray:
         parts = []
         for key in self.keys:
-            lows = self._containers[key].lows().astype(np.uint64)
+            # .get + skip: a racing remove pops an emptied container before
+            # reassigning self.keys, so a lock-free reader can see a key
+            # whose container is already gone (matches dense_range_words32).
+            c = self._containers.get(key)
+            if c is None:
+                continue
+            lows = c.lows().astype(np.uint64)
             parts.append(lows + (np.uint64(key) << np.uint64(16)))
         if not parts:
             return np.empty(0, np.uint64)

@@ -146,7 +146,8 @@ def replay_ops(bitmap: RoaringBitmap, buf: bytes | memoryview, offset: int) -> i
 #   data    array: n×uint16 | bitmap: 1024×uint64 |
 #           run: run_count uint16, then run_count×(start,last) uint16
 #   ops     records: type uint8 (0=add 1=remove), value uint64,
-#           crc32(IEEE, first 9 bytes) uint32
+#           fnv1a32(first 9 bytes) uint32   (upstream uses fnv.New32a,
+#           NOT CRC-32 — ADVICE r1)
 # import-roaring sniffs this cookie and falls back to our own layout.
 
 PILOSA_MAGIC = 12348
@@ -224,10 +225,25 @@ def _deserialize_pilosa(buf: memoryview) -> tuple[RoaringBitmap, int]:
     return b, end
 
 
+def fnv1a32(data: bytes) -> int:
+    """FNV-1a 32-bit — the hash upstream pilosa uses for op-log record
+    checksums (fnv.New32a over the 9 type+value bytes), NOT CRC-32."""
+    h = 0x811C9DC5
+    for byte in data:
+        h = ((h ^ byte) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
 def replay_pilosa_ops(bitmap: RoaringBitmap, buf: bytes | memoryview,
-                      offset: int) -> int:
-    """Single-value add/remove op records (upstream op log; crc-checked,
-    torn tail tolerated)."""
+                      offset: int, *, strict: bool = False) -> int:
+    """Single-value add/remove op records (upstream op log; FNV-1a-checked,
+    torn tail tolerated).
+
+    With strict=True (the import path, as opposed to crash recovery) a
+    checksum mismatch that leaves a full well-formed record's worth of
+    bytes unread raises instead of being treated as a clean torn tail —
+    silently importing only the snapshot would be silent data loss.
+    """
     buf = memoryview(buf)
     pos, n_ops = offset, 0
     pending_typ, pending = None, []
@@ -240,7 +256,15 @@ def replay_pilosa_ops(bitmap: RoaringBitmap, buf: bytes | memoryview,
 
     while pos + _P_OP.size <= len(buf):
         typ, value, crc = _P_OP.unpack_from(buf, pos)
-        if typ > 1 or zlib.crc32(bytes(buf[pos:pos + 9])) != crc:
+        if typ > 1 or fnv1a32(bytes(buf[pos:pos + 9])) != crc:
+            if strict:
+                reason = (f"unsupported op type {typ}" if typ > 1
+                          else "checksum mismatch")
+                raise ValueError(
+                    f"roaring: pilosa op log {reason} at byte {pos} with "
+                    f"{len(buf) - pos} bytes remaining; refusing to "
+                    "silently drop unsnapshotted ops on import"
+                )
             break
         if typ != pending_typ:  # batch consecutive same-type records
             flush()
@@ -252,14 +276,21 @@ def replay_pilosa_ops(bitmap: RoaringBitmap, buf: bytes | memoryview,
     return n_ops
 
 
-def load_any(buf: bytes | memoryview) -> tuple[RoaringBitmap, int]:
-    """Sniff our layout vs the upstream layout; returns (bitmap, op count)."""
+def load_any(buf: bytes | memoryview, *, strict_ops: bool = True
+             ) -> tuple[RoaringBitmap, int]:
+    """Sniff our layout vs the upstream layout; returns (bitmap, op count).
+
+    strict_ops applies to the upstream op log only: load_any's callers are
+    import paths (import-roaring, fragment merge), where dropping
+    unsnapshotted upstream ops must be an error, not a quiet torn tail.
+    """
     buf = memoryview(buf)
     if len(buf) >= 4:
         (magic,) = struct.unpack_from("<I", buf, 0)
         if magic & 0xFFFF == PILOSA_MAGIC and magic != MAGIC:
             bitmap, ops_at = deserialize_pilosa(buf)
-            return bitmap, replay_pilosa_ops(bitmap, buf, ops_at)
+            return bitmap, replay_pilosa_ops(bitmap, buf, ops_at,
+                                             strict=strict_ops)
     return load(buf)
 
 

@@ -7,6 +7,7 @@ in-process imports skip the network entirely.
 
 from __future__ import annotations
 
+import collections
 import datetime as dt
 
 import numpy as np
@@ -36,7 +37,9 @@ class API:
         # long-query log (reference long-query-time server knob): queries
         # slower than the threshold are logged and kept in a ring buffer.
         self.long_query_time: float = 0.0  # seconds; 0 = off
-        self.long_queries: list[dict] = []
+        # deque(maxlen): append is atomic and bounded, so concurrent HTTP
+        # handler threads can't interleave an append/trim pair (ADVICE r1)
+        self.long_queries: collections.deque[dict] = collections.deque(maxlen=100)
         self.logger = None
         # reference max-writes-per-request server knob: reject queries
         # carrying more write calls than this (0 = unlimited)
@@ -79,7 +82,6 @@ class API:
                     "at": dt.datetime.now(dt.timezone.utc).isoformat(),
                 }
                 self.long_queries.append(entry)
-                del self.long_queries[:-100]
                 if self.logger is not None:
                     self.logger.warning(
                         "long query (%.3fs > %.3fs) on %s: %s",

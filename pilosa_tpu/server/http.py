@@ -265,7 +265,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
     def get_long_queries(self, query=None):
         self._json({"threshold": self.api.long_query_time,
-                    "queries": self.api.long_queries})
+                    "queries": list(self.api.long_queries)})
 
     def get_debug_vars(self, query=None):
         from pilosa_tpu.utils.stats import global_stats

@@ -215,11 +215,6 @@ class Server:
                 return conn, addr
 
             self._http.get_request = tls_get_request
-        if self.config.tls_skip_verify:
-            from pilosa_tpu.parallel.client import set_insecure_tls
-
-            set_insecure_tls(True)
-            self._set_insecure_tls = True
         self._http_thread = threading.Thread(
             target=self._http.serve_forever, daemon=True
         )
@@ -264,6 +259,7 @@ class Server:
         uri = self.config.advertise or f"{scheme}://{self.config.bind}:{self.port}"
         cluster = Cluster(
             Node(name, uri), replica_n=self.config.replica_n, holder=self.holder,
+            insecure_tls=self.config.tls_skip_verify,
         )
         cluster.api = self.api
         self.api.cluster = cluster
@@ -290,11 +286,6 @@ class Server:
 
     def close(self) -> None:
         self._closed.set()
-        if getattr(self, "_set_insecure_tls", False):
-            from pilosa_tpu.parallel.client import set_insecure_tls
-
-            set_insecure_tls(False)
-            self._set_insecure_tls = False
         if self._anti_entropy_timer is not None:
             self._anti_entropy_timer.cancel()
         if self._heartbeat_timer is not None:

@@ -203,7 +203,7 @@ def test_long_query_log(node_api):
 def test_tls_server(tmp_path):
     import subprocess
 
-    from pilosa_tpu.parallel.client import InternalClient, set_insecure_tls
+    from pilosa_tpu.parallel.client import InternalClient
     from pilosa_tpu.server.server import Server, ServerConfig
 
     cert = tmp_path / "node.crt"
@@ -223,7 +223,9 @@ def test_tls_server(tmp_path):
     try:
         uri = f"https://localhost:{server.port}"
         assert server.api.cluster.local.uri.startswith("https://")
-        client = InternalClient()
+        # the server's own internal client got skip-verify from its config
+        assert server.api.cluster.client._ssl_context is not None
+        client = InternalClient(insecure_tls=True)
         client._call("POST", f"{uri}/index/i", json.dumps({}).encode())
         client._call("POST", f"{uri}/index/i/field/f", json.dumps({}).encode())
         out = client.query_node(uri, "i", "Set(3, f=1) Count(Row(f=1))",
@@ -235,7 +237,6 @@ def test_tls_server(tmp_path):
             urllib.request.urlopen(f"http://localhost:{server.port}/schema", timeout=5)
     finally:
         server.close()
-        set_insecure_tls(False)
 
 
 def test_parse_duration():
@@ -280,18 +281,16 @@ def test_config_to_dict_round_trips_new_keys():
     assert back.long_query_time == 1.5 and back.tls_enabled
 
 
-def test_insecure_tls_refcount():
-    from pilosa_tpu.parallel import client as pc
+def test_insecure_tls_is_per_client():
+    # One skip-verify client must not disable verification for others in
+    # the same process (ADVICE r1: scope the SSL context to the instance).
+    from pilosa_tpu.parallel.client import InternalClient
 
-    assert pc._SSL_CONTEXT is None
-    pc.set_insecure_tls(True)
-    pc.set_insecure_tls(True)
-    pc.set_insecure_tls(False)  # one opener closed; other still needs it
-    assert pc._SSL_CONTEXT is not None
-    pc.set_insecure_tls(False)
-    assert pc._SSL_CONTEXT is None
-    pc.set_insecure_tls(False)  # extra disables don't underflow
-    assert pc._INSECURE_REFS == 0
+    insecure = InternalClient(insecure_tls=True)
+    secure = InternalClient()
+    assert insecure._ssl_context is not None
+    assert insecure._ssl_context.verify_mode == __import__("ssl").CERT_NONE
+    assert secure._ssl_context is None
 
 
 def test_max_writes_per_request(node_api):

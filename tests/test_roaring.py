@@ -177,22 +177,49 @@ class TestPilosaLayout:
 
     def test_ops_replay_and_torn_tail(self):
         import struct as _s
-        import zlib
 
-        from pilosa_tpu.roaring.format import load_any, serialize_pilosa
+        from pilosa_tpu.roaring.format import fnv1a32, load_any, serialize_pilosa
 
         bm = RoaringBitmap.from_ids(np.asarray([1, 2, 3], np.uint64))
         blob = serialize_pilosa(bm)
 
         def op(typ, value):
             head = _s.pack("<BQ", typ, value)
-            return head + _s.pack("<I", zlib.crc32(head))
+            return head + _s.pack("<I", fnv1a32(head))
 
         blob += op(0, 99) + op(1, 2) + op(0, 1 << 20)
         blob += b"\x00\x07"  # torn tail: ignored
         got, n_ops = load_any(blob)
         assert n_ops == 3
         assert got.to_ids().tolist() == [1, 3, 99, 1 << 20]
+
+    def test_fnv1a32_known_vectors(self):
+        # Published FNV-1a 32 test vectors (same hash Go's fnv.New32a uses).
+        from pilosa_tpu.roaring.format import fnv1a32
+
+        assert fnv1a32(b"") == 0x811C9DC5
+        assert fnv1a32(b"a") == 0xE40C292C
+        assert fnv1a32(b"foobar") == 0xBF9CF968
+
+    def test_strict_import_rejects_bad_op_checksum(self):
+        import struct as _s
+
+        import pytest
+
+        from pilosa_tpu.roaring.format import load_any, replay_pilosa_ops, serialize_pilosa
+
+        bm = RoaringBitmap.from_ids(np.asarray([1], np.uint64))
+        blob = serialize_pilosa(bm)
+        # A full-size record with a wrong checksum: the import path must
+        # refuse (silent data loss otherwise); crash recovery tolerates it.
+        blob += _s.pack("<BQI", 0, 42, 0xDEADBEEF)
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            load_any(blob)
+        got, n_ops = load_any(blob, strict_ops=False)
+        assert n_ops == 0 and got.to_ids().tolist() == [1]
+        # replay_pilosa_ops default (crash-recovery) path also tolerates it
+        bm2 = RoaringBitmap.from_ids(np.asarray([1], np.uint64))
+        assert replay_pilosa_ops(bm2, blob, len(serialize_pilosa(bm))) == 0
 
     def test_import_roaring_accepts_upstream_layout(self, tmp_path):
         from pilosa_tpu.roaring.format import serialize_pilosa
