@@ -9,9 +9,11 @@ O(shards × RTT). Here the whole map+reduce phase is ONE XLA program over
 stacked leaves ``uint32[n_shards, ...]`` (vmapped per shard, reduced on
 device) and exactly ONE packed result array crosses back to the host.
 
-Leaves are built once per (query leaf, shard set, write generation) and
-cached in device HBM via the residency LRU (storage.residency), so
-steady-state queries touch the host only for the final packed result.
+Leaves are built once per (query leaf, shard set) and cached in device
+HBM via the residency LRU (storage.residency), so steady-state queries
+touch the host only for the final packed result. Writes are routed to
+resident leaves as in-place device scatter patches (see the
+cached-stacked-leaves section below) rather than evicting them.
 
 ``ShardBlock`` is the local (single-device) layout; parallel.mesh's
 ``ShardAssignment`` extends it with mesh padding, and parallel.dist swaps
@@ -127,6 +129,97 @@ def host_planes(idx, spec, shard: int, depth: int) -> np.ndarray:
 
 
 # ------------------------------------------------------ cached stacked leaves
+#
+# Leaves are keyed WITHOUT a write generation: a fragment mutation is
+# routed (residency.apply_write) to exactly the dependent leaves, which
+# are patched on device — a scatter of the affected shard slot — instead
+# of being evicted. SURVEY.md §7.3 hard part #3: writes no longer force
+# the next query to re-decode and re-upload its whole working set.
+
+
+@jax.jit
+def _or_delta(arr, slot, word_idx, masks):
+    """OR sparse word masks into one shard slot of a [S, W] leaf.
+    word_idx is host-deduplicated; padding repeats (0, mask 0), which
+    .at[].max resolves correctly against any real mask for word 0."""
+    delta = jnp.zeros((arr.shape[-1],), jnp.uint32).at[word_idx].max(masks)
+    return arr.at[slot].set(arr[slot] | delta)
+
+
+@jax.jit
+def _andnot_delta(arr, slot, word_idx, masks):
+    delta = jnp.zeros((arr.shape[-1],), jnp.uint32).at[word_idx].max(masks)
+    return arr.at[slot].set(arr[slot] & ~delta)
+
+
+@jax.jit
+def _or_delta_row(arr, slot, row, word_idx, masks):
+    """Same for one row of a [S, R, W] matrix leaf."""
+    delta = jnp.zeros((arr.shape[-1],), jnp.uint32).at[word_idx].max(masks)
+    return arr.at[slot, row].set(arr[slot, row] | delta)
+
+
+@jax.jit
+def _andnot_delta_row(arr, slot, row, word_idx, masks):
+    delta = jnp.zeros((arr.shape[-1],), jnp.uint32).at[word_idx].max(masks)
+    return arr.at[slot, row].set(arr[slot, row] & ~delta)
+
+
+def _word_masks(positions) -> tuple[np.ndarray, np.ndarray]:
+    """In-shard positions → (unique word indices, OR-combined masks),
+    padded to the next power of two so delta scatters compile O(log n)
+    distinct shapes."""
+    positions = np.asarray(positions, np.uint32)
+    words = (positions >> 5).astype(np.int32)
+    bits = np.uint32(1) << (positions & np.uint32(31))
+    uw = np.unique(words)
+    masks = np.zeros(uw.size, np.uint32)
+    idx = np.searchsorted(uw, words)
+    np.bitwise_or.at(masks, idx, bits)
+    n = next_pow2(max(uw.size, 1))
+    out_w = np.zeros(n, np.int32)
+    out_m = np.zeros(n, np.uint32)
+    out_w[: uw.size] = uw
+    out_m[: uw.size] = masks
+    return out_w, out_m
+
+
+def _make_probe(block: ShardBlock, match, row_pos_of, decode_row,
+                delta_on_clear: bool):
+    """Shared write-routing probe for every stacked-leaf kind.
+
+    match(ev) → is this event for our leaf's (view, row) surface?
+    row_pos_of(ev) → inner row axis position, or None for [S, W] leaves.
+    decode_row(ev) → fresh host words for the affected (shard, row), the
+    fallback when the exact delta can't be applied.
+    delta_on_clear → clears may delta-patch (single-view leaves only: with
+    multiple OR'd views a cleared bit may survive via another view).
+    """
+    slot_of = {s: i for i, s in enumerate(block.shards)}
+
+    def probe(ev):
+        slot = slot_of.get(ev.shard)
+        if slot is None or not match(ev):
+            return None
+        row_pos = row_pos_of(ev) if row_pos_of is not None else None
+        if ev.added or (ev.added is False and delta_on_clear):
+            if ev.positions is not None:
+                word_idx, masks = _word_masks(ev.positions)
+                if row_pos is None:
+                    fn = _or_delta if ev.added else _andnot_delta
+                    return lambda arr: fn(arr, slot, word_idx, masks)
+                fn = _or_delta_row if ev.added else _andnot_delta_row
+                return lambda arr: fn(arr, slot, row_pos, word_idx, masks)
+
+        def apply(arr):
+            new = jnp.asarray(decode_row(ev))
+            if row_pos is None:
+                return arr.at[slot].set(new)
+            return arr.at[slot, row_pos].set(new)
+
+        return apply
+
+    return probe
 
 
 def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
@@ -140,31 +233,58 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
     )
 
     cache = residency.global_row_cache()
-    gen = cache.write_generation
     if isinstance(spec, _RowSpec):
-        key = ("stack", gen, idx.name, spec.field, spec.views, spec.row,
+        key = ("stack", idx.name, spec.field, spec.views, spec.row,
                block.key())
 
         def decode():
             return block.stack(lambda shard: host_row(idx, spec, shard))
+
+        views = frozenset(spec.views)
+        probe = _make_probe(
+            block,
+            match=lambda ev: ev.row == spec.row and ev.view in views,
+            row_pos_of=None,
+            decode_row=lambda ev: host_row(idx, spec, ev.shard),
+            delta_on_clear=len(views) == 1,
+        )
     elif isinstance(spec, _PlanesSpec):
         field = idx.field(spec.field)
         depth = 2 + field.options.bit_depth
-        key = ("stackp", gen, idx.name, spec.field, depth, block.key())
+        bsi_view = field.bsi_view_name()
+        key = ("stackp", idx.name, spec.field, depth, block.key())
 
         def decode():
             return block.stack(
                 lambda shard: host_planes(idx, spec, shard, depth)
             )
+
+        def decode_row(ev):
+            view = idx.field(spec.field).view(bsi_view)
+            frag = view.fragment(ev.shard) if view else None
+            if frag is None:
+                return np.zeros(WORDS_PER_SHARD, np.uint32)
+            return frag.row_words(ev.row)
+
+        probe = _make_probe(
+            block,
+            match=lambda ev: ev.view == bsi_view and ev.row < depth,
+            row_pos_of=lambda ev: ev.row,
+            decode_row=decode_row,
+            delta_on_clear=True,
+        )
     elif isinstance(spec, _ZeroSpec):
         key = ("stackz", block.key())
 
         def decode():
             return np.zeros((block.padded, WORDS_PER_SHARD), np.uint32)
+
+        return cache.get_row(key, decode, device_put=device_put)
     else:
         raise PQLError(f"unknown leaf spec {type(spec).__name__}")
 
-    return cache.get_row(key, decode, device_put=device_put)
+    return cache.get_or_build(key, (idx.name, spec.field), probe, decode,
+                              device_put=device_put)
 
 
 def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
@@ -172,9 +292,8 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
     """Stacked row matrix ``uint32[padded, len(row_ids), words]`` of one
     view (TopN phase-2 candidates, GroupBy dimensions), HBM-cached."""
     cache = residency.global_row_cache()
-    gen = cache.write_generation
-    key = ("stackm", gen, idx.name, field_name,
-           view.name if view is not None else None, tuple(row_ids),
+    view_name = view.name if view is not None else None
+    key = ("stackm", idx.name, field_name, view_name, tuple(row_ids),
            block.key())
 
     def decode():
@@ -186,7 +305,23 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
 
         return block.stack(per_shard)
 
-    return cache.get_row(key, decode, device_put=device_put)
+    row_pos_of = {r: i for i, r in enumerate(row_ids)}
+
+    def decode_row(ev):
+        frag = view.fragment(ev.shard) if view else None
+        if frag is None:
+            return np.zeros(WORDS_PER_SHARD, np.uint32)
+        return frag.row_words(ev.row)
+
+    probe = _make_probe(
+        block,
+        match=lambda ev: ev.view == view_name and ev.row in row_pos_of,
+        row_pos_of=lambda ev: row_pos_of[ev.row],
+        decode_row=decode_row,
+        delta_on_clear=True,
+    )
+    return cache.get_or_build(key, (idx.name, field_name), probe, decode,
+                              device_put=device_put)
 
 
 # ------------------------------------------------------ local program builder

@@ -144,6 +144,12 @@ class Field:
             v.close()
         if self.row_attrs is not None:
             self.row_attrs.close()
+        # drop derived device entries (stacked query leaves) tied to this
+        # field: files may change while closed, or the field may be
+        # deleted and recreated under the same name
+        from pilosa_tpu.storage import residency
+
+        residency.global_row_cache().invalidate_tag((self.index, self.name))
 
     def _save_meta(self) -> None:
         with open(os.path.join(self.path, ".meta"), "w") as f:

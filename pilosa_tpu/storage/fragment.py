@@ -155,7 +155,7 @@ class Fragment:
             changed = self.bitmap.add_ids([(row << 20) + pos]) > 0
             if changed:
                 self._log_op(OP_ADD, [(row << 20) + pos])
-                self._after_row_write(row)
+                self._after_row_write(row, positions=[pos], added=True)
             return changed
 
     def clear_bit(self, row: int, pos: int) -> bool:
@@ -164,7 +164,7 @@ class Fragment:
             changed = self.bitmap.remove_ids([(row << 20) + pos]) > 0
             if changed:
                 self._log_op(OP_REMOVE, [(row << 20) + pos])
-                self._after_row_write(row)
+                self._after_row_write(row, positions=[pos], added=False)
             return changed
 
     def clear_row(self, row: int) -> int:
@@ -176,7 +176,7 @@ class Fragment:
             ids = cols + np.uint64(row << 20)
             removed = self.bitmap.remove_ids(ids)
             self._log_op(OP_REMOVE, ids)
-            self._after_row_write(row)
+            self._after_row_write(row, positions=cols, added=False)
             return removed
 
     def write_row_words(self, row: int, words: np.ndarray) -> None:
@@ -210,7 +210,9 @@ class Fragment:
             if changed:
                 self._log_op(OP_ADD, ids)
                 for row in np.unique(rows).tolist():
-                    self._after_row_write(int(row))
+                    self._after_row_write(
+                        int(row), positions=positions[rows == row], added=True
+                    )
             return changed
 
     def import_roaring(self, data: bytes) -> int:
@@ -232,8 +234,12 @@ class Fragment:
             changed = self.bitmap.add_ids(ids)
             if changed:
                 self._log_op(OP_ADD, ids)
-                for row in sorted({int(i) >> 20 for i in ids.tolist()}):
-                    self._after_row_write(row)
+                rows = ids >> np.uint64(20)
+                positions = ids & np.uint64(SHARD_WIDTH - 1)
+                for row in np.unique(rows).tolist():
+                    self._after_row_write(
+                        int(row), positions=positions[rows == row], added=True
+                    )
             return changed
 
     # ------------------------------------------------------------ durability
@@ -266,11 +272,18 @@ class Fragment:
         if self._open:
             self._file = open(self.path, "ab")
 
-    def _after_row_write(self, row: int) -> None:
+    def _after_row_write(self, row: int, positions=None, added=None) -> None:
+        """Invalidate this fragment's own device entries and route the
+        write to dependent stacked leaves for in-place patching (instead
+        of the old global generation purge — one Set() must not evict
+        unrelated resident leaves)."""
         cache = residency.global_row_cache()
         cache.invalidate(self.frag_id + (row,))
         cache.invalidate_fragment(self.frag_id + ("__planes__",))
-        cache.bump_generation()
+        cache.apply_write(residency.WriteEvent(
+            self.index, self.field, self.view, self.shard, row,
+            positions=positions, added=added,
+        ))
         self.row_cache.add(row, self.count_row(row))
         from pilosa_tpu.utils.stats import global_stats
 

@@ -20,10 +20,20 @@ is the slowest path in the system.
 
 Writes invalidate the affected row in both tiers; queries call ``get_row``
 and receive a device array ready for the bitwise kernels.
+
+Derived entries (the batched executor's stacked query leaves,
+executor/batch.py) register an *updater* instead: a write to one fragment
+row becomes an in-place device scatter of the affected shard slot
+(SURVEY.md §7.3 hard part #3 — no host round trip for pure bit-adds, one
+128 KiB row re-upload otherwise), so a Set() no longer evicts unrelated
+resident leaves. Compressed-tier copies of an affected leaf are
+invalidated rather than patched (decompress+patch costs more than the
+re-decode they were demoted to avoid).
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from functools import partial
 from typing import Callable
@@ -60,6 +70,28 @@ def _scatter_blocks(blocks, idx, n_blocks: int, block_words: int):
     repeats a real index with its real data — identical writes are safe)."""
     out = jnp.zeros((n_blocks, block_words), jnp.uint32)
     return out.at[idx].set(blocks).reshape(-1)
+
+
+class WriteEvent:
+    """One fragment-row mutation, as seen by dependent cache entries.
+
+    positions: in-shard bit positions touched, or None when unknown (bulk
+    row replace). added: True = bits only set, False = bits only cleared,
+    None = mixed/unknown.
+    """
+
+    __slots__ = ("index", "field", "view", "shard", "row", "positions",
+                 "added")
+
+    def __init__(self, index, field, view, shard, row, positions=None,
+                 added=None):
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.row = row
+        self.positions = positions
+        self.added = added
 
 
 class _DenseEntry:
@@ -102,9 +134,22 @@ class DeviceRowCache:
         self.evictions = 0
         self.compressions = 0
         self.decompressions = 0
-        # bumped on every fragment write; coarse invalidation signal for
-        # derived entries (mesh-stacked arrays) whose keys embed it
-        self.write_generation = 0
+        self.updates = 0  # in-place scatter updates of derived entries
+        self.write_events = 0  # fragment mutations routed through apply_write
+        # derived-entry dependency registry: a stacked leaf registers an
+        # updater under a (index, field) tag; apply_write routes each
+        # fragment mutation to exactly the tagged entries
+        self._updaters: dict[tuple, tuple[tuple, Callable]] = {}
+        self._tag_index: dict[tuple, set[tuple]] = {}
+        # writes-per-tag counter: get_or_build re-checks it around its
+        # unlocked host decode so a racing write can't leave a stale leaf
+        self._tag_versions: dict[tuple, int] = {}
+        # One lock for all bookkeeping. Writers patch entries under it
+        # (apply_write), so two concurrent writes to different fragments
+        # of one field can't lose each other's read-modify-write of the
+        # same leaf. Host decodes happen OUTSIDE the lock (see
+        # get_or_build) so query misses don't serialize behind it.
+        self._lock = threading.RLock()
 
     def __len__(self) -> int:
         return len(self._rows) + len(self._compressed)
@@ -117,11 +162,9 @@ class DeviceRowCache:
     def compressed_bytes(self) -> int:
         return self._compressed_bytes
 
-    def get_row(self, key: tuple, decode: Callable[[], np.ndarray],
-                device_put: Callable | None = None) -> jax.Array:
-        """Return the device array for ``key``, decoding+uploading on miss.
-        ``device_put`` overrides placement (e.g. a NamedSharding put);
-        entries with custom placement are never compressed."""
+    def _lookup_locked(self, key: tuple):
+        """Dense hit or compressed→dense promotion; None on miss.
+        Caller holds the lock."""
         entry = self._rows.get(key)
         if entry is not None:
             self.hits += 1
@@ -139,8 +182,9 @@ class DeviceRowCache:
             arr = flat.reshape(centry.shape)
             self._insert_dense(key, arr, centry.block_idx)
             return arr
-        self.misses += 1
-        host = decode()
+        return None
+
+    def _put_locked(self, key, host, device_put):
         if device_put is not None:
             arr = device_put(host)
             block_idx = None  # custom placement (mesh sharding): keep dense
@@ -149,6 +193,66 @@ class DeviceRowCache:
             block_idx = self._host_block_index(host)
         self._insert_dense(key, arr, block_idx)
         return arr
+
+    def get_row(self, key: tuple, decode: Callable[[], np.ndarray],
+                device_put: Callable | None = None) -> jax.Array:
+        """Return the device array for ``key``, decoding+uploading on miss.
+        ``device_put`` overrides placement (e.g. a NamedSharding put);
+        entries with custom placement are never compressed."""
+        with self._lock:
+            arr = self._lookup_locked(key)
+            if arr is not None:
+                return arr
+            self.misses += 1
+            # decode under the lock: plain get_row keys are per-fragment
+            # (invalidated by their writers), so staleness isn't possible,
+            # and single-row decodes are cheap
+            return self._put_locked(key, decode(), device_put)
+
+    def get_or_build(self, key: tuple, tag: tuple | None,
+                     probe: Callable | None,
+                     decode: Callable[[], np.ndarray],
+                     device_put: Callable | None = None) -> jax.Array:
+        """get_row for derived (write-patched) entries: registers ``probe``
+        under ``tag`` atomically with residency, and re-checks the tag's
+        write version around the unlocked host decode so a write landing
+        mid-decode can't leave a silently stale leaf (the decode snapshot
+        might miss it, and the event fired before registration)."""
+        for _ in range(4):
+            with self._lock:
+                arr = self._lookup_locked(key)
+                if arr is not None:
+                    if tag is not None:
+                        self._register_locked(key, tag, probe)
+                    return arr
+                v0 = self._tag_versions.get(tag, 0)
+            host = decode()  # slow host work, outside the lock
+            with self._lock:
+                if self._tag_versions.get(tag, 0) != v0:
+                    continue  # a write raced the snapshot; rebuild
+                arr = self._lookup_locked(key)
+                if arr is not None:  # another thread built it meanwhile
+                    if tag is not None:
+                        self._register_locked(key, tag, probe)
+                    return arr
+                self.misses += 1
+                arr = self._put_locked(key, host, device_put)
+                if tag is not None:
+                    self._register_locked(key, tag, probe)
+                return arr
+        # Sustained write pressure: decode while holding the lock. Racing
+        # writers then block in apply_write until the entry is registered,
+        # and their patches land afterwards — delta patches are idempotent
+        # re-applications and re-uploads re-read the bitmap, so the result
+        # is correct whichever side of the snapshot the write fell on.
+        with self._lock:
+            arr = self._lookup_locked(key)
+            if arr is None:
+                self.misses += 1
+                arr = self._put_locked(key, decode(), device_put)
+            if tag is not None:
+                self._register_locked(key, tag, probe)
+            return arr
 
     @staticmethod
     def _host_block_index(host: np.ndarray):
@@ -170,41 +274,96 @@ class DeviceRowCache:
         self._evict()
 
     def invalidate(self, key: tuple) -> None:
-        entry = self._rows.pop(key, None)
-        if entry is not None:
-            self._bytes -= entry.arr.nbytes
-        centry = self._compressed.pop(key, None)
-        if centry is not None:
-            self._compressed_bytes -= centry.nbytes
+        with self._lock:
+            entry = self._rows.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry.arr.nbytes
+            centry = self._compressed.pop(key, None)
+            if centry is not None:
+                self._compressed_bytes -= centry.nbytes
+            self._drop_updater(key)
 
     def invalidate_fragment(self, frag_id: tuple) -> None:
-        for store in (self._rows, self._compressed):
-            doomed = [k for k in store if k[: len(frag_id)] == frag_id]
-            for k in doomed:
-                self.invalidate(k)
+        with self._lock:
+            for store in (self._rows, self._compressed):
+                doomed = [k for k in store if k[: len(frag_id)] == frag_id]
+                for k in doomed:
+                    self.invalidate(k)
 
-    def bump_generation(self) -> None:
-        """Invalidate generation-keyed derived entries. Keys of the form
-        ('stack*', gen, ...) can never be hit again after the bump, so
-        purge them now rather than letting them occupy either tier (or
-        waste a demotion gather on eviction)."""
-        self.write_generation += 1
+    # --------------------------------------------------- derived-entry updates
 
-        def stale(key: tuple) -> bool:
-            # ('stackz', block_key) carries no generation and stays valid
-            return (isinstance(key[0], str) and key[0].startswith("stack")
-                    and len(key) > 1 and isinstance(key[1], int)
-                    and key[1] != self.write_generation)
+    def register_updater(self, key: tuple, tag: tuple,
+                         probe: Callable) -> None:
+        """Attach a write-routing probe to a resident derived entry.
 
-        for store in (self._rows, self._compressed):
-            for k in [k for k in store if stale(k)]:
-                self.invalidate(k)
+        ``probe(event)`` returns None when the entry is unaffected by the
+        write, else a function ``apply(arr) -> arr`` that patches the
+        device array in place (scatter of the affected shard slot).
+        Idempotent per key; dropped when the entry leaves both tiers.
+        """
+        with self._lock:
+            self._register_locked(key, tag, probe)
+
+    def _register_locked(self, key: tuple, tag: tuple, probe) -> None:
+        if key in self._rows or key in self._compressed:
+            old = self._updaters.get(key)
+            if old is not None and old[0] != tag:
+                self._tag_index[old[0]].discard(key)
+            self._updaters[key] = (tag, probe)
+            self._tag_index.setdefault(tag, set()).add(key)
+
+    def invalidate_tag(self, tag: tuple) -> None:
+        """Drop every derived entry registered under a (index, field) tag
+        (field close/delete: the durable files are no longer ours)."""
+        with self._lock:
+            for key in list(self._tag_index.get(tag, ())):
+                self.invalidate(key)
+
+    def _drop_updater(self, key: tuple) -> None:
+        reg = self._updaters.pop(key, None)
+        if reg is not None:
+            keys = self._tag_index.get(reg[0])
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._tag_index[reg[0]]
+
+    def apply_write(self, event: WriteEvent) -> None:
+        """Route one fragment mutation to the derived entries that depend
+        on it: dense entries are patched on device, compressed copies are
+        invalidated, everything else is untouched (this replaces the old
+        global write-generation purge, which evicted EVERY stacked leaf on
+        any write). Runs fully under the lock so concurrent writers can't
+        lose each other's read-modify-write of a shared leaf."""
+        tag = (event.index, event.field)
+        with self._lock:
+            self.write_events += 1
+            self._tag_versions[tag] = self._tag_versions.get(tag, 0) + 1
+            for key in list(self._tag_index.get(tag, ())):
+                reg = self._updaters.get(key)
+                if reg is None:
+                    continue
+                apply = reg[1](event)
+                if apply is None:
+                    continue  # unaffected (different row/view/shard)
+                entry = self._rows.get(key)
+                if entry is not None:
+                    entry.arr = apply(entry.arr)
+                    # occupancy may have changed; don't demote later
+                    entry.block_idx = None
+                    self.updates += 1
+                else:
+                    self.invalidate(key)
 
     def clear(self) -> None:
-        self._rows.clear()
-        self._compressed.clear()
-        self._bytes = 0
-        self._compressed_bytes = 0
+        with self._lock:
+            self._rows.clear()
+            self._compressed.clear()
+            self._updaters.clear()
+            self._tag_index.clear()
+            self._tag_versions.clear()
+            self._bytes = 0
+            self._compressed_bytes = 0
 
     def _evict(self) -> None:
         # Demotion only under real pressure: the dense tier may use the
@@ -216,13 +375,15 @@ class DeviceRowCache:
             key, entry = self._rows.popitem(last=False)
             self._bytes -= entry.arr.nbytes
             if entry.block_idx is not None:
-                self._demote(key, entry)
+                self._demote(key, entry)  # key stays resident (compressed)
             else:
                 self.evictions += 1
+                self._drop_updater(key)
         while self.bytes_used > self.budget_bytes and self._compressed:
-            _, centry = self._compressed.popitem(last=False)
+            key, centry = self._compressed.popitem(last=False)
             self._compressed_bytes -= centry.nbytes
             self.evictions += 1
+            self._drop_updater(key)
 
     def _demote(self, key: tuple, entry: _DenseEntry) -> None:
         """Dense → compressed: gather nonzero blocks on device."""

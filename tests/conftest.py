@@ -27,3 +27,16 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_global_row_cache():
+    """Isolate the process-global device residency cache per test: leaves
+    are keyed by (index, field, ...) names, which recur across tests that
+    forget to close their holder."""
+    from pilosa_tpu.storage import residency
+
+    residency.global_row_cache().clear()
+    yield

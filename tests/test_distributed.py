@@ -187,3 +187,29 @@ class TestDistGroupBy:
             'GroupBy(Rows(f), Rows(g), aggregate=Sum(field="fare"))',
         )
         assert self.groups_json(r1) == self.groups_json(r2)
+
+
+class TestDistWritePatching:
+    def test_write_patches_sharded_leaf_in_place(self, env):
+        """A Set() between two mesh queries scatter-patches the
+        NamedSharding-resident stacked leaf — no re-decode, no eviction
+        (SURVEY.md §7.3 hard part #3 on the SPMD path)."""
+        from pilosa_tpu.storage import residency
+
+        holder, base, dist = env
+        (c1,) = dist.execute("big", "Count(Row(f=1))")
+        cache = residency.global_row_cache()
+        misses = cache.misses
+        new_col = 2 * SHARD_WIDTH + 3  # not in the rng pattern? ensure:
+        idx = holder.index("big")
+        frag = idx.field("f").view("standard").fragment(2)
+        delta = 0 if frag.contains(1, 3) else 1
+        dist.execute("big", f"Set({new_col}, f=1)")
+        (c2,) = dist.execute("big", "Count(Row(f=1))")
+        assert c2 == c1 + delta
+        assert cache.misses == misses  # patched in place, not re-decoded
+        assert cache.updates >= 1
+        (r_base,) = base.execute("big", "Row(f=1)")
+        (r_dist,) = dist.execute("big", "Row(f=1)")
+        assert r_base.columns().tolist() == r_dist.columns().tolist()
+        assert new_col in set(r_dist.columns().tolist())

@@ -160,16 +160,77 @@ def test_working_set_within_budget_stays_dense():
     assert all(d.calls == 1 for d in decs)
 
 
-def test_bump_generation_purges_stale_stack_entries():
+def test_apply_write_patches_dense_and_spares_unrelated():
+    """A write routes to exactly the tagged+affected entries: the affected
+    dense entry is patched in place (no eviction, no re-decode); entries
+    under other tags or probed-unaffected stay untouched."""
+    from pilosa_tpu.storage.residency import WriteEvent
+
     rng = np.random.default_rng(13)
-    cache = DeviceRowCache(budget_bytes=1 << 20)
-    gen = cache.write_generation
-    cache.get_row(("stack", gen, "i", "f", ("standard",), 1, ((0,), 1, 1)),
-                  CountingDecoder(sparse_row(rng, 2)))
-    cache.get_row(("stackz", ((0,), 1, 1)),
-                  CountingDecoder(np.zeros(WORDS_PER_SHARD, np.uint32)))
-    assert len(cache) == 2
-    cache.bump_generation()
-    # gen-keyed entry gone; gen-less zeros entry survives
-    assert len(cache) == 1
-    assert ("stackz", ((0,), 1, 1)) in cache._rows
+    cache = DeviceRowCache(budget_bytes=4 << 20)
+    affected = CountingDecoder(sparse_row(rng, 2))
+    unrelated = CountingDecoder(sparse_row(rng, 2))
+    cache.get_row(("stack", "i", "f", 1), affected)
+    cache.get_row(("stack", "i", "g", 1), unrelated)
+
+    import jax.numpy as jnp
+
+    probed = []
+
+    def probe(ev):
+        probed.append(ev.row)
+        if ev.row != 1:
+            return None
+        return lambda arr: arr | jnp.uint32(1)
+
+    cache.register_updater(("stack", "i", "f", 1), ("i", "f"), probe)
+    cache.apply_write(WriteEvent("i", "f", "standard", 0, 1))
+    assert probed == [1] and cache.updates == 1
+    assert len(cache) == 2 and cache.misses == 2  # nothing evicted
+    got = np.asarray(cache.get_row(("stack", "i", "f", 1), affected))
+    np.testing.assert_array_equal(got, affected.host | np.uint32(1))
+    assert affected.calls == 1  # patched, never re-decoded
+    # unaffected row: probe returns None, entry untouched
+    cache.apply_write(WriteEvent("i", "f", "standard", 0, 7))
+    assert cache.updates == 1
+    # other tag never probed
+    cache.apply_write(WriteEvent("i", "g", "standard", 0, 1))
+    assert probed == [1, 7]
+
+
+def test_apply_write_invalidates_compressed_copies():
+    """An affected entry demoted to the compressed tier is invalidated
+    (not patched); unaffected compressed entries survive the write."""
+    from pilosa_tpu.storage.residency import WriteEvent
+
+    rng = np.random.default_rng(14)
+    cache = DeviceRowCache(budget_bytes=200 << 10)  # one dense row fits
+    a = CountingDecoder(sparse_row(rng, 2))
+    b = CountingDecoder(sparse_row(rng, 2))
+    cache.get_row(("stack", "i", "f", 1), a)
+
+    def probe_hit(ev):
+        return (lambda arr: arr) if ev.row == 1 else None
+
+    cache.register_updater(("stack", "i", "f", 1), ("i", "f"), probe_hit)
+    cache.get_row(("stack", "i", "f", 2), b)  # demotes a to compressed
+    assert cache.compressions == 1
+    cache.apply_write(WriteEvent("i", "f", "standard", 0, 1))
+    assert ("stack", "i", "f", 1) not in cache._compressed  # invalidated
+    assert ("stack", "i", "f", 2) in cache._rows  # dense+unaffected: kept
+
+
+def test_updaters_dropped_with_entries():
+    from pilosa_tpu.storage.residency import WriteEvent
+
+    rng = np.random.default_rng(15)
+    cache = DeviceRowCache(budget_bytes=4 << 20)
+    cache.get_row(("k",), CountingDecoder(sparse_row(rng, 2)))
+    cache.register_updater(("k",), ("i", "f"), lambda ev: None)
+    assert ("i", "f") in cache._tag_index
+    cache.invalidate(("k",))
+    assert not cache._tag_index and not cache._updaters
+    # registering for a non-resident key is a no-op
+    cache.register_updater(("gone",), ("i", "f"), lambda ev: None)
+    assert not cache._updaters
+    cache.apply_write(WriteEvent("i", "f", "standard", 0, 1))  # no crash

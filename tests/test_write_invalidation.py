@@ -1,0 +1,179 @@
+"""Write-path invalidation: one Set() patches exactly the affected shard
+slot of resident stacked leaves on device instead of purging every leaf
+(SURVEY.md §7.3 hard part #3; replaces the round-1 global generation
+purge, which made any mixed workload re-upload its working set)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.storage import residency
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    yield holder, Executor(holder)
+    holder.close()
+
+
+def fill(field, rows, per_row=50, shards=4, stride=17):
+    for r in rows:
+        for s in range(shards):
+            positions = [(i * stride) % SHARD_WIDTH for i in range(per_row)]
+            frag = field.view("standard", create=True).fragment(s, create=True)
+            frag.bulk_import([r] * len(positions), positions)
+
+
+def cache():
+    return residency.global_row_cache()
+
+
+class TestSetDoesNotEvictUnrelatedLeaves:
+    def test_single_set_patches_in_place(self, env):
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        g = idx.create_field("g")
+        fill(f, rows=[1, 2])
+        fill(g, rows=[1])
+
+        q = "Count(Intersect(Row(f=1), Row(f=2))) Count(Row(g=1))"
+        base = ex.execute("i", q)
+        resident_before = len(cache())
+        misses_before = cache().misses
+
+        # one Set into f row 1 shard 2
+        pos = 3  # not in the stride pattern
+        (changed,) = ex.execute("i", f"Set({2 * SHARD_WIDTH + pos}, f=1)")
+        assert changed is True
+
+        out = ex.execute("i", q)
+        assert out[0] == base[0] + 0  # intersect unchanged (row 2 lacks pos)
+        assert out[1] == base[1]
+        # leaves were patched, not purged: same residency, zero new decodes
+        assert cache().misses == misses_before
+        assert len(cache()) == resident_before
+        assert cache().updates >= 1
+
+        # and the patched leaf is CORRECT: row 1 now includes the new bit
+        (row1,) = ex.execute("i", "Row(f=1)")
+        assert 2 * SHARD_WIDTH + pos in set(row1.columns().tolist())
+        assert cache().misses == misses_before  # still no re-decode
+
+    def test_clear_bit_patches_single_view_leaf(self, env):
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        fill(f, rows=[1])
+        (base,) = ex.execute("i", "Count(Row(f=1))")
+        misses = cache().misses
+        ex.execute("i", "Clear(0, f=1)")  # position 0 is in the pattern
+        (after,) = ex.execute("i", "Count(Row(f=1))")
+        assert after == base - 1
+        assert cache().misses == misses  # delta-patched, not re-decoded
+
+    def test_bulk_import_patches(self, env):
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        fill(f, rows=[1], shards=2)
+        (base,) = ex.execute("i", "Count(Row(f=1))")
+        misses = cache().misses
+        frag = f.view("standard").fragment(0)
+        new_positions = [5, 7, 11]  # stride pattern avoids small odd primes
+        before = {int(c) for c in frag.row_columns(1).tolist()}
+        frag.bulk_import([1] * 3, new_positions)
+        added = len(set(new_positions) - before)
+        (after,) = ex.execute("i", "Count(Row(f=1))")
+        assert after == base + added
+        assert cache().misses == misses
+
+    def test_bsi_write_patches_plane_leaf(self, env):
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("amount", FieldOptions(type="int", min=0, max=1000))
+        for col, val in ((0, 10), (1, 20), (SHARD_WIDTH + 2, 30)):
+            f.set_value(col, val)
+        (s,) = ex.execute("i", "Sum(field=amount)")
+        assert s.value == 60
+        misses = cache().misses
+        f.set_value(2, 40)
+        (s2,) = ex.execute("i", "Sum(field=amount)")
+        assert s2.value == 100
+        assert cache().misses == misses  # plane leaf patched in place
+
+    def test_write_only_invalidates_affected_compressed_leaf(self, env):
+        """Presence check at the storage level: a write to field f never
+        touches resident leaves of field g (different tag)."""
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        g = idx.create_field("g")
+        fill(f, rows=[1], shards=1)
+        fill(g, rows=[1], shards=1)
+        ex.execute("i", "Count(Row(f=1)) Count(Row(g=1))")
+        g_keys = [k for k in cache()._rows if len(k) > 2 and k[2] == "g"]
+        assert g_keys
+        g_arrs = [cache()._rows[k].arr for k in g_keys]
+        ex.execute("i", "Set(9, f=1)")
+        for k, arr in zip(g_keys, g_arrs):
+            assert cache()._rows[k].arr is arr  # same device buffer
+
+
+class TestDeleteRecreateSafety:
+    def test_field_recreate_does_not_serve_stale_leaves(self, env):
+        """Generation-free keys must not leak data across a field
+        delete+recreate under the same name."""
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        f.set_bit(1, 10)
+        (c1,) = ex.execute("i", "Count(Row(f=1))")
+        assert c1 == 1
+        idx.delete_field("f")
+        f2 = idx.create_field("f")
+        f2.set_bit(1, 20)
+        (c2,) = ex.execute("i", "Count(Row(f=1))")
+        assert c2 == 1
+        (row,) = ex.execute("i", "Row(f=1)")
+        assert row.columns().tolist() == [20]
+
+
+class TestConcurrentWritePatching:
+    def test_parallel_writers_do_not_lose_patches(self, env):
+        """Two writers on different fragments of one field hold different
+        fragment locks; the residency lock must serialize their
+        read-modify-write of the shared stacked leaf (a lost patch here
+        serves a missing bit forever)."""
+        import threading
+
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        for s in range(2):
+            f.view("standard", create=True).fragment(s, create=True)
+        f.set_bit(1, 0)
+        ex.execute("i", "Count(Row(f=1))")  # leaf resident
+
+        N = 200
+        barrier = threading.Barrier(2)
+
+        def writer(shard):
+            barrier.wait()
+            frag = f.view("standard").fragment(shard)
+            for i in range(1, N + 1):
+                frag.set_bit(1, i)
+
+        threads = [threading.Thread(target=writer, args=(s,)) for s in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        (count,) = ex.execute("i", "Count(Row(f=1))")
+        assert count == 2 * N + 1
+        (row,) = ex.execute("i", "Row(f=1)")
+        want = {0} | set(range(1, N + 1)) | {SHARD_WIDTH + i for i in range(1, N + 1)}
+        assert set(row.columns().tolist()) == want
