@@ -717,10 +717,12 @@ class Executor:
             if frag is not None:
                 rows.update(r for r in frag.row_ids() if frag.contains(r, pos))
         else:
+            # one O(#containers) metadata pass per fragment — exact
+            # non-empty rows with no per-row count loop (fragment.row_counts)
             for shard in self._shards(idx, shards):
                 frag = view.fragment(shard)
                 if frag is not None:
-                    rows.update(r for r in frag.row_ids() if frag.count_row(r) > 0)
+                    rows.update(frag.row_counts()[0].tolist())
         out = sorted(rows)
         if previous is not None:
             out = [r for r in out if r > int(previous)]

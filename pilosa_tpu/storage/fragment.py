@@ -119,6 +119,34 @@ class Fragment:
         rows; exact after compaction since empty containers are dropped)."""
         return sorted({k >> 4 for k in self.bitmap.keys})
 
+    def row_counts(self) -> tuple[np.ndarray, np.ndarray]:
+        """Exact (row_ids, counts) for every non-empty row, in one pass
+        over container metadata: a row spans 16 containers (key >> 4), and
+        each container already knows its cardinality, so counting all rows
+        is O(#containers) with no per-row scan and no bit materialization.
+
+        This is the cold-path feed for TopN phase 1 and Rows()/GroupBy
+        dimension discovery (reference fragment.top / executor Rows —
+        SURVEY.md §3.4). The reference walks the ranked cache instead; at
+        design scale (50k rows × 1k shards) a per-row count loop is
+        millions of host calls, and a device pass would upload dense
+        zeros — container metadata is strictly cheaper than either.
+        """
+        keys, cards = [], []
+        for key in self.bitmap.keys:
+            c = self.bitmap.container(key)  # .get: lock-free vs removes
+            if c is not None and c.n:
+                keys.append(key)
+                cards.append(c.n)
+        if not keys:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        rows = np.asarray(keys, np.int64) >> 4
+        cards = np.asarray(cards, np.int64)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        counts = np.zeros(uniq.size, np.int64)
+        np.add.at(counts, inv, cards)
+        return uniq, counts
+
     def row_words(self, row: int) -> np.ndarray:
         """Dense uint32[32768] for one row (host side)."""
         base = row << 20
@@ -334,13 +362,15 @@ class Fragment:
     def top(self, n: int = 10, row_ids=None):
         """Local TopN candidates: (row, count) pairs from the ranked cache,
         counts exact (recomputed) — phase 1 of the reference's two-phase
-        TopN (SURVEY.md §3.4)."""
+        TopN (SURVEY.md §3.4). Cold/none cache falls back to the exact
+        O(#containers) metadata scan, not a per-row loop."""
         if row_ids is not None:
-            pairs = [(r, self.count_row(r)) for r in row_ids]
+            pairs = [(r, self.count_row(r)) for r in row_ids]  # O(candidates)
         else:
             pairs = self.row_cache.top()
-            if not pairs:  # cold/none cache: fall back to exact scan
-                pairs = [(r, self.count_row(r)) for r in self.row_ids()]
+            if not pairs:
+                rows, counts = self.row_counts()
+                pairs = list(zip(rows.tolist(), counts.tolist()))
         pairs = [(r, c) for r, c in pairs if c > 0]
         pairs.sort(key=lambda rc: (-rc[1], rc[0]))
         return pairs[:n] if n else pairs
