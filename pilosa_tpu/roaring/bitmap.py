@@ -11,6 +11,8 @@ on device (pilosa_tpu.ops.bitops).
 
 from __future__ import annotations
 
+import bisect
+
 import numpy as np
 
 ARRAY = 1
@@ -170,12 +172,21 @@ class RoaringBitmap:
         if stop <= start:
             return 0
         lo_key, hi_key = start >> 16, (stop - 1) >> 16
+        # bisect the sorted key list: count_range is called per written
+        # row (ranked-cache refresh), so an O(#containers) scan here turns
+        # bulk imports quadratic
+        keys = self.keys
+        lo_i = bisect.bisect_left(keys, lo_key)
+        hi_i = bisect.bisect_right(keys, hi_key)
         total = 0
-        for key in self.keys:
-            if key < lo_key or key > hi_key:
+        for key in keys[lo_i:hi_i]:
+            c = self._containers.get(key)
+            if c is None:  # lock-free reader racing a remove
                 continue
-            c = self._containers[key]
-            if lo_key < key < hi_key:
+            # fully-covered containers (incl. aligned boundaries — the
+            # count_row case) contribute their cardinality without being
+            # materialized; only genuinely partial ones unpack
+            if key << 16 >= start and (key + 1) << 16 <= stop:
                 total += c.n
             else:
                 lows = c.lows().astype(np.int64) + (key << 16)

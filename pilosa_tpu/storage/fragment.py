@@ -237,10 +237,7 @@ class Fragment:
             changed = self.bitmap.add_ids(ids)
             if changed:
                 self._log_op(OP_ADD, ids)
-                for row in np.unique(rows).tolist():
-                    self._after_row_write(
-                        int(row), positions=positions[rows == row], added=True
-                    )
+                self._after_rows_added(rows, positions)
             return changed
 
     def import_roaring(self, data: bytes) -> int:
@@ -262,12 +259,9 @@ class Fragment:
             changed = self.bitmap.add_ids(ids)
             if changed:
                 self._log_op(OP_ADD, ids)
-                rows = ids >> np.uint64(20)
-                positions = ids & np.uint64(SHARD_WIDTH - 1)
-                for row in np.unique(rows).tolist():
-                    self._after_row_write(
-                        int(row), positions=positions[rows == row], added=True
-                    )
+                self._after_rows_added(
+                    ids >> np.uint64(20), ids & np.uint64(SHARD_WIDTH - 1)
+                )
             return changed
 
     # ------------------------------------------------------------ durability
@@ -299,6 +293,21 @@ class Fragment:
         self.op_n = 0
         if self._open:
             self._file = open(self.path, "ab")
+
+    def _after_rows_added(self, rows: np.ndarray, positions: np.ndarray) -> None:
+        """Per-row write bookkeeping for bulk adds: group positions by row
+        with one sort instead of a per-row mask scan (which is O(n·rows)
+        and turns large imports quadratic)."""
+        order = np.argsort(rows, kind="stable")
+        sorted_rows = rows[order]
+        sorted_pos = positions[order]
+        uniq, starts = np.unique(sorted_rows, return_index=True)
+        bounds = np.append(starts, sorted_rows.size)
+        for i, row in enumerate(uniq.tolist()):
+            self._after_row_write(
+                int(row), positions=sorted_pos[bounds[i]:bounds[i + 1]],
+                added=True,
+            )
 
     def _after_row_write(self, row: int, positions=None, added=None) -> None:
         """Invalidate this fragment's own device entries and route the
