@@ -99,14 +99,20 @@ class InternalClient:
         )
         return [(b["block"], b["checksum"]) for b in out.get("blocks", [])]
 
-    def fragment_block_ids(self, uri: str, index: str, field: str, view: str,
-                           shard: int, block: int) -> list[int]:
-        out = self._call(
+    def fragment_block_bitmap(self, uri: str, index: str, field: str,
+                              view: str, shard: int, block: int):
+        """One checksum block's bits as a parsed RoaringBitmap (binary
+        data plane: ~O(bitmap bytes) on the wire, not JSON int lists)."""
+        from pilosa_tpu.roaring.format import load
+
+        raw = self._call(
             "GET",
             f"{uri}/internal/fragment/block/data?index={index}&field={field}"
             f"&view={view}&shard={shard}&block={block}",
+            raw=True,
         )
-        return out.get("ids", [])
+        bitmap, _ = load(raw)
+        return bitmap
 
     def fragment_data(self, uri: str, index: str, field: str, view: str,
                       shard: int) -> bytes:

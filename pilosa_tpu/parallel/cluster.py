@@ -243,37 +243,47 @@ class Cluster:
                 )
         self.resize_fetch()
 
+    def _peer_fragment_entries(self, index_name: str):
+        """(field, view, shard, source node) for every fragment any peer
+        holds of one index — shared by resize fetches and the anti-entropy
+        inventory walk."""
+        out = []
+        for node in self.sorted_nodes():
+            if node.id == self.local.id:
+                continue
+            try:
+                catalog = self.client.fragment_catalog(node.uri, index_name)
+            except ClientError:
+                continue
+            for entry in catalog:
+                out.append((entry["field"], entry["view"], entry["shard"],
+                            node))
+        return out
+
     def resize_fetch(self) -> None:
         """Fetch fragment data for every shard this node now owns but does
         not yet have (the receiving half of a ResizeInstruction)."""
         self.state = STATE_RESIZING
         try:
             for index_name, idx in list(self.holder.indexes.items()):
-                for node in self.sorted_nodes():
-                    if node.id == self.local.id:
+                for fname, vname, shard, node in self._peer_fragment_entries(
+                    index_name
+                ):
+                    if not self.owns_shard(index_name, shard):
                         continue
+                    field = idx.field(fname)
+                    if field is None:
+                        continue
+                    view = field.view(vname, create=True)
+                    frag = view.fragment(shard, create=True)
                     try:
-                        catalog = self.client.fragment_catalog(node.uri, index_name)
+                        data = self.client.fragment_data(
+                            node.uri, index_name, fname, vname, shard,
+                        )
                     except ClientError:
                         continue
-                    for entry in catalog:
-                        shard = entry["shard"]
-                        if not self.owns_shard(index_name, shard):
-                            continue
-                        field = idx.field(entry["field"])
-                        if field is None:
-                            continue
-                        view = field.view(entry["view"], create=True)
-                        frag = view.fragment(shard, create=True)
-                        try:
-                            data = self.client.fragment_data(
-                                node.uri, index_name, entry["field"],
-                                entry["view"], shard,
-                            )
-                        except ClientError:
-                            continue
-                        if data:
-                            frag.import_roaring(data)
+                    if data:
+                        frag.import_roaring(data)
         finally:
             self.state = STATE_NORMAL
 
@@ -316,50 +326,66 @@ class Cluster:
         """One anti-entropy pass over every fragment this node replicates
         (reference HolderSyncer.SyncHolder — SURVEY.md §3.5). Returns
         repair counts for observability."""
-        import numpy as np
-
         repaired = {"fragments": 0, "bits": 0, "attr_blocks": 0}
         repaired["translate_ops"] = self.sync_translate()
         repaired["attr_blocks"] = self._sync_attrs()
         for index_name, idx in list(self.holder.indexes.items()):
+            # Inventory = local fragments ∪ peers' catalogs: a replica that
+            # never materialized an owned fragment must still repair it
+            # (the reference syncer walks the schema × max-shard space, not
+            # just local files — SURVEY.md §3.5).
+            inventory = set()
             for field_name, field in list(idx.fields.items()):
                 for view_name, view in list(field.views.items()):
-                    for shard, frag in list(view.fragments.items()):
-                        replicas = [
-                            n for n in self.shard_nodes(index_name, shard)
-                            if n.id != self.local.id
-                        ]
-                        if not self.owns_shard(index_name, shard):
+                    for shard in list(view.fragments):
+                        inventory.add((field_name, view_name, shard))
+            inventory.update(
+                (f, v, s) for f, v, s, _ in self._peer_fragment_entries(index_name)
+            )
+            for field_name, view_name, shard in sorted(inventory):
+                if not self.owns_shard(index_name, shard):
+                    continue
+                field = idx.field(field_name)
+                if field is None:
+                    continue
+                replicas = [
+                    n for n in self.shard_nodes(index_name, shard)
+                    if n.id != self.local.id
+                ]
+                view = field.view(view_name, create=True)
+                # fragment created lazily at first import so a sync pass
+                # that repairs nothing leaves no empty fragment files
+                frag = view.fragment(shard)
+                local_blocks = dict(frag.blocks()) if frag is not None else {}
+                for node in replicas:
+                    try:
+                        peer_blocks = dict(
+                            self.client.fragment_blocks(
+                                node.uri, index_name, field_name,
+                                view_name, shard,
+                            )
+                        )
+                    except ClientError:
+                        continue
+                    for block, checksum in peer_blocks.items():
+                        if local_blocks.get(block) == checksum:
                             continue
+                        try:
+                            bm = self.client.fragment_block_bitmap(
+                                node.uri, index_name, field_name,
+                                view_name, shard, block,
+                            )
+                        except ClientError:
+                            continue
+                        if bm.count():
+                            if frag is None:
+                                frag = view.fragment(shard, create=True)
+                            added = frag.import_roaring_bitmap(bm)
+                            if added:
+                                repaired["bits"] += added
+                                repaired["fragments"] += 1
+                    if frag is not None:
                         local_blocks = dict(frag.blocks())
-                        for node in replicas:
-                            try:
-                                peer_blocks = dict(
-                                    self.client.fragment_blocks(
-                                        node.uri, index_name, field_name,
-                                        view_name, shard,
-                                    )
-                                )
-                            except ClientError:
-                                continue
-                            for block, checksum in peer_blocks.items():
-                                if local_blocks.get(block) == checksum:
-                                    continue
-                                try:
-                                    ids = self.client.fragment_block_ids(
-                                        node.uri, index_name, field_name,
-                                        view_name, shard, block,
-                                    )
-                                except ClientError:
-                                    continue
-                                if ids:
-                                    added = frag.add_ids(
-                                        np.asarray(ids, np.uint64)
-                                    )
-                                    if added:
-                                        repaired["bits"] += added
-                                        repaired["fragments"] += 1
-                            local_blocks = dict(frag.blocks())
         return repaired
 
     def _sync_attrs(self) -> int:

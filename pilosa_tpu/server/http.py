@@ -131,6 +131,13 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _bytes(self, data: bytes) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     # --------------------------------------------------------------- routes
 
     def post_query(self, index, query=None):
@@ -317,11 +324,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         v = fld.view(view)
         frag = v.fragment(shard) if v else None
         data = frag.serialize_snapshot() if frag else b""
-        self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        self._bytes(data)
 
     def get_shards_list(self, query=None):
         index = (query.get("index") or [""])[0]
@@ -329,6 +332,13 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self._json({"shards": idx.available_shards()})
 
     def get_fragment_block_data(self, query=None):
+        """One checksum block's bits as a roaring-serialized octet-stream.
+        The reference moves block data as protobuf bodies (SURVEY.md §2
+        #16-17); JSON int lists here cost ~20 bytes/bit, which makes
+        dense-block repair two orders of magnitude larger than the data."""
+        from pilosa_tpu.roaring import RoaringBitmap
+        from pilosa_tpu.roaring.format import serialize
+
         index = (query.get("index") or [""])[0]
         field = (query.get("field") or [""])[0]
         view = (query.get("view") or ["standard"])[0]
@@ -338,8 +348,9 @@ class HTTPHandler(BaseHTTPRequestHandler):
         fld = self.api._field(idx, field)
         v = fld.view(view)
         frag = v.fragment(shard) if v else None
-        ids = frag.block_ids(block).tolist() if frag else []
-        self._json({"ids": [int(i) for i in ids]})
+        ids = frag.block_ids(block) if frag is not None else []
+        data = serialize(RoaringBitmap.from_ids(ids))
+        self._bytes(data)
 
     def get_fragments_catalog(self, query=None):
         """Every (field, view, shard) fragment of an index — drives resize
@@ -383,11 +394,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
     def get_translate_data(self, query=None):
         offset = _int_param((query.get("offset") or ["0"])[0], "offset")
         data = self.api.holder.translate.read_log(offset)
-        self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        self._bytes(data)
 
     def post_cluster_message(self, query=None):
         body = self._json_body()
