@@ -38,6 +38,11 @@ STATE_NORMAL = "NORMAL"
 STATE_RESIZING = "RESIZING"
 STATE_DEGRADED = "DEGRADED"
 
+# Consecutive failed heartbeats before the acting coordinator declares a
+# node dead and re-replicates its shards (memberlist suspect→dead in the
+# reference — SURVEY.md §2 #14, §5.3).
+DEAD_HEARTBEATS = 3
+
 
 class Node:
     def __init__(self, id: str, uri: str):
@@ -70,11 +75,38 @@ class Cluster:
         self.holder = holder
         self.api = api  # set by Server after API construction
         self.client = InternalClient(insecure_tls=insecure_tls)
-        self.state = STATE_NORMAL
+        self._state = STATE_NORMAL
+        self._state_normal = threading.Event()
+        self._state_normal.set()
         self._lock = threading.RLock()
         # bytes of the coordinator's translate log already applied locally;
         # resets on restart (re-apply is idempotent)
         self._translate_offset = 0
+        # shards learned from peers' create-shard broadcasts (reference
+        # CreateShardMessage): new remote shards become visible to queries
+        # immediately instead of after a catalog-poll TTL
+        self.known_shards: dict[str, set[int]] = {}
+        self._announced_shards: dict[str, set[int]] = {}
+        self._heartbeat_failures: dict[str, int] = {}
+        self._resize_lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @state.setter
+    def state(self, value: str) -> None:
+        self._state = value
+        if value == STATE_NORMAL:
+            self._state_normal.set()
+        else:
+            self._state_normal.clear()
+
+    def wait_until_normal(self, timeout: float) -> bool:
+        """Block until the cluster leaves RESIZING (queries are deferred
+        during a resize, reference cluster state machine — SURVEY.md §2
+        #13). Returns False on timeout."""
+        return self._state_normal.wait(timeout)
 
     # ----------------------------------------------------------- membership
 
@@ -129,15 +161,22 @@ class Cluster:
 
     # ------------------------------------------------------------ broadcast
 
-    def send_sync(self, message: dict) -> None:
-        """Deliver a schema delta to every peer (reference SendSync)."""
+    def _broadcast(self, message: dict, mark_degraded: bool = False) -> None:
+        """Deliver a message to every peer, tolerating per-node failures
+        (the one broadcast loop — send_sync/leave/state/shard announcements
+        all route here so error handling can't drift between them)."""
         for node in self.sorted_nodes():
             if node.id == self.local.id:
                 continue
             try:
                 self.client.send_message(node.uri, message)
             except ClientError:
-                node.state = STATE_DEGRADED
+                if mark_degraded:
+                    node.state = STATE_DEGRADED
+
+    def send_sync(self, message: dict) -> None:
+        """Deliver a schema delta to every peer (reference SendSync)."""
+        self._broadcast(message, mark_degraded=True)
 
     def handle_message(self, message: dict) -> dict:
         """Apply a cluster message received from a peer (reference
@@ -153,6 +192,7 @@ class Cluster:
         elif kind == "delete-index":
             if self.holder.index(message["index"]) is not None:
                 self.holder.delete_index(message["index"])
+            self.forget_index(message["index"])
         elif kind == "create-field":
             from pilosa_tpu.storage import FieldOptions
 
@@ -175,31 +215,113 @@ class Cluster:
             node = Node(message["id"], message["uri"])
             with self._lock:
                 self.nodes[node.id] = node
+            # membership changed ownership: the acting coordinator computes
+            # per-node fetch instructions (reference ResizeInstruction)
+            if self.is_acting_coordinator:
+                self._spawn_resize()
         elif kind == "node-leave":
             with self._lock:
                 self.nodes.pop(message["id"], None)
-            # ownership moved: pull newly-owned shards from surviving
-            # replicas (reference: coordinator resize on node death)
-            try:
-                self.resize_fetch()
-            except Exception:
-                pass
+                self._heartbeat_failures.pop(message["id"], None)
+            if self.is_acting_coordinator:
+                self._spawn_resize()
+        elif kind == "create-shard":
+            with self._lock:
+                self.known_shards.setdefault(message["index"], set()).update(
+                    int(s) for s in message.get("shards", [])
+                )
+        elif kind == "cluster-state":
+            self.state = message.get("state", STATE_NORMAL)
+        elif kind == "resize-instruction":
+            self.fetch_fragments(message.get("sources", []))
         else:
             return {"error": f"unknown message type {kind!r}"}
         return {}
 
+    def note_local_shards(self, index: str, shards) -> None:
+        """Announce newly-created local shards to every peer (reference
+        CreateShardMessage on max-shard bump — SURVEY.md §2 #15), so remote
+        queries see them immediately rather than after the catalog-poll
+        TTL. Fire-and-forget: the catalog poll remains the backstop."""
+        with self._lock:
+            seen = self._announced_shards.setdefault(index, set())
+            new = sorted(set(int(s) for s in shards) - seen)
+            if not new:
+                return
+            seen.update(new)
+        if len(self.nodes) <= 1:
+            return
+        message = {"type": "create-shard", "index": index, "shards": new}
+        threading.Thread(
+            target=self._broadcast, args=(message,), daemon=True
+        ).start()
+
+    def get_known_shards(self, index: str) -> list[int]:
+        """Snapshot of peer-announced shards (copied under the lock: the
+        message handler mutates the set from HTTP threads)."""
+        with self._lock:
+            return sorted(self.known_shards.get(index, ()))
+
+    def forget_index(self, index: str) -> None:
+        """Drop shard bookkeeping for a deleted index: stale entries would
+        fan queries out to phantom shards and suppress announcements for a
+        recreated index of the same name."""
+        with self._lock:
+            self.known_shards.pop(index, None)
+            self._announced_shards.pop(index, None)
+
     # ------------------------------------------------------------ heartbeat
 
+    @property
+    def is_acting_coordinator(self) -> bool:
+        """First NON-DEAD node in id order: coordination must fail over
+        when the coordinator itself is the node that died."""
+        for n in self.sorted_nodes():
+            if n.state != STATE_DEGRADED:
+                return n.id == self.local.id
+        return True
+
     def heartbeat(self) -> None:
-        """Liveness probe of peers (memberlist's role — SURVEY.md §2 #14)."""
+        """Liveness probe of peers (memberlist's role — SURVEY.md §2 #14).
+        After DEAD_HEARTBEATS consecutive failures the acting coordinator
+        declares the node dead: removes it, broadcasts node-leave, and
+        drives a resize so surviving replicas restore full replication
+        (reference suspect→dead → coordinator resize — SURVEY.md §5.3)."""
+        dead: list[Node] = []
         for node in self.sorted_nodes():
             if node.id == self.local.id:
                 continue
             try:
                 self.client.status(node.uri)
                 node.state = STATE_NORMAL
+                self._heartbeat_failures.pop(node.id, None)
             except ClientError:
                 node.state = STATE_DEGRADED
+                fails = self._heartbeat_failures.get(node.id, 0) + 1
+                self._heartbeat_failures[node.id] = fails
+                if fails >= DEAD_HEARTBEATS:
+                    dead.append(node)
+        if dead and self.is_acting_coordinator:
+            for node in dead:
+                self.declare_dead(node.id)
+
+    def declare_dead(self, node_id: str) -> None:
+        """Remove a dead node and re-replicate its shards: broadcast the
+        departure, then send per-node resize instructions."""
+        with self._lock:
+            if self.nodes.pop(node_id, None) is None:
+                return
+            self._heartbeat_failures.pop(node_id, None)
+        for node in self.sorted_nodes():
+            if node.id == self.local.id:
+                continue
+            try:
+                self.client.send_message(
+                    node.uri, {"type": "node-leave", "id": node_id}
+                )
+            except ClientError:
+                pass
+        self.coordinate_resize()
 
     # ----------------------------------------------------------- join/resize
 
@@ -261,8 +383,9 @@ class Cluster:
         return out
 
     def resize_fetch(self) -> None:
-        """Fetch fragment data for every shard this node now owns but does
-        not yet have (the receiving half of a ResizeInstruction)."""
+        """Pull-based fallback: fetch every fragment this node owns but
+        does not have (used on self-join, where the joiner cannot wait for
+        the coordinator's instructions to arrive)."""
         self.state = STATE_RESIZING
         try:
             for index_name, idx in list(self.holder.indexes.items()):
@@ -271,21 +394,110 @@ class Cluster:
                 ):
                     if not self.owns_shard(index_name, shard):
                         continue
-                    field = idx.field(fname)
-                    if field is None:
-                        continue
-                    view = field.view(vname, create=True)
-                    frag = view.fragment(shard, create=True)
-                    try:
-                        data = self.client.fragment_data(
-                            node.uri, index_name, fname, vname, shard,
-                        )
-                    except ClientError:
-                        continue
-                    if data:
-                        frag.import_roaring(data)
+                    self.fetch_fragments([{
+                        "index": index_name, "field": fname, "view": vname,
+                        "shard": shard, "from": node.uri,
+                    }])
         finally:
             self.state = STATE_NORMAL
+
+    def fetch_fragments(self, sources: list[dict]) -> int:
+        """Execute the receiving half of resize instructions: fetch and
+        union each listed fragment from its source node."""
+        fetched = 0
+        for src in sources:
+            idx = self.holder.index(src["index"])
+            field = idx.field(src["field"]) if idx else None
+            if field is None:
+                continue
+            view = field.view(src["view"], create=True)
+            frag = view.fragment(int(src["shard"]), create=True)
+            try:
+                data = self.client.fragment_data(
+                    src["from"], src["index"], src["field"], src["view"],
+                    int(src["shard"]),
+                )
+            except ClientError:
+                continue
+            if data:
+                frag.import_roaring(data)
+                fetched += 1
+        return fetched
+
+    def _spawn_resize(self) -> None:
+        threading.Thread(target=self.coordinate_resize, daemon=True).start()
+
+    def coordinate_resize(self) -> dict:
+        """Coordinator-computed resize (reference ResizeInstruction —
+        SURVEY.md §2 #13, §3.5): gather the cluster-wide fragment catalog,
+        compute which fragments each owner is missing and a live source
+        for each, gate queries cluster-wide (RESIZING), send every node
+        its instruction list, then return the cluster to NORMAL.
+
+        Runs are serialized: an overlapping run's NORMAL broadcast must
+        not un-gate queries while another run is still moving fragments.
+        """
+        with self._resize_lock:
+            return self._coordinate_resize_locked()
+
+    def _coordinate_resize_locked(self) -> dict:
+        if not self.is_acting_coordinator:
+            return {}
+        # fragment → holders (node ids), from local + peer catalogs
+        holders: dict[tuple, list[Node]] = {}
+        for index_name, idx in list(self.holder.indexes.items()):
+            for field_name, field in list(idx.fields.items()):
+                for view_name, view in list(field.views.items()):
+                    for shard in list(view.fragments):
+                        holders.setdefault(
+                            (index_name, field_name, view_name, shard), []
+                        ).append(self.local)
+            for f, v, s, node in self._peer_fragment_entries(index_name):
+                holders.setdefault((index_name, f, v, s), []).append(node)
+        instructions: dict[str, list[dict]] = {}
+        for (index_name, f, v, s), have in holders.items():
+            have_ids = {n.id for n in have}
+            live_sources = [n for n in have if n.state != STATE_DEGRADED]
+            if not live_sources:
+                continue
+            for owner in self.shard_nodes(index_name, s):
+                if owner.state == STATE_DEGRADED or owner.id in have_ids:
+                    continue
+                src = next((n for n in live_sources if n.id != owner.id), None)
+                if src is None:
+                    continue
+                instructions.setdefault(owner.id, []).append({
+                    "index": index_name, "field": f, "view": v, "shard": s,
+                    "from": src.uri,
+                })
+        if not instructions:
+            return {}
+        self._broadcast_state(STATE_RESIZING)
+        try:
+            for node_id, sources in instructions.items():
+                if node_id == self.local.id:
+                    self.fetch_fragments(sources)
+                    continue
+                node = self.nodes.get(node_id)
+                if node is None:
+                    continue
+                try:
+                    self.client.send_message(
+                        node.uri,
+                        {"type": "resize-instruction", "sources": sources},
+                    )
+                except ClientError:
+                    node.state = STATE_DEGRADED
+        finally:
+            self._broadcast_state(STATE_NORMAL)
+        return instructions
+
+    def _broadcast_state(self, state: str) -> None:
+        # sent to EVERY node, including ones marked DEGRADED mid-resize: a
+        # node that received RESIZING but is skipped for NORMAL would stay
+        # gated forever (queries time out with "cluster is resizing")
+        self.state = state
+        self._broadcast({"type": "cluster-state", "state": state})
 
     def leave(self) -> None:
         """Graceful departure: announce node-leave so peers re-own our

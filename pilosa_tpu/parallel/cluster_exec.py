@@ -32,6 +32,10 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH, position, shard_of
 _WRITE_BROADCAST = {"SetRowAttrs", "SetColumnAttrs"}
 _SHARDS_TTL = 3.0
 
+# How long a query waits for a resize to finish before erroring
+# (reference: queries are deferred while the cluster is RESIZING).
+_RESIZE_WAIT = 30.0
+
 
 class ClusterExecutor:
     """Wraps a local executor with shard routing across cluster nodes."""
@@ -68,6 +72,8 @@ class ClusterExecutor:
             # sub-query from a peer: evaluate strictly locally on the given
             # shards, no re-fan-out (reference Remote=true)
             return self.local.execute(index_name, query, shards=shards)
+        if not self.cluster.wait_until_normal(_RESIZE_WAIT):
+            raise PQLError("cluster is resizing; query deferred past timeout")
         if isinstance(query, str):
             query = parse(query)
         elif isinstance(query, Call):
@@ -80,28 +86,32 @@ class ClusterExecutor:
     # -------------------------------------------------------- shard routing
 
     def _all_shards(self, index_name: str) -> list[int]:
-        """Cluster-wide shard list: local + each live peer's, briefly cached
-        (the reference tracks max-shard via CreateShardMessage broadcasts;
-        a TTL poll keeps the control plane simpler)."""
+        """Cluster-wide shard list: local shards ∪ peers' create-shard
+        broadcasts (reference CreateShardMessage — new remote shards are
+        visible immediately) ∪ a TTL-cached catalog poll as the backstop
+        for missed broadcasts (e.g. this node restarted)."""
         with self._lock:
             hit = self._shards_cache.get(index_name)
-            if hit and time.monotonic() - hit[0] < _SHARDS_TTL:
-                return hit[1]
+            polled = hit[1] if hit and time.monotonic() - hit[0] < _SHARDS_TTL else None
+        if polled is None:
+            polled = set()
+            for node in self.cluster.sorted_nodes():
+                if node.id == self.cluster.local.id:
+                    continue
+                try:
+                    out = self.cluster.client._call(
+                        "GET",
+                        f"{node.uri}/internal/shards/list?index={index_name}",
+                    )
+                    polled.update(out.get("shards", []))
+                except ClientError:
+                    pass
+            with self._lock:
+                self._shards_cache[index_name] = (time.monotonic(), polled)
         shards = set(self.holder.index(index_name).available_shards())
-        for node in self.cluster.sorted_nodes():
-            if node.id == self.cluster.local.id:
-                continue
-            try:
-                out = self.cluster.client._call(
-                    "GET", f"{node.uri}/internal/shards/list?index={index_name}"
-                )
-                shards.update(out.get("shards", []))
-            except ClientError:
-                pass
-        result = sorted(shards)
-        with self._lock:
-            self._shards_cache[index_name] = (time.monotonic(), result)
-        return result
+        shards.update(polled)
+        shards.update(self.cluster.known_shards.get(index_name, ()))
+        return sorted(shards)
 
     def _route(self, index_name: str, shards: list[int]):
         """Group shards by executing node (primary live replica; self
@@ -192,6 +202,8 @@ class ClusterExecutor:
         for node in owners:
             if node.id == self.cluster.local.id:
                 result = bool(self.local._execute_call(idx, call)) or result
+                if result and call.name == "Set":
+                    self.cluster.note_local_shards(idx.name, [shard])
             else:
                 try:
                     out = self.cluster.client.query_node(

@@ -198,6 +198,10 @@ class API:
                         )
         if not clear:
             idx.mark_columns_exist(columns.tolist())
+            if self.cluster is not None:
+                self.cluster.note_local_shards(
+                    index, np.unique(shards_sorted).tolist()
+                )
         return int(changed)
 
     def _route_import(self, index, field, rows, columns, timestamps, clear,
@@ -278,6 +282,10 @@ class API:
                 raise ApiError(str(e)) from e
         if not clear:
             idx.mark_columns_exist([int(c) for c in columns])
+            if self.cluster is not None:
+                self.cluster.note_local_shards(
+                    index, {int(c) >> SHARD_WIDTH_EXP for c in columns}
+                )
         return int(changed)
 
     def import_roaring(self, index: str, field: str, shard: int, data: bytes,
@@ -297,6 +305,8 @@ class API:
         idx.mark_columns_exist(
             ((shard << SHARD_WIDTH_EXP) + positions.astype(np.int64)).tolist()
         )
+        if self.cluster is not None:
+            self.cluster.note_local_shards(index, [shard])
         return changed
 
     # --------------------------------------------------------------- export
