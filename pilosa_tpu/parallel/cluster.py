@@ -471,6 +471,15 @@ class Cluster:
                     "from": src.uri,
                 })
         if not instructions:
+            # A coordinator can die between broadcasting RESIZING and
+            # NORMAL; if the failover coordinator then finds nothing to
+            # move (e.g. replica_n == 1 left no live source) it must still
+            # un-gate peers or every query fails with "cluster is
+            # resizing" forever. Unconditional (not gated on local state):
+            # the dying coordinator's RESIZING broadcast may have missed
+            # THIS node while reaching others — idempotent and serialized
+            # under _resize_lock, so always safe.
+            self._broadcast_state(STATE_NORMAL)
             return {}
         self._broadcast_state(STATE_RESIZING)
         try:

@@ -110,7 +110,7 @@ class ClusterExecutor:
                 self._shards_cache[index_name] = (time.monotonic(), polled)
         shards = set(self.holder.index(index_name).available_shards())
         shards.update(polled)
-        shards.update(self.cluster.known_shards.get(index_name, ()))
+        shards.update(self.cluster.get_known_shards(index_name))
         return sorted(shards)
 
     def _route(self, index_name: str, shards: list[int]):

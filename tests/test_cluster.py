@@ -501,3 +501,77 @@ class TestEagerShardVisibility:
         finally:
             for s in servers:
                 s.close()
+
+
+class TestClusterRaces:
+    def test_known_shards_read_during_create_shard_broadcasts(self, tmp_path):
+        """ADVICE r2 (medium): _all_shards used to iterate the raw
+        known_shards set while handle_message('create-shard') resized it
+        from HTTP threads — set.update over a set being resized raises
+        RuntimeError mid-query. Hammer both sides concurrently."""
+        import threading
+
+        servers = make_cluster(tmp_path, 1)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            cluster = servers[0].api.cluster
+            execu = servers[0].api.executor
+            errors = []
+            stop = threading.Event()
+
+            def mutate():
+                shard = 0
+                while not stop.is_set():
+                    shard += 1
+                    try:
+                        cluster.handle_message({
+                            "type": "create-shard", "index": "i",
+                            "shards": [shard],
+                        })
+                    except Exception as e:  # pragma: no cover
+                        errors.append(e)
+
+            def read():
+                while not stop.is_set():
+                    try:
+                        execu._all_shards("i")
+                    except Exception as e:
+                        errors.append(e)
+
+            threads = [threading.Thread(target=mutate) for _ in range(2)]
+            threads += [threading.Thread(target=read) for _ in range(2)]
+            for t in threads:
+                t.start()
+            import time as _time
+            _time.sleep(1.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            assert not errors, errors
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_failover_coordinator_ungates_stuck_resizing(self, tmp_path):
+        """ADVICE r2 (medium): coordinator dies between broadcasting
+        RESIZING and NORMAL; the failover coordinator finds nothing to
+        move (replica_n=1 left no live source) and must STILL broadcast
+        NORMAL or peers stay gated forever."""
+        servers = make_cluster(tmp_path, 2)
+        try:
+            coord = next(s for s in servers
+                         if s.api.cluster.is_acting_coordinator)
+            # simulate the dead coordinator's last act reaching only the
+            # peers: the failover coordinator itself stays NORMAL (its
+            # RESIZING delivery hit a transient error), peers are gated
+            for s in servers:
+                if s is not coord:
+                    s.api.cluster.state = "RESIZING"
+            instructions = coord.api.cluster.coordinate_resize()
+            assert instructions == {}  # nothing to move...
+            for s in servers:            # ...but everyone un-gated
+                assert s.api.cluster.state == "NORMAL", s.config.name
+        finally:
+            for s in servers:
+                s.close()
