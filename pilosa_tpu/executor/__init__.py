@@ -10,5 +10,5 @@ host loop on one chip (M2) and a shard_map over the mesh axis in the
 distributed path (pilosa_tpu.parallel).
 """
 
-from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.executor.executor import Deferred, Executor
 from pilosa_tpu.executor.result import RowResult

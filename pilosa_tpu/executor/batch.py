@@ -86,9 +86,14 @@ class ShardBlock:
         self.shards = sorted(shards)
         self.padded = next_pow2(max(len(self.shards), 1))
         self.n_devices = 1
+        self._key = None
 
     def key(self) -> tuple:
-        return (tuple(self.shards), self.padded, self.n_devices)
+        # cached: leaf-cache keys embed it, and rebuilding a 1k-shard
+        # tuple per leaf per query is measurable on the serving path
+        if self._key is None:
+            self._key = (tuple(self.shards), self.padded, self.n_devices)
+        return self._key
 
     def stack(self, per_shard_fn) -> np.ndarray:
         """Build the [padded, ...] host array: per_shard_fn(shard) → row
@@ -240,14 +245,15 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
         def decode():
             return block.stack(lambda shard: host_row(idx, spec, shard))
 
-        views = frozenset(spec.views)
-        probe = _make_probe(
-            block,
-            match=lambda ev: ev.row == spec.row and ev.view in views,
-            row_pos_of=None,
-            decode_row=lambda ev: host_row(idx, spec, ev.shard),
-            delta_on_clear=len(views) == 1,
-        )
+        def probe():  # factory: only built when the key isn't registered
+            views = frozenset(spec.views)
+            return _make_probe(
+                block,
+                match=lambda ev: ev.row == spec.row and ev.view in views,
+                row_pos_of=None,
+                decode_row=lambda ev: host_row(idx, spec, ev.shard),
+                delta_on_clear=len(spec.views) == 1,
+            )
     elif isinstance(spec, _PlanesSpec):
         field = idx.field(spec.field)
         depth = 2 + field.options.bit_depth
@@ -266,13 +272,14 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
                 return np.zeros(WORDS_PER_SHARD, np.uint32)
             return frag.row_words(ev.row)
 
-        probe = _make_probe(
-            block,
-            match=lambda ev: ev.view == bsi_view and ev.row < depth,
-            row_pos_of=lambda ev: ev.row,
-            decode_row=decode_row,
-            delta_on_clear=True,
-        )
+        def probe():
+            return _make_probe(
+                block,
+                match=lambda ev: ev.view == bsi_view and ev.row < depth,
+                row_pos_of=lambda ev: ev.row,
+                decode_row=decode_row,
+                delta_on_clear=True,
+            )
     elif isinstance(spec, _ZeroSpec):
         key = ("stackz", block.key())
 
@@ -305,21 +312,22 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
 
         return block.stack(per_shard)
 
-    row_pos_of = {r: i for i, r in enumerate(row_ids)}
-
     def decode_row(ev):
         frag = view.fragment(ev.shard) if view else None
         if frag is None:
             return np.zeros(WORDS_PER_SHARD, np.uint32)
         return frag.row_words(ev.row)
 
-    probe = _make_probe(
-        block,
-        match=lambda ev: ev.view == view_name and ev.row in row_pos_of,
-        row_pos_of=lambda ev: row_pos_of[ev.row],
-        decode_row=decode_row,
-        delta_on_clear=True,
-    )
+    def probe():
+        row_pos_of = {r: i for i, r in enumerate(row_ids)}
+        return _make_probe(
+            block,
+            match=lambda ev: ev.view == view_name and ev.row in row_pos_of,
+            row_pos_of=lambda ev: row_pos_of[ev.row],
+            decode_row=decode_row,
+            delta_on_clear=True,
+        )
+
     return cache.get_or_build(key, (idx.name, field_name), probe, decode,
                               device_put=device_put)
 
@@ -359,15 +367,10 @@ def minmax_merge(values, counts, want_max: bool):
     return minmax_finalize(best, n, jnp.any(valid))
 
 
-def local_fn(structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
-    """Build (or fetch) the single-device batched evaluator for a query
-    shape: vmap over the stacked shard axis + on-device reduction."""
-    key = ("local", structure, reduce_kind, leaf_ranks, n_scalars)
-    fn = _LOCAL_JIT_CACHE.get(key)
-    if fn is not None:
-        return fn
-
-    n_leaves = len(leaf_ranks)
+def _local_body(structure, reduce_kind: str, n_leaves: int):
+    """Uncompiled single-query evaluator body: vmap over the stacked
+    shard axis + on-device reduction. Shared by the per-query program
+    (local_fn) and the micro-batched program (local_fn_batched)."""
 
     def body(*args):
         leaves = args[:n_leaves]
@@ -391,6 +394,53 @@ def local_fn(structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
             values, counts = out
             return minmax_merge(values, counts, reduce_kind == "max")
         return out  # 'row': [padded, words]
+
+    return body
+
+
+def local_fn(structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
+    """Build (or fetch) the single-device batched evaluator for a query
+    shape: vmap over the stacked shard axis + on-device reduction."""
+    key = ("local", structure, reduce_kind, leaf_ranks, n_scalars)
+    fn = _LOCAL_JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(_local_body(structure, reduce_kind, len(leaf_ranks)))
+        _LOCAL_JIT_CACHE[key] = fn
+    return fn
+
+
+def local_fn_batched(structure, reduce_kind: str, leaf_ranks: tuple,
+                     n_scalars: int, n_queries: int):
+    """ONE device program evaluating ``n_queries`` same-shape queries
+    (Executor.submit micro-batching). Each program dispatch on a
+    tunneled/remote backend carries a fixed launch cost comparable to the
+    device compute of a whole 1B-column query; stacking a micro-batch of
+    pipelined queries into one program amortizes it, and the single
+    [B, ...] readback serves every query in the batch with one host
+    round trip. Args: B repetitions of the leaves, then (when the shape
+    has scalars) ONE int32[B, n_scalars] array carrying every query's
+    scalars in a single transfer; returns the per-query packed results
+    stacked on axis 0."""
+    key = ("localB", structure, reduce_kind, leaf_ranks, n_scalars,
+           n_queries)
+    fn = _LOCAL_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_leaves = len(leaf_ranks)
+    body1 = _local_body(structure, reduce_kind, n_leaves)
+
+    def body(*args):
+        if n_scalars:
+            flat, scal = args[:-1], args[-1]
+        else:
+            flat, scal = args, None
+        outs = []
+        for i in range(n_queries):
+            leaves_i = flat[i * n_leaves:(i + 1) * n_leaves]
+            scalars_i = tuple(scal[i, j] for j in range(n_scalars)) if n_scalars else ()
+            outs.append(body1(*leaves_i, *scalars_i))
+        return jnp.stack(outs)
 
     fn = jax.jit(body)
     _LOCAL_JIT_CACHE[key] = fn

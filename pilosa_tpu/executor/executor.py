@@ -18,6 +18,7 @@ reduce to const-empty / all-existing without touching the device.
 from __future__ import annotations
 
 import datetime as dt
+import threading
 
 import numpy as np
 
@@ -156,10 +157,35 @@ class _Compiled:
         return expr.evaluate(self.node, leaves, self.scalars)
 
 
+class Deferred:
+    """Handle for a pipelined query result (Executor.submit).
+
+    The device program is already enqueued; ``result()`` performs the
+    blocking host readback (and any host-side finalization). Because a
+    single device's stream is ordered, resolving the LAST Deferred of a
+    submitted pipeline implies every earlier program has completed.
+    """
+
+    __slots__ = ("_finalize", "_value")
+
+    def __init__(self, finalize=None, value=None):
+        self._finalize = finalize
+        self._value = value
+
+    def result(self):
+        if self._finalize is not None:
+            self._value = self._finalize()
+            self._finalize = None
+        return self._value
+
+
 # ----------------------------------------------------------------- executor
 
 
 class Executor:
+    # Queries per micro-batched dispatch (see _microbatch_enqueue).
+    MICROBATCH_MAX = 8
+
     def __init__(self, holder):
         self.holder = holder
         # cluster hooks (set by ClusterExecutor): key_resolver translates
@@ -167,6 +193,9 @@ class Executor:
         # coordinator's translate log before reverse lookups
         self.key_resolver = None
         self.key_backfill = None
+        self.microbatch_max = self.MICROBATCH_MAX
+        self._pending: dict = {}
+        self._mb_lock = threading.Lock()
 
     # ------------------------------------------------------------ top level
 
@@ -190,6 +219,38 @@ class Executor:
                 ):
                     out.append(self._execute_call(idx, call, shards))
                 stats.count("queries", 1, {"call": call.name})
+        return out
+
+    def submit(self, index_name: str, query, shards=None):
+        """Pipelined execution: parse, compile, and ENQUEUE each call's
+        device program without blocking on the result readback; returns
+        one ``Deferred`` per call, resolved on ``.result()``.
+
+        Device streams are ordered, so a serving loop can enqueue a stream
+        of queries and resolve them in order — the host↔device round trip
+        (the latency floor on tunneled/remote backends) overlaps with
+        device compute instead of serializing after it. Pipelined Count
+        queries sharing a program shape are additionally coalesced into
+        micro-batched dispatches (see _microbatch_enqueue). Reductions
+        whose readback is a few ints (Count, Sum, Min, Max) stay in
+        flight; other call types evaluate eagerly at submit time and
+        return an already-resolved Deferred.
+        """
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise PQLError(f"index {index_name!r} not found")
+        if isinstance(query, str):
+            query = parse(query)
+        elif isinstance(query, Call):
+            query = Query([query])
+        out = []
+        for call in query.calls:
+            if call.name == "Count":
+                out.append(self._submit_count(idx, call, shards, pipeline=True))
+            elif call.name in ("Sum", "Min", "Max"):
+                out.append(self._submit_bsi_aggregate(idx, call, shards))
+            else:
+                out.append(Deferred(value=self._execute_call(idx, call, shards)))
         return out
 
     def _execute_call(self, idx: Index, call: Call, shards=None):
@@ -313,10 +374,11 @@ class Executor:
             filt_structure, n_filt, n_scalars, n_gather, has_agg
         )
 
-    def _batched_eval(self, idx: Index, compiled: _Compiled, block,
-                      reduce_kind: str, extra_leaves=()):
-        import jax.numpy as jnp
-
+    def _eval_operands(self, idx: Index, compiled: _Compiled, block,
+                       extra_leaves=()):
+        """Resolve a compiled query's device leaves; scalars stay host
+        ints (converted at dispatch — the micro-batch path ships a whole
+        group's scalars as one array)."""
         put = self._leaf_put()
         leaves = [
             batch.stacked_leaf(idx, spec, block, put) for spec in compiled.specs
@@ -324,12 +386,87 @@ class Executor:
         leaves.extend(extra_leaves)
         if not leaves:
             leaves = [batch.stacked_leaf(idx, _ZeroSpec(), block, put)]
-        scalars = tuple(jnp.asarray(s, jnp.int32) for s in compiled.scalars)
+        return leaves, tuple(int(s) for s in compiled.scalars)
+
+    def _dispatch(self, node, reduce_kind: str, leaves, scalars):
+        import jax.numpy as jnp
+
         fn = self._program(
-            compiled.node, reduce_kind,
-            tuple(l.ndim - 1 for l in leaves), len(scalars),
+            node, reduce_kind, tuple(l.ndim - 1 for l in leaves), len(scalars)
         )
-        return fn(*leaves, *scalars)
+        return fn(*leaves, *(jnp.asarray(s, jnp.int32) for s in scalars))
+
+    def _batched_eval(self, idx: Index, compiled: _Compiled, block,
+                      reduce_kind: str, extra_leaves=()):
+        leaves, scalars = self._eval_operands(idx, compiled, block, extra_leaves)
+        return self._dispatch(compiled.node, reduce_kind, leaves, scalars)
+
+    # ------------------------------------------------- query micro-batching
+    #
+    # Pipelined (submit) reductions are coalesced: queries sharing one
+    # program shape (structure, reduce kind, operand shapes) accumulate in
+    # a pending group and dispatch as ONE device program of
+    # ``microbatch_max`` queries (batch.local_fn_batched) — amortizing the
+    # fixed per-dispatch launch cost that otherwise rivals the device
+    # compute of an entire query, and serving the whole group's results
+    # with one [B, ...] readback. A group also flushes when any of its
+    # Deferreds resolves, so results are never held hostage. Leaves are
+    # captured at submit time: writes between submit and flush patch the
+    # residency cache functionally (new arrays), so an in-flight query
+    # keeps its snapshot.
+
+    def _microbatch_enqueue(self, node, reduce_kind: str, leaves, scalars):
+        """Queue one pipelined query; returns a thunk yielding this
+        query's packed host result, or None when micro-batching is off
+        (then the caller dispatches per-query)."""
+        if self.microbatch_max <= 1 or not self._supports_microbatch():
+            return None
+        shapes = tuple(tuple(l.shape) for l in leaves)
+        key = (node, reduce_kind, shapes, len(scalars))
+        with self._mb_lock:
+            group = self._pending.get(key)
+            if group is None:
+                group = self._pending[key] = {"rows": [], "out": None}
+            i = len(group["rows"])
+            group["rows"].append((tuple(leaves), scalars))
+            if len(group["rows"]) >= self.microbatch_max:
+                self._flush_group_locked(key, group)
+
+        def read():
+            with self._mb_lock:
+                if group["out"] is None:
+                    self._flush_group_locked(key, group)
+                out = group["out"]
+            if not isinstance(out, np.ndarray):
+                out = np.asarray(out)  # blocking readback, outside the lock
+                with self._mb_lock:
+                    group["out"] = out
+            return out[i]
+
+        return read
+
+    def _supports_microbatch(self) -> bool:
+        """Subclasses whose programs are not plain local programs (e.g.
+        the SPMD mesh executor) opt out until they provide a batched
+        builder."""
+        return type(self)._program is Executor._program
+
+    def _flush_group_locked(self, key, group) -> None:
+        """Dispatch a pending group as one program (caller holds _mb_lock)."""
+        if group["out"] is not None:
+            return
+        node, reduce_kind, shapes, n_scalars = key
+        rows = group["rows"]
+        fn = batch.local_fn_batched(
+            node, reduce_kind, tuple(len(s) - 1 for s in shapes),
+            n_scalars, len(rows),
+        )
+        args = [leaf for leaves, _ in rows for leaf in leaves]
+        if n_scalars:
+            args.append(np.asarray([s for _, s in rows], np.int32))
+        group["out"] = fn(*args)
+        if self._pending.get(key) is group:
+            del self._pending[key]
 
     # --------------------------------------------------------- bitmap calls
 
@@ -368,15 +505,28 @@ class Executor:
         return res
 
     def _execute_count(self, idx: Index, call: Call, shards=None) -> int:
+        return self._submit_count(idx, call, shards).result()
+
+    def _submit_count(self, idx: Index, call: Call, shards=None,
+                      pipeline: bool = False) -> "Deferred":
         if len(call.children) != 1:
             raise PQLError("Count requires exactly one child call")
         compiled = self._compile(idx, call.children[0], wrap="count")
         shard_list = self._shards(idx, shards)
         if not shard_list:
-            return 0
+            return Deferred(value=0)
         block = self._shard_block(shard_list)
-        packed = np.asarray(self._batched_eval(idx, compiled, block, "count"))
-        return int(batch.merge_split(packed))
+        if pipeline:
+            leaves, scalars = self._eval_operands(idx, compiled, block)
+            read = self._microbatch_enqueue(
+                compiled.node, "count", leaves, scalars
+            )
+            if read is not None:
+                return Deferred(lambda: int(batch.merge_split(read())))
+            packed = self._dispatch(compiled.node, "count", leaves, scalars)
+        else:
+            packed = self._batched_eval(idx, compiled, block, "count")
+        return Deferred(lambda: int(batch.merge_split(np.asarray(packed))))
 
     def _execute_includes_column(self, idx: Index, call: Call) -> bool:
         col = call.arg("column")
@@ -554,6 +704,9 @@ class Executor:
     # ------------------------------------------------------- BSI aggregates
 
     def _execute_bsi_aggregate(self, idx: Index, call: Call, shards=None) -> ValCount:
+        return self._submit_bsi_aggregate(idx, call, shards).result()
+
+    def _submit_bsi_aggregate(self, idx: Index, call: Call, shards=None) -> "Deferred":
         field_name = call.arg("field") or call.arg("_field")
         if field_name is None:
             raise PQLError(f"{call.name} requires field=")
@@ -572,30 +725,37 @@ class Executor:
 
         shard_list = self._shards(idx, shards)
         if not shard_list:
-            return ValCount(0, 0)
+            return Deferred(value=ValCount(0, 0))
         block = self._shard_block(shard_list)
 
         if call.name == "Sum":
             node = ("bsisum", planes_i, filt_node)
-            merged = batch.merge_split(np.asarray(
-                self._batched_eval(idx, _Compiled(node, specs, scalars),
-                                   block, "bsisum")
-            ))  # [depth + 1]: plane counts ++ n
-            count = int(merged[-1])
-            total = sum(int(c) << i for i, c in enumerate(merged[:-1].tolist()))
-            return ValCount(total + base * count, count)
+            out = self._batched_eval(idx, _Compiled(node, specs, scalars),
+                                     block, "bsisum")
+
+            def finish_sum():
+                merged = batch.merge_split(np.asarray(out))
+                # [depth + 1]: plane counts ++ n
+                count = int(merged[-1])
+                total = sum(int(c) << i for i, c in enumerate(merged[:-1].tolist()))
+                return ValCount(total + base * count, count)
+
+            return Deferred(finish_sum)
 
         want_max = call.name == "Max"
         node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
-        packed = np.asarray(
-            self._batched_eval(idx, _Compiled(node, specs, scalars),
-                               block, "max" if want_max else "min")
-        )  # [best, count_lo, count_hi]
-        best = int(packed[0])
-        count = int(batch.merge_split(packed[1:]))
-        if count == 0:
-            return ValCount(0, 0)
-        return ValCount(best + base, count)
+        out = self._batched_eval(idx, _Compiled(node, specs, scalars),
+                                 block, "max" if want_max else "min")
+
+        def finish_minmax():
+            packed = np.asarray(out)  # [best, count_lo, count_hi]
+            best = int(packed[0])
+            count = int(batch.merge_split(packed[1:]))
+            if count == 0:
+                return ValCount(0, 0)
+            return ValCount(best + base, count)
+
+        return Deferred(finish_minmax)
 
     # ----------------------------------------------------------------- TopN
 
@@ -715,7 +875,7 @@ class Executor:
             pos = position(int(column))
             frag = view.fragment(shard)
             if frag is not None:
-                rows.update(r for r in frag.row_ids() if frag.contains(r, pos))
+                rows.update(frag.rows_containing(pos))
         else:
             # one O(#containers) metadata pass per fragment — exact
             # non-empty rows with no per-row count loop (fragment.row_counts)

@@ -85,6 +85,21 @@ class Container:
         out = np.repeat(runs[:, 0] - np.concatenate(([0], np.cumsum(lengths)[:-1])), lengths)
         return (out + np.arange(total)).astype(np.uint16)
 
+    def contains_low(self, low: int) -> bool:
+        """O(1)/O(log n) membership for one in-container value — no
+        materialization (``lows()`` unpacks all 65536 bits; a bitmap
+        container probe must not)."""
+        if self.kind == ARRAY:
+            i = int(np.searchsorted(self.data, low))
+            return i < self.data.size and int(self.data[i]) == low
+        if self.kind == BITMAP:
+            return bool((int(self.data[low >> 6]) >> (low & 63)) & 1)
+        runs = self.data
+        if runs.size == 0:
+            return False
+        i = int(np.searchsorted(runs[:, 0], low, side="right")) - 1
+        return i >= 0 and low <= int(runs[i, 1])
+
     def dense_words32(self) -> np.ndarray:
         """Container as 2048 uint32 words (65536 bits) — device format block.
         Host→device decode hot path: native fastbits when available."""
@@ -303,7 +318,7 @@ class RoaringBitmap:
         c = self._containers.get(int(id_) >> 16)
         if c is None:
             return False
-        return int(id_) & 0xFFFF in c.lows()
+        return c.contains_low(int(id_) & 0xFFFF)
 
     def __eq__(self, other) -> bool:
         if not isinstance(other, RoaringBitmap):

@@ -213,11 +213,14 @@ class DeviceRowCache:
                      probe: Callable | None,
                      decode: Callable[[], np.ndarray],
                      device_put: Callable | None = None) -> jax.Array:
-        """get_row for derived (write-patched) entries: registers ``probe``
-        under ``tag`` atomically with residency, and re-checks the tag's
-        write version around the unlocked host decode so a write landing
-        mid-decode can't leave a silently stale leaf (the decode snapshot
-        might miss it, and the event fired before registration)."""
+        """get_row for derived (write-patched) entries: registers the
+        probe produced by ``probe`` (a zero-arg FACTORY, invoked only when
+        the key isn't yet registered — hits skip closure construction on
+        the hot query path) under ``tag`` atomically with residency, and
+        re-checks the tag's write version around the unlocked host decode
+        so a write landing mid-decode can't leave a silently stale leaf
+        (the decode snapshot might miss it, and the event fired before
+        registration)."""
         for _ in range(4):
             with self._lock:
                 arr = self._lookup_locked(key)
@@ -302,14 +305,16 @@ class DeviceRowCache:
         Idempotent per key; dropped when the entry leaves both tiers.
         """
         with self._lock:
-            self._register_locked(key, tag, probe)
+            self._register_locked(key, tag, lambda: probe)
 
-    def _register_locked(self, key: tuple, tag: tuple, probe) -> None:
+    def _register_locked(self, key: tuple, tag: tuple, probe_factory) -> None:
         if key in self._rows or key in self._compressed:
             old = self._updaters.get(key)
-            if old is not None and old[0] != tag:
+            if old is not None and old[0] == tag:
+                return  # already registered; probes are stateless closures
+            if old is not None:
                 self._tag_index[old[0]].discard(key)
-            self._updaters[key] = (tag, probe)
+            self._updaters[key] = (tag, probe_factory())
             self._tag_index.setdefault(tag, set()).add(key)
 
     def invalidate_tag(self, tag: tuple) -> None:

@@ -359,3 +359,94 @@ class TestErrors:
         assert ex.execute(
             "repos", "IncludesColumn(Row(stargazer=1), column=11)"
         ) == [False]
+
+
+class TestSubmitPipelined:
+    def test_submit_count_matches_execute(self, env):
+        holder, ex = env
+        _, data, langs = setup_stars(holder)
+        pql = "Count(Intersect(Row(stargazer=1), Row(language=5)))"
+        want = ex.execute("repos", pql)[0]
+        (d,) = ex.submit("repos", pql)
+        assert d.result() == want
+        assert d.result() == want  # idempotent resolve
+
+    def test_submit_pipeline_resolves_in_order(self, env):
+        """Enqueue several salted Shift queries without blocking, then
+        resolve; each matches its eager counterpart (the bench.py method:
+        scalars are runtime args, so one compiled program serves every
+        salt)."""
+        holder, ex = env
+        setup_stars(holder)
+        pqls = [
+            f"Count(Intersect(Row(stargazer=1), Shift(Row(language=5), n={n})))"
+            for n in range(4)
+        ]
+        defs = [ex.submit("repos", p)[0] for p in pqls]
+        want = [ex.execute("repos", p)[0] for p in pqls]
+        assert [d.result() for d in defs] == want
+
+    def test_submit_sum_min_max_deferred(self, env):
+        holder, ex = env
+        idx = holder.create_index("vals")
+        f = idx.create_field("n", FieldOptions(type="int", min=0, max=1000))
+        for col, v in ((1, 7), (2, 100), (3, 900)):
+            f.set_value(col, v)
+        for name, want in (("Sum", ValCount(1007, 3)), ("Min", ValCount(7, 1)),
+                           ("Max", ValCount(900, 1))):
+            (d,) = ex.submit("vals", f'{name}(field="n")')
+            assert d.result() == want
+
+    def test_submit_non_reduction_is_eager(self, env):
+        holder, ex = env
+        _, data, _ = setup_stars(holder)
+        (d,) = ex.submit("repos", "Row(stargazer=1)")
+        assert d.result().columns().tolist() == data[1]
+
+    def test_submit_count_microbatch_coalesces(self, env):
+        """Pipelined same-shape Counts dispatch as ONE micro-batched
+        program; each Deferred gets its own slice of the [B, 2] packed
+        readback. Resolving any Deferred flushes a partial group."""
+        holder, ex = env
+        _, data, langs = setup_stars(holder)
+        pqls = [
+            "Count(Row(stargazer=1))",
+            "Count(Row(stargazer=2))",
+            "Count(Row(stargazer=3))",
+            "Count(Row(language=5))",
+            "Count(Row(language=6))",
+        ]
+        want = [ex.execute("repos", p)[0] for p in pqls]
+        defs = [ex.submit("repos", p)[0] for p in pqls]
+        assert ex._pending  # partial group still pending (5 < batch max)
+        got = [d.result() for d in defs]  # first resolve flushes the group
+        assert got == want
+        assert not ex._pending
+
+    def test_submit_microbatch_flushes_at_max(self, env):
+        holder, ex = env
+        setup_stars(holder)
+        ex.microbatch_max = 2
+        defs = [
+            ex.submit("repos", f"Count(Row(stargazer={r}))")[0]
+            for r in (1, 2, 3)
+        ]
+        # first two flushed as a pair at max; third still pending
+        assert sum(len(g["rows"]) for g in ex._pending.values()) == 1
+        assert [d.result() for d in defs] == [4, 3, 2]
+
+    def test_submit_microbatch_mixed_shapes_group_separately(self, env):
+        """Different program shapes (plain vs Shift trees) land in
+        different groups and both resolve correctly."""
+        holder, ex = env
+        setup_stars(holder)
+        a = ex.submit("repos", "Count(Row(stargazer=1))")[0]
+        b = ex.submit(
+            "repos", "Count(Intersect(Row(stargazer=1), Shift(Row(language=5), n=0)))"
+        )[0]
+        want_b = ex.execute(
+            "repos", "Count(Intersect(Row(stargazer=1), Row(language=5)))"
+        )[0]
+        assert len(ex._pending) == 2
+        assert a.result() == 4
+        assert b.result() == want_b

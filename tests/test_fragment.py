@@ -1,0 +1,53 @@
+
+
+class TestRowsContaining:
+    def test_matches_per_row_contains(self, tmp_path):
+        import numpy as np
+
+        from pilosa_tpu.storage.fragment import Fragment
+
+        frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+        rng = np.random.default_rng(11)
+        # mixed container kinds: sparse rows (array), a dense run row, a
+        # bitmap-container row
+        rows, cols = [], []
+        for r in range(40):
+            n = 50 if r % 3 else 6000
+            rows.append(np.full(n, r, np.uint64))
+            cols.append(rng.integers(0, 1 << 20, n, dtype=np.uint64))
+        rows.append(np.full(70000, 40, np.uint64))
+        cols.append(np.arange(70000, dtype=np.uint64))  # run containers
+        frag.bulk_import(np.concatenate(rows), np.concatenate(cols))
+
+        for pos in [0, 1, 77, 65535, 65536, 69999, 70000, (1 << 20) - 1,
+                    int(cols[0][0]), int(cols[3][0])]:
+            want = sorted(
+                r for r in frag.row_ids() if frag.contains(r, pos)
+            )
+            assert sorted(frag.rows_containing(pos)) == want, pos
+        frag.close()
+
+    def test_contains_low_all_kinds(self):
+        import numpy as np
+
+        from pilosa_tpu.roaring.bitmap import Container
+
+        # array
+        c = Container.from_lows(np.asarray([3, 9, 1000], np.uint16))
+        assert c.contains_low(9) and not c.contains_low(8)
+        # run
+        c = Container.from_lows(np.arange(100, 4200, dtype=np.uint16))
+        assert c.kind == 3 and c.contains_low(100) and c.contains_low(4199)
+        assert not c.contains_low(99) and not c.contains_low(4200)
+        # bitmap
+        lows = np.unique(
+            np.random.default_rng(0).integers(0, 65536, 8000).astype(np.uint16)
+        )
+        c = Container.from_lows(lows)
+        assert c.kind == 2
+        s = set(lows.tolist())
+        for v in [0, 1, 17, 65535, int(lows[0]), int(lows[-1])]:
+            assert c.contains_low(v) == (v in s)
+        # empty
+        c = Container.from_lows(np.empty(0, np.uint16))
+        assert not c.contains_low(0)
