@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import datetime as dt
 import threading
+import weakref
 
 import numpy as np
 
@@ -148,6 +149,7 @@ class _Compiled:
         self.specs = specs
         self.scalars = scalars
 
+
     def eval(self, idx: Index, shard: int):
         """Single-shard evaluation (IncludesColumn); batched queries go
         through Executor._batched_eval instead."""
@@ -155,6 +157,17 @@ class _Compiled:
         if not leaves:
             leaves = [_zeros_words()]
         return expr.evaluate(self.node, leaves, self.scalars)
+
+
+def _node_has_const0(node) -> bool:
+    """True when a compiled tree contains a const0 leaf — compiled from
+    an unknown row key (or a degenerate range), whose meaning can change
+    with later writes; such plans are not memoized."""
+    if not isinstance(node, tuple):
+        return False
+    if node and node[0] == "const0":
+        return True
+    return any(_node_has_const0(c) for c in node[1:])
 
 
 class Deferred:
@@ -184,7 +197,9 @@ class Deferred:
 
 class Executor:
     # Queries per micro-batched dispatch (see _microbatch_enqueue).
-    MICROBATCH_MAX = 8
+    MICROBATCH_MAX = 16
+    # Plan-memo bound; cleared wholesale when full (see _compile_cached).
+    PLAN_CACHE_MAX = 4096
 
     def __init__(self, holder):
         self.holder = holder
@@ -196,6 +211,8 @@ class Executor:
         self.microbatch_max = self.MICROBATCH_MAX
         self._pending: dict = {}
         self._mb_lock = threading.Lock()
+        # (index, call identity, wrap) -> validated plan; see _compile_cached
+        self._plan_cache: dict = {}
 
     # ------------------------------------------------------------ top level
 
@@ -471,7 +488,7 @@ class Executor:
     # --------------------------------------------------------- bitmap calls
 
     def _execute_bitmap(self, idx: Index, call: Call, shards=None) -> RowResult:
-        compiled = self._compile(idx, call)
+        compiled = self._compile_cached(idx, call)
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return self._finish_row_result(idx, call, RowResult({}))
@@ -511,7 +528,7 @@ class Executor:
                       pipeline: bool = False) -> "Deferred":
         if len(call.children) != 1:
             raise PQLError("Count requires exactly one child call")
-        compiled = self._compile(idx, call.children[0], wrap="count")
+        compiled = self._compile_cached(idx, call.children[0], wrap="count")
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return Deferred(value=0)
@@ -535,7 +552,7 @@ class Executor:
         if len(call.children) != 1:
             raise PQLError("IncludesColumn requires one child call")
         shard, pos = shard_of(col), position(col)
-        compiled = self._compile(idx, call.children[0])
+        compiled = self._compile_cached(idx, call.children[0])
         words = np.asarray(compiled.eval(idx, shard))
         return bool((words[pos // 32] >> np.uint32(pos % 32)) & np.uint32(1))
 
@@ -561,6 +578,40 @@ class Executor:
         return res
 
     # -------------------------------------------------------------- compile
+
+    def _compile_cached(self, idx: Index, call: Call,
+                        wrap: str | None = None) -> _Compiled:
+        """_compile with a plan memo. parse() memoizes query text to one
+        immutable Call tree, so the tree's identity keys repeated queries
+        — the serving hot path. A cached plan revalidates in two identity
+        checks plus one int compare: the Call tree, the Index object (a
+        delete_index + recreate under the same name restarts plan_epoch,
+        so the epoch alone could alias a stale plan; the index is held
+        weakly so the cache never pins a deleted index's bitmaps), and
+        the index's schema epoch — bumped on field create/delete, which
+        covers every compiled-in field property (views from time quantum,
+        BSI base/bit_depth from min/max) since FieldOptions are immutable
+        after creation. Plans whose tree degenerated to const0 (e.g. a
+        row key unknown at compile time that a later write may create)
+        are not cached."""
+        key = (idx.name, id(call), wrap)
+        entry = self._plan_cache.get(key)
+        if entry is not None:
+            call_ref, idx_ref, epoch, compiled = entry
+            if (call_ref is call and idx_ref() is idx
+                    and epoch == idx.plan_epoch):
+                return compiled
+        # epoch snapshot BEFORE compiling: DDL racing the compile bumps
+        # the epoch, so the entry (tagged pre-DDL) fails its next
+        # validation instead of serving the stale plan under the new epoch
+        epoch = idx.plan_epoch
+        compiled = self._compile(idx, call, wrap=wrap)
+        if not _node_has_const0(compiled.node):
+            if len(self._plan_cache) >= self.PLAN_CACHE_MAX:
+                self._plan_cache.clear()
+            self._plan_cache[key] = (call, weakref.ref(idx), epoch,
+                                     compiled)
+        return compiled
 
     def _compile(self, idx: Index, call: Call, wrap: str | None = None) -> _Compiled:
         specs: list = []
@@ -1233,7 +1284,7 @@ class Executor:
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return True
-        compiled = self._compile(idx, call.children[0])
+        compiled = self._compile_cached(idx, call.children[0])
         block = self._shard_block(shard_list)
         host = np.asarray(self._batched_eval(idx, compiled, block, "row"))
         for i, shard in enumerate(block.shards):

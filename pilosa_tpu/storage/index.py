@@ -27,6 +27,12 @@ class Index:
         self.track_existence = track_existence
         self.fields: dict[str, Field] = {}
         self.column_attrs = None  # AttrStore, opened in open()
+        # schema epoch: bumped on field create/delete so cached query
+        # plans (executor._plan_cache) revalidate with one int compare
+        self.plan_epoch = 0
+        # available_shards memo, validated by total fragment count (the
+        # shard set only ever grows, and only by creating a fragment)
+        self._shards_memo: tuple[int, list[int]] | None = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -69,6 +75,7 @@ class Index:
         _validate_name(name, allow_internal=name == EXISTENCE_FIELD)
         field = Field(os.path.join(self.path, name), self.name, name, options).open()
         self.fields[name] = field
+        self.plan_epoch += 1
         return field
 
     def field(self, name: str) -> Field | None:
@@ -80,6 +87,8 @@ class Index:
             raise KeyError(f"field {name!r} not found")
         field.close()
         shutil.rmtree(field.path, ignore_errors=True)
+        self.plan_epoch += 1
+        self._shards_memo = None  # deletes can shrink the shard set
 
     def public_fields(self) -> list[Field]:
         return [f for n, f in sorted(self.fields.items()) if not n.startswith("_")]
@@ -102,10 +111,24 @@ class Index:
     # ----------------------------------------------------------------- info
 
     def available_shards(self) -> list[int]:
+        """Sorted union of every field's shard set, memoized: between
+        field deletions (which drop the memo) the set only grows, and
+        only by fragment creation, so a total-fragment count validates
+        the memo in O(fields x views). The per-query set-union + sort
+        otherwise shows up on the pipelined submit path."""
+        n_frags = 0
+        for f in self.fields.values():
+            for v in f.views.values():
+                n_frags += len(v.fragments)
+        memo = self._shards_memo
+        if memo is not None and memo[0] == n_frags:
+            return memo[1]
         shards: set[int] = set()
         for f in self.fields.values():
             shards.update(f.available_shards())
-        return sorted(shards)
+        out = sorted(shards)
+        self._shards_memo = (n_frags, out)
+        return out
 
     def schema(self) -> dict:
         return {

@@ -450,3 +450,95 @@ class TestSubmitPipelined:
         assert len(ex._pending) == 2
         assert a.result() == 4
         assert b.result() == want_b
+
+
+class TestPlanCache:
+    """_compile_cached: repeated query text (one parse-memoized Call tree)
+    reuses the compiled plan; schema changes and BSI shape growth
+    invalidate; unknown-key plans are never memoized."""
+
+    def test_repeat_query_hits_cache_and_stays_correct(self, env):
+        holder, ex = env
+        setup_stars(holder)
+        q = "Count(Intersect(Row(stargazer=1), Row(language=5)))"
+        assert ex.execute("repos", q)[0] == 3
+        assert len(ex._plan_cache) == 1
+        entry = next(iter(ex._plan_cache.values()))
+        assert ex.execute("repos", q)[0] == 3
+        assert next(iter(ex._plan_cache.values())) is entry  # reused
+
+    def test_write_through_cached_plan(self, env):
+        holder, ex = env
+        setup_stars(holder)
+        q = "Count(Row(stargazer=2))"
+        assert ex.execute("repos", q)[0] == 3
+        holder.index("repos").field("stargazer").set_bit(2, 77)
+        assert ex.execute("repos", q)[0] == 4  # plan reused, data fresh
+
+    def test_field_recreate_invalidates(self, env):
+        holder, ex = env
+        idx = holder.create_index("repos")
+        idx.create_field("stargazer").set_bit(1, 5)
+        q = "Count(Row(stargazer=1))"
+        assert ex.execute("repos", q)[0] == 1
+        idx.delete_field("stargazer")
+        idx.create_field("stargazer").set_bit(1, 6)
+        idx.field("stargazer").set_bit(1, 7)
+        assert ex.execute("repos", q)[0] == 2
+
+    def test_bsi_range_recreate_invalidates(self, env):
+        """A cached compare plan bakes in base/bit_depth (predicate
+        shifting + clamping); recreating the field with a different range
+        must not reuse it."""
+        holder, ex = env
+        idx = holder.create_index("metrics")
+        f = idx.create_field("size", FieldOptions(type="int", min=0, max=100))
+        f.set_value(1, 50)
+        q = "Count(Row(size > 40))"
+        assert ex.execute("metrics", q)[0] == 1
+        idx.delete_field("size")
+        f = idx.create_field(
+            "size", FieldOptions(type="int", min=0, max=100000)
+        )
+        f.set_value(1, 50)
+        f.set_value(2, 99999)
+        assert ex.execute("metrics", q)[0] == 2
+
+    def test_unknown_key_plan_not_cached(self, env):
+        holder, ex = env
+        idx = holder.create_index("people", keys=False)
+        f = idx.create_field("name", FieldOptions(keys=True))
+        ex.execute("people", 'Set(9, name="bob")')  # materialize the field
+        q = 'Count(Row(name="alice"))'
+        assert ex.execute("people", q)[0] == 0
+        assert not ex._plan_cache  # const0 plan: not memoized
+        # create the key after the first compile; the same query text
+        # (same memoized Call tree) must now see the new row
+        ex.execute("people", 'Set(3, name="alice")')
+        assert ex.execute("people", q)[0] == 1
+
+    def test_field_delete_shrinks_shard_list(self, env):
+        """available_shards memo: a delete_field followed by equal-count
+        fragment creation must not alias the memoized shard list."""
+        holder, ex = env
+        idx = holder.create_index("repos", track_existence=False)
+        idx.create_field("a").set_bit(1, 0)  # shard 0
+        assert idx.available_shards() == [0]
+        idx.delete_field("a")
+        idx.create_field("b").set_bit(1, 5 * SHARD_WIDTH)  # shard 5
+        assert idx.available_shards() == [5]
+        assert ex.execute("repos", "Count(Row(b=1))")[0] == 1
+
+    def test_index_recreate_same_name_invalidates(self, env):
+        """delete_index + create_index under one name restarts plan_epoch;
+        the cached plan must not survive into the new index."""
+        holder, ex = env
+        idx = holder.create_index("repos", track_existence=False)
+        idx.create_field("f").set_bit(1, 10)
+        q = "Count(Row(f=1))"
+        assert ex.execute("repos", q)[0] == 1
+        holder.delete_index("repos")
+        idx2 = holder.create_index("repos", track_existence=False)
+        idx2.create_field("f").set_bit(1, 20)
+        idx2.field("f").set_bit(1, 21)
+        assert ex.execute("repos", q)[0] == 2
