@@ -1,20 +1,18 @@
-"""Core bitwise/popcount kernels over bit-packed uint32 tensors.
+"""Device bitwise kernels that need more than an infix operator.
 
-TPU-native re-expression of the reference's roaring container ops
-(roaring/roaring.go: Union/Intersect/Difference/Xor/Count/CountRange/Flip
-and row.go Shift). Every op is a uniform dense vector op — no container
-kind dispatch — so XLA fuses arbitrary PQL expression trees
-(e.g. Count(Intersect(Union(a,b), Not(c)))) into a single HBM pass.
+Fused query evaluation does NOT live here: the expression compiler
+(executor/expr.py) lowers whole PQL trees to jnp operator chains that XLA
+fuses into one HBM pass, so Union/Intersect/Difference/Xor/Count never
+exist as standalone kernels (they would be ``a | b`` etc. with extra
+indirection). The only op with a non-trivial body is Shift — reference
+row.go Shift — which expr.py inlines via ``shift.__wrapped__`` so it
+still fuses into the pass.
 
-Shapes: ops are shape-polymorphic over uint32 arrays; a shard-row is
-``uint32[32768]`` and a row-block is ``uint32[rows, 32768]``. Counts are
-returned as int32 per row (max 2^20 per shard-row, far below overflow);
-cross-shard / cross-row totals are summed host-side in Python ints.
+Shapes: shape-polymorphic over bit-packed uint32 arrays; a shard-row is
+``uint32[32768]`` (shardwidth.WORDS_PER_SHARD).
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -23,89 +21,6 @@ from jax import lax
 from pilosa_tpu.shardwidth import WORD_BITS
 
 _U32 = jnp.uint32
-
-
-@jax.jit
-def union(a, b):
-    return a | b
-
-
-@jax.jit
-def intersect(a, b):
-    return a & b
-
-
-@jax.jit
-def difference(a, b):
-    return a & ~b
-
-
-@jax.jit
-def xor(a, b):
-    return a ^ b
-
-
-@jax.jit
-def count(a):
-    """Total set bits in the whole tensor (int32 scalar).
-
-    Safe for a single shard-row or a small batch; use count_rows + host sum
-    for large row-blocks.
-    """
-    return jnp.sum(lax.population_count(a).astype(jnp.int32))
-
-
-@jax.jit
-def count_rows(a):
-    """Per-row popcount for a row-block uint32[rows, words] -> int32[rows]."""
-    return jnp.sum(lax.population_count(a).astype(jnp.int32), axis=-1)
-
-
-@jax.jit
-def intersect_count(a, b):
-    """Fused Intersect+Count — the north-star metric op. XLA fuses the AND
-    with the popcount reduce so the intersection bitmap never materializes."""
-    return jnp.sum(lax.population_count(a & b).astype(jnp.int32))
-
-
-@partial(jax.jit, static_argnums=0)
-def _range_mask(n_words, start, stop):
-    """uint32[n_words] mask with bits [start, stop) set."""
-    idx = lax.iota(jnp.int32, n_words)
-    word_lo = jnp.asarray(start, jnp.int32) // WORD_BITS
-    word_hi = jnp.asarray(stop, jnp.int32) // WORD_BITS
-    bit_lo = jnp.asarray(start, jnp.int32) % WORD_BITS
-    bit_hi = jnp.asarray(stop, jnp.int32) % WORD_BITS
-    full = ((idx > word_lo) & (idx < word_hi)).astype(_U32) * _U32(0xFFFFFFFF)
-    # Partial masks at the boundary words. (-1 << b) keeps bits >= b.
-    lo_mask = _U32(0xFFFFFFFF) << bit_lo.astype(_U32)
-    hi_mask = jnp.where(
-        bit_hi > 0, ~(_U32(0xFFFFFFFF) << bit_hi.astype(_U32)), _U32(0)
-    )
-    both = lo_mask & hi_mask
-    mask = full
-    mask = jnp.where(idx == word_lo, jnp.where(word_lo == word_hi, both, lo_mask), mask)
-    mask = jnp.where((idx == word_hi) & (word_hi > word_lo), hi_mask, mask)
-    return jnp.where(jnp.asarray(stop, jnp.int32) > jnp.asarray(start, jnp.int32), mask, _U32(0))
-
-
-def range_mask(n_words: int, start, stop):
-    return _range_mask(n_words, start, stop)
-
-
-@jax.jit
-def count_range(a, start, stop):
-    """Count set bits with position in [start, stop) along the last axis
-    (reference roaring CountRange)."""
-    mask = _range_mask(a.shape[-1], start, stop)
-    return jnp.sum(lax.population_count(a & mask).astype(jnp.int32))
-
-
-@jax.jit
-def flip_range(a, start, stop):
-    """Flip bits in [start, stop) (reference roaring Flip; basis of Not)."""
-    mask = _range_mask(a.shape[-1], start, stop)
-    return a ^ mask
 
 
 @jax.jit
@@ -145,15 +60,3 @@ def shift(a, n):
         bit_shift > 0, a[..., -1:] >> carry, jnp.zeros_like(a[..., :1])
     )
     return jnp.where(idx == n_words + word_shift, tail, out)
-
-
-@jax.jit
-def any_set(a):
-    """True if any bit is set (used by Rows() existence filtering)."""
-    return jnp.any(a != 0)
-
-
-@jax.jit
-def rows_any(a):
-    """Per-row non-empty flags for uint32[rows, words] -> bool[rows]."""
-    return jnp.any(a != 0, axis=-1)

@@ -6,7 +6,7 @@ one of three kinds — sorted uint16 **array**, 1024×uint64 **bitmap**, or
 **run** list of inclusive [start, last] uint16 intervals. Unlike the
 reference this implementation is vectorized numpy (no per-value loops) and
 exists only for durability/interchange; set algebra at query time happens
-on device (pilosa_tpu.ops.bitops).
+on device via the fused expression compiler (pilosa_tpu.executor.expr).
 """
 
 from __future__ import annotations
