@@ -87,6 +87,12 @@ class ShardBlock:
         self.padded = next_pow2(max(len(self.shards), 1))
         self.n_devices = 1
         self._key = None
+        # single-process defaults; the multi-host ShardAssignment
+        # (parallel/mesh.py) narrows local_slots to this process's rows
+        # and clears patchable (write events then purge resident leaves
+        # instead of scatter-patching them)
+        self.local_slots = (0, self.padded)
+        self.patchable = True
 
     def key(self) -> tuple:
         # cached: leaf-cache keys embed it, and rebuilding a 1k-shard
@@ -95,13 +101,30 @@ class ShardBlock:
             self._key = (tuple(self.shards), self.padded, self.n_devices)
         return self._key
 
-    def stack(self, per_shard_fn) -> np.ndarray:
-        """Build the [padded, ...] host array: per_shard_fn(shard) → row
-        block; empty slots are zeros."""
-        first = per_shard_fn(self.shards[0]) if self.shards else None
-        inner_shape = first.shape if first is not None else ()
-        out = np.zeros((self.padded,) + tuple(inner_shape), np.uint32)
-        for i, s in enumerate(self.shards):
+    @property
+    def host_rows(self) -> int:
+        """Rows this process materializes on host: padded single-process,
+        the local slot span under multi-host feeding."""
+        lo, hi = self.local_slots
+        return hi - lo
+
+    def stack(self, per_shard_fn, inner: tuple | None = None) -> np.ndarray:
+        """Build the [host_rows, ...] host array for this process's slots
+        (all of [0, padded) single-process): per_shard_fn(shard) → row
+        block; empty slots are zeros. ``inner`` is the per-shard row
+        shape; when omitted it is probed by decoding one shard (an
+        all-padding process then pays a wasted decode — callers with a
+        statically known shape should pass it)."""
+        lo, hi = self.local_slots
+        local = self.shards[lo:min(hi, len(self.shards))]
+        first = per_shard_fn(local[0]) if local else None
+        if first is not None:
+            inner = first.shape
+        elif inner is None:
+            # all-padding process: still must feed correctly-shaped zeros
+            inner = per_shard_fn(self.shards[0]).shape if self.shards else ()
+        out = np.zeros((hi - lo,) + tuple(inner), np.uint32)
+        for i, s in enumerate(local):
             out[i] = first if i == 0 else per_shard_fn(s)
         return out
 
@@ -199,8 +222,28 @@ def _make_probe(block: ShardBlock, match, row_pos_of, decode_row,
     fallback when the exact delta can't be applied.
     delta_on_clear → clears may delta-patch (single-view leaves only: with
     multiple OR'd views a cleared bit may survive via another view).
+
+    Non-patchable blocks (multi-host ShardAssignment): a device scatter
+    on a multi-process global array would be a collective program every
+    process must join, but a write event fires only on the process whose
+    holder received the write — so a matching write purges the resident
+    entry (an array-handle drop; device buffers of other slots are
+    untouched) and the next query re-feeds this host's slots from its
+    holder. Correctness contract: a shard's writes must be applied on
+    (at least) the process owning that shard's slot — the cluster layer
+    routes writes to fragment owners, which the slot layout mirrors; a
+    process that only observes a foreign shard's write merely refreshes
+    its handle.
     """
     slot_of = {s: i for i, s in enumerate(block.shards)}
+
+    if not block.patchable:
+        def purge_probe(ev):
+            if ev.shard in slot_of and match(ev):
+                return residency.PURGE
+            return None
+
+        return purge_probe
 
     def probe(ev):
         slot = slot_of.get(ev.shard)
@@ -243,7 +286,8 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
                block.key())
 
         def decode():
-            return block.stack(lambda shard: host_row(idx, spec, shard))
+            return block.stack(lambda shard: host_row(idx, spec, shard),
+                               inner=(WORDS_PER_SHARD,))
 
         def probe():  # factory: only built when the key isn't registered
             views = frozenset(spec.views)
@@ -262,7 +306,8 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
 
         def decode():
             return block.stack(
-                lambda shard: host_planes(idx, spec, shard, depth)
+                lambda shard: host_planes(idx, spec, shard, depth),
+                inner=(depth, WORDS_PER_SHARD),
             )
 
         def decode_row(ev):
@@ -284,7 +329,7 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
         key = ("stackz", block.key())
 
         def decode():
-            return np.zeros((block.padded, WORDS_PER_SHARD), np.uint32)
+            return np.zeros((block.host_rows, WORDS_PER_SHARD), np.uint32)
 
         return cache.get_row(key, decode, device_put=device_put)
     else:
@@ -310,7 +355,7 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
                 return np.zeros((len(row_ids), WORDS_PER_SHARD), np.uint32)
             return np.stack([frag.row_words(r) for r in row_ids])
 
-        return block.stack(per_shard)
+        return block.stack(per_shard, inner=(len(row_ids), WORDS_PER_SHARD))
 
     def decode_row(ev):
         frag = view.fragment(ev.shard) if view else None

@@ -377,8 +377,9 @@ class Executor:
     def _shard_block(self, shard_list: list[int]):
         return batch.ShardBlock(shard_list)
 
-    def _leaf_put(self):
-        """Optional device_put override for stacked leaves (mesh sharding)."""
+    def _leaf_put(self, block):
+        """Optional device_put override for stacked leaves (mesh sharding;
+        the block supplies the global row count for multi-host feeding)."""
         return None
 
     def _program(self, structure, reduce_kind: str, leaf_ranks: tuple,
@@ -396,7 +397,7 @@ class Executor:
         """Resolve a compiled query's device leaves; scalars stay host
         ints (converted at dispatch — the micro-batch path ships a whole
         group's scalars as one array)."""
-        put = self._leaf_put()
+        put = self._leaf_put(block)
         leaves = [
             batch.stacked_leaf(idx, spec, block, put) for spec in compiled.specs
         ]
@@ -851,7 +852,7 @@ class Executor:
         node = ("countrows", len(specs), filt_node)
         block = self._shard_block(shard_list)
         matrix = batch.stacked_matrix(
-            idx, field_name, view, candidates, block, self._leaf_put()
+            idx, field_name, view, candidates, block, self._leaf_put(block)
         )
         counts = self._batched_eval(
             idx, _Compiled(node, specs, scalars), block, "countrows",
@@ -1048,7 +1049,7 @@ class Executor:
             else None
         )
         block = self._shard_block(shard_list)
-        put = self._leaf_put()
+        put = self._leaf_put(block)
         filt_leaves = [batch.stacked_leaf(idx, s, block, put) for s in specs]
         dim_mats = []
         for fname, row_ids in dims:

@@ -145,9 +145,24 @@ def _dist_groupby_level_fn(mesh, filt_structure, n_filt: int, n_scalars: int,
 class DistExecutor(Executor):
     """Executor whose shard map phase runs as one SPMD program on a mesh.
 
-    Used single-process over all local devices; over multiple hosts the
-    same code runs under jax.distributed with a global mesh (each host
-    feeds its addressable shards)."""
+    Single-process: the mesh spans all local devices and behaves like the
+    base executor with on-device reduction.
+
+    Multi-host (exercised for real by tests/test_multihost.py, two
+    jax.distributed processes on the CPU backend): the same mesh spans
+    hosts, and the contract is SPMD — every process drives the same query
+    sequence. Each process decodes and uploads ONLY the shard slots its
+    devices own (ShardAssignment.local_slots narrows block.stack, and
+    _leaf_put assembles the global array with
+    jax.make_array_from_process_local_data), reductions cross hosts via
+    psum inside the compiled program, and reduced results come back
+    replicated. Writes purge resident sharded leaves instead of
+    scatter-patching them (batch._make_probe: a device scatter on a
+    multi-process array would be a collective a single host can't run
+    alone). Row-materializing results stay shard-sharded and are only
+    read back single-process; in a deployed cluster they travel per-node
+    through the HTTP layer (parallel/cluster_exec.py), as the reference's
+    do."""
 
     def __init__(self, holder, mesh=None):
         super().__init__(holder)
@@ -156,9 +171,23 @@ class DistExecutor(Executor):
     def _shard_block(self, shard_list):
         return ShardAssignment(shard_list, self.mesh)
 
-    def _leaf_put(self):
+    def _leaf_put(self, block):
         sharding = NamedSharding(self.mesh, P(SHARDS_AXIS))
-        return lambda host: jax.device_put(host, sharding)
+        if jax.process_count() == 1:
+            return lambda host: jax.device_put(host, sharding)
+        # Multi-host: ``host`` holds only this process's slot rows
+        # (ShardAssignment narrows block.local_slots, so block.stack
+        # decoded just the addressable slice); assemble the global array
+        # from per-process local data — no host ever materializes or
+        # ships the full shard axis
+        padded = block.padded
+
+        def put(host):
+            return jax.make_array_from_process_local_data(
+                sharding, host, (padded,) + host.shape[1:]
+            )
+
+        return put
 
     def _program(self, structure, reduce_kind, leaf_ranks, n_scalars):
         return _dist_fn(self.mesh, structure, reduce_kind, leaf_ranks,

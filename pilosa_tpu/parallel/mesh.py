@@ -80,6 +80,36 @@ class ShardAssignment(ShardBlock):
         # stays O(log shards) as the index grows
         self.padded = self.n_devices * next_pow2(-(-n // self.n_devices))
         self.mesh = mesh
+        self.local_slots = (0, self.padded)
+        # Multi-host: this process feeds only the slot rows that live on
+        # its addressable devices (jax.make_array_from_process_local_data
+        # in DistExecutor._leaf_put assembles the global array), and
+        # resident leaves cannot be patched in place on write — a device
+        # scatter on a multi-process global array would be a collective
+        # every process must join, but a write event fires only on the
+        # process whose holder received it — so write events purge the
+        # local array handle instead (batch._make_probe, which also
+        # states the owner-applies-the-write correctness contract).
+        if jax.process_count() > 1:
+            per_dev = self.padded // self.n_devices
+            flat = mesh.devices.ravel()
+            mine = [i for i, d in enumerate(flat)
+                    if d.process_index == jax.process_index()]
+            if not mine:
+                raise ValueError(
+                    f"mesh contains no devices of process "
+                    f"{jax.process_index()}; every process driving a "
+                    f"multi-host DistExecutor must own mesh devices "
+                    f"(don't slice jax.devices() down to one host)"
+                )
+            lo, hi = mine[0], mine[-1] + 1
+            if mine != list(range(lo, hi)):
+                raise ValueError(
+                    "mesh devices of one process must be contiguous for "
+                    "per-host shard feeding"
+                )
+            self.local_slots = (lo * per_dev, hi * per_dev)
+            self.patchable = False
 
     @property
     def slot_of(self) -> dict[int, int]:

@@ -53,6 +53,11 @@ DEFAULT_BUDGET_BYTES = 4 << 30
 # Compression granularity: 4 KiB device blocks. Row = 32 blocks.
 COMPRESS_BLOCK_WORDS = 1024
 
+# Probe return sentinel: "this write affects the entry but it cannot be
+# patched in place — drop it" (multi-host sharded leaves, where a device
+# scatter would be a collective program a single host can't run alone).
+PURGE = object()
+
 # Demote-as-compressed only when it actually saves memory; denser entries
 # are simply dropped (host re-decode is the fallback, as before).
 COMPRESS_MAX_OCCUPANCY = 0.5
@@ -351,6 +356,9 @@ class DeviceRowCache:
                 apply = reg[1](event)
                 if apply is None:
                     continue  # unaffected (different row/view/shard)
+                if apply is PURGE:
+                    self.invalidate(key)
+                    continue
                 entry = self._rows.get(key)
                 if entry is not None:
                     entry.arr = apply(entry.arr)

@@ -1,0 +1,59 @@
+"""Multi-host execution: 2 real processes, one global mesh over DCN.
+
+The reference scales across nodes with HTTP fan-out + gossip (SURVEY.md
+§2.4); the TPU framework's data plane scales by making the shard-axis
+mesh span hosts under jax.distributed (SURVEY.md §7.2 M4/M6). This test
+runs that path for real: two OS processes, each with 4 virtual CPU
+devices, form an 8-device global mesh (gloo collectives over the
+coordination service); each process decodes and feeds only its
+addressable shard slots (ShardAssignment.local_slots +
+jax.make_array_from_process_local_data), and cross-host psum reduces
+return replicated results asserted against a host oracle inside each
+worker (tests/multihost_worker.py).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_query_correctness():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon TPU registration
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(worker))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_WORKER_{pid}_OK" in out, out[-4000:]
