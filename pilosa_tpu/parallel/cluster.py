@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
+import uuid
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.storage.view import VIEW_STANDARD
@@ -64,6 +66,11 @@ def _hash64(data: str) -> int:
 class Cluster:
     """Shard→node assignment + membership + schema broadcast."""
 
+    # How long the coordinator holds RESIZING waiting for peers'
+    # resize-complete reports before releasing stragglers to anti-entropy
+    # repair (tests shrink this).
+    RESIZE_COMPLETE_TIMEOUT = 120.0
+
     def __init__(self, local: Node, peers: list[Node] | None = None,
                  replica_n: int = 1, holder=None, api=None,
                  insecure_tls: bool = False):
@@ -89,6 +96,14 @@ class Cluster:
         self._announced_shards: dict[str, set[int]] = {}
         self._heartbeat_failures: dict[str, int] = {}
         self._resize_lock = threading.Lock()
+        # async resize-job tracking (coordinator side): peers ack the
+        # instruction immediately, fetch in a worker, and report
+        # resize-complete; the coordinator holds RESIZING until every
+        # pending peer reports (or the straggler timeout passes)
+        self._resize_cv = threading.Condition()
+        self._resize_job: str | None = None
+        self._resize_pending: set[str] = set()
+        self._resize_deadline = 0.0
 
     @property
     def state(self) -> str:
@@ -107,6 +122,14 @@ class Cluster:
         during a resize, reference cluster state machine — SURVEY.md §2
         #13). Returns False on timeout."""
         return self._state_normal.wait(timeout)
+
+    def _drop_resize_pending(self, node_id: str) -> None:
+        """A departed/dead node can't report resize-complete; don't gate
+        the cluster on it for the full straggler timeout."""
+        with self._resize_cv:
+            if node_id in self._resize_pending:
+                self._resize_pending.discard(node_id)
+                self._resize_cv.notify_all()
 
     # ----------------------------------------------------------- membership
 
@@ -223,6 +246,7 @@ class Cluster:
             with self._lock:
                 self.nodes.pop(message["id"], None)
                 self._heartbeat_failures.pop(message["id"], None)
+            self._drop_resize_pending(message["id"])
             if self.is_acting_coordinator:
                 self._spawn_resize()
         elif kind == "create-shard":
@@ -233,7 +257,40 @@ class Cluster:
         elif kind == "cluster-state":
             self.state = message.get("state", STATE_NORMAL)
         elif kind == "resize-instruction":
-            self.fetch_fragments(message.get("sources", []))
+            job, reply_to = message.get("job"), message.get("reply_to")
+            if job is None:
+                # direct form (tests/tools): fetch inline
+                self.fetch_fragments(message.get("sources", []))
+            else:
+                # ack now, fetch in a worker: the coordinator's delivery
+                # must not block on the fetch (a large move would trip
+                # the client timeout, spuriously DEGRADE a healthy-but-
+                # busy node, and un-gate queries mid-move)
+                threading.Thread(
+                    target=self._run_resize_job,
+                    args=(message.get("sources", []), job, reply_to),
+                    daemon=True,
+                ).start()
+        elif kind == "resize-complete":
+            if int(message.get("fetched", 0)) < 0:
+                # the peer's fetch raised: it acked but is missing
+                # fragments — exclude it as a query source until
+                # anti-entropy repairs it (the synchronous path's HTTP 500
+                # → DEGRADED signal, preserved across the async split)
+                node = self.nodes.get(message.get("node"))
+                if node is not None:
+                    node.state = STATE_DEGRADED
+            with self._resize_cv:
+                if message.get("job") == self._resize_job:
+                    self._resize_pending.discard(message.get("node"))
+                    self._resize_cv.notify_all()
+        elif kind == "resize-progress":
+            with self._resize_cv:
+                if message.get("job") == self._resize_job:
+                    # still alive and moving: push the straggler deadline
+                    self._resize_deadline = (
+                        time.monotonic() + self.RESIZE_COMPLETE_TIMEOUT
+                    )
         else:
             return {"error": f"unknown message type {kind!r}"}
         return {}
@@ -312,6 +369,7 @@ class Cluster:
             if self.nodes.pop(node_id, None) is None:
                 return
             self._heartbeat_failures.pop(node_id, None)
+        self._drop_resize_pending(node_id)
         for node in self.sorted_nodes():
             if node.id == self.local.id:
                 continue
@@ -401,9 +459,11 @@ class Cluster:
         finally:
             self.state = STATE_NORMAL
 
-    def fetch_fragments(self, sources: list[dict]) -> int:
+    def fetch_fragments(self, sources: list[dict], progress=None) -> int:
         """Execute the receiving half of resize instructions: fetch and
-        union each listed fragment from its source node."""
+        union each listed fragment from its source node. ``progress`` (if
+        given) is called after each fragment — the async resize job wires
+        it to rate-limited keepalives."""
         fetched = 0
         for src in sources:
             idx = self.holder.index(src["index"])
@@ -422,7 +482,47 @@ class Cluster:
             if data:
                 frag.import_roaring(data)
                 fetched += 1
+            if progress is not None:
+                progress()
         return fetched
+
+    # Min seconds between resize-progress keepalives during a long fetch.
+    RESIZE_PROGRESS_INTERVAL = 10.0
+
+    def _run_resize_job(self, sources: list[dict], job: str,
+                        reply_to: str | None) -> None:
+        """Receiver worker for an async resize instruction: fetch (with
+        per-fragment progress keepalives so the coordinator can tell a
+        large move from a dead peer), then report completion (reference
+        resize-job pattern — nodes fetch asynchronously and report,
+        SURVEY.md §3.5)."""
+        last_sent = time.monotonic()
+
+        def progress() -> None:
+            nonlocal last_sent
+            now = time.monotonic()
+            if reply_to and now - last_sent >= self.RESIZE_PROGRESS_INTERVAL:
+                last_sent = now
+                try:
+                    self.client.send_message(reply_to, {
+                        "type": "resize-progress", "job": job,
+                        "node": self.local.id,
+                    })
+                except ClientError:
+                    pass
+
+        try:
+            fetched = self.fetch_fragments(sources, progress=progress)
+        except Exception:
+            fetched = -1  # report anyway: the coordinator must not wait
+        if reply_to:
+            try:
+                self.client.send_message(reply_to, {
+                    "type": "resize-complete", "job": job,
+                    "node": self.local.id, "fetched": fetched,
+                })
+            except ClientError:
+                pass  # coordinator's straggler timeout covers lost acks
 
     def _spawn_resize(self) -> None:
         threading.Thread(target=self.coordinate_resize, daemon=True).start()
@@ -481,23 +581,55 @@ class Cluster:
             # under _resize_lock, so always safe.
             self._broadcast_state(STATE_NORMAL)
             return {}
+        job = uuid.uuid4().hex
+        with self._resize_cv:
+            self._resize_job = job
+            self._resize_pending = set()
+            self._resize_deadline = (
+                time.monotonic() + self.RESIZE_COMPLETE_TIMEOUT
+            )
         self._broadcast_state(STATE_RESIZING)
         try:
+            local_sources = None
             for node_id, sources in instructions.items():
                 if node_id == self.local.id:
-                    self.fetch_fragments(sources)
-                    continue
+                    local_sources = sources  # after the sends: peers
+                    continue                 # fetch concurrently with us
                 node = self.nodes.get(node_id)
                 if node is None:
                     continue
+                with self._resize_cv:
+                    self._resize_pending.add(node_id)
                 try:
                     self.client.send_message(
                         node.uri,
-                        {"type": "resize-instruction", "sources": sources},
+                        {"type": "resize-instruction", "sources": sources,
+                         "job": job, "reply_to": self.local.uri},
                     )
                 except ClientError:
+                    # failing the quick ack IS a health signal (unlike a
+                    # long fetch, which no longer holds this request open)
                     node.state = STATE_DEGRADED
+                    with self._resize_cv:
+                        self._resize_pending.discard(node_id)
+            if local_sources is not None:
+                self.fetch_fragments(local_sources)
+            # hold RESIZING (queries stay gated) until every peer reports
+            # its fetch done. The deadline distinguishes dead from slow:
+            # peers send resize-progress keepalives per fetched fragment,
+            # each pushing the deadline out — a large move stays gated to
+            # completion, while a silent straggler (died mid-fetch) is
+            # released to anti-entropy repair after one quiet timeout.
+            with self._resize_cv:
+                while self._resize_pending:
+                    remaining = self._resize_deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._resize_cv.wait(remaining)
         finally:
+            with self._resize_cv:
+                self._resize_job = None
+                self._resize_pending = set()
             self._broadcast_state(STATE_NORMAL)
         return instructions
 

@@ -575,3 +575,143 @@ class TestClusterRaces:
         finally:
             for s in servers:
                 s.close()
+
+    def test_async_resize_slow_fetch_gates_queries_no_degrade(self, tmp_path):
+        """A fetch slower than instruction delivery must not DEGRADE the
+        fetching node or un-gate queries mid-move: peers ack immediately,
+        fetch in a worker, and the coordinator holds RESIZING until the
+        resize-complete report (reference resize-job pattern)."""
+        import threading
+        import time as _time
+
+        servers = make_cluster(tmp_path, 2, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            coord = next(s for s in servers
+                         if s.api.cluster.is_acting_coordinator)
+            peer = next(s for s in servers if s is not coord)
+            # the fragment lives on the coordinator; the PEER is the owner
+            # that must fetch it, exercising the remote async job path
+            fc = coord.holder.index("i").field("f")
+            fragc = fc.view("standard", create=True).fragment(3, create=True)
+            fragc.bulk_import(np.asarray([2, 2], np.uint64),
+                              np.asarray([5, 9], np.uint64))
+            peer_cluster = peer.api.cluster
+
+            fetch_started = threading.Event()
+            release_fetch = threading.Event()
+            real_fetch = type(peer_cluster).fetch_fragments
+            states_during_fetch = []
+
+            def slow_fetch(self, sources, progress=None):
+                fetch_started.set()
+                assert release_fetch.wait(30)
+                return real_fetch(self, sources, progress=progress)
+
+            peer_cluster.fetch_fragments = slow_fetch.__get__(peer_cluster)
+            t = threading.Thread(
+                target=coord.api.cluster.coordinate_resize, daemon=True
+            )
+            t.start()
+            assert fetch_started.wait(30)
+            # mid-move: everyone still gated, nobody DEGRADED
+            _time.sleep(0.2)
+            states_during_fetch = [
+                coord.api.cluster.state,
+                next(n.state for n in coord.api.cluster.nodes.values()
+                     if n.id == peer_cluster.local.id),
+            ]
+            release_fetch.set()
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert states_during_fetch == ["RESIZING", "NORMAL"]
+            for s in servers:
+                assert s.api.cluster.state == "NORMAL"
+            frag0 = (peer.holder.index("i").field("f")
+                     .view("standard").fragment(3))
+            assert frag0 is not None and frag0.count() == 2
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_async_resize_straggler_timeout_ungates(self, tmp_path, monkeypatch):
+        """A peer that never reports completion (died mid-fetch) must not
+        gate the cluster forever: the coordinator's straggler timeout
+        releases it to anti-entropy repair."""
+        from pilosa_tpu.parallel.cluster import Cluster
+
+        monkeypatch.setattr(Cluster, "RESIZE_COMPLETE_TIMEOUT", 0.5)
+        servers = make_cluster(tmp_path, 2, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            coord = next(s for s in servers
+                         if s.api.cluster.is_acting_coordinator)
+            peer = next(s for s in servers if s is not coord)
+            fc = coord.holder.index("i").field("f")
+            fragc = fc.view("standard", create=True).fragment(3, create=True)
+            fragc.bulk_import(np.asarray([2], np.uint64),
+                              np.asarray([5], np.uint64))
+            # peer swallows the instruction: fetch never runs, no report
+            peer.api.cluster.fetch_fragments = lambda sources: 0
+            peer.api.cluster._run_resize_job = lambda *a, **k: None
+
+            coord.api.cluster.coordinate_resize()
+            for s in servers:
+                assert s.api.cluster.state == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_async_resize_progress_keepalive_outlives_timeout(self, tmp_path, monkeypatch):
+        """A move longer than the straggler timeout stays gated to
+        completion as long as the peer sends progress keepalives — the
+        timeout distinguishes dead from slow, not big from small."""
+        import threading
+        import time as _time
+
+        from pilosa_tpu.parallel.cluster import Cluster
+
+        monkeypatch.setattr(Cluster, "RESIZE_COMPLETE_TIMEOUT", 0.6)
+        monkeypatch.setattr(Cluster, "RESIZE_PROGRESS_INTERVAL", 0.0)
+        servers = make_cluster(tmp_path, 2, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            coord = next(s for s in servers
+                         if s.api.cluster.is_acting_coordinator)
+            peer = next(s for s in servers if s is not coord)
+            fc = coord.holder.index("i").field("f")
+            fragc = fc.view("standard", create=True).fragment(3, create=True)
+            fragc.bulk_import(np.asarray([2], np.uint64),
+                              np.asarray([5], np.uint64))
+
+            peer_cluster = peer.api.cluster
+            real_fetch = type(peer_cluster).fetch_fragments
+            fetch_done = threading.Event()
+
+            def long_fetch(self, sources, progress=None):
+                # 1.5s of "fetching", far past the 0.6s quiet timeout,
+                # with keepalives throughout
+                for _ in range(5):
+                    _time.sleep(0.3)
+                    if progress is not None:
+                        progress()
+                out = real_fetch(self, sources, progress=progress)
+                fetch_done.set()
+                return out
+
+            peer_cluster.fetch_fragments = long_fetch.__get__(peer_cluster)
+            coord.api.cluster.coordinate_resize()
+            # returned only AFTER the slow move finished (not released by
+            # the quiet timeout): the fetch completed and data landed
+            assert fetch_done.is_set()
+            frag = (peer.holder.index("i").field("f")
+                    .view("standard").fragment(3))
+            assert frag is not None and frag.count() == 1
+            for s in servers:
+                assert s.api.cluster.state == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
