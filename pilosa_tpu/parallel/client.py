@@ -2,8 +2,10 @@
 
 Reference: http/client.go InternalClient (SURVEY.md §2 #17) — remote
 query, routed imports, fragment block lists / block data for anti-entropy,
-fragment data for resize, schema fetch, cluster messages. JSON bodies
-(the reference uses protobuf; this wire is host-control-plane only).
+fragment data for resize, schema fetch, cluster messages. Data-plane hops
+(imports, query results, block repair) are binary — protobuf or roaring
+octet-stream — with per-peer JSON fallback on 406; control-plane messages
+stay JSON.
 """
 
 from __future__ import annotations
@@ -25,6 +27,10 @@ class InternalClient:
         owning server's config so one skip-verify server can't disable
         certificate verification for other servers in the same process."""
         self.timeout = timeout
+        # peers that answered 406 to a protobuf hop: a mixed-capability
+        # cluster (one node without the protobuf runtime) falls back to
+        # JSON per peer instead of failing every internal request
+        self._json_only_peers: set[str] = set()
         self._ssl_context: ssl.SSLContext | None = None
         if insecure_tls:
             ctx = ssl.create_default_context()
@@ -34,11 +40,23 @@ class InternalClient:
 
     # -------------------------------------------------------------- helpers
 
+    def _proto_ok(self, uri: str) -> bool:
+        from pilosa_tpu import wire
+
+        return wire.available() and uri not in self._json_only_peers
+
+    @staticmethod
+    def _is_406(err: "ClientError") -> bool:
+        return "HTTP 406" in str(err)
+
     def _call(self, method: str, url: str, body: bytes | None = None,
-              content_type: str = "application/json", raw: bool = False):
+              content_type: str = "application/json", raw: bool = False,
+              accept: str | None = None):
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        if accept is not None:
+            req.add_header("Accept", accept)
         try:
             with urllib.request.urlopen(
                 req, timeout=self.timeout, context=self._ssl_context
@@ -56,35 +74,100 @@ class InternalClient:
     def query_node(self, uri: str, index: str, pql: str, shards: list[int],
                    remote: bool = True) -> dict:
         """One sub-query carrying an explicit shard list (reference
-        QueryRequest{Remote: true, Shards: [...]} — SURVEY.md §3.2)."""
+        QueryRequest{Remote: true, Shards: [...]} — SURVEY.md §3.2).
+
+        Negotiates a protobuf response (Accept: x-protobuf) so remote row
+        results travel as varint-packed column ids instead of JSON int
+        lists; decoded to the same dict shapes either way. A peer whose
+        wire lacks protobuf answers 406 once, then gets JSON."""
         qs = f"?shards={','.join(map(str, shards))}"
         if remote:
             qs += "&remote=true"
-        return self._call(
-            "POST", f"{uri}/index/{index}/query{qs}", pql.encode(),
-            content_type="text/plain",
-        )
+        url = f"{uri}/index/{index}/query{qs}"
+        if self._proto_ok(uri):
+            from pilosa_tpu.wire.serializer import decode_results_json
+
+            try:
+                raw = self._call(
+                    "POST", url, pql.encode(), content_type="text/plain",
+                    raw=True, accept="application/x-protobuf",
+                )
+            except ClientError as e:
+                if not self._is_406(e):
+                    raise
+                self._json_only_peers.add(uri)
+            else:
+                out = decode_results_json(raw)
+                if "error" in out:
+                    raise ClientError(f"POST {url}: {out['error']}")
+                return out
+        return self._call("POST", url, pql.encode(),
+                          content_type="text/plain")
 
     # --------------------------------------------------------------- import
 
     def import_bits(self, uri: str, index: str, field: str, rows, columns,
                     timestamps=None, clear: bool = False) -> int:
+        """Routed bit import. Protobuf body when both ends speak it
+        (the reference's internal hops are all protobuf — SURVEY.md §2
+        #16-17: varint-packed ids, ~2-5x smaller than JSON int lists);
+        JSON fallback otherwise, including on a peer's 406."""
+        url = f"{uri}/index/{index}/field/{field}/import?remote=true"
+        if self._proto_ok(uri):
+            from pilosa_tpu.wire.serializer import encode_import_request
+
+            body = encode_import_request(index, field, rows, columns,
+                                         timestamps=timestamps, clear=clear)
+            try:
+                out = self._call("POST", url, body,
+                                 content_type="application/x-protobuf")
+                return out.get("changed", 0)
+            except ClientError as e:
+                if not self._is_406(e):
+                    raise
+                self._json_only_peers.add(uri)
         payload: dict = {"rows": list(map(int, rows)),
-                         "columns": list(map(int, columns)), "clear": clear}
+                         "columns": list(map(int, columns)),
+                         "clear": clear}
         if timestamps is not None:
             payload["timestamps"] = timestamps
-        out = self._call(
-            "POST", f"{uri}/index/{index}/field/{field}/import?remote=true",
-            json.dumps(payload).encode(),
-        )
+        out = self._call("POST", url, json.dumps(payload).encode())
         return out.get("changed", 0)
 
     def import_values(self, uri: str, index: str, field: str, columns, values,
                       clear: bool = False) -> int:
+        url = f"{uri}/index/{index}/field/{field}/import-value?remote=true"
+        if self._proto_ok(uri):
+            from pilosa_tpu.wire.serializer import (
+                encode_import_value_request,
+            )
+
+            body = encode_import_value_request(index, field, columns, values,
+                                               clear=clear)
+            try:
+                out = self._call("POST", url, body,
+                                 content_type="application/x-protobuf")
+                return out.get("changed", 0)
+            except ClientError as e:
+                if not self._is_406(e):
+                    raise
+                self._json_only_peers.add(uri)
         out = self._call(
-            "POST", f"{uri}/index/{index}/field/{field}/import-value?remote=true",
+            "POST", url,
             json.dumps({"columns": list(map(int, columns)),
-                        "values": list(map(int, values)), "clear": clear}).encode(),
+                        "values": list(map(int, values)),
+                        "clear": clear}).encode(),
+        )
+        return out.get("changed", 0)
+
+    def import_roaring(self, uri: str, index: str, field: str, shard: int,
+                       data: bytes) -> int:
+        """Whole-shard roaring body (O(bitmap bytes) on the wire): the
+        bulk path for routed set-bit imports."""
+        out = self._call(
+            "POST",
+            f"{uri}/index/{index}/field/{field}/import-roaring/{shard}",
+            data, content_type="application/octet-stream",
         )
         return out.get("changed", 0)
 

@@ -272,18 +272,20 @@ class Cluster:
                     daemon=True,
                 ).start()
         elif kind == "resize-complete":
-            if int(message.get("fetched", 0)) < 0:
-                # the peer's fetch raised: it acked but is missing
-                # fragments — exclude it as a query source until
+            with self._resize_cv:
+                current = message.get("job") == self._resize_job
+                if current:
+                    self._resize_pending.discard(message.get("node"))
+                    self._resize_cv.notify_all()
+            if current and int(message.get("fetched", 0)) < 0:
+                # the CURRENT job's peer fetch raised: it acked but is
+                # missing fragments — exclude it as a query source until
                 # anti-entropy repairs it (the synchronous path's HTTP 500
-                # → DEGRADED signal, preserved across the async split)
+                # → DEGRADED signal, preserved across the async split).
+                # Stale reports from superseded jobs are ignored.
                 node = self.nodes.get(message.get("node"))
                 if node is not None:
                     node.state = STATE_DEGRADED
-            with self._resize_cv:
-                if message.get("job") == self._resize_job:
-                    self._resize_pending.discard(message.get("node"))
-                    self._resize_cv.notify_all()
         elif kind == "resize-progress":
             with self._resize_cv:
                 if message.get("job") == self._resize_job:
@@ -459,11 +461,9 @@ class Cluster:
         finally:
             self.state = STATE_NORMAL
 
-    def fetch_fragments(self, sources: list[dict], progress=None) -> int:
+    def fetch_fragments(self, sources: list[dict]) -> int:
         """Execute the receiving half of resize instructions: fetch and
-        union each listed fragment from its source node. ``progress`` (if
-        given) is called after each fragment — the async resize job wires
-        it to rate-limited keepalives."""
+        union each listed fragment from its source node."""
         fetched = 0
         for src in sources:
             idx = self.holder.index(src["index"])
@@ -482,27 +482,23 @@ class Cluster:
             if data:
                 frag.import_roaring(data)
                 fetched += 1
-            if progress is not None:
-                progress()
         return fetched
 
-    # Min seconds between resize-progress keepalives during a long fetch.
+    # Seconds between resize-progress keepalives while a fetch runs.
     RESIZE_PROGRESS_INTERVAL = 10.0
 
     def _run_resize_job(self, sources: list[dict], job: str,
                         reply_to: str | None) -> None:
-        """Receiver worker for an async resize instruction: fetch (with
-        per-fragment progress keepalives so the coordinator can tell a
-        large move from a dead peer), then report completion (reference
-        resize-job pattern — nodes fetch asynchronously and report,
-        SURVEY.md §3.5)."""
-        last_sent = time.monotonic()
+        """Receiver worker for an async resize instruction: fetch, with a
+        timer thread sending progress keepalives for as long as the fetch
+        runs — wall-clock-based, not per-fragment, so one huge fragment
+        cannot outlast the coordinator's quiet deadline silently — then
+        report completion (reference resize-job pattern — nodes fetch
+        asynchronously and report, SURVEY.md §3.5)."""
+        done = threading.Event()
 
-        def progress() -> None:
-            nonlocal last_sent
-            now = time.monotonic()
-            if reply_to and now - last_sent >= self.RESIZE_PROGRESS_INTERVAL:
-                last_sent = now
+        def keepalive() -> None:
+            while not done.wait(self.RESIZE_PROGRESS_INTERVAL):
                 try:
                     self.client.send_message(reply_to, {
                         "type": "resize-progress", "job": job,
@@ -511,10 +507,18 @@ class Cluster:
                 except ClientError:
                     pass
 
+        ka = None
+        if reply_to:
+            ka = threading.Thread(target=keepalive, daemon=True)
+            ka.start()
         try:
-            fetched = self.fetch_fragments(sources, progress=progress)
+            fetched = self.fetch_fragments(sources)
         except Exception:
             fetched = -1  # report anyway: the coordinator must not wait
+        finally:
+            done.set()
+        if ka is not None:
+            ka.join(timeout=5)
         if reply_to:
             try:
                 self.client.send_message(reply_to, {

@@ -15,7 +15,7 @@ import numpy as np
 from pilosa_tpu import __version__
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.executor.result import result_to_json
-from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, shard_groups
 from pilosa_tpu.storage import FieldOptions, Holder
 from pilosa_tpu.storage.field import TYPE_INT, TYPE_TIME
 from pilosa_tpu.storage.view import VIEW_STANDARD
@@ -151,31 +151,29 @@ class API:
         cluster, each shard group is routed to every replica owner."""
         idx = self._index(index)
         fld = self._field(idx, field)
+        # validate BEFORE routing: the roaring bulk route ships pre-built
+        # bitmaps that the receiving end cannot re-validate, so bad input
+        # must 400 here, not corrupt or 500 downstream
+        rows_i = np.asarray(rows, dtype=np.int64)
+        columns_i = np.asarray(columns, dtype=np.int64)
+        if rows_i.shape != columns_i.shape:
+            raise ApiError("rows and columns must be the same length")
+        if rows_i.size and (rows_i.min() < 0 or columns_i.min() < 0):
+            raise ApiError("rows and columns must be non-negative")
         if not remote and self.cluster is not None and len(self.cluster.nodes) > 1:
             return self._route_import(
                 index, field, rows, columns, timestamps, clear, values=None
             )
-        rows_i = np.asarray(rows, dtype=np.int64)
-        columns_i = np.asarray(columns, dtype=np.int64)
-        if rows_i.size and (rows_i.min() < 0 or columns_i.min() < 0):
-            raise ApiError("rows and columns must be non-negative")
         rows = rows_i.astype(np.uint64)
         columns = columns_i.astype(np.uint64)
-        if rows.shape != columns.shape:
-            raise ApiError("rows and columns must be the same length")
         if timestamps is not None and len(timestamps) != rows.size:
             raise ApiError("timestamps must match rows length")
         if rows.size == 0:
             return 0
         changed = 0
-        shards = (columns >> np.uint64(SHARD_WIDTH_EXP)).astype(np.int64)
-        order = np.argsort(shards, kind="stable")
+        order, boundaries, shards_sorted = shard_groups(columns)
         rows, columns = rows[order], columns[order]
-        shards_sorted = shards[order]
         ts_sorted = [timestamps[i] for i in order] if timestamps is not None else None
-        boundaries = np.concatenate(
-            ([0], np.nonzero(np.diff(shards_sorted))[0] + 1, [rows.size])
-        )
         for i in range(boundaries.size - 1):
             lo, hi = int(boundaries[i]), int(boundaries[i + 1])
             shard = int(shards_sorted[lo])
@@ -235,7 +233,16 @@ class API:
                     timestamps=pick(list(timestamps), li) if timestamps else None,
                     clear=clear, remote=True,
                 )
+            bulk_roaring = timestamps is None and not clear
             for node, idxs in remote_batches.values():
+                if bulk_roaring:
+                    # plain set-bit batches ship as per-shard roaring
+                    # bodies — O(bitmap bytes) on the wire (the import-
+                    # roaring endpoint already unions + tracks existence)
+                    changed += self._send_roaring_batch(
+                        node, index, field, rows, columns_arr, shards, idxs
+                    )
+                    continue
                 changed += self.cluster.client.import_bits(
                     node.uri, index, field,
                     pick(list(rows), idxs), pick(list(columns), idxs),
@@ -255,6 +262,30 @@ class API:
                     pick(list(columns), idxs), pick(list(values), idxs),
                     clear=clear,
                 )
+        return changed
+
+    def _send_roaring_batch(self, node, index, field, rows, columns_arr,
+                            shards, idxs) -> int:
+        """Ship one node's slice of a routed set-bit import as per-shard
+        roaring bodies (fragment id space: row * SHARD_WIDTH + position)."""
+        import numpy as np
+
+        from pilosa_tpu.roaring import RoaringBitmap
+        from pilosa_tpu.roaring.format import serialize
+
+        idxs = np.asarray(idxs, np.int64)
+        rows_arr = np.asarray(list(rows), np.uint64)[idxs]
+        cols = columns_arr[idxs]
+        node_shards = np.asarray(shards)[idxs]
+        changed = 0
+        for shard in np.unique(node_shards).tolist():
+            sel = node_shards == shard
+            ids = (rows_arr[sel] * np.uint64(SHARD_WIDTH)
+                   + (cols[sel].astype(np.uint64) & np.uint64(SHARD_WIDTH - 1)))
+            data = serialize(RoaringBitmap.from_ids(np.unique(ids)))
+            changed += self.cluster.client.import_roaring(
+                node.uri, index, field, int(shard), data
+            )
         return changed
 
     def import_values(self, index: str, field: str, columns, values,
@@ -389,6 +420,9 @@ class API:
 
 
 def _parse_ts(value):
+    if value is None or value == "":
+        # protobuf import bodies encode a missing per-bit timestamp as ""
+        return None
     if isinstance(value, dt.datetime):
         return value
     return dt.datetime.fromisoformat(str(value))

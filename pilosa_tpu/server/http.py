@@ -214,6 +214,10 @@ class HTTPHandler(BaseHTTPRequestHandler):
     def post_import(self, index, field, query=None):
         remote = bool(query and query.get("remote", ["false"])[0] == "true")
         if "application/x-protobuf" in self.headers.get("Content-Type", ""):
+            from pilosa_tpu import wire
+
+            if not wire.available():
+                raise ApiError("protobuf wire format unavailable", 406)
             from pilosa_tpu.wire.serializer import decode_import_request
 
             rows, columns, timestamps, clear = decode_import_request(self._body())
@@ -231,6 +235,10 @@ class HTTPHandler(BaseHTTPRequestHandler):
     def post_import_value(self, index, field, query=None):
         remote = bool(query and query.get("remote", ["false"])[0] == "true")
         if "application/x-protobuf" in self.headers.get("Content-Type", ""):
+            from pilosa_tpu import wire
+
+            if not wire.available():
+                raise ApiError("protobuf wire format unavailable", 406)
             from pilosa_tpu.wire.serializer import decode_import_value_request
 
             columns, values, clear = decode_import_value_request(self._body())

@@ -28,3 +28,24 @@ def next_pow2(n: int) -> int:
     """Smallest power of two >= n (>= 1). Shared padding/bucketing rule for
     compiled-shape axes (shard blocks, GroupBy chunks, compressed blocks)."""
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def shard_groups(columns):
+    """Group absolute column ids by shard for bulk writes.
+
+    Returns (order, bounds, shards_sorted): ``order`` is the stable
+    argsort of the shard of each column; ``bounds[i]:bounds[i+1]`` slices
+    ``order``-permuted arrays to the rows of shard ``shards_sorted[bounds
+    [i]]``. One implementation of the argsort/diff boundary walk shared
+    by every import path (api.import_bits, Index.mark_columns_exist).
+    """
+    import numpy as np
+
+    cols = np.asarray(columns, np.uint64)
+    shards = (cols >> np.uint64(SHARD_WIDTH_EXP)).astype(np.int64)
+    order = np.argsort(shards, kind="stable")
+    shards_sorted = shards[order]
+    bounds = np.concatenate(
+        ([0], np.nonzero(np.diff(shards_sorted))[0] + 1, [cols.size])
+    )
+    return order, bounds, shards_sorted

@@ -12,7 +12,7 @@ import json
 import os
 import shutil
 
-from pilosa_tpu.shardwidth import position, shard_of
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, position, shard_of
 from pilosa_tpu.storage.field import Field, FieldOptions, TYPE_SET
 from pilosa_tpu.storage.view import VIEW_STANDARD
 
@@ -96,11 +96,30 @@ class Index:
     # ------------------------------------------------------------- existence
 
     def mark_columns_exist(self, columns) -> None:
+        """Set row 0 of the _exists field for every column. Bulk-imports
+        per shard: bulk writes mark hundreds of thousands of columns, and
+        a per-column set_bit loop (op-log append each) dominates the
+        whole import at that scale."""
         if not self.track_existence:
             return
+        import numpy as np
+
+        from pilosa_tpu.shardwidth import shard_groups
+
+        cols = np.asarray(list(columns), np.uint64)
+        if cols.size == 0:
+            return
         ex = self.fields[EXISTENCE_FIELD]
-        for col in columns:
-            ex.set_bit(0, int(col))
+        view = ex.view(VIEW_STANDARD, create=True)
+        order, bounds, shards_sorted = shard_groups(cols)
+        cols = cols[order]
+        zeros = np.zeros(cols.size, np.uint64)
+        for i in range(bounds.size - 1):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            frag = view.fragment(int(shards_sorted[lo]), create=True)
+            frag.bulk_import(
+                zeros[lo:hi], cols[lo:hi] & np.uint64(SHARD_WIDTH - 1)
+            )
 
     def existence_fragment(self, shard: int):
         if not self.track_existence:

@@ -141,3 +141,87 @@ def decode_import_value_request(data: bytes):
     req = p.ImportValueRequest()
     req.ParseFromString(data)
     return list(req.column_ids), list(req.values), req.clear
+
+
+# ------------------------------------------------------- request encoders
+#
+# The internal client's side of the negotiated wire (reference: every
+# node-to-node hop is protobuf — SURVEY.md §2 #16-17). Varint-packed id
+# lists are ~2-5x smaller than JSON int lists; bulk set-bit imports go
+# smaller still via the octet-stream roaring path (api._route_import).
+
+
+def encode_import_request(index: str, field: str, rows, columns,
+                          timestamps=None, clear: bool = False) -> bytes:
+    p = pb2()
+    req = p.ImportRequest()
+    req.index, req.field, req.clear = index, field, clear
+    req.row_ids.extend(int(r) for r in rows)
+    req.column_ids.extend(int(c) for c in columns)
+    if timestamps is not None:
+        req.timestamps.extend("" if t is None else str(t) for t in timestamps)
+    return req.SerializeToString()
+
+
+def encode_import_value_request(index: str, field: str, columns, values,
+                                clear: bool = False) -> bytes:
+    p = pb2()
+    req = p.ImportValueRequest()
+    req.index, req.field, req.clear = index, field, clear
+    req.column_ids.extend(int(c) for c in columns)
+    req.values.extend(int(v) for v in values)
+    return req.SerializeToString()
+
+
+def decode_results_json(data: bytes) -> dict:
+    """Parse a QueryResponse into the SAME dict shapes the JSON surface
+    emits (executor/result.py to_json), so callers reduce remote partials
+    identically whichever encoding the hop negotiated."""
+    p = pb2()
+    resp = p.QueryResponse()
+    resp.ParseFromString(data)
+    if resp.err:
+        return {"error": resp.err}
+    out = []
+    for qr in resp.results:
+        t = qr.type
+        if t == RESULT_ROW:
+            row: dict = {"attrs": attrs_from_proto(qr.row.attrs)}
+            if qr.row.keys:
+                row["keys"] = list(qr.row.keys)
+            else:
+                row["columns"] = list(qr.row.columns)
+            out.append(row)
+        elif t == RESULT_PAIRS:
+            out.append([
+                {"id": pp.id, "count": pp.count, **({"key": pp.key} if pp.key else {})}
+                for pp in qr.pairs
+            ])
+        elif t == RESULT_COUNT:
+            out.append(int(qr.n))
+        elif t == RESULT_CHANGED:
+            out.append(bool(qr.changed))
+        elif t == RESULT_VALCOUNT:
+            out.append({"value": qr.val_count.value, "count": qr.val_count.count})
+        elif t == RESULT_GROUPS:
+            groups = []
+            for gg in qr.groups:
+                g: dict = {
+                    "group": [
+                        {"field": fr.field, "rowKey": fr.row_key}
+                        if fr.row_key else {"field": fr.field, "rowID": fr.row_id}
+                        for fr in gg.group
+                    ],
+                    "count": gg.count,
+                }
+                if gg.has_sum:
+                    g["sum"] = gg.sum
+                groups.append(g)
+            out.append(groups)
+        elif t == RESULT_ROW_IDS:
+            out.append(list(qr.row_ids))
+        elif t == RESULT_ROW_KEYS:
+            out.append(list(qr.row_keys))
+        else:
+            out.append(None)
+    return {"results": out}

@@ -604,10 +604,10 @@ class TestClusterRaces:
             real_fetch = type(peer_cluster).fetch_fragments
             states_during_fetch = []
 
-            def slow_fetch(self, sources, progress=None):
+            def slow_fetch(self, sources):
                 fetch_started.set()
                 assert release_fetch.wait(30)
-                return real_fetch(self, sources, progress=progress)
+                return real_fetch(self, sources)
 
             peer_cluster.fetch_fragments = slow_fetch.__get__(peer_cluster)
             t = threading.Thread(
@@ -674,7 +674,7 @@ class TestClusterRaces:
         from pilosa_tpu.parallel.cluster import Cluster
 
         monkeypatch.setattr(Cluster, "RESIZE_COMPLETE_TIMEOUT", 0.6)
-        monkeypatch.setattr(Cluster, "RESIZE_PROGRESS_INTERVAL", 0.0)
+        monkeypatch.setattr(Cluster, "RESIZE_PROGRESS_INTERVAL", 0.2)
         servers = make_cluster(tmp_path, 2, replica_n=2)
         try:
             req("POST", f"{uri(servers[0])}/index/i", {})
@@ -691,14 +691,11 @@ class TestClusterRaces:
             real_fetch = type(peer_cluster).fetch_fragments
             fetch_done = threading.Event()
 
-            def long_fetch(self, sources, progress=None):
-                # 1.5s of "fetching", far past the 0.6s quiet timeout,
-                # with keepalives throughout
-                for _ in range(5):
-                    _time.sleep(0.3)
-                    if progress is not None:
-                        progress()
-                out = real_fetch(self, sources, progress=progress)
+            def long_fetch(self, sources):
+                # 1.5s of "fetching", far past the 0.6s quiet timeout;
+                # the worker's timer thread keeps sending progress
+                _time.sleep(1.5)
+                out = real_fetch(self, sources)
                 fetch_done.set()
                 return out
 
@@ -712,6 +709,83 @@ class TestClusterRaces:
             assert frag is not None and frag.count() == 1
             for s in servers:
                 assert s.api.cluster.state == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestBinaryInternalWire:
+    def test_routed_bulk_import_transfers_bitmap_bytes(self, tmp_path):
+        """A routed set-bit import ships per-shard roaring bodies: the
+        bytes on the wire are O(bitmap bytes), not JSON int lists
+        (reference: every internal hop is protobuf — SURVEY.md §2 #16-17)."""
+        servers = make_cluster(tmp_path, 2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            sent = []
+            for s in servers:
+                client = s.api.cluster.client
+                real_call = client._call
+
+                def spy(method, url, body=None, _real=real_call, **kw):
+                    if body is not None:
+                        sent.append((url, len(body)))
+                    return _real(method, url, body, **kw)
+
+                client._call = spy
+            # 2^17 contiguous bits in each of two shards via ONE node:
+            # at least one shard's slice routes to the other node
+            n = 1 << 17
+            cols = list(range(n)) + [SHARD_WIDTH + c for c in range(n)]
+            body = {"rows": [1] * len(cols), "columns": cols}
+            req("POST", f"{uri(servers[0])}/index/i/field/f/import", body)
+            out = req("POST", f"{uri(servers[0])}/index/i/query",
+                      b"Count(Row(f=1))")
+            assert out["results"] == [2 * n]
+            routed = [(u, sz) for u, sz in sent if "import-roaring" in u]
+            assert routed, sent
+            total = sum(sz for _, sz in routed)
+            # run-encoded roaring: a few hundred bytes for 131k contiguous
+            # bits; JSON int lists would be ~1.3 MB. Bound generously.
+            assert total < 16 * 1024, (total, routed)
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_remote_row_results_negotiate_protobuf(self, tmp_path):
+        """Remote Row() partials come back as protobuf (varint-packed
+        columns), decoded to the same shapes the JSON path yields."""
+        import pytest as _pytest
+
+        from pilosa_tpu import wire
+
+        if not wire.available():
+            _pytest.skip("protoc/protobuf runtime unavailable")
+        servers = make_cluster(tmp_path, 2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + c for s in range(4) for c in range(50)]
+            req("POST", f"{uri(servers[0])}/index/i/field/f/import",
+                {"rows": [1] * len(cols), "columns": cols})
+            seen_accept = []
+            for s in servers:
+                client = s.api.cluster.client
+                real_call = client._call
+
+                def spy(method, url, body=None, _real=real_call, **kw):
+                    if "/query" in url:
+                        seen_accept.append(kw.get("accept"))
+                    return _real(method, url, body, **kw)
+
+                client._call = spy
+            # query via BOTH nodes: whatever the shard ownership split,
+            # at least one of the two must fan out remotely
+            for s in servers:
+                out = req("POST", f"{uri(s)}/index/i/query", b"Row(f=1)")
+                assert out["results"][0]["columns"] == sorted(cols)
+            assert "application/x-protobuf" in seen_accept
         finally:
             for s in servers:
                 s.close()
