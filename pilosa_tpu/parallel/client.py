@@ -63,7 +63,18 @@ class InternalClient:
             ) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
+            body = e.read()
+            if "x-protobuf" in (e.headers.get("Content-Type") or ""):
+                # protobuf-negotiated error body: surface the readable
+                # QueryResponse.err, not raw tag/length bytes
+                try:
+                    from pilosa_tpu.wire.serializer import decode_results_json
+
+                    detail = decode_results_json(body).get("error", "")
+                except Exception:
+                    detail = body.decode(errors="replace")
+            else:
+                detail = body.decode(errors="replace")
             raise ClientError(f"{method} {url}: HTTP {e.code}: {detail}") from e
         except urllib.error.URLError as e:
             raise ClientError(f"{method} {url}: {e.reason}") from e

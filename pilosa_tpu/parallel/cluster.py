@@ -273,19 +273,20 @@ class Cluster:
                 ).start()
         elif kind == "resize-complete":
             with self._resize_cv:
-                current = message.get("job") == self._resize_job
-                if current:
+                if message.get("job") == self._resize_job:
+                    if int(message.get("fetched", 0)) < 0:
+                        # the CURRENT job's peer fetch raised: it acked
+                        # but is missing fragments — mark it DEGRADED
+                        # BEFORE the notify wakes the coordinator, so
+                        # queries can't route to it in the window between
+                        # un-gating and the mark (stale reports from
+                        # superseded jobs are ignored; anti-entropy
+                        # repairs and the next heartbeat restores it)
+                        node = self.nodes.get(message.get("node"))
+                        if node is not None:
+                            node.state = STATE_DEGRADED
                     self._resize_pending.discard(message.get("node"))
                     self._resize_cv.notify_all()
-            if current and int(message.get("fetched", 0)) < 0:
-                # the CURRENT job's peer fetch raised: it acked but is
-                # missing fragments — exclude it as a query source until
-                # anti-entropy repairs it (the synchronous path's HTTP 500
-                # → DEGRADED signal, preserved across the async split).
-                # Stale reports from superseded jobs are ignored.
-                node = self.nodes.get(message.get("node"))
-                if node is not None:
-                    node.state = STATE_DEGRADED
         elif kind == "resize-progress":
             with self._resize_cv:
                 if message.get("job") == self._resize_job:

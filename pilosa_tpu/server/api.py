@@ -160,14 +160,14 @@ class API:
             raise ApiError("rows and columns must be the same length")
         if rows_i.size and (rows_i.min() < 0 or columns_i.min() < 0):
             raise ApiError("rows and columns must be non-negative")
+        if timestamps is not None and len(timestamps) != rows_i.size:
+            raise ApiError("timestamps must match rows length")
         if not remote and self.cluster is not None and len(self.cluster.nodes) > 1:
             return self._route_import(
                 index, field, rows, columns, timestamps, clear, values=None
             )
         rows = rows_i.astype(np.uint64)
         columns = columns_i.astype(np.uint64)
-        if timestamps is not None and len(timestamps) != rows.size:
-            raise ApiError("timestamps must match rows length")
         if rows.size == 0:
             return 0
         changed = 0
@@ -195,7 +195,7 @@ class API:
                             timestamp=_parse_ts(ts),
                         )
         if not clear:
-            idx.mark_columns_exist(columns.tolist())
+            idx.mark_columns_exist(columns)
             if self.cluster is not None:
                 self.cluster.note_local_shards(
                     index, np.unique(shards_sorted).tolist()
@@ -275,16 +275,17 @@ class API:
 
         idxs = np.asarray(idxs, np.int64)
         rows_arr = np.asarray(list(rows), np.uint64)[idxs]
-        cols = columns_arr[idxs]
-        node_shards = np.asarray(shards)[idxs]
+        cols = columns_arr[idxs].astype(np.uint64)
+        order, bounds, shards_sorted = shard_groups(cols)
+        rows_arr, cols = rows_arr[order], cols[order]
         changed = 0
-        for shard in np.unique(node_shards).tolist():
-            sel = node_shards == shard
-            ids = (rows_arr[sel] * np.uint64(SHARD_WIDTH)
-                   + (cols[sel].astype(np.uint64) & np.uint64(SHARD_WIDTH - 1)))
+        for i in range(bounds.size - 1):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            ids = (rows_arr[lo:hi] * np.uint64(SHARD_WIDTH)
+                   + (cols[lo:hi] & np.uint64(SHARD_WIDTH - 1)))
             data = serialize(RoaringBitmap.from_ids(np.unique(ids)))
             changed += self.cluster.client.import_roaring(
-                node.uri, index, field, int(shard), data
+                node.uri, index, field, int(shards_sorted[lo]), data
             )
         return changed
 

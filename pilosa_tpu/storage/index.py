@@ -106,7 +106,7 @@ class Index:
 
         from pilosa_tpu.shardwidth import shard_groups
 
-        cols = np.asarray(list(columns), np.uint64)
+        cols = np.asarray(columns, np.uint64)
         if cols.size == 0:
             return
         ex = self.fields[EXISTENCE_FIELD]

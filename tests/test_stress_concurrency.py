@@ -1,0 +1,131 @@
+"""Mixed-workload concurrency stress (SURVEY.md §4: the reference runs
+its suite under -race; CPython's races surface as torn state, dropped
+patches, or RuntimeErrors instead of sanitizer reports).
+
+One holder takes concurrent writers + queries + anti-entropy + snapshots
+for a couple of seconds; every thread's exception fails the test, and
+the final state must exactly match the write oracle on both replicas.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.server import Server, ServerConfig
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+N_WRITERS = 3
+BATCHES_PER_WRITER = 12
+BITS_PER_BATCH = 200
+
+
+def req(method, url, body=None, ct="application/json"):
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        r.add_header("Content-Type", ct)
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+@pytest.fixture
+def cluster2(tmp_path):
+    servers = []
+    for i in range(2):
+        seeds = [f"http://localhost:{servers[0].port}"] if servers else []
+        servers.append(Server(ServerConfig(
+            data_dir=str(tmp_path / f"node{i}"), port=0, name=f"n{i}",
+            replica_n=2, seeds=seeds, anti_entropy_interval=0,
+            heartbeat_interval=0, use_mesh=False,
+        )).open())
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def test_writers_queries_antientropy_snapshot(cluster2):
+    servers = cluster2
+    base = [f"http://localhost:{s.port}" for s in servers]
+    req("POST", f"{base[0]}/index/i", {})
+    req("POST", f"{base[0]}/index/i/field/f", {})
+
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 - collect everything
+                errors.append(e)
+                stop.set()
+        return run
+
+    # disjoint column ranges per (writer, batch): the oracle is exact
+    def writer(w: int):
+        def go():
+            rng = np.random.default_rng(w)
+            for b in range(BATCHES_PER_WRITER):
+                lo = (w * BATCHES_PER_WRITER + b) * BITS_PER_BATCH
+                cols = [int(c) for c in
+                        rng.permutation(np.arange(lo, lo + BITS_PER_BATCH))]
+                # spread across two shards to hit two fragments
+                cols = [c if c % 2 else c + SHARD_WIDTH for c in cols]
+                req("POST", f"{base[b % 2]}/index/i/field/f/import",
+                    {"rows": [1] * len(cols), "columns": cols})
+                if stop.is_set():
+                    return
+        return go
+
+    def querier():
+        last = 0
+        while not stop.is_set():
+            out = req("POST", f"{base[0]}/index/i/query",
+                      b"Count(Row(f=1))", "text/plain")
+            n = out["results"][0]
+            # bits are only added: the count must never go backwards
+            assert n >= last, (n, last)
+            last = n
+            req("POST", f"{base[1]}/index/i/query", b"TopN(f, n=4)",
+                "text/plain")
+
+    def anti_entropy():
+        while not stop.is_set():
+            for s in servers:
+                s.api.cluster.sync_holder()
+
+    def snapshotter():
+        while not stop.is_set():
+            for s in servers:
+                idx = s.holder.index("i")
+                field = idx.field("f") if idx else None
+                view = field.view("standard") if field else None
+                if view is None:
+                    continue
+                for frag in list(view.fragments.values()):
+                    frag.snapshot()
+
+    writers = [threading.Thread(target=guard(writer(w))) for w in range(N_WRITERS)]
+    aux = [threading.Thread(target=guard(fn), daemon=True)
+           for fn in (querier, anti_entropy, snapshotter)]
+    for t in writers + aux:
+        t.start()
+    for t in writers:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    stop.set()
+    for t in aux:
+        t.join(timeout=30)
+    assert not errors, errors[0]
+
+    # exact final state on both replicas (one quiescent sync first)
+    for s in servers:
+        s.api.cluster.sync_holder()
+    want = N_WRITERS * BATCHES_PER_WRITER * BITS_PER_BATCH
+    for b in base:
+        out = req("POST", f"{b}/index/i/query", b"Count(Row(f=1))",
+                  "text/plain")
+        assert out["results"] == [want]
