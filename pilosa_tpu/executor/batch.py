@@ -348,9 +348,18 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
     key = ("stackm", idx.name, field_name, view_name, tuple(row_ids),
            block.key())
 
+    def live_view():
+        # resolve by NAME at decode time, never through the captured
+        # object: a delete_field racing the build must read the live
+        # schema (None / the recreated field), not a dead view's bitmap
+        field = idx.field(field_name)
+        return field.view(view_name) if field and view_name else None
+
     def decode():
+        v = live_view()
+
         def per_shard(shard):
-            frag = view.fragment(shard) if view else None
+            frag = v.fragment(shard) if v else None
             if frag is None:
                 return np.zeros((len(row_ids), WORDS_PER_SHARD), np.uint32)
             return np.stack([frag.row_words(r) for r in row_ids])
@@ -358,7 +367,8 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
         return block.stack(per_shard, inner=(len(row_ids), WORDS_PER_SHARD))
 
     def decode_row(ev):
-        frag = view.fragment(ev.shard) if view else None
+        v = live_view()
+        frag = v.fragment(ev.shard) if v else None
         if frag is None:
             return np.zeros(WORDS_PER_SHARD, np.uint32)
         return frag.row_words(ev.row)

@@ -240,7 +240,7 @@ class API:
                     # bodies — O(bitmap bytes) on the wire (the import-
                     # roaring endpoint already unions + tracks existence)
                     changed += self._send_roaring_batch(
-                        node, index, field, rows, columns_arr, shards, idxs
+                        node, index, field, rows, columns_arr, idxs
                     )
                     continue
                 changed += self.cluster.client.import_bits(
@@ -265,7 +265,7 @@ class API:
         return changed
 
     def _send_roaring_batch(self, node, index, field, rows, columns_arr,
-                            shards, idxs) -> int:
+                            idxs) -> int:
         """Ship one node's slice of a routed set-bit import as per-shard
         roaring bodies (fragment id space: row * SHARD_WIDTH + position)."""
         import numpy as np

@@ -146,15 +146,17 @@ class DeviceRowCache:
         # fragment mutation to exactly the tagged entries
         self._updaters: dict[tuple, tuple[tuple, Callable]] = {}
         self._tag_index: dict[tuple, set[tuple]] = {}
-        # writes-per-tag counter: get_or_build re-checks it around its
-        # unlocked host decode so a racing write can't leave a stale leaf
-        self._tag_versions: dict[tuple, int] = {}
         # One lock for all bookkeeping. Writers patch entries under it
         # (apply_write), so two concurrent writes to different fragments
         # of one field can't lose each other's read-modify-write of the
         # same leaf. Host decodes happen OUTSIDE the lock (see
         # get_or_build) so query misses don't serialize behind it.
         self._lock = threading.RLock()
+        # in-flight builds: key -> buffered write events, replayed onto
+        # the entry after its unlocked decode (see get_or_build); the
+        # condition lets concurrent builders of one key wait for the first
+        self._pending_builds: dict[tuple, list] = {}
+        self._build_done = threading.Condition(self._lock)
 
     def __len__(self) -> int:
         return len(self._rows) + len(self._compressed)
@@ -218,49 +220,77 @@ class DeviceRowCache:
                      probe: Callable | None,
                      decode: Callable[[], np.ndarray],
                      device_put: Callable | None = None) -> jax.Array:
-        """get_row for derived (write-patched) entries: registers the
-        probe produced by ``probe`` (a zero-arg FACTORY, invoked only when
-        the key isn't yet registered — hits skip closure construction on
-        the hot query path) under ``tag`` atomically with residency, and
-        re-checks the tag's write version around the unlocked host decode
-        so a write landing mid-decode can't leave a silently stale leaf
-        (the decode snapshot might miss it, and the event fired before
-        registration)."""
-        for _ in range(4):
-            with self._lock:
+        """get_row for derived (write-patched) entries.
+
+        Event-buffered build: on a miss, the builder registers the
+        probe (produced by the ``probe`` zero-arg factory) and claims the
+        key BEFORE decoding, so writes landing during the unlocked host
+        decode are buffered (apply_write) and replayed as patches after
+        the upload — no write can be missed, the slow decode never holds
+        the global lock (queries and writers to other keys proceed), and
+        concurrent builders of the SAME key wait on the first instead of
+        decoding twice. Delta patches are idempotent, so an event whose
+        write the decode already saw replays harmlessly. A buffered
+        event the probe cannot patch (PURGE — multi-host sharded leaves)
+        forces one re-decode under the lock, which writers then
+        serialize behind."""
+        with self._lock:
+            while True:
                 arr = self._lookup_locked(key)
                 if arr is not None:
                     if tag is not None:
                         self._register_locked(key, tag, probe)
                     return arr
-                v0 = self._tag_versions.get(tag, 0)
-            host = decode()  # slow host work, outside the lock
-            with self._lock:
-                if self._tag_versions.get(tag, 0) != v0:
-                    continue  # a write raced the snapshot; rebuild
-                arr = self._lookup_locked(key)
-                if arr is not None:  # another thread built it meanwhile
-                    if tag is not None:
-                        self._register_locked(key, tag, probe)
-                    return arr
-                self.misses += 1
-                arr = self._put_locked(key, host, device_put)
-                if tag is not None:
-                    self._register_locked(key, tag, probe)
-                return arr
-        # Sustained write pressure: decode while holding the lock. Racing
-        # writers then block in apply_write until the entry is registered,
-        # and their patches land afterwards — delta patches are idempotent
-        # re-applications and re-uploads re-read the bitmap, so the result
-        # is correct whichever side of the snapshot the write fell on.
-        with self._lock:
-            arr = self._lookup_locked(key)
-            if arr is None:
-                self.misses += 1
-                arr = self._put_locked(key, decode(), device_put)
+                if key not in self._pending_builds:
+                    break
+                self._build_done.wait()  # another thread is building key
+            buf: list = []
+            self._pending_builds[key] = buf
             if tag is not None:
-                self._register_locked(key, tag, probe)
-            return arr
+                # route this tag's writes into the buffer from now on
+                self._updaters[key] = (tag, probe())
+                self._tag_index.setdefault(tag, set()).add(key)
+        try:
+            host = decode()  # slow host work, outside the lock
+        except BaseException:
+            with self._lock:
+                self._pending_builds.pop(key, None)
+                self._drop_updater(key)
+                self._build_done.notify_all()
+            raise
+        with self._lock:
+            try:
+                self.misses += 1
+                reg = self._updaters.get(key)
+                if tag is not None and reg is None:
+                    # invalidate_tag raced the build (field delete): the
+                    # decode belongs to a dead field — serve it to this
+                    # query but don't cache it
+                    return (jax.device_put(host, self.device)
+                            if device_put is None else device_put(host))
+                arr = self._put_locked(key, host, device_put)
+                for ev in buf:  # replay writes that landed mid-decode
+                    apply = reg[1](ev) if reg is not None else None
+                    if apply is None:
+                        continue
+                    if apply is PURGE:
+                        # can't patch: drop the first upload (and its
+                        # byte accounting) and re-decode with writers
+                        # held off
+                        old = self._rows.pop(key, None)
+                        if old is not None:
+                            self._bytes -= old.arr.nbytes
+                        arr = self._put_locked(key, decode(), device_put)
+                        break
+                    entry = self._rows.get(key)
+                    if entry is not None:
+                        entry.arr = apply(entry.arr)
+                        entry.block_idx = None
+                        arr = entry.arr
+                return arr
+            finally:
+                self._pending_builds.pop(key, None)
+                self._build_done.notify_all()
 
     @staticmethod
     def _host_block_index(host: np.ndarray):
@@ -348,10 +378,15 @@ class DeviceRowCache:
         tag = (event.index, event.field)
         with self._lock:
             self.write_events += 1
-            self._tag_versions[tag] = self._tag_versions.get(tag, 0) + 1
             for key in list(self._tag_index.get(tag, ())):
                 reg = self._updaters.get(key)
                 if reg is None:
+                    continue
+                pending = self._pending_builds.get(key)
+                if pending is not None:
+                    # key is mid-build: its decode may or may not see this
+                    # write — buffer it for replay after the upload
+                    pending.append(event)
                     continue
                 apply = reg[1](event)
                 if apply is None:
@@ -374,7 +409,6 @@ class DeviceRowCache:
             self._compressed.clear()
             self._updaters.clear()
             self._tag_index.clear()
-            self._tag_versions.clear()
             self._bytes = 0
             self._compressed_bytes = 0
 

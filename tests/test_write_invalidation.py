@@ -177,3 +177,94 @@ class TestConcurrentWritePatching:
         (row,) = ex.execute("i", "Row(f=1)")
         want = {0} | set(range(1, N + 1)) | {SHARD_WIDTH + i for i in range(1, N + 1)}
         assert set(row.columns().tolist()) == want
+
+
+class TestBufferedBuild:
+    def test_write_landing_mid_decode_is_replayed(self, env):
+        """A write that lands while a stacked leaf is being decoded (after
+        the builder claimed the key, before the upload) must appear in the
+        resulting leaf: get_or_build buffers the event and replays it as a
+        patch after the upload."""
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        fill(f, rows=[1])
+
+        from pilosa_tpu.executor import batch
+
+        new_col = 2 * SHARD_WIDTH + 3  # not in the stride pattern
+        fired = {"done": False}
+        real_host_row = batch.host_row
+
+        def host_row_with_midwrite(idx_, spec, shard):
+            out = real_host_row(idx_, spec, shard)
+            if not fired["done"] and spec.field == "f":
+                fired["done"] = True
+                # the builder has already claimed the key and registered
+                # the probe; this write must be buffered and replayed
+                f.set_bit(1, new_col)
+            return out
+
+        batch.host_row = host_row_with_midwrite
+        try:
+            (row1,) = ex.execute("i", "Row(f=1)")
+        finally:
+            batch.host_row = real_host_row
+        assert fired["done"]
+        assert new_col in set(row1.columns().tolist())
+        # the resident leaf (not just this query's result) has the bit
+        (n,) = ex.execute("i", f"Count(Intersect(Row(f=1), Row(f=1)))")
+        (row1b,) = ex.execute("i", "Row(f=1)")
+        assert new_col in set(row1b.columns().tolist())
+
+    def test_concurrent_builders_of_one_key_decode_once(self, env):
+        """Two threads missing on the same key: the second waits for the
+        first build instead of decoding the leaf twice."""
+        import threading
+
+        holder, ex = env
+        idx = holder.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        fill(f, rows=[1])
+
+        from pilosa_tpu.executor import batch
+
+        decodes = []
+        entered = threading.Event()
+        release = threading.Event()
+        real_host_row = batch.host_row
+
+        def slow_host_row(idx_, spec, shard):
+            if spec.field == "f" and not decodes:
+                decodes.append(1)
+                entered.set()
+                assert release.wait(20)
+            elif spec.field == "f" and shard == 0:
+                decodes.append(1)
+            return real_host_row(idx_, spec, shard)
+
+        batch.host_row = slow_host_row
+        results = []
+        try:
+            t1 = threading.Thread(
+                target=lambda: results.append(ex.execute("i", "Row(f=1)"))
+            )
+            t1.start()
+            assert entered.wait(20)
+            t2 = threading.Thread(
+                target=lambda: results.append(ex.execute("i", "Row(f=1)"))
+            )
+            t2.start()
+            import time
+            time.sleep(0.2)  # t2 reaches the wait on the pending build
+            release.set()
+            t1.join(20)
+            t2.join(20)
+        finally:
+            batch.host_row = real_host_row
+        assert len(results) == 2
+        a, b = (set(r[0].columns().tolist()) for r in results)
+        assert a == b
+        # one build: slow path entered once, per-shard decode not repeated
+        # by the second thread (it waited and reused the entry)
+        assert sum(decodes) <= 5  # 4 shards + the gate, single build
