@@ -147,9 +147,10 @@ def host_row(idx, spec, shard: int) -> np.ndarray:
 
 
 def host_planes(idx, spec, shard: int, depth: int) -> np.ndarray:
-    """uint32[depth, words] BSI plane matrix for one shard (host side)."""
+    """uint32[depth, words] BSI plane matrix for one shard (host side).
+    A delete_field racing the decode reads zeros, not a dead object."""
     field = idx.field(spec.field)
-    view = field.view(field.bsi_view_name())
+    view = field.view(field.bsi_view_name()) if field is not None else None
     frag = view.fragment(shard) if view else None
     if frag is None:
         return np.zeros((depth, WORDS_PER_SHARD), np.uint32)
@@ -311,7 +312,8 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
             )
 
         def decode_row(ev):
-            view = idx.field(spec.field).view(bsi_view)
+            field = idx.field(spec.field)  # live schema: None post-delete
+            view = field.view(bsi_view) if field is not None else None
             frag = view.fragment(ev.shard) if view else None
             if frag is None:
                 return np.zeros(WORDS_PER_SHARD, np.uint32)

@@ -164,3 +164,65 @@ def test_keyed_groupby_over_protobuf(node):
     got = {g.group[0].row_key: g.count for g in resp.results[0].groups}
     assert got == {"go": 2, "py": 1}
     assert all(g.group[0].field == "lang" for g in resp.results[0].groups)
+
+
+@requires_proto
+def test_import_request_encoders_roundtrip():
+    """Client-side request encoders invert the server-side decoders — the
+    routed-import protobuf hop (parallel/client.py import_bits/values)."""
+    from pilosa_tpu.wire.serializer import (
+        decode_import_request,
+        decode_import_value_request,
+        encode_import_request,
+        encode_import_value_request,
+    )
+
+    body = encode_import_request(
+        "i", "f", [1, 2, 3], [10, 20, 1 << 40],
+        timestamps=["2019-01-15T00:00", None, ""], clear=True,
+    )
+    rows, cols, ts, clear = decode_import_request(body)
+    assert rows == [1, 2, 3]
+    assert cols == [10, 20, 1 << 40]
+    assert ts == ["2019-01-15T00:00", "", ""]  # None -> "" (= no timestamp)
+    assert clear is True
+
+    body = encode_import_value_request("i", "v", [5, 6], [-7, 1 << 40],
+                                       clear=False)
+    cols, values, clear = decode_import_value_request(body)
+    assert (cols, values, clear) == ([5, 6], [-7, 1 << 40], False)
+
+
+@requires_proto
+def test_decode_results_json_matches_json_shapes():
+    """decode_results_json (the remote-partial decoder) emits exactly the
+    shapes executor/result.py to_json emits, for every result type the
+    cluster reducer consumes."""
+    import numpy as np
+
+    from pilosa_tpu.executor.result import (
+        GroupCount,
+        Pair,
+        RowResult,
+        ValCount,
+        result_to_json,
+    )
+    from pilosa_tpu.ops.packing import pack_bits
+    from pilosa_tpu.wire.serializer import decode_results_json, encode_results
+
+    row = RowResult({0: np.asarray(pack_bits(np.asarray([3, 17]), 1 << 20))})
+    keyed = RowResult({}, keys=["alice", "bob"])
+    results = [
+        row, keyed, 42, True, None, ValCount(-5, 3),
+        [Pair(1, 9), Pair(2, 4, key="k")],
+        [GroupCount([{"field": "a", "rowID": 1},
+                     {"field": "b", "rowKey": "x"}], 7, sum=-2)],
+        [10, 20], ["r1", "r2"],
+    ]
+    got = decode_results_json(encode_results(results))["results"]
+    want = [result_to_json(r) for r in results]
+    # RowResult JSON carries attrs; the reducer reads columns/keys
+    assert got[0]["columns"] == want[0]["columns"]
+    assert got[1]["keys"] == want[1]["keys"]
+    for g, w in zip(got[2:], want[2:]):
+        assert g == w, (g, w)
