@@ -300,9 +300,12 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
                 delta_on_clear=len(spec.views) == 1,
             )
     elif isinstance(spec, _PlanesSpec):
-        field = idx.field(spec.field)
-        depth = 2 + field.options.bit_depth
-        bsi_view = field.bsi_view_name()
+        from pilosa_tpu.storage.view import view_name_bsi
+
+        # compile-time depth + name-derived view: a delete_field racing
+        # the query resolves to zeros instead of a dead dereference
+        depth = 2 + spec.depth
+        bsi_view = view_name_bsi(spec.field)
         key = ("stackp", idx.name, spec.field, depth, block.key())
 
         def decode():

@@ -86,15 +86,20 @@ class _RowSpec:
 
 
 class _PlanesSpec:
-    """Device leaf: the stacked BSI plane matrix uint32[2+depth, words]."""
+    """Device leaf: the stacked BSI plane matrix uint32[2+depth, words].
+    ``depth`` is captured at compile time so a delete_field racing the
+    query resolves to correctly-shaped zeros, not a dead dereference."""
 
-    __slots__ = ("field",)
+    __slots__ = ("field", "depth")
 
-    def __init__(self, field: str):
+    def __init__(self, field: str, depth: int):
         self.field = field
+        self.depth = depth
 
     def resolve(self, idx: Index, shard: int):
         field = idx.field(self.field)
+        if field is None:
+            return _zeros_planes(2 + self.depth)
         depth = field.options.bit_depth
         view = field.view(field.bsi_view_name())
         frag = view.fragment(shard) if view else None
@@ -265,7 +270,8 @@ class Executor:
             if call.name == "Count":
                 out.append(self._submit_count(idx, call, shards, pipeline=True))
             elif call.name in ("Sum", "Min", "Max"):
-                out.append(self._submit_bsi_aggregate(idx, call, shards))
+                out.append(self._submit_bsi_aggregate(idx, call, shards,
+                                                       pipeline=True))
             else:
                 out.append(Deferred(value=self._execute_call(idx, call, shards)))
         return out
@@ -534,17 +540,30 @@ class Executor:
         if not shard_list:
             return Deferred(value=0)
         block = self._shard_block(shard_list)
+        return self._submit_reduction(
+            idx, compiled, block, "count", pipeline,
+            lambda packed: int(batch.merge_split(packed)),
+        )
+
+    def _submit_reduction(self, idx: Index, compiled: _Compiled, block,
+                          reduce_kind: str, pipeline: bool,
+                          finish) -> "Deferred":
+        """Shared dispatch tail for pipelined scalar reductions (Count and
+        the BSI aggregates): micro-batch same-shape pipelined queries into
+        one program, else dispatch per query; ``finish`` maps this query's
+        packed host row to its result."""
         if pipeline:
             leaves, scalars = self._eval_operands(idx, compiled, block)
             read = self._microbatch_enqueue(
-                compiled.node, "count", leaves, scalars
+                compiled.node, reduce_kind, leaves, scalars
             )
             if read is not None:
-                return Deferred(lambda: int(batch.merge_split(read())))
-            packed = self._dispatch(compiled.node, "count", leaves, scalars)
+                return Deferred(lambda: finish(read()))
+            packed = self._dispatch(compiled.node, reduce_kind, leaves,
+                                    scalars)
         else:
-            packed = self._batched_eval(idx, compiled, block, "count")
-        return Deferred(lambda: int(batch.merge_split(np.asarray(packed))))
+            packed = self._batched_eval(idx, compiled, block, reduce_kind)
+        return Deferred(lambda: finish(np.asarray(packed)))
 
     def _execute_includes_column(self, idx: Index, call: Call) -> bool:
         col = call.arg("column")
@@ -733,7 +752,7 @@ class Executor:
         for i, s in enumerate(specs):
             if isinstance(s, _PlanesSpec) and s.field == field.name:
                 return i
-        specs.append(_PlanesSpec(field.name))
+        specs.append(_PlanesSpec(field.name, field.options.bit_depth))
         return len(specs) - 1
 
     def _bsi_exists_node(self, field, specs):
@@ -758,7 +777,8 @@ class Executor:
     def _execute_bsi_aggregate(self, idx: Index, call: Call, shards=None) -> ValCount:
         return self._submit_bsi_aggregate(idx, call, shards).result()
 
-    def _submit_bsi_aggregate(self, idx: Index, call: Call, shards=None) -> "Deferred":
+    def _submit_bsi_aggregate(self, idx: Index, call: Call, shards=None,
+                              pipeline: bool = False) -> "Deferred":
         field_name = call.arg("field") or call.arg("_field")
         if field_name is None:
             raise PQLError(f"{call.name} requires field=")
@@ -782,32 +802,32 @@ class Executor:
 
         if call.name == "Sum":
             node = ("bsisum", planes_i, filt_node)
-            out = self._batched_eval(idx, _Compiled(node, specs, scalars),
-                                     block, "bsisum")
+            reduce_kind = "bsisum"
 
-            def finish_sum():
-                merged = batch.merge_split(np.asarray(out))
+            def finish(packed) -> ValCount:
+                merged = batch.merge_split(packed)
                 # [depth + 1]: plane counts ++ n
                 count = int(merged[-1])
-                total = sum(int(c) << i for i, c in enumerate(merged[:-1].tolist()))
+                total = sum(int(c) << i
+                            for i, c in enumerate(merged[:-1].tolist()))
                 return ValCount(total + base * count, count)
+        else:
+            want_max = call.name == "Max"
+            node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
+            reduce_kind = "max" if want_max else "min"
 
-            return Deferred(finish_sum)
+            def finish(packed) -> ValCount:
+                packed = np.asarray(packed)  # [best, count_lo, count_hi]
+                best = int(packed[0])
+                count = int(batch.merge_split(packed[1:]))
+                if count == 0:
+                    return ValCount(0, 0)
+                return ValCount(best + base, count)
 
-        want_max = call.name == "Max"
-        node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
-        out = self._batched_eval(idx, _Compiled(node, specs, scalars),
-                                 block, "max" if want_max else "min")
-
-        def finish_minmax():
-            packed = np.asarray(out)  # [best, count_lo, count_hi]
-            best = int(packed[0])
-            count = int(batch.merge_split(packed[1:]))
-            if count == 0:
-                return ValCount(0, 0)
-            return ValCount(best + base, count)
-
-        return Deferred(finish_minmax)
+        return self._submit_reduction(
+            idx, _Compiled(node, specs, scalars), block, reduce_kind,
+            pipeline, finish,
+        )
 
     # ----------------------------------------------------------------- TopN
 
@@ -1059,7 +1079,11 @@ class Executor:
                 batch.stacked_matrix(idx, fname, view, row_ids, block, put)
             )
         planes = (
-            batch.stacked_leaf(idx, _PlanesSpec(agg_field.name), block, put)
+            batch.stacked_leaf(
+                idx,
+                _PlanesSpec(agg_field.name, agg_field.options.bit_depth),
+                block, put,
+            )
             if agg_field is not None
             else None
         )

@@ -542,3 +542,41 @@ class TestPlanCache:
         idx2.create_field("f").set_bit(1, 20)
         idx2.field("f").set_bit(1, 21)
         assert ex.execute("repos", q)[0] == 2
+
+
+class TestSubmitBSIAggregates:
+    def setup_vals(self, holder):
+        from pilosa_tpu.storage import FieldOptions
+
+        idx = holder.create_index("m", track_existence=False)
+        f = idx.create_field("v", FieldOptions(type="int", min=-10, max=500))
+        self.values = {0: -10, 1: 0, 5: 42, SHARD_WIDTH + 2: 499}
+        for c, v in self.values.items():
+            f.set_value(c, v)
+        g = idx.create_field("w", FieldOptions(type="int", min=0, max=100))
+        for c in (3, 7):
+            g.set_value(c, c * 10)
+        return idx
+
+    def test_pipelined_sums_coalesce_into_one_dispatch(self, env):
+        """Pipelined same-shape Sum queries micro-batch like Counts: one
+        device program, per-query slices of the packed readback."""
+        holder, ex = env
+        self.setup_vals(holder)
+        want_v = ex.execute("m", 'Sum(field="v")')[0]
+        want_v2 = ex.execute("m", 'Sum(Row(v > 0), field="v")')[0]
+        defs = [ex.submit("m", 'Sum(field="v")')[0],
+                ex.submit("m", 'Sum(field="v")')[0]]
+        assert ex._pending  # grouped, not yet dispatched
+        got = [d.result() for d in defs]
+        assert got == [want_v, want_v]
+        assert not ex._pending
+        # filtered Sum (different shape) still correct via submit
+        assert ex.submit("m", 'Sum(Row(v > 0), field="v")')[0].result() == want_v2
+
+    def test_pipelined_min_max_via_submit(self, env):
+        holder, ex = env
+        self.setup_vals(holder)
+        for pql in ('Min(field="v")', 'Max(field="v")', 'Min(field="w")'):
+            want = ex.execute("m", pql)[0]
+            assert ex.submit("m", pql)[0].result() == want
