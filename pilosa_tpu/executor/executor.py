@@ -20,6 +20,7 @@ from __future__ import annotations
 import datetime as dt
 import threading
 import weakref
+from typing import Callable
 
 import numpy as np
 
@@ -97,11 +98,13 @@ class _PlanesSpec:
         self.depth = depth
 
     def resolve(self, idx: Index, shard: int):
+        # compile-time depth throughout: the node's clamped scalars were
+        # built for it, so a racing delete+recreate with a different
+        # range must not change the leaf shape mid-plan (the schema epoch
+        # invalidates the plan for the NEXT query)
+        depth = self.depth
         field = idx.field(self.field)
-        if field is None:
-            return _zeros_planes(2 + self.depth)
-        depth = field.options.bit_depth
-        view = field.view(field.bsi_view_name())
+        view = field.view(field.bsi_view_name()) if field is not None else None
         frag = view.fragment(shard) if view else None
         if frag is None:
             return _zeros_planes(2 + depth)
@@ -251,12 +254,12 @@ class Executor:
         Device streams are ordered, so a serving loop can enqueue a stream
         of queries and resolve them in order — the host↔device round trip
         (the latency floor on tunneled/remote backends) overlaps with
-        device compute instead of serializing after it. Pipelined Count
-        queries sharing a program shape are additionally coalesced into
-        micro-batched dispatches (see _microbatch_enqueue). Reductions
-        whose readback is a few ints (Count, Sum, Min, Max) stay in
-        flight; other call types evaluate eagerly at submit time and
-        return an already-resolved Deferred.
+        device compute instead of serializing after it. Pipelined
+        reductions sharing a program shape — Count AND the BSI
+        aggregates Sum/Min/Max — are additionally coalesced into
+        micro-batched dispatches (see _microbatch_enqueue) and stay in
+        flight until resolved; other call types evaluate eagerly at
+        submit time and return an already-resolved Deferred.
         """
         idx = self.holder.index(index_name)
         if idx is None:
@@ -600,7 +603,8 @@ class Executor:
     # -------------------------------------------------------------- compile
 
     def _compile_cached(self, idx: Index, call: Call,
-                        wrap: str | None = None) -> _Compiled:
+                        wrap: str | None = None,
+                        build: Callable | None = None) -> _Compiled:
         """_compile with a plan memo. parse() memoizes query text to one
         immutable Call tree, so the tree's identity keys repeated queries
         — the serving hot path. A cached plan revalidates in two identity
@@ -625,7 +629,8 @@ class Executor:
         # the epoch, so the entry (tagged pre-DDL) fails its next
         # validation instead of serving the stale plan under the new epoch
         epoch = idx.plan_epoch
-        compiled = self._compile(idx, call, wrap=wrap)
+        compiled = (self._compile(idx, call, wrap=wrap) if build is None
+                    else build())
         if not _node_has_const0(compiled.node):
             if len(self._plan_cache) >= self.PLAN_CACHE_MAX:
                 self._plan_cache.clear()
@@ -787,12 +792,20 @@ class Executor:
             raise PQLError(f"{call.name} requires an int field")
         filt_call = call.children[0] if call.children else None
 
-        specs: list = []
-        scalars: list = []
-        planes_i = self._planes_index(field, specs)
-        filt_node = (
-            self._compile_node(idx, filt_call, specs, scalars) if filt_call else None
-        )
+        def build() -> _Compiled:
+            specs: list = []
+            scalars: list = []
+            planes_i = self._planes_index(field, specs)
+            filt_node = (self._compile_node(idx, filt_call, specs, scalars)
+                         if filt_call else None)
+            if call.name == "Sum":
+                node = ("bsisum", planes_i, filt_node)
+            else:
+                node = ("bsiminmax", 1 if call.name == "Max" else 0,
+                        planes_i, filt_node)
+            return _Compiled(node, specs, scalars)
+
+        compiled = self._compile_cached(idx, call, wrap="agg", build=build)
         base = field.options.base
 
         shard_list = self._shards(idx, shards)
@@ -801,7 +814,6 @@ class Executor:
         block = self._shard_block(shard_list)
 
         if call.name == "Sum":
-            node = ("bsisum", planes_i, filt_node)
             reduce_kind = "bsisum"
 
             def finish(packed) -> ValCount:
@@ -812,9 +824,7 @@ class Executor:
                             for i, c in enumerate(merged[:-1].tolist()))
                 return ValCount(total + base * count, count)
         else:
-            want_max = call.name == "Max"
-            node = ("bsiminmax", 1 if want_max else 0, planes_i, filt_node)
-            reduce_kind = "max" if want_max else "min"
+            reduce_kind = "max" if call.name == "Max" else "min"
 
             def finish(packed) -> ValCount:
                 packed = np.asarray(packed)  # [best, count_lo, count_hi]
@@ -825,8 +835,7 @@ class Executor:
                 return ValCount(best + base, count)
 
         return self._submit_reduction(
-            idx, _Compiled(node, specs, scalars), block, reduce_kind,
-            pipeline, finish,
+            idx, compiled, block, reduce_kind, pipeline, finish,
         )
 
     # ----------------------------------------------------------------- TopN

@@ -580,3 +580,45 @@ class TestSubmitBSIAggregates:
         for pql in ('Min(field="v")', 'Max(field="v")', 'Min(field="w")'):
             want = ex.execute("m", pql)[0]
             assert ex.submit("m", pql)[0].result() == want
+
+    def test_plan_cache_survives_concurrent_ddl_churn(self, env):
+        """Queries racing create/delete of an unrelated field must never
+        serve a stale plan or crash; the epoch snapshot taken before
+        compile prevents a racing DDL from tagging a stale plan current."""
+        import threading
+
+        holder, ex = env
+        idx = holder.create_index("repos", track_existence=False)
+        f = idx.create_field("f")
+        for c in (1, 5, 9):
+            f.set_bit(1, c)
+        errors = []
+        stop = threading.Event()
+
+        def churn():
+            try:
+                for i in range(60):
+                    g = idx.create_field("tmp")
+                    g.set_bit(1, 2)
+                    idx.delete_field("tmp")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                stop.set()
+
+        def query():
+            try:
+                while not stop.is_set():
+                    assert ex.execute("repos", "Count(Row(f=1))")[0] == 3
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn)] + [
+            threading.Thread(target=query) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[0]
+        assert ex.execute("repos", "Count(Row(f=1))")[0] == 3
