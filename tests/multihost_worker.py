@@ -48,7 +48,8 @@ def build(holder):
             rows[1].add(base + 7 * k)
             if k % 2 == 0:
                 rows[2].add(base + 7 * k)
-            rows[3].add(base + 11 * k + 1)
+            if k < 30:  # distinct row sizes: TopN ordering is exact
+                rows[3].add(base + 11 * k + 1)
     for row, cols in rows.items():
         for c in sorted(cols):
             f.set_bit(row, c)
@@ -78,6 +79,19 @@ with tempfile.TemporaryDirectory() as tmp:
 
     (s,) = ex.execute("repos", 'Sum(field="v")')
     assert (s.value, s.count) == (sum(values.values()), len(values)), s
+
+    # TopN: phase-1 candidate counts via cross-host countrows psum,
+    # phase-2 exact recount — row sizes are distinct by construction
+    (pairs,) = ex.execute("repos", "TopN(f, n=2)")
+    sizes = sorted(((len(c), r) for r, c in rows.items()), reverse=True)
+    got = [(p.id, p.count) for p in pairs]
+    want = [(r, n) for n, r in sizes[:2]]
+    assert got == want, (got, want)
+
+    # GroupBy over one dimension, cross-host reduced
+    (groups,) = ex.execute("repos", "GroupBy(Rows(f))")
+    got = {g.group[0]["rowID"]: g.count for g in groups}
+    assert got == {r: len(c) for r, c in rows.items()}, got
 
     # write-through: the contract is that a shard's write is applied on
     # (at least) the process owning that shard's slot; here both
