@@ -38,6 +38,8 @@ and recombined on the host as ``hi·2^15 + lo``, exact to 2^15 shards
 
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,6 +51,12 @@ from pilosa_tpu.storage import residency
 
 INT32_MIN = -(1 << 31)
 INT32_MAX = (1 << 31) - 1
+
+# Block-layout key interning table (see ShardBlock.key). Tokens are
+# monotonic — never reused even across overflow resets, so a stale
+# resident entry can never alias a new layout's key.
+_KEY_INTERN: dict[tuple, tuple] = {}
+_KEY_INTERN_SEQ = itertools.count()
 
 # Split-sum carry point: per-shard summands are ≤ 2^20, so the lo channel
 # (15 bits) sums safely over 2^16 shards and the hi channel (≤ 2^5 per
@@ -95,10 +103,27 @@ class ShardBlock:
         self.patchable = True
 
     def key(self) -> tuple:
-        # cached: leaf-cache keys embed it, and rebuilding a 1k-shard
-        # tuple per leaf per query is measurable on the serving path
+        # Interned: leaf-cache keys embed the block key, and hashing a
+        # 1k-shard tuple on every residency lookup is measurable on the
+        # serving path. Equal layouts (shards, padding, device count,
+        # local slot span) share one small token, so equal blocks built
+        # at different times still hit the same cache entries; the full
+        # tuple is hashed once per distinct layout.
         if self._key is None:
-            self._key = (tuple(self.shards), self.padded, self.n_devices)
+            full = (tuple(self.shards), self.padded, self.n_devices,
+                    self.local_slots)
+            if len(_KEY_INTERN) >= 4096:
+                # runaway distinct layouts (pathological Options(
+                # shards=) traffic): reset — orphaned residency entries
+                # simply age out of the LRU; tokens stay monotonic so
+                # none can alias
+                _KEY_INTERN.clear()
+            # setdefault: atomic under the GIL, so two threads racing the
+            # same new layout agree on ONE token (a loser's token would
+            # split the residency cache for that layout forever)
+            self._key = _KEY_INTERN.setdefault(
+                full, ("blk", next(_KEY_INTERN_SEQ))
+            )
         return self._key
 
     @property

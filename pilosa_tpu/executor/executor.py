@@ -17,6 +17,7 @@ reduce to const-empty / all-existing without touching the device.
 
 from __future__ import annotations
 
+import collections
 import datetime as dt
 import threading
 import weakref
@@ -221,6 +222,8 @@ class Executor:
         self._mb_lock = threading.Lock()
         # (index, call identity, wrap) -> validated plan; see _compile_cached
         self._plan_cache: dict = {}
+        # shard-list identity -> ShardBlock (LRU); see _shard_block
+        self._block_memo: collections.OrderedDict = collections.OrderedDict()
 
     # ------------------------------------------------------------ top level
 
@@ -384,6 +387,28 @@ class Executor:
     # and swaps the program builders for shard_map+psum versions.
 
     def _shard_block(self, shard_list: list[int]):
+        """Block for a query's shard list, memoized on the LIST OBJECT:
+        Index.available_shards returns one memoized list until the shard
+        set changes, so steady-state queries reuse one block — skipping
+        the per-query sort of (up to) thousands of shard ids, the padded
+        layout build, and the cache-key construction. Explicit shard
+        lists (Options(shards=)) miss the identity check and build
+        fresh, as before."""
+        key = id(shard_list)
+        entry = self._block_memo.get(key)
+        if entry is not None and entry[0] is shard_list:
+            self._block_memo.move_to_end(key)
+            return entry[1]
+        block = self._make_block(shard_list)
+        if len(self._block_memo) >= 64:
+            # LRU, not wholesale clear: explicit Options(shards=) lists
+            # never recur (fresh list object per query) and must not
+            # evict the hot available_shards entry when they age out
+            self._block_memo.popitem(last=False)
+        self._block_memo[key] = (shard_list, block)
+        return block
+
+    def _make_block(self, shard_list: list[int]):
         return batch.ShardBlock(shard_list)
 
     def _leaf_put(self, block):

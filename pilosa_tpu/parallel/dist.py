@@ -168,7 +168,7 @@ class DistExecutor(Executor):
         super().__init__(holder)
         self.mesh = mesh if mesh is not None else make_mesh()
 
-    def _shard_block(self, shard_list):
+    def _make_block(self, shard_list):
         return ShardAssignment(shard_list, self.mesh)
 
     def _leaf_put(self, block):
