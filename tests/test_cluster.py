@@ -56,6 +56,20 @@ def uri(s: Server) -> str:
     return f"http://localhost:{s.port}"
 
 
+def _resize_pair(tmp_path, servers):
+    """Shared resize-test fixture: schema on node 0, one fragment on the
+    acting coordinator so the PEER is the owner that must fetch it.
+    Returns (coord, peer)."""
+    req("POST", f"{uri(servers[0])}/index/i", {})
+    req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+    coord = next(s for s in servers if s.api.cluster.is_acting_coordinator)
+    peer = next(s for s in servers if s is not coord)
+    fc = coord.holder.index("i").field("f")
+    fragc = fc.view("standard", create=True).fragment(3, create=True)
+    fragc.bulk_import(np.asarray([2], np.uint64), np.asarray([5], np.uint64))
+    return coord, peer
+
+
 class TestMembership:
     def test_all_nodes_see_each_other(self, cluster3):
         for s in cluster3:
@@ -464,6 +478,46 @@ class TestResizeAndReReplication:
                 s.close()
 
 
+    def test_node_leave_mid_resize_releases_pending(self, tmp_path, monkeypatch):
+        """A peer that leaves (or is declared dead) after acking a resize
+        instruction is dropped from the pending set immediately — the
+        cluster must not stay gated for the full straggler timeout."""
+        import threading
+        import time as _time
+
+        from pilosa_tpu.parallel.cluster import Cluster
+
+        monkeypatch.setattr(Cluster, "RESIZE_COMPLETE_TIMEOUT", 30.0)
+        servers = make_cluster(tmp_path, 2, replica_n=2)
+        try:
+            coord, peer = _resize_pair(tmp_path, servers)
+            # peer acks the instruction but never fetches nor reports
+            peer.api.cluster._run_resize_job = lambda *a, **k: None
+
+            done = threading.Event()
+            t = threading.Thread(
+                target=lambda: (coord.api.cluster.coordinate_resize(),
+                                done.set()),
+                daemon=True,
+            )
+            t.start()
+            # wait until the peer is actually pending — a fixed sleep
+            # could fire the node-leave before the instruction is sent,
+            # passing without exercising the pending-drop path
+            deadline = _time.monotonic() + 10
+            while not coord.api.cluster._resize_pending:
+                assert _time.monotonic() < deadline, "peer never pending"
+                _time.sleep(0.01)
+            coord.api.cluster.handle_message(
+                {"type": "node-leave", "id": peer.api.cluster.local.id}
+            )
+            assert done.wait(10), "coordinator still gated on departed node"
+            assert coord.api.cluster.state == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
+
+
 class TestEagerShardVisibility:
     def test_new_remote_shard_visible_without_poll(self, tmp_path):
         """A shard created on one node is broadcast (CreateShardMessage)
@@ -644,15 +698,7 @@ class TestClusterRaces:
         monkeypatch.setattr(Cluster, "RESIZE_COMPLETE_TIMEOUT", 0.5)
         servers = make_cluster(tmp_path, 2, replica_n=2)
         try:
-            req("POST", f"{uri(servers[0])}/index/i", {})
-            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
-            coord = next(s for s in servers
-                         if s.api.cluster.is_acting_coordinator)
-            peer = next(s for s in servers if s is not coord)
-            fc = coord.holder.index("i").field("f")
-            fragc = fc.view("standard", create=True).fragment(3, create=True)
-            fragc.bulk_import(np.asarray([2], np.uint64),
-                              np.asarray([5], np.uint64))
+            coord, peer = _resize_pair(tmp_path, servers)
             # peer swallows the instruction: fetch never runs, no report
             peer.api.cluster.fetch_fragments = lambda sources: 0
             peer.api.cluster._run_resize_job = lambda *a, **k: None
@@ -677,16 +723,7 @@ class TestClusterRaces:
         monkeypatch.setattr(Cluster, "RESIZE_PROGRESS_INTERVAL", 0.2)
         servers = make_cluster(tmp_path, 2, replica_n=2)
         try:
-            req("POST", f"{uri(servers[0])}/index/i", {})
-            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
-            coord = next(s for s in servers
-                         if s.api.cluster.is_acting_coordinator)
-            peer = next(s for s in servers if s is not coord)
-            fc = coord.holder.index("i").field("f")
-            fragc = fc.view("standard", create=True).fragment(3, create=True)
-            fragc.bulk_import(np.asarray([2], np.uint64),
-                              np.asarray([5], np.uint64))
-
+            coord, peer = _resize_pair(tmp_path, servers)
             peer_cluster = peer.api.cluster
             real_fetch = type(peer_cluster).fetch_fragments
             fetch_done = threading.Event()
