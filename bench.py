@@ -49,8 +49,9 @@ BITS_PER_ROW_SHARD = 512  # set bits per (row, shard); throughput is
                           # density-independent (dense words on device)
 KERNEL_ITERS = 96
 EXEC_ITERS = 256
-TRIALS = 6  # best-of: the tunneled backend's throughput wanders ±25%
-            # across seconds; more trials tighten the recorded best
+TRIALS = 10  # best-of: the tunneled backend's throughput wanders ±25%
+             # across seconds; each executor trial costs ~0.2s, so ten
+             # trials buy a much tighter recorded best for ~2s
 
 
 # ------------------------------------------------------------ raw kernel path
