@@ -51,3 +51,27 @@ class TestRowsContaining:
         # empty
         c = Container.from_lows(np.empty(0, np.uint16))
         assert not c.contains_low(0)
+
+
+class TestRowCountsMemo:
+    def test_row_counts_memoized_and_invalidated_by_writes(self, tmp_path):
+        import numpy as np
+
+        from pilosa_tpu.storage.fragment import Fragment
+
+        frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+        frag.bulk_import(np.asarray([1, 1, 2], np.uint64),
+                         np.asarray([10, 20, 30], np.uint64))
+        rows, counts = frag.row_counts()
+        assert rows.tolist() == [1, 2] and counts.tolist() == [2, 1]
+        # memo hit: identical object back while unmutated
+        assert frag.row_counts()[0] is rows
+        # any write invalidates: a NEW row must appear
+        frag.set_bit(7, 40)
+        rows2, counts2 = frag.row_counts()
+        assert rows2.tolist() == [1, 2, 7]
+        assert counts2.tolist() == [2, 1, 1]
+        # clears too
+        frag.clear_bit(7, 40)
+        assert frag.row_counts()[0].tolist() == [1, 2]
+        frag.close()
