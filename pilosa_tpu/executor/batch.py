@@ -494,26 +494,14 @@ def local_fn(structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
     return fn
 
 
-def local_fn_batched(structure, reduce_kind: str, leaf_ranks: tuple,
-                     n_scalars: int, n_queries: int):
-    """ONE device program evaluating ``n_queries`` same-shape queries
-    (Executor.submit micro-batching). Each program dispatch on a
-    tunneled/remote backend carries a fixed launch cost comparable to the
-    device compute of a whole 1B-column query; stacking a micro-batch of
-    pipelined queries into one program amortizes it, and the single
-    [B, ...] readback serves every query in the batch with one host
-    round trip. Args: B repetitions of the leaves, then (when the shape
-    has scalars) ONE int32[B, n_scalars] array carrying every query's
-    scalars in a single transfer; returns the per-query packed results
-    stacked on axis 0."""
-    key = ("localB", structure, reduce_kind, leaf_ranks, n_scalars,
-           n_queries)
-    fn = _LOCAL_JIT_CACHE.get(key)
-    if fn is not None:
-        return fn
-
-    n_leaves = len(leaf_ranks)
-    body1 = _local_body(structure, reduce_kind, n_leaves)
+def batched_body(body1, n_leaves: int, n_scalars: int, n_queries: int):
+    """Wrap a per-query evaluator body into the micro-batch calling
+    convention shared by _flush_group_locked's dispatch, the local
+    builder below, and the SPMD builder (parallel.dist._dist_fn_batched):
+    args are B repetitions of the leaves, then (when the shape has
+    scalars) ONE int32[B, n_scalars] array carrying every query's scalars
+    in a single transfer; the per-query packed results come back stacked
+    on axis 0."""
 
     def body(*args):
         if n_scalars:
@@ -523,11 +511,33 @@ def local_fn_batched(structure, reduce_kind: str, leaf_ranks: tuple,
         outs = []
         for i in range(n_queries):
             leaves_i = flat[i * n_leaves:(i + 1) * n_leaves]
-            scalars_i = tuple(scal[i, j] for j in range(n_scalars)) if n_scalars else ()
+            scalars_i = (
+                tuple(scal[i, j] for j in range(n_scalars))
+                if n_scalars else ()
+            )
             outs.append(body1(*leaves_i, *scalars_i))
         return jnp.stack(outs)
 
-    fn = jax.jit(body)
+    return body
+
+
+def local_fn_batched(structure, reduce_kind: str, leaf_ranks: tuple,
+                     n_scalars: int, n_queries: int):
+    """ONE device program evaluating ``n_queries`` same-shape queries
+    (Executor.submit micro-batching). Each program dispatch on a
+    tunneled/remote backend carries a fixed launch cost comparable to the
+    device compute of a whole 1B-column query; stacking a micro-batch of
+    pipelined queries into one program amortizes it, and the single
+    [B, ...] readback serves every query in the batch with one host
+    round trip."""
+    key = ("localB", structure, reduce_kind, leaf_ranks, n_scalars,
+           n_queries)
+    fn = _LOCAL_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    body1 = _local_body(structure, reduce_kind, len(leaf_ranks))
+    fn = jax.jit(batched_body(body1, len(leaf_ranks), n_scalars, n_queries))
     _LOCAL_JIT_CACHE[key] = fn
     return fn
 

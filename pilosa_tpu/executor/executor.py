@@ -471,7 +471,7 @@ class Executor:
         """Queue one pipelined query; returns a thunk yielding this
         query's packed host result, or None when micro-batching is off
         (then the caller dispatches per-query)."""
-        if self.microbatch_max <= 1 or not self._supports_microbatch():
+        if self.microbatch_max <= 1:
             return None
         shapes = tuple(tuple(l.shape) for l in leaves)
         key = (node, reduce_kind, shapes, len(scalars))
@@ -497,11 +497,13 @@ class Executor:
 
         return read
 
-    def _supports_microbatch(self) -> bool:
-        """Subclasses whose programs are not plain local programs (e.g.
-        the SPMD mesh executor) opt out until they provide a batched
-        builder."""
-        return type(self)._program is Executor._program
+    def _program_batched(self, structure, reduce_kind: str, leaf_ranks: tuple,
+                         n_scalars: int, n_queries: int):
+        """Micro-batched program builder hook (one program, ``n_queries``
+        same-shape queries). DistExecutor swaps in the shard_map+psum
+        version so the mesh path keeps micro-batching."""
+        return batch.local_fn_batched(structure, reduce_kind, leaf_ranks,
+                                      n_scalars, n_queries)
 
     def _flush_group_locked(self, key, group) -> None:
         """Dispatch a pending group as one program (caller holds _mb_lock)."""
@@ -509,7 +511,7 @@ class Executor:
             return
         node, reduce_kind, shapes, n_scalars = key
         rows = group["rows"]
-        fn = batch.local_fn_batched(
+        fn = self._program_batched(
             node, reduce_kind, tuple(len(s) - 1 for s in shapes),
             n_scalars, len(rows),
         )

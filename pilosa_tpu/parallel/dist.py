@@ -9,10 +9,12 @@ kernel over its resident block of shards (vmapped over the block), and
 serialization, no scatter/gather, no per-node re-dispatch.
 
 All mapping/result logic lives in the base Executor's batched path
-(executor/batch.py) — this class only swaps the three hooks: shard
-blocks pad to the mesh, stacked leaves are device_put with a
-NamedSharding over the shard axis, and the program builders wrap the
-same per-shard bodies in shard_map with collective reductions.
+(executor/batch.py) — this class only swaps the placement/program
+hooks: shard blocks pad to the mesh, stacked leaves are device_put with
+a NamedSharding over the shard axis, and the program builders (per-query
+AND micro-batched — the mesh path keeps Executor.submit's pipelined
+micro-batching) wrap the same per-shard bodies in shard_map with
+collective reductions.
 """
 
 from __future__ import annotations
@@ -31,22 +33,16 @@ from pilosa_tpu.parallel.mesh import SHARDS_AXIS, ShardAssignment, make_mesh
 _DIST_JIT_CACHE: dict = {}
 
 
-def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
-             n_scalars: int):
-    """Build (or fetch) the compiled SPMD evaluator for a query shape.
-    Packed results match batch.local_fn's contracts exactly."""
-    key = (mesh, structure, reduce_kind, leaf_ranks, n_scalars)
-    fn = _DIST_JIT_CACHE.get(key)
-    if fn is not None:
-        return fn
-
-    leaf_specs = tuple(P(SHARDS_AXIS) for _ in leaf_ranks)
-    scalar_specs = tuple(P() for _ in range(n_scalars))
-    out_specs = P(SHARDS_AXIS) if reduce_kind == "row" else P()
+def _dist_body(structure, reduce_kind: str, n_leaves: int):
+    """Uncompiled per-query SPMD evaluator body (runs inside shard_map):
+    vmap over the local shard slots, then collective reduction over the
+    mesh axis. Shared by the per-query program (_dist_fn) and the
+    micro-batched program (_dist_fn_batched), mirroring
+    batch._local_body / batch.local_fn_batched."""
 
     def body(*args):
-        leaves = args[: len(leaf_ranks)]
-        scalars = args[len(leaf_ranks):]
+        leaves = args[:n_leaves]
+        scalars = args[n_leaves:]
 
         def per_shard(*ls):
             return expr._go(structure, ls, scalars)
@@ -83,12 +79,64 @@ def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
             return batch.minmax_finalize(best, n, any_valid)
         return out  # 'row': stays shard-sharded
 
+    return body
+
+
+def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
+             n_scalars: int):
+    """Build (or fetch) the compiled SPMD evaluator for a query shape.
+    Packed results match batch.local_fn's contracts exactly."""
+    key = (mesh, structure, reduce_kind, leaf_ranks, n_scalars)
+    fn = _DIST_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    leaf_specs = tuple(P(SHARDS_AXIS) for _ in leaf_ranks)
+    scalar_specs = tuple(P() for _ in range(n_scalars))
+    out_specs = P(SHARDS_AXIS) if reduce_kind == "row" else P()
+
     fn = jax.jit(
         shard_map(
-            body,
+            _dist_body(structure, reduce_kind, len(leaf_ranks)),
             mesh=mesh,
             in_specs=leaf_specs + scalar_specs,
             out_specs=out_specs,
+        )
+    )
+    _DIST_JIT_CACHE[key] = fn
+    return fn
+
+
+def _dist_fn_batched(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
+                     n_scalars: int, n_queries: int):
+    """ONE SPMD program evaluating ``n_queries`` same-shape pipelined
+    queries over the mesh (the mesh counterpart of
+    batch.local_fn_batched): per query the shared per-shard body runs
+    vmapped over the local slots and psum-reduces over the shard axis;
+    results come back stacked [B, ...] and replicated. Only scalar
+    reductions micro-batch (count/bsisum/min/max — Executor.submit never
+    coalesces 'row'), so out_specs is always replicated. Args: B
+    repetitions of the sharded leaves, then (when the shape has scalars)
+    ONE replicated int32[B, n_scalars] array."""
+    key = ("distB", mesh, structure, reduce_kind, leaf_ranks, n_scalars,
+           n_queries)
+    fn = _DIST_JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_leaves = len(leaf_ranks)
+    body1 = _dist_body(structure, reduce_kind, n_leaves)
+    in_specs = (
+        tuple(P(SHARDS_AXIS) for _ in range(n_leaves * n_queries))
+        + ((P(),) if n_scalars else ())
+    )
+
+    fn = jax.jit(
+        shard_map(
+            batch.batched_body(body1, n_leaves, n_scalars, n_queries),
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(),
         )
     )
     _DIST_JIT_CACHE[key] = fn
@@ -192,6 +240,11 @@ class DistExecutor(Executor):
     def _program(self, structure, reduce_kind, leaf_ranks, n_scalars):
         return _dist_fn(self.mesh, structure, reduce_kind, leaf_ranks,
                         n_scalars)
+
+    def _program_batched(self, structure, reduce_kind, leaf_ranks, n_scalars,
+                         n_queries):
+        return _dist_fn_batched(self.mesh, structure, reduce_kind, leaf_ranks,
+                                n_scalars, n_queries)
 
     def _groupby_level_program(self, filt_structure, n_filt, n_scalars,
                                n_gather, has_agg):

@@ -213,3 +213,56 @@ class TestDistWritePatching:
         (r_dist,) = dist.execute("big", "Row(f=1)")
         assert r_base.columns().tolist() == r_dist.columns().tolist()
         assert new_col in set(r_dist.columns().tolist())
+
+
+class TestDistMicrobatch:
+    """Executor.submit on the mesh path: pipelined same-shape reductions
+    coalesce into micro-batched SPMD dispatches (one shard_map program of
+    B queries), matching the single-device executor's results — the
+    serving-path behavior, not just correctness-demo eager dispatch."""
+
+    def test_submit_count_microbatch_coalesces_on_mesh(self, env):
+        holder, base, dist = env
+        dispatches = []
+        orig = dist._program_batched
+
+        def counting(structure, rk, lr, ns, nq):
+            dispatches.append(nq)
+            return orig(structure, rk, lr, ns, nq)
+
+        dist._program_batched = counting
+        try:
+            pqls = [
+                f"Count(Intersect(Row(f={1 + (i % 2)}), Row(g=3)))"
+                for i in range(32)
+            ]
+            want = [base.execute("big", p)[0] for p in pqls]
+            defs = [dist.submit("big", p)[0] for p in pqls]
+            got = [d.result() for d in defs]
+        finally:
+            dist._program_batched = orig
+        assert got == want
+        # 32 same-shape queries / microbatch_max=16 → exactly 2 dispatches
+        assert sum(dispatches) == 32
+        assert len(dispatches) == -(-32 // dist.microbatch_max)
+
+    def test_submit_partial_group_flushes_on_resolve(self, env):
+        holder, base, dist = env
+        pqls = ["Count(Row(f=1))", "Count(Row(f=2))", "Count(Row(g=3))"]
+        want = [base.execute("big", p)[0] for p in pqls]
+        defs = [dist.submit("big", p)[0] for p in pqls]
+        assert dist._pending  # 3 < microbatch_max: group still pending
+        assert [d.result() for d in defs] == want
+        assert not dist._pending
+
+    def test_submit_bsi_aggregates_microbatch_on_mesh(self, env):
+        holder, base, dist = env
+        pqls = [
+            'Sum(field="fare")',
+            'Sum(Row(f=1), field="fare")',
+            'Min(field="fare")',
+            'Max(field="fare")',
+        ]
+        want = [base.execute("big", p)[0] for p in pqls]
+        defs = [dist.submit("big", p)[0] for p in pqls]
+        assert [d.result() for d in defs] == want
