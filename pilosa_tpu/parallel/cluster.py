@@ -32,6 +32,7 @@ import uuid
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.storage.view import VIEW_STANDARD
+from pilosa_tpu.utils.pool import concurrent_map
 
 PARTITION_N = 256
 
@@ -104,6 +105,17 @@ class Cluster:
         self._resize_job: str | None = None
         self._resize_pending: set[str] = set()
         self._resize_deadline = 0.0
+        # Local fetch-job gate: while this node is pulling fragments it
+        # does not yet have (self-join pull, resize-instruction worker),
+        # it must stay RESIZING — a concurrently finishing resize path
+        # (the coordinator's NORMAL broadcast, another local job's
+        # completion) must not un-gate queries mid-fetch. The counter
+        # tracks jobs in flight; _commanded_state remembers the last
+        # externally commanded state so the final job restores it.
+        self._gate_lock = threading.Lock()
+        self._local_fetch_jobs = 0
+        self._commanded_state = STATE_NORMAL
+        self.logger = None  # set by Server; failures fall back to stderr
 
     @property
     def state(self) -> str:
@@ -122,6 +134,37 @@ class Cluster:
         during a resize, reference cluster state machine — SURVEY.md §2
         #13). Returns False on timeout."""
         return self._state_normal.wait(timeout)
+
+    def _command_state(self, value: str) -> None:
+        """Apply an externally commanded cluster state (coordinator
+        broadcast, or the local coordinator path itself). A NORMAL
+        command is deferred while local fetch jobs are in flight — the
+        last job to finish restores it (_end_local_fetch)."""
+        with self._gate_lock:
+            self._commanded_state = value
+            if value == STATE_NORMAL and self._local_fetch_jobs > 0:
+                return
+            self.state = value
+
+    def _begin_local_fetch(self) -> None:
+        with self._gate_lock:
+            self._local_fetch_jobs += 1
+            self.state = STATE_RESIZING
+
+    def _end_local_fetch(self) -> None:
+        with self._gate_lock:
+            self._local_fetch_jobs -= 1
+            if self._local_fetch_jobs <= 0:
+                self.state = self._commanded_state
+
+    def _log_exception(self, what: str, exc: BaseException) -> None:
+        logger = self.logger
+        if logger is not None:
+            logger.error("%s failed on %s: %r", what, self.local.id, exc)
+        else:  # no server wired (bare Cluster in tests/tools)
+            import traceback
+
+            traceback.print_exception(exc)
 
     def _drop_resize_pending(self, node_id: str) -> None:
         """A departed/dead node can't report resize-complete; don't gate
@@ -255,7 +298,7 @@ class Cluster:
                     int(s) for s in message.get("shards", [])
                 )
         elif kind == "cluster-state":
-            self.state = message.get("state", STATE_NORMAL)
+            self._command_state(message.get("state", STATE_NORMAL))
         elif kind == "resize-instruction":
             job, reply_to = message.get("job"), message.get("reply_to")
             if job is None:
@@ -424,48 +467,86 @@ class Cluster:
                         "options": f.get("options", {}),
                     }
                 )
-        self.resize_fetch()
+        self.resize_fetch_async()
+
+    def resize_fetch_async(self) -> threading.Thread:
+        """Self-join fetch as a background job — the async pattern the
+        instruction-driven resize path uses (_run_resize_job): the joiner
+        flips to RESIZING immediately (queries gate on wait_until_normal)
+        and returns, so Server.open completes and the node answers
+        /status and cluster messages while fragments stream in
+        concurrently. Unlike the instruction path, no keepalives are
+        sent: this is the pull-based fallback — no coordinator is
+        awaiting a completion report, and progress is observable as
+        state=RESIZING in /status."""
+        self._begin_local_fetch()  # gate queries before returning
+        t = threading.Thread(target=self._resize_fetch_gated, daemon=True,
+                             name="self-join-fetch")
+        t.start()
+        return t
 
     def _peer_fragment_entries(self, index_name: str):
         """(field, view, shard, source node) for every fragment any peer
         holds of one index — shared by resize fetches and the anti-entropy
-        inventory walk."""
-        out = []
-        for node in self.sorted_nodes():
-            if node.id == self.local.id:
-                continue
+        inventory walk. Peers are polled CONCURRENTLY (reference: one
+        goroutine per node in cross-node walks — SURVEY.md §2 #12), so
+        the walk costs the slowest peer's RTT, not the sum; an
+        unreachable peer contributes nothing."""
+        peers = [n for n in self.sorted_nodes() if n.id != self.local.id]
+
+        def one(node):
             try:
                 catalog = self.client.fragment_catalog(node.uri, index_name)
             except ClientError:
-                continue
-            for entry in catalog:
-                out.append((entry["field"], entry["view"], entry["shard"],
-                            node))
-        return out
+                return []
+            return [(e["field"], e["view"], e["shard"], node)
+                    for e in catalog]
+
+        return [e for chunk in concurrent_map(one, peers) for e in chunk]
+
+    def _owned_missing_sources(self) -> list[dict]:
+        """Fetch-instruction list for every fragment this node owns but
+        does not hold locally (the self-join inventory)."""
+        sources = []
+        for index_name, idx in list(self.holder.indexes.items()):
+            for fname, vname, shard, node in self._peer_fragment_entries(
+                index_name
+            ):
+                if not self.owns_shard(index_name, shard):
+                    continue
+                sources.append({
+                    "index": index_name, "field": fname, "view": vname,
+                    "shard": shard, "from": node.uri,
+                })
+        return sources
 
     def resize_fetch(self) -> None:
         """Pull-based fallback: fetch every fragment this node owns but
         does not have (used on self-join, where the joiner cannot wait for
         the coordinator's instructions to arrive)."""
-        self.state = STATE_RESIZING
+        self._begin_local_fetch()
+        self._resize_fetch_gated()
+
+    def _resize_fetch_gated(self) -> None:
+        """The fetch body, with the local-fetch gate already held;
+        always releases it. A failure is logged loudly (the async join
+        path has no caller to raise to) and leaves the gap to
+        anti-entropy repair."""
         try:
-            for index_name, idx in list(self.holder.indexes.items()):
-                for fname, vname, shard, node in self._peer_fragment_entries(
-                    index_name
-                ):
-                    if not self.owns_shard(index_name, shard):
-                        continue
-                    self.fetch_fragments([{
-                        "index": index_name, "field": fname, "view": vname,
-                        "shard": shard, "from": node.uri,
-                    }])
+            self.fetch_fragments(self._owned_missing_sources())
+        except Exception as e:  # noqa: BLE001 — must not die silently
+            self._log_exception("self-join fragment fetch", e)
         finally:
-            self.state = STATE_NORMAL
+            self._end_local_fetch()
 
     def fetch_fragments(self, sources: list[dict]) -> int:
         """Execute the receiving half of resize instructions: fetch and
-        union each listed fragment from its source node."""
-        fetched = 0
+        union each listed fragment from its source node, with the HTTP
+        fetches running concurrently. Fragment objects are resolved (and
+        created) serially first — view.fragment(create=True) must not be
+        raced for one (view, shard) — and the per-fragment union runs
+        under each fragment's own lock."""
+        work = []
         for src in sources:
             idx = self.holder.index(src["index"])
             field = idx.field(src["field"]) if idx else None
@@ -473,17 +554,23 @@ class Cluster:
                 continue
             view = field.view(src["view"], create=True)
             frag = view.fragment(int(src["shard"]), create=True)
+            work.append((src, frag))
+
+        def one(item):
+            src, frag = item
             try:
                 data = self.client.fragment_data(
                     src["from"], src["index"], src["field"], src["view"],
                     int(src["shard"]),
                 )
             except ClientError:
-                continue
+                return 0
             if data:
                 frag.import_roaring(data)
-                fetched += 1
-        return fetched
+                return 1
+            return 0
+
+        return sum(concurrent_map(one, work))
 
     # Seconds between resize-progress keepalives while a fetch runs.
     RESIZE_PROGRESS_INTERVAL = 10.0
@@ -512,11 +599,14 @@ class Cluster:
         if reply_to:
             ka = threading.Thread(target=keepalive, daemon=True)
             ka.start()
+        self._begin_local_fetch()
         try:
             fetched = self.fetch_fragments(sources)
-        except Exception:
+        except Exception as e:
+            self._log_exception("resize-instruction fetch", e)
             fetched = -1  # report anyway: the coordinator must not wait
         finally:
+            self._end_local_fetch()
             done.set()
         if ka is not None:
             ka.join(timeout=5)
@@ -642,7 +732,7 @@ class Cluster:
         # sent to EVERY node, including ones marked DEGRADED mid-resize: a
         # node that received RESIZING but is skipped for NORMAL would stay
         # gated forever (queries time out with "cluster is resizing")
-        self.state = state
+        self._command_state(state)
         self._broadcast({"type": "cluster-state", "state": state})
 
     def leave(self) -> None:

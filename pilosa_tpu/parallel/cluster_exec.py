@@ -28,6 +28,7 @@ from pilosa_tpu.parallel.cluster import Cluster, Node
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.pql.ast import Query
 from pilosa_tpu.shardwidth import SHARD_WIDTH, position, shard_of
+from pilosa_tpu.utils.pool import concurrent_map
 
 _WRITE_BROADCAST = {"SetRowAttrs", "SetColumnAttrs"}
 _SHARDS_TTL = 3.0
@@ -94,18 +95,21 @@ class ClusterExecutor:
             hit = self._shards_cache.get(index_name)
             polled = hit[1] if hit and time.monotonic() - hit[0] < _SHARDS_TTL else None
         if polled is None:
-            polled = set()
-            for node in self.cluster.sorted_nodes():
-                if node.id == self.cluster.local.id:
-                    continue
+            peers = [n for n in self.cluster.sorted_nodes()
+                     if n.id != self.cluster.local.id]
+
+            def poll(node):
                 try:
                     out = self.cluster.client._call(
                         "GET",
                         f"{node.uri}/internal/shards/list?index={index_name}",
                     )
-                    polled.update(out.get("shards", []))
+                    return out.get("shards", [])
                 except ClientError:
-                    pass
+                    return []
+
+            polled = {s for chunk in concurrent_map(poll, peers)
+                      for s in chunk}
             with self._lock:
                 self._shards_cache[index_name] = (time.monotonic(), polled)
         shards = set(self.holder.index(index_name).available_shards())
@@ -129,14 +133,19 @@ class ClusterExecutor:
         return local, list(remote.values())
 
     def _map_remote(self, index_name: str, call: Call, groups):
-        """One sub-query per remote node; returns raw JSON partials."""
-        partials = []
-        for node, shard_group in groups:
+        """One CONCURRENT sub-query per remote node (reference mapReduce:
+        one goroutine per remote node — SURVEY.md §2 #12); returns raw
+        JSON partials in group order. Any node's failure propagates."""
+        pql = call.to_pql()
+
+        def one(group):
+            node, shard_group = group
             out = self.cluster.client.query_node(
-                node.uri, index_name, call.to_pql(), shard_group, remote=True
+                node.uri, index_name, pql, shard_group, remote=True
             )
-            partials.append(out["results"][0])
-        return partials
+            return out["results"][0]
+
+        return concurrent_map(one, groups)
 
     # ----------------------------------------------------------- dispatch
 
@@ -151,15 +160,12 @@ class ClusterExecutor:
             )
             return res
         if name in ("Store", "ClearRow"):
-            # row-wide writes execute on every shard owner
+            # row-wide writes execute on every shard owner, concurrently
             shard_list = shards if shards is not None else self._all_shards(idx.name)
             local, groups = self._route(idx.name, shard_list)
             result = self.local._execute_call(idx, call, local) if local else False
-            for node, shard_group in groups:
-                out = self.cluster.client.query_node(
-                    node.uri, idx.name, call.to_pql(), shard_group, remote=True
-                )
-                result = result or out["results"][0]
+            for out in self._map_remote(idx.name, call, groups):
+                result = result or out
             return result
 
         shard_list = shards if shards is not None else self._all_shards(idx.name)

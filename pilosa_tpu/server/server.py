@@ -262,6 +262,7 @@ class Server:
             insecure_tls=self.config.tls_skip_verify,
         )
         cluster.api = self.api
+        cluster.logger = self.logger
         self.api.cluster = cluster
 
         use_mesh = self.config.use_mesh

@@ -1,0 +1,32 @@
+"""Bounded concurrent map for cross-node HTTP fan-out.
+
+The reference maps remote nodes concurrently — one goroutine per
+sub-query/fetch (executor.go mapReduce remote branch, SURVEY.md §2 #12,
+§3.2) — so cross-node wall time is the max of the per-node latencies,
+not the sum. Python analog: a short-lived thread pool per fan-out; the
+threads spend their lives blocked in HTTP I/O, so the GIL is irrelevant
+and pool construction cost (~100 µs) is noise against network RTTs.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+# Wide enough to cover every peer of a realistically sized cluster in one
+# wave; bounded so a pathological node count cannot spawn unbounded
+# threads per query.
+MAX_FANOUT = 16
+
+
+def concurrent_map(fn, items, max_workers: int = MAX_FANOUT) -> list:
+    """Apply ``fn`` to every item concurrently; results in input order.
+
+    The first exception propagates to the caller (after in-flight calls
+    finish — pool shutdown joins its threads); callers wanting per-item
+    error tolerance catch inside ``fn``.
+    """
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
+        return list(pool.map(fn, items))

@@ -264,6 +264,8 @@ class TestJoinResize:
             )
             late = Server(cfg).open()
             servers.append(late)
+            # the join-time fetch runs as a background job; wait for it
+            assert late.api.cluster.wait_until_normal(30)
             # membership propagated
             st = req("GET", f"{uri(servers[0])}/status")
             assert {n["id"] for n in st["nodes"]} == {"n0", "n9"}
@@ -342,6 +344,7 @@ class TestFailureHandling:
                 heartbeat_interval=0, use_mesh=False,
             )).open()
             servers.append(reborn)
+            assert reborn.api.cluster.wait_until_normal(30)
             servers[0].api.cluster.heartbeat()
             st = req("GET", f"{uri(servers[0])}/status")
             assert {n["id"]: n["state"] for n in st["nodes"]} == {
@@ -826,3 +829,151 @@ class TestBinaryInternalWire:
         finally:
             for s in servers:
                 s.close()
+
+
+class TestConcurrentFanout:
+    def test_remote_map_cost_is_max_not_sum(self, tmp_path):
+        """Cross-node fan-out runs one concurrent sub-query per node
+        (reference mapReduce): with two remote nodes each answering in
+        ~delay seconds, the query's wall time is ~max(delays), not the
+        sum (VERDICT r3 #2)."""
+        import time
+
+        servers = make_cluster(tmp_path, 3)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            s0 = servers[0]
+            cluster = s0.api.cluster
+            shard_for = {}
+            for shard in range(64):
+                owner = cluster.shard_nodes("i", shard)[0].id
+                shard_for.setdefault(owner, shard)
+                if len(shard_for) == 3:
+                    break
+            assert {"n0", "n1", "n2"} <= set(shard_for)
+            for node_id, shard in shard_for.items():
+                col = shard * SHARD_WIDTH + 1
+                req("POST", f"{uri(s0)}/index/i/query",
+                    f"Set({col}, f=1)".encode(), content_type="text/plain")
+            out = req("POST", f"{uri(s0)}/index/i/query",
+                      b"Count(Row(f=1))", content_type="text/plain")
+            assert out["results"][0] == 3
+
+            client = s0.api.executor.cluster.client
+            orig = client.query_node
+            delay = 0.35
+
+            def slow(node_uri, *a, **k):
+                time.sleep(delay)
+                return orig(node_uri, *a, **k)
+
+            client.query_node = slow
+            try:
+                t0 = time.monotonic()
+                out = req("POST", f"{uri(s0)}/index/i/query",
+                          b"Count(Row(f=1))", content_type="text/plain")
+                wall = time.monotonic() - t0
+            finally:
+                client.query_node = orig
+            assert out["results"][0] == 3
+            # serial fan-out would cost >= 2*delay of pure sleep
+            assert wall < 2 * delay * 0.9, f"fan-out not concurrent: {wall:.3f}s"
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestAsyncSelfJoin:
+    def test_joiner_with_slow_peer_serves_status_and_gates_queries(self, tmp_path):
+        """Self-join fetch runs as a background job (VERDICT r3 #8): while
+        a slow peer drags the fragment fetch out, Server.open has already
+        returned, the joiner answers /status as RESIZING, and queries
+        gate on wait_until_normal — then complete correctly once the
+        fetch finishes."""
+        import threading
+        import time
+
+        from pilosa_tpu.parallel.client import InternalClient
+
+        servers = make_cluster(tmp_path, 1)
+        late = None
+        orig = InternalClient.fragment_data
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow_fragment_data(self, *a, **k):
+            started.set()
+            release.wait(30)
+            return orig(self, *a, **k)
+
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 3 for s in range(16)]
+            req("POST", f"{uri(servers[0])}/index/i/field/f/import",
+                {"rows": [1] * len(cols), "columns": cols})
+
+            InternalClient.fragment_data = slow_fragment_data
+            t0 = time.monotonic()
+            late = Server(ServerConfig(
+                data_dir=str(tmp_path / "late"), port=0, name="n9",
+                seeds=[uri(servers[0])], anti_entropy_interval=0,
+                heartbeat_interval=0, use_mesh=False,
+            )).open()
+            open_wall = time.monotonic() - t0
+            assert started.wait(10), "join fetch never started"
+            # open() returned while the fetch is still blocked
+            assert release.is_set() is False
+            assert open_wall < 10
+            # /status answers mid-fetch and reports the gate
+            st = req("GET", f"{uri(late)}/status")
+            assert st["state"] == "RESIZING"
+
+            # a query against the joiner gates (does not error, does not
+            # return early with partial data)
+            result = {}
+
+            def query():
+                out = req("POST", f"{uri(late)}/index/i/query",
+                          b"Count(Row(f=1))")
+                result["count"] = out["results"][0]
+
+            qt = threading.Thread(target=query, daemon=True)
+            qt.start()
+            qt.join(timeout=0.8)
+            assert qt.is_alive(), "query should gate while RESIZING"
+
+            release.set()
+            qt.join(timeout=30)
+            assert not qt.is_alive()
+            assert result["count"] == 16
+            assert late.api.cluster.wait_until_normal(10)
+            assert req("GET", f"{uri(late)}/status")["state"] == "NORMAL"
+        finally:
+            InternalClient.fragment_data = orig
+            release.set()
+            for s in servers + ([late] if late else []):
+                s.close()
+
+    def test_normal_command_deferred_while_local_fetch_in_flight(self):
+        """A coordinator's NORMAL broadcast arriving while this node is
+        still pulling fragments must not un-gate queries mid-fetch; the
+        last local fetch job restores the commanded state."""
+        from pilosa_tpu.parallel.cluster import Cluster, Node
+
+        c = Cluster(Node("n0", "http://localhost:1"))
+        c._begin_local_fetch()
+        assert c.state == "RESIZING"
+        c.handle_message({"type": "cluster-state", "state": "NORMAL"})
+        assert c.state == "RESIZING"  # deferred, not stomped
+        c._end_local_fetch()
+        assert c.state == "NORMAL"  # restored on last job exit
+
+        # and a RESIZING command outlives the local fetch
+        c._begin_local_fetch()
+        c.handle_message({"type": "cluster-state", "state": "RESIZING"})
+        c._end_local_fetch()
+        assert c.state == "RESIZING"
+        c.handle_message({"type": "cluster-state", "state": "NORMAL"})
+        assert c.state == "NORMAL"
