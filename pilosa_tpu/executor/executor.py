@@ -53,7 +53,7 @@ GROUPBY_DENSE_MAX_GROUPS = 4096
 _RESERVED_ARGS = {"_field", "_col", "from", "to", "n", "limit", "offset",
                   "previous", "column", "filter", "field", "ids", "timestamp",
                   "excludeColumns", "shards", "aggregate", "columnAttrs",
-                  "attrName", "attrValue", "like"}
+                  "attrName", "attrValue", "like", "threshold", "having"}
 
 
 class PQLError(ValueError):
@@ -915,8 +915,17 @@ class Executor:
             extra_leaves=(matrix,),
         )
         totals = batch.merge_split(np.asarray(counts))
+        # threshold= : minimum global count to be included (SURVEY-LOW
+        # surface, Appendix B — the upstream arg's exact version gate is
+        # unverifiable with the mount empty; conservative reading: a
+        # post-recount filter, so it never changes which rows WOULD have
+        # qualified, only trims the result). Applied here, after the
+        # exact phase-2 counts; the cluster path strips it from mapped
+        # sub-queries and applies it after the cross-node merge.
+        floor = max(1, int(call.arg("threshold", 0) or 0))
         order = sorted(
-            (int(-c), r) for r, c in zip(candidates, totals.tolist()) if c > 0
+            (int(-c), r)
+            for r, c in zip(candidates, totals.tolist()) if c >= floor
         )
         if n:
             order = order[:n]
@@ -925,14 +934,18 @@ class Executor:
     @staticmethod
     def _filter_topn_candidates(field, call: Call, candidates: list[int]) -> list[int]:
         """TopN(attrName=, attrValue=): keep candidate rows whose attrs
-        match (reference TopN attribute filter)."""
+        match (reference TopN attribute filter). One bulk read for the
+        whole candidate set — the cross-shard overfetch makes this an
+        O(candidates) list, and a per-candidate query loop would pay one
+        sqlite round trip each."""
         attr_name = call.arg("attrName")
         if attr_name is None or field.row_attrs is None:
             return candidates
         attr_value = call.arg("attrValue")
+        attr_map = field.row_attrs.bulk(candidates) if candidates else {}
         return [
             r for r in candidates
-            if field.row_attrs.attrs(r).get(attr_name) == attr_value
+            if attr_map.get(r, {}).get(attr_name) == attr_value
         ]
 
     def _finish_pairs(self, idx: Index, field, pairs: list[Pair]) -> list[Pair]:
@@ -1002,9 +1015,9 @@ class Executor:
 
     def _groupby_prelude(self, idx: Index, call: Call, shards=None):
         """Shared GroupBy argument parsing/validation: returns
-        (limit, filter call|None, aggregate int field|None, dims) where
-        dims is [(field_name, row_ids), ...]; dims is empty when any
-        dimension has no rows (→ empty result)."""
+        (limit, filter call|None, aggregate int field|None, dims, having
+        predicate|None) where dims is [(field_name, row_ids), ...]; dims
+        is empty when any dimension has no rows (→ empty result)."""
         if not call.children or any(c.name != "Rows" for c in call.children):
             raise PQLError("GroupBy requires Rows(...) children")
         limit = call.arg("limit", 0)
@@ -1023,21 +1036,30 @@ class Executor:
             if agg_field is None or agg_field.options.type != TYPE_INT:
                 raise PQLError("GroupBy aggregate requires an int field")
 
+        # build having= eagerly (before the possibly-empty dims early
+        # return) so a malformed condition errors even on empty results
+        having = having_predicate(call, has_agg=agg_field is not None)
+
         dims = []
         for child in call.children:
             fname = child.arg("_field") or child.arg("field")
             row_ids = self._rows_ids(idx, child, shards)
             if not row_ids:
-                return limit, filt_call, agg_field, []
+                return limit, filt_call, agg_field, [], having
             dims.append((fname, row_ids))
-        return limit, filt_call, agg_field, dims
+        return limit, filt_call, agg_field, dims, having
 
     def _groupby_result(
-        self, idx: Index, dims, counts: dict, sums: dict, agg_field, limit
+        self, idx: Index, dims, counts: dict, sums: dict, agg_field, limit,
+        having=None,
     ) -> list[GroupCount]:
         """Shared GroupBy result construction: rowID→rowKey translation for
         keyed dimension fields (reference GroupBy FieldRow carries RowKey
-        when the field has keys), ordering, limit."""
+        when the field has keys), having filter, ordering, limit."""
+        if having is not None:
+            counts = {
+                k: c for k, c in counts.items() if having(c, sums.get(k))
+            }
         dim_keys: list[dict[int, str] | None] = []
         for fname, row_ids in dims:
             field = idx.field(fname)
@@ -1090,7 +1112,9 @@ class Executor:
         (batch.GROUPBY_MASK_BUDGET_BYTES) so the dense group masks never
         outgrow HBM.
         """
-        limit, filt_call, agg_field, dims = self._groupby_prelude(idx, call, shards)
+        limit, filt_call, agg_field, dims, having = self._groupby_prelude(
+            idx, call, shards
+        )
         if not dims:
             return []
         shard_list = self._shards(idx, shards)
@@ -1175,7 +1199,9 @@ class Executor:
                 n = int(agg_arrs[0][j])
                 pc = agg_arrs[1][:, j].tolist()
                 sums[gkey] = sum(int(v) << b for b, v in enumerate(pc)) + base * n
-        return self._groupby_result(idx, dims, counts, sums, agg_field, limit)
+        return self._groupby_result(
+            idx, dims, counts, sums, agg_field, limit, having=having,
+        )
 
     def _groupby_eval_level(self, idx: Index, block, filt_leaves, filt_node,
                             scalars, dim_mats, cand: np.ndarray, planes,
@@ -1352,6 +1378,50 @@ class Executor:
             frag = field.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
             frag.write_row_words(int(row), host[i])
         return True
+
+
+def condition_test(cond: Condition, val: int) -> bool:
+    """Evaluate a PQL Condition against a scalar (having= filters)."""
+    if cond.op == "><":
+        lo, hi = cond.value
+        return int(lo) <= val <= int(hi)
+    ref = int(cond.value)
+    return {
+        "<": val < ref, "<=": val <= ref, ">": val > ref, ">=": val >= ref,
+        "==": val == ref, "!=": val != ref,
+    }[cond.op]
+
+
+def having_predicate(call: Call, has_agg: bool):
+    """GroupBy(having=Condition(count > N)) / Condition(sum > N).
+
+    SURVEY-LOW surface (Appendix B: exact upstream version gate
+    unverifiable with the mount empty). Conservative reading implemented:
+    exactly one condition on ``count`` or ``sum``, applied to fully
+    merged groups BEFORE limit truncation — so having trims groups, never
+    changes their counts, and a sum condition requires
+    aggregate=Sum(...). Returns ``pred(count, sum) -> bool`` or None.
+    """
+    having = call.arg("having")
+    if having is None:
+        return None
+    if not isinstance(having, Call) or having.name != "Condition":
+        raise PQLError("having= requires Condition(count/sum <op> value)")
+    conds = [(k, v) for k, v in having.args.items()
+             if isinstance(v, Condition)]
+    if len(conds) != 1 or conds[0][0] not in ("count", "sum"):
+        raise PQLError(
+            "having= supports exactly one condition on count or sum"
+        )
+    subject, cond = conds[0]
+    if subject == "sum" and not has_agg:
+        raise PQLError("having on sum requires aggregate=Sum(...)")
+
+    def pred(count: int, sum_) -> bool:
+        val = count if subject == "count" else int(sum_ or 0)
+        return condition_test(cond, val)
+
+    return pred
 
 
 def _attr_args(call: Call) -> dict:

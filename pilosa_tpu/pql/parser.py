@@ -34,6 +34,9 @@ CALL_NAMES = {
     "Count", "TopN", "Min", "Max", "Sum", "Range", "Rows", "GroupBy",
     "Set", "Clear", "ClearRow", "Store", "SetValue", "SetRowAttrs",
     "SetColumnAttrs", "Options", "IncludesColumn",
+    # pseudo-call: appears only as an arg value —
+    # GroupBy(..., having=Condition(count > 10))
+    "Condition",
 } | set(ALIASES)
 
 _CMP_OPS = ("><", "<=", ">=", "==", "!=", "<", ">")
@@ -197,6 +200,13 @@ def _parse_arg(lex: _Lexer, call: Call) -> None:
             return
         op = lex.take_cmp()
         if op is not None:
+            if isinstance(call.args.get(ident), Condition):
+                # Condition(count > 1, count < 5) would silently keep only
+                # the last condition; ranges must use `count >< [lo, hi]`
+                raise ParseError(
+                    f"duplicate condition on {ident!r} (use >< for ranges)",
+                    lex.pos,
+                )
             call.args[ident] = Condition(op, _parse_value(lex))
             return
         if ident in ("true", "false"):

@@ -64,13 +64,20 @@ class AttrStore:
         return current
 
     def bulk(self, ids) -> dict[int, dict]:
+        """One read for many ids, chunked under SQLite's host-parameter
+        limit (999 in older builds) so TopN-scale candidate lists work."""
+        ids = [int(i) for i in ids]
+        out: dict[int, dict] = {}
         with self._lock:
-            marks = ",".join("?" * len(ids))
-            rows = self._conn.execute(
-                f"SELECT id, data FROM attrs WHERE id IN ({marks})",
-                [int(i) for i in ids],
-            ).fetchall()
-        return {int(i): json.loads(d) for i, d in rows}
+            for lo in range(0, len(ids), 500):
+                chunk = ids[lo:lo + 500]
+                marks = ",".join("?" * len(chunk))
+                rows = self._conn.execute(
+                    f"SELECT id, data FROM attrs WHERE id IN ({marks})",
+                    chunk,
+                ).fetchall()
+                out.update((int(i), json.loads(d)) for i, d in rows)
+        return out
 
     def blocks(self) -> list[tuple[int, str]]:
         """Content-hashed ATTR_BLOCK_SIZE-id blocks (anti-entropy diffing)."""

@@ -293,6 +293,86 @@ class TestTopNRowsGroupBy:
         got = {g.group[0]["rowID"]: g.count for g in groups}
         assert got[1] == 3 and got[2] == 25
 
+    def test_topn_threshold(self, env):
+        """TopN(threshold=) — SURVEY-LOW surface (Appendix B: exact
+        upstream semantics unverifiable, mount empty). Conservative
+        reading under test: a minimum-global-count filter applied after
+        the exact phase-2 recount, before trimming to n."""
+        holder, ex = env
+        self.setup_ranked(holder)
+        # counts: row2=50, row3=35, row4=35, row1=5
+        (pairs,) = ex.execute("r", "TopN(f, n=10, threshold=35)")
+        assert [(p.id, p.count) for p in pairs] == [(2, 50), (3, 35), (4, 35)]
+        (pairs,) = ex.execute("r", "TopN(f, n=10, threshold=36)")
+        assert [(p.id, p.count) for p in pairs] == [(2, 50)]
+        # threshold composes with n (filter first, then trim)
+        (pairs,) = ex.execute("r", "TopN(f, n=1, threshold=35)")
+        assert [(p.id, p.count) for p in pairs] == [(2, 50)]
+        # explicit-ids recount respects the floor too
+        (pairs,) = ex.execute("r", "TopN(f, ids=[1, 3], n=5, threshold=10)")
+        assert [(p.id, p.count) for p in pairs] == [(3, 35)]
+
+    def test_groupby_having_count(self, env):
+        """GroupBy(having=Condition(count <op> N)) — SURVEY-LOW surface
+        (Appendix B). Conservative reading under test: one condition on
+        the merged group count, applied before limit."""
+        holder, ex = env
+        self.setup_ranked(holder)
+        # base counts: (1,7)=3 (2,7)=25 (3,7)=10 (4,7)=18
+        (groups,) = ex.execute(
+            "r", "GroupBy(Rows(f), Rows(g), having=Condition(count > 10))"
+        )
+        got = {g.group[0]["rowID"]: g.count for g in groups}
+        assert got == {2: 25, 4: 18}
+        (groups,) = ex.execute(
+            "r", "GroupBy(Rows(f), Rows(g), having=Condition(count >< [3, 18]))"
+        )
+        assert {g.group[0]["rowID"] for g in groups} == {1, 3, 4}
+        # having applies BEFORE limit: the one survivor is returned even
+        # though it sorts after the groups having filtered out
+        (groups,) = ex.execute(
+            "r",
+            "GroupBy(Rows(f), Rows(g), limit=1, having=Condition(count == 18))",
+        )
+        assert [(g.group[0]["rowID"], g.count) for g in groups] == [(4, 18)]
+
+    def test_groupby_having_sum_requires_aggregate(self, env):
+        from pilosa_tpu.executor.executor import PQLError
+
+        holder, ex = env
+        self.setup_ranked(holder)
+        with pytest.raises(PQLError, match="aggregate"):
+            ex.execute(
+                "r", "GroupBy(Rows(f), having=Condition(sum > 10))"
+            )
+        with pytest.raises(PQLError, match="count or sum"):
+            ex.execute(
+                "r", "GroupBy(Rows(f), having=Condition(bogus > 10))"
+            )
+        with pytest.raises(PQLError, match="Condition"):
+            ex.execute("r", "GroupBy(Rows(f), having=5)")
+
+    def test_groupby_having_sum(self, env):
+        holder, ex = env
+        idx = holder.create_index("hs")
+        f = idx.create_field("f")
+        amt = idx.create_field("amt", FieldOptions(type="int", min=0, max=100))
+        # group 1: cols 0..4 value 10 (sum 50); group 2: cols 5..6 value 40 (sum 80)
+        for c in range(5):
+            f.set_bit(1, c)
+            amt.set_value(c, 10)
+        for c in range(5, 7):
+            f.set_bit(2, c)
+            amt.set_value(c, 40)
+        (groups,) = ex.execute(
+            "hs",
+            'GroupBy(Rows(f), aggregate=Sum(field="amt"), '
+            "having=Condition(sum > 60))",
+        )
+        assert [(g.group[0]["rowID"], g.count, g.sum) for g in groups] == [
+            (2, 2, 80)
+        ]
+
 
 class TestTimeViews:
     def test_row_time_range(self, env):

@@ -42,6 +42,41 @@ def test_topn_attr_filter(tmp_path):
     holder.close()
 
 
+def test_topn_attr_filter_bulk_read(tmp_path, monkeypatch):
+    """The attr filter issues ONE bulk read for the whole candidate set
+    (1k+ candidates), not a per-candidate attrs() loop — and bulk()
+    chunks under SQLite's host-parameter limit."""
+    holder = Holder(str(tmp_path / "d")).open()
+    ex = Executor(holder)
+    idx = holder.create_index("i")
+    f = idx.create_field("f", FieldOptions(cache_size=2048))
+    n_rows = 1100
+    for row in range(1, n_rows + 1):
+        f.set_bit(row, row % 7)
+        if row % 2:
+            f.row_attrs.set_attrs(row, {"cat": "a"})
+
+    calls = {"bulk": 0, "single": 0}
+    real_bulk = f.row_attrs.bulk
+    monkeypatch.setattr(
+        f.row_attrs, "bulk",
+        lambda ids: (calls.__setitem__("bulk", calls["bulk"] + 1),
+                     real_bulk(ids))[1],
+    )
+    monkeypatch.setattr(
+        f.row_attrs, "attrs",
+        lambda id_: (_ for _ in ()).throw(
+            AssertionError("per-candidate attrs() call in TopN filter")
+        ),
+    )
+    (pairs,) = ex.execute(
+        "i", f'TopN(f, n={n_rows}, attrName="cat", attrValue="a")'
+    )
+    assert calls["bulk"] == 1
+    assert {p.id for p in pairs} == {r for r in range(1, n_rows + 1) if r % 2}
+    holder.close()
+
+
 def test_rows_like(tmp_path):
     holder = Holder(str(tmp_path / "d")).open()
     ex = Executor(holder)

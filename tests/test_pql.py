@@ -112,6 +112,17 @@ def test_parse_errors():
             parse(bad)
 
 
+def test_duplicate_condition_arg_rejected():
+    """Condition(count > 1, count < 5) would silently keep only the last
+    condition (dict overwrite); the parser rejects it and points at ><."""
+    with pytest.raises(ParseError, match="duplicate condition"):
+        parse("GroupBy(Rows(f), having=Condition(count > 1, count < 5))")
+    # ranges spell it with the between operator
+    q = parse("GroupBy(Rows(f), having=Condition(count >< [2, 4]))")
+    having = q.calls[0].args["having"]
+    assert having.args["count"] == Condition("><", [2, 4])
+
+
 def test_negative_and_list_values():
     q = parse("Range(fare >< [-10, -5]) Row(f=-1)")
     assert q.calls[0].args["fare"] == Condition("><", [-10, -5])
