@@ -482,7 +482,13 @@ class Cluster:
         self._begin_local_fetch()  # gate queries before returning
         t = threading.Thread(target=self._resize_fetch_gated, daemon=True,
                              name="self-join-fetch")
-        t.start()
+        try:
+            t.start()
+        except BaseException:
+            # the thread never ran, so the gate would never drain and the
+            # node would sit RESIZING forever
+            self._end_local_fetch()
+            raise
         return t
 
     def _peer_fragment_entries(self, index_name: str):
@@ -506,26 +512,47 @@ class Cluster:
 
     def _owned_missing_sources(self) -> list[dict]:
         """Fetch-instruction list for every fragment this node owns but
-        does not hold locally (the self-join inventory)."""
+        does not hold locally (the self-join inventory). One FETCH per
+        fragment: with replicaN>1 the peer walk reports the same
+        (field, view, shard) once per replica holding it, and fetching a
+        full payload per replica would multiply join transfer — so extra
+        replicas become ``fallbacks`` that fetch_fragments tries only if
+        the first source errors. Fragments already present locally WITH
+        DATA are left to anti-entropy's block diff instead of a redundant
+        full fetch; an empty local fragment is re-fetched (it may be the
+        placeholder of an earlier failed fetch, which must not mask the
+        repair)."""
         sources = []
+        by_key: dict[tuple, dict] = {}
         for index_name, idx in list(self.holder.indexes.items()):
             for fname, vname, shard, node in self._peer_fragment_entries(
                 index_name
             ):
+                key = (index_name, fname, vname, shard)
+                prior = by_key.get(key)
+                if prior is not None:
+                    prior["fallbacks"].append(node.uri)
+                    continue
                 if not self.owns_shard(index_name, shard):
                     continue
-                sources.append({
+                field = idx.field(fname)
+                view = field.view(vname) if field is not None else None
+                frag = view.fragment(shard) if view is not None else None
+                if frag is not None and frag.count() > 0:
+                    continue  # already held locally with data
+                src = {
                     "index": index_name, "field": fname, "view": vname,
-                    "shard": shard, "from": node.uri,
-                })
+                    "shard": shard, "from": node.uri, "fallbacks": [],
+                }
+                by_key[key] = src
+                sources.append(src)
         return sources
 
     def resize_fetch(self) -> None:
-        """Pull-based fallback: fetch every fragment this node owns but
-        does not have (used on self-join, where the joiner cannot wait for
-        the coordinator's instructions to arrive)."""
-        self._begin_local_fetch()
-        self._resize_fetch_gated()
+        """Synchronous form of the self-join fetch (tests/tools): run the
+        background job and wait for it. Same error behavior as the async
+        path — failures are logged and left to anti-entropy, not raised."""
+        self.resize_fetch_async().join()
 
     def _resize_fetch_gated(self) -> None:
         """The fetch body, with the local-fetch gate already held;
@@ -534,6 +561,14 @@ class Cluster:
         anti-entropy repair."""
         try:
             self.fetch_fragments(self._owned_missing_sources())
+            # Freshness: fragments we ALREADY held may be stale from an
+            # outage window (writes landed on replicas while this node
+            # was away). Block-diff them against replicas before the
+            # gate releases, so a rejoining node never serves the stale
+            # window — the full fetch above covers only missing
+            # fragments, and a checksum-block diff is far cheaper than
+            # re-downloading every held fragment's full payload.
+            self.sync_holder()
         except Exception as e:  # noqa: BLE001 — must not die silently
             self._log_exception("self-join fragment fetch", e)
         finally:
@@ -556,19 +591,35 @@ class Cluster:
             frag = view.fragment(int(src["shard"]), create=True)
             work.append((src, frag))
 
+        from pilosa_tpu.roaring.format import load_any
+
         def one(item):
             src, frag = item
-            try:
-                data = self.client.fragment_data(
-                    src["from"], src["index"], src["field"], src["view"],
-                    int(src["shard"]),
-                )
-            except ClientError:
-                return 0
-            if data:
-                frag.import_roaring(data)
+            for source_uri in [src["from"], *src.get("fallbacks", [])]:
+                try:
+                    data = self.client.fragment_data(
+                        source_uri, src["index"], src["field"], src["view"],
+                        int(src["shard"]),
+                    )
+                except ClientError:
+                    continue  # replica fallback: try the next holder
+                if not data:
+                    continue  # source lacks the fragment; try a replica
+                try:
+                    bitmap, _ = load_any(data)
+                except Exception:
+                    # torn/corrupt payload (e.g. a snapshot mid-write on
+                    # the source) must not abort the batch — a healthy
+                    # replica may hold good data for this fragment
+                    continue
+                if bitmap.count() == 0:
+                    # an EMPTY payload may be the placeholder of the
+                    # source's own failed fetch — keep trying replicas
+                    # rather than declaring the move done with no data
+                    continue
+                frag.import_roaring_bitmap(bitmap)
                 return 1
-            return 0
+            return 0  # no replica holds data (or all are unreachable)
 
         return sum(concurrent_map(one, work))
 

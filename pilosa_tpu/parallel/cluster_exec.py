@@ -20,7 +20,11 @@ import time
 import numpy as np
 
 from pilosa_tpu.executor import Executor
-from pilosa_tpu.executor.executor import PQLError, TOPN_CANDIDATE_FACTOR
+from pilosa_tpu.executor.executor import (
+    PQLError,
+    TOPN_CANDIDATE_FACTOR,
+    having_predicate,
+)
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.ops.packing import pack_bits
 from pilosa_tpu.parallel.client import ClientError
@@ -28,7 +32,7 @@ from pilosa_tpu.parallel.cluster import Cluster, Node
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.pql.ast import Query
 from pilosa_tpu.shardwidth import SHARD_WIDTH, position, shard_of
-from pilosa_tpu.utils.pool import concurrent_map
+from pilosa_tpu.utils.pool import concurrent_map, run_concurrently
 
 _WRITE_BROADCAST = {"SetRowAttrs", "SetColumnAttrs"}
 _SHARDS_TTL = 3.0
@@ -161,10 +165,15 @@ class ClusterExecutor:
             return res
         if name in ("Store", "ClearRow"):
             # row-wide writes execute on every shard owner, concurrently
+            # (local evaluation overlaps the remote fan-out)
             shard_list = shards if shards is not None else self._all_shards(idx.name)
             local, groups = self._route(idx.name, shard_list)
-            result = self.local._execute_call(idx, call, local) if local else False
-            for out in self._map_remote(idx.name, call, groups):
+            result, outs = run_concurrently(
+                lambda: (self.local._execute_call(idx, call, local)
+                         if local else False),
+                lambda: self._map_remote(idx.name, call, groups),
+            )
+            for out in outs:
                 result = result or out
             return result
 
@@ -176,18 +185,36 @@ class ClusterExecutor:
         if name == "IncludesColumn":
             return self._execute_includes(idx, call)
 
-        # Rows/GroupBy: limits must apply AFTER the cross-node merge, so
-        # strip them from the mapped call and re-apply in _reduce
+        # Rows/GroupBy: limit (and GroupBy's having) must apply AFTER the
+        # cross-node merge — a per-node filter would drop partial groups
+        # whose merged count qualifies — so strip them from the mapped
+        # call and re-apply in _reduce. The having predicate is built
+        # BEFORE the map phase so a malformed condition errors without
+        # wasting the distributed scan (matching the executor's eager
+        # validation in _groupby_prelude).
+        having = None
+        if name == "GroupBy":
+            having = having_predicate(
+                call, has_agg=isinstance(call.arg("aggregate"), Call)
+            )
         mapped = call
-        if name in ("Rows", "GroupBy") and call.arg("limit"):
+        if name in ("Rows", "GroupBy") and (
+            call.arg("limit") or having is not None
+        ):
             mapped = Call(
                 name,
-                {k: v for k, v in call.args.items() if k != "limit"},
+                {k: v for k, v in call.args.items()
+                 if k not in ("limit", "having")},
                 call.children,
             )
-        partials = self._map_remote(idx.name, mapped, groups) if groups else []
-        local_res = self.local._execute_call(idx, mapped, local)
-        return self._reduce(idx, call, local_res, partials)
+        # local map phase overlaps the remote fan-out (reference
+        # mapReduce: local mapper goroutines and remote sub-queries share
+        # one errgroup) — wall time is max(local, slowest peer), not sum
+        local_res, partials = run_concurrently(
+            lambda: self.local._execute_call(idx, mapped, local),
+            lambda: self._map_remote(idx.name, mapped, groups) if groups else [],
+        )
+        return self._reduce(idx, call, local_res, partials, having=having)
 
     # --------------------------------------------------------------- writes
 
@@ -222,7 +249,7 @@ class ClusterExecutor:
 
     # --------------------------------------------------------------- reduce
 
-    def _reduce(self, idx, call: Call, local_res, partials):
+    def _reduce(self, idx, call: Call, local_res, partials, having=None):
         name = call.name
         if name == "Count":
             return int(local_res) + sum(int(p) for p in partials)
@@ -305,6 +332,10 @@ class ClusterExecutor:
                     for e in kv[0]
                 )
 
+            if having is not None:
+                counts = {
+                    k: c for k, c in counts.items() if having(c, sums.get(k))
+                }
             out = [
                 GroupCount(fields[k], c, sum=sums.get(k))
                 for k, c in sorted(counts.items(), key=order)
@@ -328,15 +359,23 @@ class ClusterExecutor:
 
     def _execute_topn(self, idx, call: Call, local, groups):
         n = call.arg("n", 10)
+        # threshold= filters on GLOBAL counts, so it is stripped from
+        # every mapped sub-query (a per-node floor would drop candidates
+        # whose cross-node sum qualifies) and applied after the merge
+        mapped_args = {k: v for k, v in call.args.items() if k != "threshold"}
         explicit_ids = call.arg("ids")
         if explicit_ids is None:
-            # phase 1: overfetched candidates from every node
+            # phase 1: overfetched candidates from every node (local
+            # evaluation overlapping the remote fan-out)
             overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
-            phase1 = Call("TopN", {**call.args, "n": overfetch}, call.children)
+            phase1 = Call("TopN", {**mapped_args, "n": overfetch}, call.children)
             candidates: set[int] = set()
-            local_pairs = self.local._execute_call(idx, phase1, local)
+            local_pairs, remote1 = run_concurrently(
+                lambda: self.local._execute_call(idx, phase1, local),
+                lambda: self._map_remote(idx.name, phase1, groups),
+            )
             candidates.update(p.id for p in local_pairs)
-            for p in self._map_remote(idx.name, phase1, groups):
+            for p in remote1:
                 candidates.update(pair["id"] for pair in p)
             if not candidates:
                 return []
@@ -344,14 +383,19 @@ class ClusterExecutor:
         else:
             ids = sorted(int(i) for i in explicit_ids)
         # phase 2: exact recount of the merged candidate set everywhere
-        phase2 = Call("TopN", {**call.args, "ids": ids, "n": 0}, call.children)
+        phase2 = Call("TopN", {**mapped_args, "ids": ids, "n": 0}, call.children)
         totals: dict[int, int] = {}
-        for p in self.local._execute_call(idx, phase2, local):
+        local2, remote2 = run_concurrently(
+            lambda: self.local._execute_call(idx, phase2, local),
+            lambda: self._map_remote(idx.name, phase2, groups),
+        )
+        for p in local2:
             totals[p.id] = totals.get(p.id, 0) + p.count
-        for partial in self._map_remote(idx.name, phase2, groups):
+        for partial in remote2:
             for pair in partial:
                 totals[pair["id"]] = totals.get(pair["id"], 0) + pair["count"]
-        order = sorted((-c, r) for r, c in totals.items() if c > 0)
+        floor = max(1, int(call.arg("threshold", 0) or 0))
+        order = sorted((-c, r) for r, c in totals.items() if c >= floor)
         pairs = [Pair(r, -negc) for negc, r in order[: n or len(order)]]
         field = idx.field(call.arg("_field") or call.arg("field"))
         return self.local._finish_pairs(idx, field, pairs)

@@ -30,3 +30,14 @@ def concurrent_map(fn, items, max_workers: int = MAX_FANOUT) -> list:
         return [fn(x) for x in items]
     with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
         return list(pool.map(fn, items))
+
+
+def run_concurrently(*thunks) -> list:
+    """Run zero-arg thunks concurrently; results in input order.
+
+    Used to overlap the coordinator's LOCAL shard evaluation with the
+    remote fan-out (reference mapReduce runs the local mapper goroutines
+    and remote sub-queries in the same errgroup): distributed query wall
+    time is max(local, slowest peer), not their sum.
+    """
+    return concurrent_map(lambda f: f(), thunks)
