@@ -97,8 +97,9 @@ class ShardBlock:
         self._key = None
         # single-process defaults; the multi-host ShardAssignment
         # (parallel/mesh.py) narrows local_slots to this process's rows
-        # and clears patchable (write events then purge resident leaves
-        # instead of scatter-patching them)
+        # and clears patchable (write events then patch the addressable
+        # single-device PIECE holding the slot — _patch_sharded — instead
+        # of scattering into the whole array)
         self.local_slots = (0, self.padded)
         self.patchable = True
 
@@ -238,6 +239,29 @@ def _word_masks(positions) -> tuple[np.ndarray, np.ndarray]:
     return out_w, out_m
 
 
+def _patch_sharded(arr, slot: int, make_patch):
+    """Patch one global row of a multi-process sharded array WITHOUT a
+    collective: rewrite only the addressable single-device piece holding
+    ``slot`` (a single-device program on that piece's device) and
+    reassemble the global handle from the per-device buffers — every
+    other piece's buffer is reused as-is. Each process's handle only
+    contributes its own addressable data to SPMD execution, so a
+    process-local reassembly is all a local write needs (SURVEY.md §7.3
+    hard part #3, multi-host case — VERDICT r3 #6)."""
+    pieces = list(arr.addressable_shards)
+    datas = [p.data for p in pieces]
+    for i, p in enumerate(pieces):
+        sl = p.index[0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else arr.shape[0]
+        if start <= slot < stop:
+            datas[i] = make_patch(datas[i], slot - start)
+            return jax.make_array_from_single_device_arrays(
+                arr.shape, arr.sharding, datas
+            )
+    return arr  # slot not addressable here: nothing local to patch
+
+
 def _make_probe(block: ShardBlock, match, row_pos_of, decode_row,
                 delta_on_clear: bool):
     """Shared write-routing probe for every stacked-leaf kind.
@@ -249,31 +273,30 @@ def _make_probe(block: ShardBlock, match, row_pos_of, decode_row,
     delta_on_clear → clears may delta-patch (single-view leaves only: with
     multiple OR'd views a cleared bit may survive via another view).
 
-    Non-patchable blocks (multi-host ShardAssignment): a device scatter
-    on a multi-process global array would be a collective program every
+    Non-patchable blocks (multi-host ShardAssignment): a whole-array
+    scatter on a multi-process global array would be a collective every
     process must join, but a write event fires only on the process whose
-    holder received the write — so a matching write purges the resident
-    entry (an array-handle drop; device buffers of other slots are
-    untouched) and the next query re-feeds this host's slots from its
-    holder. Correctness contract: a shard's writes must be applied on
-    (at least) the process owning that shard's slot — the cluster layer
-    routes writes to fragment owners, which the slot layout mirrors; a
-    process that only observes a foreign shard's write merely refreshes
-    its handle.
+    holder received the write — so the patch is applied per-piece
+    (_patch_sharded): the addressable single-device buffer holding the
+    shard's slot is rewritten locally and the global handle reassembled,
+    with no host round trip and no purge-refeed of unrelated slots.
+    Correctness contract: a shard's writes must be applied on (at least)
+    the process owning that shard's slot — the cluster layer routes
+    writes to fragment owners, which the slot layout mirrors; a process
+    observing a foreign shard's write has nothing local to patch (its
+    pieces don't contain that slot) and leaves its handle untouched.
     """
     slot_of = {s: i for i, s in enumerate(block.shards)}
-
-    if not block.patchable:
-        def purge_probe(ev):
-            if ev.shard in slot_of and match(ev):
-                return residency.PURGE
-            return None
-
-        return purge_probe
+    per_piece = not block.patchable
+    slot_lo, slot_hi = block.local_slots
 
     def probe(ev):
         slot = slot_of.get(ev.shard)
         if slot is None or not match(ev):
+            return None
+        if per_piece and not (slot_lo <= slot < slot_hi):
+            # foreign shard's write observed on this process: none of our
+            # addressable pieces contain that slot — nothing local to do
             return None
         row_pos = row_pos_of(ev) if row_pos_of is not None else None
         if ev.added or (ev.added is False and delta_on_clear):
@@ -281,17 +304,30 @@ def _make_probe(block: ShardBlock, match, row_pos_of, decode_row,
                 word_idx, masks = _word_masks(ev.positions)
                 if row_pos is None:
                     fn = _or_delta if ev.added else _andnot_delta
+                    if per_piece:
+                        return lambda arr: _patch_sharded(
+                            arr, slot,
+                            lambda piece, r: fn(piece, r, word_idx, masks),
+                        )
                     return lambda arr: fn(arr, slot, word_idx, masks)
                 fn = _or_delta_row if ev.added else _andnot_delta_row
+                if per_piece:
+                    return lambda arr: _patch_sharded(
+                        arr, slot,
+                        lambda piece, r: fn(piece, r, row_pos, word_idx,
+                                            masks),
+                    )
                 return lambda arr: fn(arr, slot, row_pos, word_idx, masks)
 
-        def apply(arr):
+        def set_row(arr_or_piece, r):
             new = jnp.asarray(decode_row(ev))
             if row_pos is None:
-                return arr.at[slot].set(new)
-            return arr.at[slot, row_pos].set(new)
+                return arr_or_piece.at[r].set(new)
+            return arr_or_piece.at[r, row_pos].set(new)
 
-        return apply
+        if per_piece:
+            return lambda arr: _patch_sharded(arr, slot, set_row)
+        return lambda arr: set_row(arr, slot)
 
     return probe
 

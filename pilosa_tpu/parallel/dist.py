@@ -204,10 +204,13 @@ class DistExecutor(Executor):
     _leaf_put assembles the global array with
     jax.make_array_from_process_local_data), reductions cross hosts via
     psum inside the compiled program, and reduced results come back
-    replicated. Writes purge resident sharded leaves instead of
-    scatter-patching them (batch._make_probe: a device scatter on a
-    multi-process array would be a collective a single host can't run
-    alone). Row-materializing results stay shard-sharded and are only
+    replicated. Writes scatter-patch resident sharded leaves per
+    addressable PIECE (batch._patch_sharded): the single-device buffer
+    holding the written shard's slot is rewritten locally — a
+    single-device program, no collective — and the global handle
+    reassembled from the per-device buffers, so multi-host writes don't
+    pay a purge + full re-decode of the process's slots.
+    Row-materializing results stay shard-sharded and are only
     read back single-process; in a deployed cluster they travel per-node
     through the HTTP layer (parallel/cluster_exec.py), as the reference's
     do."""

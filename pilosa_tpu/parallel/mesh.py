@@ -83,13 +83,12 @@ class ShardAssignment(ShardBlock):
         self.local_slots = (0, self.padded)
         # Multi-host: this process feeds only the slot rows that live on
         # its addressable devices (jax.make_array_from_process_local_data
-        # in DistExecutor._leaf_put assembles the global array), and
-        # resident leaves cannot be patched in place on write — a device
-        # scatter on a multi-process global array would be a collective
-        # every process must join, but a write event fires only on the
-        # process whose holder received it — so write events purge the
-        # local array handle instead (batch._make_probe, which also
-        # states the owner-applies-the-write correctness contract).
+        # in DistExecutor._leaf_put assembles the global array). Writes
+        # patch resident leaves per-PIECE: the addressable single-device
+        # buffer holding the shard's slot is rewritten locally and the
+        # global handle reassembled, no collective involved
+        # (batch._patch_sharded; batch._make_probe states the
+        # owner-applies-the-write correctness contract).
         if jax.process_count() > 1:
             per_dev = self.padded // self.n_devices
             flat = mesh.devices.ravel()

@@ -95,15 +95,35 @@ with tempfile.TemporaryDirectory() as tmp:
 
     # write-through: the contract is that a shard's write is applied on
     # (at least) the process owning that shard's slot; here both
-    # replicated holders apply it, which covers the owner. The purge
-    # probe drops each process's resident array handle and the next
-    # query re-feeds each host's slots from its holder.
+    # replicated holders apply it, which covers the owner. Resident
+    # sharded leaves are PATCHED per addressable piece (VERDICT r3 #6:
+    # batch._patch_sharded, a single-device scatter + handle reassembly,
+    # no collective) — asserted via residency counters: the write must
+    # bump `updates` and the re-query must re-decode nothing.
+    from pilosa_tpu.storage import residency  # noqa: E402
+
+    cache = residency.global_row_cache()
+    misses_before = cache.misses
+    updates_before = cache.updates
     new_col = 5 * SHARD_WIDTH + 997  # shard 5: process 1's half
     holder.index("repos").field("f").set_bit(1, new_col)
     holder.index("repos").field("f").set_bit(2, new_col)
+    if PROC_ID == 1:  # shard 5's slot is addressable on process 1 only
+        assert cache.updates >= updates_before + 2, (
+            "multi-host write did not patch resident leaves in place",
+            updates_before, cache.updates,
+        )
+    else:  # non-owner: nothing local to patch, and nothing purged
+        assert cache.updates == updates_before, (
+            updates_before, cache.updates,
+        )
     got = ex.execute("repos", "Count(Intersect(Row(f=1), Row(f=2)))")[0]
     want = len((rows[1] | {new_col}) & (rows[2] | {new_col}))
     assert got == want, (got, want)
+    assert cache.misses == misses_before, (
+        "write purged resident leaves: re-query re-decoded",
+        misses_before, cache.misses,
+    )
 
     holder.close()
 
