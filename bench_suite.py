@@ -244,23 +244,144 @@ def config5_ssb_4way(n_shards: int) -> dict:
         }
 
 
+def config5_mesh_cpu8(n_shards: int = 16, n_queries: int = 64) -> dict:
+    """Config 5's defining feature — the cross-shard mesh reduce —
+    exercised on a REAL 8-device mesh (virtual CPU devices, VERDICT r3
+    #7). NOT a perf claim: CPU devices; perf numbers stay single-chip
+    (config 5 proper). Verified here: (a) a pipelined stream of SSB
+    4-way intersect counts through DistExecutor.submit matches the local
+    single-device executor on every query, and (b) the mesh path keeps
+    micro-batching — program dispatches ≈ queries / microbatch_max, not
+    one eager dispatch per query."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.parallel import DistExecutor, make_mesh
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import Holder
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    mesh = make_mesh()
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp).open()
+        idx = holder.create_index("ssb")
+        rng = np.random.default_rng(55)
+        fields = ["year", "region", "category", "brand"]
+        n_rows = 4
+        for fname, d in zip(fields, [0.5, 0.25, 0.2, 0.3]):
+            f = idx.create_field(fname)
+            for shard in range(n_shards):
+                n = int(SHARD_WIDTH * d)
+                for row in range(1, n_rows + 1):
+                    cols = rng.choice(SHARD_WIDTH, n, replace=False)
+                    f.view(VIEW_STANDARD, create=True).fragment(
+                        shard, create=True
+                    ).bulk_import(np.full(n, row, np.uint64), cols)
+
+        def pql(i: int) -> str:
+            combo = [(i + k) % n_rows + 1 for k in range(4)]
+            return ("Count(Intersect(" + ", ".join(
+                f"Row({f}={r})" for f, r in zip(fields, combo)
+            ) + "))")
+
+        local = Executor(holder)
+        want = [local.execute("ssb", pql(i))[0] for i in range(n_rows)]
+
+        ex = DistExecutor(holder, mesh)
+        dispatches = [0]
+        real_builder = ex._program_batched
+
+        def counting_builder(*a, **k):
+            fn = real_builder(*a, **k)
+
+            def counted(*args):
+                dispatches[0] += 1
+                return fn(*args)
+
+            return counted
+
+        ex._program_batched = counting_builder
+        # warm compiles outside the accounting
+        warm = [ex.submit("ssb", pql(i))[0] for i in range(ex.microbatch_max)]
+        warm[-1].result()
+        dispatches[0] = 0
+
+        t0 = time.perf_counter()
+        deferreds = [ex.submit("ssb", pql(i))[0] for i in range(n_queries)]
+        got = [d.result() for d in deferreds]
+        wall = time.perf_counter() - t0
+        ok = all(g == want[i % n_rows] for i, g in enumerate(got))
+        expected_dispatches = -(-n_queries // ex.microbatch_max)
+        holder.close()
+        return {
+            "config": 5, "metric": "ssb_4way_mesh_microbatched_dispatches",
+            "value": dispatches[0], "unit": "dispatches",
+            "queries": n_queries, "microbatch": ex.microbatch_max,
+            "expected_dispatches": expected_dispatches,
+            "mesh_devices": mesh.size,
+            "wall_ms": round(wall * 1e3, 1),
+            "ok": ok and dispatches[0] == expected_dispatches,
+            "note": ("8 virtual CPU devices — correctness + dispatch "
+                     "accounting for the SPMD path only; perf claims are "
+                     "single-chip (config 5 proper)"),
+        }
+
+
+def _spawn_cpu_mesh_entry() -> None:
+    """Run config5_mesh_cpu8 in a subprocess pinned to an 8-device
+    virtual CPU platform (the axon TPU plugin would otherwise own the
+    backend; see .claude/skills/verify for the env contract)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                      " --xla_force_host_platform_device_count=8").strip(),
+    }
+    proc = subprocess.run(
+        [sys.executable, __file__, "--cpu-mesh-inner"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        print(json.dumps({
+            "config": 5, "metric": "ssb_4way_mesh_microbatched_dispatches",
+            "ok": False, "error": (proc.stderr or "no output")[-500:],
+        }), flush=True)
+        return
+    print(lines[-1], flush=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--full", action="store_true",
                         help="billion-column scale (real TPU)")
-    parser.add_argument("--configs", default="1,2,3,4,5")
+    parser.add_argument("--configs", default="1,2,3,4,5,mesh8")
+    parser.add_argument("--cpu-mesh-inner", action="store_true",
+                        help=argparse.SUPPRESS)
     args = parser.parse_args()
+    if args.cpu_mesh_inner:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(config5_mesh_cpu8()), flush=True)
+        return
     n_shards = 954 if args.full else 4
     small = 2 if not args.full else 64
     runners = {
-        1: lambda: config1_star_trace(n_shards),
-        2: lambda: config2_taxi_topn_groupby(small),
-        3: lambda: config3_bsi_range_sum(small),
-        4: lambda: config4_time_quantum(1 if not args.full else 8),
-        5: lambda: config5_ssb_4way(n_shards),
+        "1": lambda: config1_star_trace(n_shards),
+        "2": lambda: config2_taxi_topn_groupby(small),
+        "3": lambda: config3_bsi_range_sum(small),
+        "4": lambda: config4_time_quantum(1 if not args.full else 8),
+        "5": lambda: config5_ssb_4way(n_shards),
     }
     floor = dispatch_floor_ms()
-    for c in [int(x) for x in args.configs.split(",")]:
+    for c in args.configs.split(","):
+        if c == "mesh8":
+            _spawn_cpu_mesh_entry()
+            continue
         out = runners[c]()
         out["dispatch_floor_ms"] = floor
         print(json.dumps(out), flush=True)
