@@ -510,7 +510,15 @@ class Cluster:
 
         return [e for chunk in concurrent_map(one, peers) for e in chunk]
 
-    def _owned_missing_sources(self) -> list[dict]:
+    def _peer_entries_by_index(self) -> dict[str, list]:
+        """One concurrent catalog walk per index, shared by the self-join
+        inventory and the gated freshness sync (one walk, two consumers)."""
+        return {
+            name: self._peer_fragment_entries(name)
+            for name in list(self.holder.indexes)
+        }
+
+    def _owned_missing_sources(self, peer_entries: dict | None = None) -> list[dict]:
         """Fetch-instruction list for every fragment this node owns but
         does not hold locally (the self-join inventory). One FETCH per
         fragment: with replicaN>1 the peer walk reports the same
@@ -522,24 +530,29 @@ class Cluster:
         full fetch; an empty local fragment is re-fetched (it may be the
         placeholder of an earlier failed fetch, which must not mask the
         repair)."""
+        if peer_entries is None:
+            peer_entries = self._peer_entries_by_index()
         sources = []
-        by_key: dict[tuple, dict] = {}
+        # key -> source dict, or None for a key already evaluated and
+        # skipped (so replicaN>1 doesn't re-resolve/count per replica)
+        by_key: dict[tuple, dict | None] = {}
         for index_name, idx in list(self.holder.indexes.items()):
-            for fname, vname, shard, node in self._peer_fragment_entries(
-                index_name
-            ):
+            for fname, vname, shard, node in peer_entries.get(index_name, []):
                 key = (index_name, fname, vname, shard)
-                prior = by_key.get(key)
-                if prior is not None:
-                    prior["fallbacks"].append(node.uri)
+                if key in by_key:
+                    prior = by_key[key]
+                    if prior is not None:
+                        prior["fallbacks"].append(node.uri)
                     continue
                 if not self.owns_shard(index_name, shard):
+                    by_key[key] = None
                     continue
                 field = idx.field(fname)
                 view = field.view(vname) if field is not None else None
                 frag = view.fragment(shard) if view is not None else None
                 if frag is not None and frag.count() > 0:
-                    continue  # already held locally with data
+                    by_key[key] = None  # already held locally with data
+                    continue
                 src = {
                     "index": index_name, "field": fname, "view": vname,
                     "shard": shard, "from": node.uri, "fallbacks": [],
@@ -560,15 +573,22 @@ class Cluster:
         path has no caller to raise to) and leaves the gap to
         anti-entropy repair."""
         try:
-            self.fetch_fragments(self._owned_missing_sources())
+            peer_entries = self._peer_entries_by_index()
+            sources = self._owned_missing_sources(peer_entries)
+            self.fetch_fragments(sources)
             # Freshness: fragments we ALREADY held may be stale from an
             # outage window (writes landed on replicas while this node
             # was away). Block-diff them against replicas before the
             # gate releases, so a rejoining node never serves the stale
             # window — the full fetch above covers only missing
-            # fragments, and a checksum-block diff is far cheaper than
-            # re-downloading every held fragment's full payload.
-            self.sync_holder()
+            # fragments (skipped here), a checksum-block diff is far
+            # cheaper than re-downloading every held payload, and the
+            # peer catalog walk is shared with the inventory above.
+            self.sync_holder(
+                peer_entries=peer_entries,
+                skip={(s["index"], s["field"], s["view"], s["shard"])
+                      for s in sources},
+            )
         except Exception as e:  # noqa: BLE001 — must not die silently
             self._log_exception("self-join fragment fetch", e)
         finally:
@@ -821,10 +841,13 @@ class Cluster:
 
     # --------------------------------------------------------- anti-entropy
 
-    def sync_holder(self) -> dict:
+    def sync_holder(self, peer_entries: dict | None = None,
+                    skip: set | None = None) -> dict:
         """One anti-entropy pass over every fragment this node replicates
         (reference HolderSyncer.SyncHolder — SURVEY.md §3.5). Returns
-        repair counts for observability."""
+        repair counts for observability. ``peer_entries`` reuses an
+        already-gathered catalog walk; ``skip`` excludes fragments just
+        fetched in full (the gated self-join path uses both)."""
         repaired = {"fragments": 0, "bits": 0, "attr_blocks": 0}
         repaired["translate_ops"] = self.sync_translate()
         repaired["attr_blocks"] = self._sync_attrs()
@@ -838,10 +861,13 @@ class Cluster:
                 for view_name, view in list(field.views.items()):
                     for shard in list(view.fragments):
                         inventory.add((field_name, view_name, shard))
-            inventory.update(
-                (f, v, s) for f, v, s, _ in self._peer_fragment_entries(index_name)
-            )
+            entries = (peer_entries.get(index_name, [])
+                       if peer_entries is not None
+                       else self._peer_fragment_entries(index_name))
+            inventory.update((f, v, s) for f, v, s, _ in entries)
             for field_name, view_name, shard in sorted(inventory):
+                if skip and (index_name, field_name, view_name, shard) in skip:
+                    continue
                 if not self.owns_shard(index_name, shard):
                     continue
                 field = idx.field(field_name)
