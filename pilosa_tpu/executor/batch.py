@@ -488,14 +488,65 @@ def minmax_merge(values, counts, want_max: bool):
     return minmax_finalize(best, n, jnp.any(valid))
 
 
-def _local_body(structure, reduce_kind: str, n_leaves: int):
+# Reduction row width for the elementwise-count fast path. Measured on
+# v5e (2026-07, /tmp/shape_test): axis-1 popcount sums over 2^18-word
+# rows run at flat-array speed, while the natural 32768-word shard rows
+# are ~8% slower (too many short reduction rows). Must divide any
+# stacked block size: S_padded·2^15 words with S_padded a power of two.
+COUNT_CHUNK_WORDS = 1 << 18
+
+
+def count_elementwise_sub(structure, leaf_ranks: tuple):
+    """For a ('count', sub) structure whose tree is purely elementwise
+    over rank-1 word leaves (and/or/xor/diff/flipall/leaf/const0 — no
+    shift, whose bit motion is per-shard, and no BSI ops), return
+    ``sub``; else None. Such counts need no per-shard vmap: bit position
+    never matters, so the whole stacked block reduces as one flat array
+    in wider chunks (COUNT_CHUNK_WORDS) — the per-shard row width of
+    2^15 words costs measurable reduction overhead on TPU."""
+    if not structure or structure[0] != "count":
+        return None
+    if any(r != 1 for r in leaf_ranks):
+        return None
+
+    def ok(n):
+        if not isinstance(n, tuple):
+            return True
+        if n[0] in ("leaf", "const0"):
+            return True
+        if n[0] in ("and", "or", "xor", "diff", "flipall"):
+            return all(ok(c) for c in n[1:])
+        return False
+
+    return structure[1] if ok(structure[1]) else None
+
+
+def count_flat(sub, leaves, scalars):
+    """Evaluate an elementwise count subtree over whole stacked leaves
+    and reduce popcounts in COUNT_CHUNK_WORDS-wide rows. Exact for any
+    block size: per-chunk sums ≤ 2^23 fit int32 and cross-chunk sums ride
+    the same split channels as the per-shard path."""
+    words = expr._go(sub, leaves, scalars)
+    chunk = min(COUNT_CHUNK_WORDS, words.size)
+    rows = words.reshape(-1, chunk)
+    counts = jnp.sum(lax.population_count(rows).astype(jnp.int32), axis=-1)
+    return split_sum(counts)
+
+
+def _local_body(structure, reduce_kind: str, leaf_ranks: tuple):
     """Uncompiled single-query evaluator body: vmap over the stacked
     shard axis + on-device reduction. Shared by the per-query program
     (local_fn) and the micro-batched program (local_fn_batched)."""
+    n_leaves = len(leaf_ranks)
+    count_sub = (count_elementwise_sub(structure, leaf_ranks)
+                 if reduce_kind == "count" else None)
 
     def body(*args):
         leaves = args[:n_leaves]
         scalars = args[n_leaves:]
+
+        if count_sub is not None:
+            return count_flat(count_sub, leaves, scalars)
 
         def per_shard(*ls):
             return expr._go(structure, ls, scalars)
@@ -525,7 +576,7 @@ def local_fn(structure, reduce_kind: str, leaf_ranks: tuple, n_scalars: int):
     key = ("local", structure, reduce_kind, leaf_ranks, n_scalars)
     fn = _LOCAL_JIT_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(_local_body(structure, reduce_kind, len(leaf_ranks)))
+        fn = jax.jit(_local_body(structure, reduce_kind, leaf_ranks))
         _LOCAL_JIT_CACHE[key] = fn
     return fn
 
@@ -572,7 +623,7 @@ def local_fn_batched(structure, reduce_kind: str, leaf_ranks: tuple,
     if fn is not None:
         return fn
 
-    body1 = _local_body(structure, reduce_kind, len(leaf_ranks))
+    body1 = _local_body(structure, reduce_kind, leaf_ranks)
     fn = jax.jit(batched_body(body1, len(leaf_ranks), n_scalars, n_queries))
     _LOCAL_JIT_CACHE[key] = fn
     return fn

@@ -33,16 +33,26 @@ from pilosa_tpu.parallel.mesh import SHARDS_AXIS, ShardAssignment, make_mesh
 _DIST_JIT_CACHE: dict = {}
 
 
-def _dist_body(structure, reduce_kind: str, n_leaves: int):
+def _dist_body(structure, reduce_kind: str, leaf_ranks: tuple):
     """Uncompiled per-query SPMD evaluator body (runs inside shard_map):
     vmap over the local shard slots, then collective reduction over the
     mesh axis. Shared by the per-query program (_dist_fn) and the
     micro-batched program (_dist_fn_batched), mirroring
     batch._local_body / batch.local_fn_batched."""
+    n_leaves = len(leaf_ranks)
+    count_sub = (batch.count_elementwise_sub(structure, leaf_ranks)
+                 if reduce_kind == "count" else None)
 
     def body(*args):
         leaves = args[:n_leaves]
         scalars = args[n_leaves:]
+
+        if count_sub is not None:
+            # elementwise count: reduce the local block flat in wide
+            # chunks (batch.count_flat), then psum the packed channels
+            return lax.psum(
+                batch.count_flat(count_sub, leaves, scalars), SHARDS_AXIS
+            )
 
         def per_shard(*ls):
             return expr._go(structure, ls, scalars)
@@ -97,7 +107,7 @@ def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
 
     fn = jax.jit(
         shard_map(
-            _dist_body(structure, reduce_kind, len(leaf_ranks)),
+            _dist_body(structure, reduce_kind, leaf_ranks),
             mesh=mesh,
             in_specs=leaf_specs + scalar_specs,
             out_specs=out_specs,
@@ -125,7 +135,7 @@ def _dist_fn_batched(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
         return fn
 
     n_leaves = len(leaf_ranks)
-    body1 = _dist_body(structure, reduce_kind, n_leaves)
+    body1 = _dist_body(structure, reduce_kind, leaf_ranks)
     in_specs = (
         tuple(P(SHARDS_AXIS) for _ in range(n_leaves * n_queries))
         + ((P(),) if n_scalars else ())

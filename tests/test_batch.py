@@ -46,3 +46,40 @@ class TestSplitSum:
         packed = np.asarray(batch.minmax_merge(values, counts, want_max=False))
         assert int(packed[0]) == 0
         assert int(batch.merge_split(packed[1:])) == 0
+
+
+class TestCountFastPath:
+    """Elementwise-count classification + flat-chunk reduction (the
+    count fast path skips the per-shard vmap when bit position can't
+    matter)."""
+
+    def test_classification(self):
+        and_tree = ("count", ("and", ("leaf", 0), ("leaf", 1)))
+        assert batch.count_elementwise_sub(and_tree, (1, 1)) == and_tree[1]
+        deep = ("count", ("diff", ("or", ("leaf", 0), ("flipall", ("leaf", 1))),
+                          ("xor", ("leaf", 2), ("const0",))))
+        assert batch.count_elementwise_sub(deep, (1, 1, 1)) == deep[1]
+        # shift moves bits across word boundaries per shard: no fast path
+        shifted = ("count", ("and", ("shift", ("leaf", 0), 0), ("leaf", 1)))
+        assert batch.count_elementwise_sub(shifted, (1, 1)) is None
+        # BSI compare trees carry rank-2 plane leaves: no fast path
+        bsi = ("count", ("bsicmp", ">", 0, ("leaf", 1), 0))
+        assert batch.count_elementwise_sub(bsi, (2, 1)) is None
+        # non-count reductions never classify
+        assert batch.count_elementwise_sub(("and", ("leaf", 0), ("leaf", 1)),
+                                           (1, 1)) is None
+
+    def test_count_flat_matches_per_shard_sum(self):
+        rng = np.random.default_rng(3)
+        # 16 shards x 2^15 words: spans multiple COUNT_CHUNK_WORDS rows
+        # only when chunked at the min() fallback; also test tiny blocks
+        for s in (1, 16):
+            a = rng.integers(0, 1 << 32, (s, 1 << 15), dtype=np.uint32)
+            b = rng.integers(0, 1 << 32, (s, 1 << 15), dtype=np.uint32)
+            sub = ("and", ("leaf", 0), ("leaf", 1))
+            packed = np.asarray(
+                batch.count_flat(sub, (jnp.asarray(a), jnp.asarray(b)), ())
+            )
+            got = int(batch.merge_split(packed))
+            want = int(np.bitwise_count(a & b).sum())
+            assert got == want, (s, got, want)
