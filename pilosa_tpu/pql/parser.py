@@ -22,17 +22,20 @@ ALIASES = {
     "ClearBit": "Clear",
     "Bitmap": "Row",
     "ClearRowBit": "Clear",
+    # v0.x-era BSI write spelling; v1.x writes int fields via
+    # Set(col, field=value), which Set already implements
+    "SetValue": "Set",
 }
 
 WRITE_CALLS = {
-    "Set", "Clear", "ClearRow", "Store", "SetValue",
+    "Set", "Clear", "ClearRow", "Store",
     "SetRowAttrs", "SetColumnAttrs", "Delete",
 }
 
 CALL_NAMES = {
     "Row", "Union", "Intersect", "Difference", "Xor", "Not", "All", "Shift",
     "Count", "TopN", "Min", "Max", "Sum", "Range", "Rows", "GroupBy",
-    "Set", "Clear", "ClearRow", "Store", "SetValue", "SetRowAttrs",
+    "Set", "Clear", "ClearRow", "Store", "SetRowAttrs",
     "SetColumnAttrs", "Options", "IncludesColumn",
     # pseudo-call: appears only as an arg value —
     # GroupBy(..., having=Condition(count > 10))

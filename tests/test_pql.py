@@ -21,8 +21,9 @@ def test_nested_set_ops():
 
 
 def test_v0_aliases():
-    q = parse("SetBit(10, f=1) Bitmap(f=1) ClearBit(10, f=1)")
-    assert [c.name for c in q.calls] == ["Set", "Row", "Clear"]
+    q = parse("SetBit(10, f=1) Bitmap(f=1) ClearBit(10, f=1) SetValue(10, v=7)")
+    assert [c.name for c in q.calls] == ["Set", "Row", "Clear", "Set"]
+    assert q.write_calls() == q.calls[:1] + q.calls[2:]
 
 
 def test_set_with_positional_column():
