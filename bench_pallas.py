@@ -1,0 +1,149 @@
+"""Same-run Pallas-vs-XLA comparison for the fused intersect-count op.
+
+VERDICT r3 #3 asked for the Pallas question to be settled with data
+whenever the XLA kernel sits below ~0.8 of the HBM roofline. This
+harness measures, in ONE process run on the real chip (the tunnel
+drifts ±25% between runs — only same-run ratios mean anything):
+
+  1. the XLA fused kernel (the bench.py ceiling op):
+     per-row sum(popcount(a & (b ^ salt))) over uint32[R, W];
+  2. a Pallas grid kernel for the same op at several VMEM block sizes
+     (R-row operand blocks, grid over the word axis, accumulating
+     per-row partial counts in the revisited output block);
+  3. the XLA kernel again, to bracket in-run drift.
+
+History: the round-2 measurement (README "Kernel strategy") found
+parity — Pallas 287-319 GB/s vs XLA 309-333 GB/s interleaved — and the
+Pallas path was retired. Round 4's roofline fields put the XLA kernel
+at 0.63-0.77 of the 819 GB/s v5e spec depending on run, keeping the
+question open; re-run this harness when the op or toolchain changes.
+
+Prints one JSON line per variant; correctness is asserted against the
+XLA reference counts before any timing is reported.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+R = 8
+N_COLS = 1 << 30
+W = N_COLS // 32  # 2^25 words per row
+ITERS = 32
+TRIALS = 3
+HBM_PEAK = 819e9
+
+
+def pallas_intersect_count(block_w: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(salt_ref, a_ref, b_ref, out_ref):
+        w = pl.program_id(0)
+        s = salt_ref[0]
+        x = a_ref[:] & (b_ref[:] ^ s)
+        c = jnp.sum(lax.population_count(x).astype(jnp.int32), axis=1,
+                    keepdims=True)
+
+        @pl.when(w == 0)
+        def _():
+            out_ref[:] = c
+
+        @pl.when(w != 0)
+        def _():
+            out_ref[:] = out_ref[:] + c
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(W // block_w,),
+        in_specs=[
+            pl.BlockSpec((R, block_w), lambda w, s: (0, w)),
+            pl.BlockSpec((R, block_w), lambda w, s: (0, w)),
+        ],
+        out_specs=pl.BlockSpec((R, 1), lambda w, s: (0, 0)),
+    )
+    return jax.jit(
+        lambda a, b, salt: pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R, 1), jnp.int32),
+            grid_spec=grid_spec,
+        )(salt, a, b)
+    )
+
+
+def bench(fn, a, b, name, wrap, expect=None):
+    """Compile, check counts against ``expect`` (BEFORE any timing is
+    reported — a wrong variant prints an error line and no numbers),
+    then time. Errors never abort the harness: the remaining variants
+    and the closing drift bracket still run. cols_per_sec counts all R
+    row-queries per call, the same unit as bench.py's
+    kernel_cols_per_sec (K_ROWS · n_cols / dt)."""
+    salt = 0
+    try:
+        ref = np.asarray(fn(a, b, wrap(salt)))  # compile + reference
+    except Exception as e:  # noqa: BLE001 — report and keep comparing
+        print(json.dumps({
+            "variant": name, "error": f"{type(e).__name__}: {e}"
+        }), flush=True)
+        return None
+    if expect is not None and not np.array_equal(
+        ref.ravel().astype(np.int64), expect.astype(np.int64)
+    ):
+        print(json.dumps({
+            "variant": name,
+            "error": f"wrong counts: {ref.ravel().tolist()} != {expect.tolist()}",
+        }), flush=True)
+        return None
+    salt += 1
+    best = float("inf")
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(ITERS):
+            out = fn(a, b, wrap(salt))
+            salt += 1
+        np.asarray(out)  # stream-ordered: last done => all done
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    rate = R * N_COLS / best
+    print(json.dumps({
+        "variant": name, "cols_per_sec": round(rate, 1),
+        "hbm_bytes_per_sec": round(rate / 4, 1),
+        "frac_hbm_peak": round((rate / 4) / HBM_PEAK, 3),
+    }), flush=True)
+    return ref.ravel()
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.default_rng(1)
+    a = jax.device_put(rng.integers(0, 1 << 32, (R, W), dtype=np.uint32))
+    b = jax.device_put(rng.integers(0, 1 << 32, (R, W), dtype=np.uint32))
+    jax.block_until_ready((a, b))
+
+    @jax.jit
+    def xla_kernel(a, b, salt):
+        return jnp.sum(
+            lax.population_count(a & (b ^ salt)).astype(jnp.uint32), axis=1
+        )
+
+    scalar = lambda s: jnp.uint32(s)  # noqa: E731
+    vec1 = lambda s: np.full(1, s, np.uint32)  # noqa: E731
+
+    ref = bench(xla_kernel, a, b, "xla", scalar)
+    for bw in (1 << 15, 1 << 16, 1 << 17):
+        bench(pallas_intersect_count(bw), a, b, f"pallas_bw{bw}", vec1,
+              expect=ref)
+    bench(xla_kernel, a, b, "xla_rerun", scalar)
+
+
+if __name__ == "__main__":
+    main()
