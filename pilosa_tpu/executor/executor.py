@@ -207,6 +207,13 @@ class Deferred:
 class Executor:
     # Queries per micro-batched dispatch (see _microbatch_enqueue).
     MICROBATCH_MAX = 16
+    # XLA accounts every parameter of a compiled program as distinct HBM
+    # storage even when parameters alias one buffer (measured on v5e: a
+    # 64-query batch of 2×128MiB leaves fails compile with "arguments
+    # 16.00G"), so a micro-batch of wide queries (many leaves) must cap
+    # its TOTAL argument bytes, not just its query count — 4-way
+    # intersects over 1B columns would otherwise OOM at MICROBATCH_MAX.
+    MICROBATCH_ARG_BUDGET = 4 << 30
     # Plan-memo bound; cleared wholesale when full (see _compile_cached).
     PLAN_CACHE_MAX = 4096
 
@@ -218,6 +225,10 @@ class Executor:
         self.key_resolver = None
         self.key_backfill = None
         self.microbatch_max = self.MICROBATCH_MAX
+        self.microbatch_arg_budget = self.MICROBATCH_ARG_BUDGET
+        # divisor for per-DEVICE argument accounting: mesh-sharded leaves
+        # occupy nbytes/n_devices per chip (DistExecutor sets mesh.size)
+        self.arg_shard_factor = 1
         self._pending: dict = {}
         self._mb_lock = threading.Lock()
         # (index, call identity, wrap) -> validated plan; see _compile_cached
@@ -478,10 +489,22 @@ class Executor:
         with self._mb_lock:
             group = self._pending.get(key)
             if group is None:
-                group = self._pending[key] = {"rows": [], "out": None}
+                # group size: microbatch_max, capped so the batched
+                # program's total PER-DEVICE argument bytes stay under
+                # budget (XLA accounts each parameter separately — see
+                # MICROBATCH_ARG_BUDGET; mesh-sharded leaves cost
+                # nbytes/n_devices per chip)
+                per_query = (sum(l.nbytes for l in leaves)
+                             // self.arg_shard_factor)
+                limit = max(1, min(
+                    self.microbatch_max,
+                    self.microbatch_arg_budget // max(per_query, 1),
+                ))
+                group = self._pending[key] = {"rows": [], "out": None,
+                                              "limit": limit}
             i = len(group["rows"])
             group["rows"].append((tuple(leaves), scalars))
-            if len(group["rows"]) >= self.microbatch_max:
+            if len(group["rows"]) >= group["limit"]:
                 self._flush_group_locked(key, group)
 
         def read():

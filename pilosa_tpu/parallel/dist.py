@@ -228,6 +228,9 @@ class DistExecutor(Executor):
     def __init__(self, holder, mesh=None):
         super().__init__(holder)
         self.mesh = mesh if mesh is not None else make_mesh()
+        # micro-batch argument budgeting counts per-DEVICE bytes: leaves
+        # are sharded over the mesh, so each chip holds 1/size of them
+        self.arg_shard_factor = self.mesh.size
 
     def _make_block(self, shard_list):
         return ShardAssignment(shard_list, self.mesh)

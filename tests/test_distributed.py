@@ -167,6 +167,22 @@ class TestDistGroupBy:
         assert self.groups_json(r1) == self.groups_json(r2)
         assert len(r2) == 1
 
+    def test_groupby_having_and_topn_threshold(self, env):
+        """Round-4 PQL edges on the mesh path: having filters merged
+        groups and threshold floors the exact recount, both matching the
+        single-device executor."""
+        r1, r2 = both(env, "GroupBy(Rows(f), Rows(g), having=Condition(count > 0))")
+        assert self.groups_json(r1) == self.groups_json(r2) and r2
+        base_counts = {g.count for g in r2}
+        floor = sorted(base_counts)[len(base_counts) // 2]  # drop some
+        r1, r2 = both(
+            env, f"GroupBy(Rows(f), Rows(g), having=Condition(count >= {floor}))"
+        )
+        assert self.groups_json(r1) == self.groups_json(r2)
+        assert all(g.count >= floor for g in r2)
+        r1, r2 = both(env, "TopN(f, n=10, threshold=2)")
+        assert [(p.id, p.count) for p in r1] == [(p.id, p.count) for p in r2]
+
     def test_groupby_level_pruning_path(self, env, monkeypatch):
         """Force the per-dimension prefix-pruning strategy (cross-product
         'too big' for a single level) and check it matches the dense path."""

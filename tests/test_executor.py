@@ -515,6 +515,36 @@ class TestSubmitPipelined:
         assert sum(len(g["rows"]) for g in ex._pending.values()) == 1
         assert [d.result() for d in defs] == [4, 3, 2]
 
+    def test_submit_microbatch_caps_group_by_argument_bytes(self, env, monkeypatch):
+        """Wide queries (many leaves) cap the micro-batch below
+        microbatch_max so the batched program's total argument bytes
+        stay under budget — XLA accounts every parameter as distinct
+        HBM storage, so a 16-query batch of 4-leaf queries at full
+        shard counts would fail to compile."""
+        holder, ex = env
+        setup_stars(holder)
+        # each Count(Intersect(a, b)) carries 2 stacked leaves; size the
+        # budget so exactly 2 queries (4 leaves) fit per dispatch
+        pql = "Count(Intersect(Row(stargazer=1), Row(language=5)))"
+        d0 = ex.submit("repos", pql)[0]
+        (group,) = ex._pending.values()
+        leaf_bytes = sum(l.nbytes for l in group["rows"][0][0])
+        d0.result()  # flush the probe group
+
+        ex.microbatch_arg_budget = 2 * leaf_bytes
+        flushes = []
+        orig = ex._program_batched
+
+        def counting(structure, rk, lr, ns, nq):
+            flushes.append(nq)
+            return orig(structure, rk, lr, ns, nq)
+
+        monkeypatch.setattr(ex, "_program_batched", counting)
+        want = ex.execute("repos", pql)[0]
+        defs = [ex.submit("repos", pql)[0] for _ in range(6)]
+        assert [d.result() for d in defs] == [want] * 6
+        assert flushes == [2, 2, 2], flushes
+
     def test_submit_microbatch_mixed_shapes_group_separately(self, env):
         """Different program shapes (plain vs Shift trees) land in
         different groups and both resolve correctly."""
