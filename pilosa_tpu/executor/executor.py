@@ -639,15 +639,9 @@ class Executor:
         if not isinstance(res, RowResult):
             return res
         if call.arg("columnAttrs"):
-            cols = res.columns().tolist()
-            attr_map = idx.column_attrs.bulk(cols) if cols else {}
-            res.column_attrs = [
-                {"id": c, "attrs": attr_map[c]} for c in cols if c in attr_map
-            ]
+            res.column_attrs = column_attr_sets(idx, res)
         if call.arg("excludeColumns"):
-            out = RowResult({}, attrs=res.attrs, keys=res.keys)
-            out.column_attrs = res.column_attrs
-            return out
+            return strip_columns(res)
         return res
 
     # -------------------------------------------------------------- compile
@@ -1401,6 +1395,26 @@ class Executor:
             frag = field.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
             frag.write_row_words(int(row), host[i])
         return True
+
+
+def column_attr_sets(idx: Index, res: RowResult) -> list[dict]:
+    """columnAttrs option output: one bulk attr-store read for the
+    result's columns (shared by PQL Options() and the request-level URL
+    param so the two spellings cannot drift)."""
+    cols = res.columns().tolist()
+    attr_map = idx.column_attrs.bulk(cols) if cols else {}
+    return [{"id": c, "attrs": attr_map[c]} for c in cols if c in attr_map]
+
+
+def strip_columns(res: RowResult) -> RowResult:
+    """excludeColumns option: drop the column identities (translated keys
+    included — they ARE the columns on a keyed index) while keeping row
+    attrs and any computed columnAttrs. Shared by PQL Options() and the
+    request-level URL param."""
+    out = RowResult({}, attrs=res.attrs,
+                    keys=[] if res.keys is not None else None)
+    out.column_attrs = res.column_attrs
+    return out
 
 
 def condition_test(cond: Condition, val: int) -> bool:

@@ -47,7 +47,8 @@ class API:
 
     # ---------------------------------------------------------------- query
 
-    def query_raw(self, index: str, pql: str, shards=None, remote: bool = False):
+    def query_raw(self, index: str, pql: str, shards=None,
+                  remote: bool = False, opts: dict | None = None):
         """Execute and return raw result objects (serializer-agnostic)."""
         import time
 
@@ -70,7 +71,10 @@ class API:
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
                 kwargs["remote"] = remote
-            return self.executor.execute(index, query, **kwargs)
+            results = self.executor.execute(index, query, **kwargs)
+            if opts:
+                results = self._apply_request_opts(index, results, opts)
+            return results
         except (ParseError, PQLError) as e:
             raise ApiError(str(e)) from e
         finally:
@@ -88,9 +92,38 @@ class API:
                         elapsed, self.long_query_time, index, entry["pql"],
                     )
 
-    def query(self, index: str, pql: str, shards=None, remote: bool = False) -> dict:
-        results = self.query_raw(index, pql, shards=shards, remote=remote)
+    def query(self, index: str, pql: str, shards=None, remote: bool = False,
+              opts: dict | None = None) -> dict:
+        results = self.query_raw(index, pql, shards=shards, remote=remote,
+                                 opts=opts)
         return {"results": [result_to_json(r) for r in results]}
+
+    def _apply_request_opts(self, index: str, results: list,
+                            opts: dict) -> list:
+        """Request-level result options (reference QueryRequest
+        ColumnAttrs / ExcludeColumns / ExcludeRowAttrs — SURVEY.md §2
+        #19 handler query args; exact reference spelling is MED, the
+        URL-param names mirror the PQL Options() args). Applied on the
+        coordinator AFTER the cross-node merge, to every
+        row-materializing result of the request."""
+        from pilosa_tpu.executor.executor import (
+            column_attr_sets,
+            strip_columns,
+        )
+        from pilosa_tpu.executor.result import RowResult
+
+        idx = self.holder.index(index)
+        out = []
+        for res in results:
+            if isinstance(res, RowResult):
+                if opts.get("columnAttrs") and idx is not None:
+                    res.column_attrs = column_attr_sets(idx, res)
+                if opts.get("excludeRowAttrs"):
+                    res.attrs = {}
+                if opts.get("excludeColumns"):
+                    res = strip_columns(res)
+            out.append(res)
+        return out
 
     # --------------------------------------------------------------- schema
 

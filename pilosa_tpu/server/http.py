@@ -166,13 +166,22 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 ]
             remote = bool(query and query.get("remote", ["false"])[0] == "true")
 
+        # request-level result options (reference handler query args)
+        opts = {
+            k: True for k in ("columnAttrs", "excludeColumns",
+                              "excludeRowAttrs")
+            if query and query.get(k, ["false"])[0] == "true"
+        }
+
         if not proto_out:
-            self._json(self.api.query(index, pql, shards=shards, remote=remote))
+            self._json(self.api.query(index, pql, shards=shards,
+                                      remote=remote, opts=opts))
             return
         from pilosa_tpu.wire.serializer import encode_error, encode_results
 
         try:
-            results = self.api.query_raw(index, pql, shards=shards, remote=remote)
+            results = self.api.query_raw(index, pql, shards=shards,
+                                         remote=remote, opts=opts)
             payload = encode_results(results)
             status = 200
         except ApiError as e:

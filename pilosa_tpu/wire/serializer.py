@@ -63,6 +63,11 @@ def _encode_result(qr, res) -> None:
         else:
             qr.row.columns.extend(int(c) for c in res.columns().tolist())
         _attrs_to_proto(qr.row.attrs, res.attrs)
+        if res.column_attrs:
+            for entry in res.column_attrs:
+                cs = qr.column_attrs.add()
+                cs.id = int(entry["id"])
+                _attrs_to_proto(cs.attrs, entry["attrs"])
     elif isinstance(res, bool):
         qr.type = RESULT_CHANGED
         qr.changed = res
@@ -191,6 +196,11 @@ def decode_results_json(data: bytes) -> dict:
                 row["keys"] = list(qr.row.keys)
             else:
                 row["columns"] = list(qr.row.columns)
+            if qr.column_attrs:
+                row["columnAttrs"] = [
+                    {"id": cs.id, "attrs": attrs_from_proto(cs.attrs)}
+                    for cs in qr.column_attrs
+                ]
             out.append(row)
         elif t == RESULT_PAIRS:
             out.append([

@@ -317,3 +317,30 @@ def test_import_roaring_malformed_upstream_blob_is_400(node):
         req("POST", f"{node}/index/i/field/f/import-roaring/0",
             b"\x3c\x30\x00\x00\x01", content_type="application/octet-stream")
     assert e.value.code == 400
+
+
+def test_request_level_query_options(node):
+    """URL params columnAttrs / excludeColumns / excludeRowAttrs apply to
+    row results of the whole request (reference handler query args;
+    SURVEY-MED spelling — names mirror the PQL Options() args)."""
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/query",
+        b'Set(1, f=1) Set(2, f=1) SetColumnAttrs(1, city="nyc") '
+        b'SetRowAttrs(f, 1, team="blue")')
+    base = req("POST", f"{node}/index/i/query", b"Row(f=1)")["results"][0]
+    assert base["columns"] == [1, 2] and base["attrs"] == {"team": "blue"}
+
+    out = req("POST", f"{node}/index/i/query?columnAttrs=true",
+              b"Row(f=1)")["results"][0]
+    assert out["columnAttrs"] == [{"id": 1, "attrs": {"city": "nyc"}}]
+
+    out = req("POST", f"{node}/index/i/query?excludeRowAttrs=true",
+              b"Row(f=1)")["results"][0]
+    assert out["attrs"] == {} and out["columns"] == [1, 2]
+
+    out = req("POST",
+              f"{node}/index/i/query?excludeColumns=true&columnAttrs=true",
+              b"Row(f=1)")["results"][0]
+    assert out["columns"] == [] and out["attrs"] == {"team": "blue"}
+    assert out["columnAttrs"] == [{"id": 1, "attrs": {"city": "nyc"}}]

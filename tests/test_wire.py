@@ -226,3 +226,20 @@ def test_decode_results_json_matches_json_shapes():
     assert got[1]["keys"] == want[1]["keys"]
     for g, w in zip(got[2:], want[2:]):
         assert g == w, (g, w)
+
+
+def test_column_attrs_survive_protobuf():
+    """columnAttrs option output rides the wire (QueryResult.column_attrs)
+    and decodes back to the JSON surface's columnAttrs shape."""
+    import numpy as np
+
+    from pilosa_tpu.executor.result import RowResult, result_to_json
+    from pilosa_tpu.ops.packing import pack_bits
+    from pilosa_tpu.wire.serializer import decode_results_json, encode_results
+
+    row = RowResult({0: np.asarray(pack_bits(np.asarray([1, 2]), 1 << 20))})
+    row.column_attrs = [
+        {"id": 1, "attrs": {"city": "nyc", "n": 3, "vip": True}},
+    ]
+    (got,) = decode_results_json(encode_results([row]))["results"]
+    assert got["columnAttrs"] == result_to_json(row)["columnAttrs"]
