@@ -277,9 +277,15 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self._json(self.api.version())
 
     def get_metrics(self, query=None):
+        from pilosa_tpu.storage.residency import global_row_cache
         from pilosa_tpu.utils.stats import global_stats
 
-        self._text(global_stats().prometheus_text(), "text/plain; version=0.0.4")
+        stats = global_stats()
+        text = stats.prometheus_text()
+        text += global_row_cache().prometheus_lines(
+            getattr(stats, "prefix", "pilosa_tpu")
+        )
+        self._text(text, "text/plain; version=0.0.4")
 
     def get_traces(self, query=None):
         from pilosa_tpu.utils.tracing import global_tracer
@@ -292,9 +298,12 @@ class HTTPHandler(BaseHTTPRequestHandler):
                     "queries": list(self.api.long_queries)})
 
     def get_debug_vars(self, query=None):
+        from pilosa_tpu.storage.residency import global_row_cache
         from pilosa_tpu.utils.stats import global_stats
 
-        self._json(global_stats().snapshot())
+        snap = global_stats().snapshot()
+        snap["residency"] = global_row_cache().metrics()
+        self._json(snap)
 
     def get_pprof(self, query=None):
         """Thread stack dump (the /debug/pprof role for a python server)."""

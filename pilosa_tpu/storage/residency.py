@@ -403,6 +403,45 @@ class DeviceRowCache:
                 else:
                     self.invalidate(key)
 
+    # metrics() keys that are monotonic counters (get the Prometheus
+    # _total suffix); the rest are point-in-time gauges
+    _MONOTONIC_METRICS = frozenset({
+        "residency_hits", "residency_misses", "residency_evictions",
+        "residency_compressions", "residency_decompressions",
+        "residency_updates", "residency_write_events",
+    })
+
+    def metrics(self) -> dict:
+        """Operational gauges/counters for /metrics and /debug/vars (the
+        HBM LRU is the system's central capacity mechanism — reference
+        analog: syswrap's mmap-count limits, SURVEY.md §2 #26)."""
+        with self._lock:
+            return {
+                "residency_entries": len(self._rows) + len(self._compressed),
+                "residency_entries_compressed": len(self._compressed),
+                "residency_bytes_used": self.bytes_used,
+                "residency_bytes_compressed": self._compressed_bytes,
+                "residency_budget_bytes": self.budget_bytes,
+                "residency_hits": self.hits,
+                "residency_misses": self.misses,
+                "residency_evictions": self.evictions,
+                "residency_compressions": self.compressions,
+                "residency_decompressions": self.decompressions,
+                "residency_updates": self.updates,
+                "residency_write_events": self.write_events,
+            }
+
+    def prometheus_lines(self, prefix: str = "pilosa_tpu") -> str:
+        """metrics() in Prometheus text form, following the stats
+        registry's conventions (one render shared by every consumer):
+        counters carry the _total suffix; values are ints emitted
+        exactly (no %g truncation of byte gauges or large counters)."""
+        return "".join(
+            f"{prefix}_{name}"
+            f"{'_total' if name in self._MONOTONIC_METRICS else ''} {v}\n"
+            for name, v in sorted(self.metrics().items())
+        )
+
     def clear(self) -> None:
         with self._lock:
             self._rows.clear()

@@ -120,9 +120,16 @@ def test_status_info_version_metrics(node):
     assert info["shardWidth"] == 1 << 20
     v = req("GET", f"{node}/version")
     assert v["version"]
-    # metrics endpoint serves prometheus text
+    # metrics endpoint serves prometheus text incl. residency gauges:
+    # counters carry _total, values are exact ints (no %g truncation)
     text = req("GET", f"{node}/metrics", raw=True).decode()
-    assert isinstance(text, str)
+    assert "pilosa_tpu_residency_bytes_used" in text
+    assert "pilosa_tpu_residency_hits_total" in text
+    (budget_line,) = [l for l in text.splitlines()
+                      if l.startswith("pilosa_tpu_residency_budget_bytes")]
+    dv = req("GET", f"{node}/debug/vars")
+    # exact int emission (no %g scientific-notation truncation)
+    assert budget_line.split()[1] == str(dv["residency"]["residency_budget_bytes"])
 
 
 def test_error_statuses(node):
