@@ -406,13 +406,18 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
 
 
 def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
-                   device_put=None):
-    """Stacked row matrix ``uint32[padded, len(row_ids), words]`` of one
-    view (TopN phase-2 candidates, GroupBy dimensions), HBM-cached."""
+                   device_put=None, pad_rows: int = 0):
+    """Stacked row matrix ``uint32[padded, len(row_ids) + pad_rows,
+    words]`` of one view (TopN phase-2 candidates, GroupBy dimensions),
+    HBM-cached. ``pad_rows`` appends all-zero rows (shape bucketing for
+    pipelined TopN) — zeros, NOT duplicates of a real row: a duplicate
+    would break the write-patch routing, which maps each row id to ONE
+    inner position."""
     cache = residency.global_row_cache()
     view_name = view.name if view is not None else None
+    n_rows = len(row_ids) + pad_rows
     key = ("stackm", idx.name, field_name, view_name, tuple(row_ids),
-           block.key())
+           pad_rows, block.key())
 
     def live_view():
         # resolve by NAME at decode time, never through the captured
@@ -427,10 +432,15 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
         def per_shard(shard):
             frag = v.fragment(shard) if v else None
             if frag is None:
-                return np.zeros((len(row_ids), WORDS_PER_SHARD), np.uint32)
-            return np.stack([frag.row_words(r) for r in row_ids])
+                return np.zeros((n_rows, WORDS_PER_SHARD), np.uint32)
+            rows = [frag.row_words(r) for r in row_ids]
+            rows.extend(
+                np.zeros(WORDS_PER_SHARD, np.uint32)
+                for _ in range(pad_rows)
+            )
+            return np.stack(rows)
 
-        return block.stack(per_shard, inner=(len(row_ids), WORDS_PER_SHARD))
+        return block.stack(per_shard, inner=(n_rows, WORDS_PER_SHARD))
 
     def decode_row(ev):
         v = live_view()

@@ -269,11 +269,13 @@ class Executor:
         of queries and resolve them in order — the host↔device round trip
         (the latency floor on tunneled/remote backends) overlaps with
         device compute instead of serializing after it. Pipelined
-        reductions sharing a program shape — Count AND the BSI
-        aggregates Sum/Min/Max — are additionally coalesced into
-        micro-batched dispatches (see _microbatch_enqueue) and stay in
-        flight until resolved; other call types evaluate eagerly at
-        submit time and return an already-resolved Deferred.
+        reductions sharing a program shape — Count, the BSI aggregates
+        Sum/Min/Max, AND TopN's phase-2 recount (candidate lists pad to
+        power-of-two buckets so same-field TopN streams share shapes) —
+        are additionally coalesced into micro-batched dispatches (see
+        _microbatch_enqueue) and stay in flight until resolved; other
+        call types evaluate eagerly at submit time and return an
+        already-resolved Deferred.
         """
         idx = self.holder.index(index_name)
         if idx is None:
@@ -289,6 +291,8 @@ class Executor:
             elif call.name in ("Sum", "Min", "Max"):
                 out.append(self._submit_bsi_aggregate(idx, call, shards,
                                                        pipeline=True))
+            elif call.name == "TopN":
+                out.append(self._submit_topn(idx, call, shards, pipeline=True))
             else:
                 out.append(Deferred(value=self._execute_call(idx, call, shards)))
         return out
@@ -885,6 +889,16 @@ class Executor:
     # ----------------------------------------------------------------- TopN
 
     def _execute_topn(self, idx: Index, call: Call, shards=None) -> list[Pair]:
+        return self._submit_topn(idx, call, shards).result()
+
+    def _submit_topn(self, idx: Index, call: Call, shards=None,
+                     pipeline: bool = False) -> "Deferred":
+        """TopN with a pipelineable phase 2. Phase 1 (ranked-cache
+        candidates) is host-only; phase 2 — the exact recount over the
+        stacked candidate matrix — is one ``countrows`` device program,
+        which under ``submit()`` micro-batches with other pipelined TopNs
+        of the same shape (candidate lists pad to the next power of two
+        so same-field TopN streams share one program shape)."""
         field_name = call.arg("_field") or call.arg("field")
         if field_name is None:
             raise PQLError("TopN requires a field")
@@ -895,7 +909,7 @@ class Executor:
         filt_call = call.children[0] if call.children else None
         shard_list = self._shards(idx, shards)
         if not shard_list:
-            return []
+            return Deferred(value=[])
         view = field.view(VIEW_STANDARD)
 
         explicit_ids = call.arg("ids")
@@ -913,10 +927,14 @@ class Executor:
             candidates = sorted(cand)
         candidates = self._filter_topn_candidates(field, call, candidates)
         if not candidates:
-            return []
+            return Deferred(value=[])
 
         # phase 2: exact recount of every candidate across all shards —
-        # one batched program over the stacked candidate matrix
+        # one batched program over the stacked candidate matrix. The
+        # candidate axis pads to a power of two with ZERO rows (zeros
+        # match no write event, so the residency patch routing stays
+        # exact) so pipelined TopNs bucket into shared shapes.
+        n_real = len(candidates)
         specs: list = []
         scalars: list = []
         filt_node = (
@@ -925,28 +943,44 @@ class Executor:
         node = ("countrows", len(specs), filt_node)
         block = self._shard_block(shard_list)
         matrix = batch.stacked_matrix(
-            idx, field_name, view, candidates, block, self._leaf_put(block)
+            idx, field_name, view, candidates, block, self._leaf_put(block),
+            pad_rows=next_pow2(n_real) - n_real,
         )
-        counts = self._batched_eval(
-            idx, _Compiled(node, specs, scalars), block, "countrows",
+        leaves, scalar_ints = self._eval_operands(
+            idx, _Compiled(node, specs, scalars), block,
             extra_leaves=(matrix,),
         )
-        totals = batch.merge_split(np.asarray(counts))
-        # threshold= : minimum global count to be included (SURVEY-LOW
-        # surface, Appendix B — the upstream arg's exact version gate is
-        # unverifiable with the mount empty; conservative reading: a
-        # post-recount filter, so it never changes which rows WOULD have
-        # qualified, only trims the result). Applied here, after the
-        # exact phase-2 counts; the cluster path strips it from mapped
-        # sub-queries and applies it after the cross-node merge.
-        floor = max(1, int(call.arg("threshold", 0) or 0))
-        order = sorted(
-            (int(-c), r)
-            for r, c in zip(candidates, totals.tolist()) if c >= floor
-        )
-        if n:
-            order = order[:n]
-        return self._finish_pairs(idx, field, [Pair(r, -negc) for negc, r in order])
+
+        def finish(packed) -> list[Pair]:
+            # packed [2, padded] split sums; the pad slice drops the
+            # repeated candidate's duplicate count
+            totals = batch.merge_split(np.asarray(packed))[:n_real]
+            # threshold= : minimum global count to be included
+            # (SURVEY-LOW surface, Appendix B — the upstream arg's exact
+            # version gate is unverifiable with the mount empty;
+            # conservative reading: a post-recount filter, so it never
+            # changes which rows WOULD have qualified, only trims the
+            # result). Applied after the exact phase-2 counts; the
+            # cluster path strips it from mapped sub-queries and applies
+            # it after the cross-node merge.
+            floor = max(1, int(call.arg("threshold", 0) or 0))
+            order = sorted(
+                (int(-c), r)
+                for r, c in zip(candidates, totals.tolist()) if c >= floor
+            )
+            if n:
+                order = order[:n]
+            return self._finish_pairs(
+                idx, field, [Pair(r, -negc) for negc, r in order]
+            )
+
+        if pipeline:
+            read = self._microbatch_enqueue(node, "countrows", leaves,
+                                            scalar_ints)
+            if read is not None:
+                return Deferred(lambda: finish(read()))
+        packed = self._dispatch(node, "countrows", leaves, scalar_ints)
+        return Deferred(lambda: finish(np.asarray(packed)))
 
     @staticmethod
     def _filter_topn_candidates(field, call: Call, candidates: list[int]) -> list[int]:
@@ -1337,10 +1371,14 @@ class Executor:
 
     def _execute_clear_row(self, idx: Index, call: Call, shards=None) -> bool:
         field_name, row = self._row_field_and_value(call)
-        _check_row(row)
         field = idx.field(field_name)
         if field is None:
             raise PQLError(f"field {field_name!r} not found")
+        if not isinstance(row, int):
+            row = self._translate_row(idx, field, row, create=False)
+            if row is None:
+                return False  # unknown row key: nothing to clear
+        _check_row(row)
         view = field.view(VIEW_STANDARD)
         changed = False
         if view is not None:
@@ -1381,10 +1419,12 @@ class Executor:
         if len(call.children) != 1:
             raise PQLError("Store requires one child call")
         field_name, row = self._row_field_and_value(call)
-        _check_row(row)
         field = idx.field(field_name)
         if field is None:
             field = idx.create_field(field_name)
+        if not isinstance(row, int):
+            row = self._translate_row(idx, field, row, create=True)
+        _check_row(row)
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return True

@@ -545,6 +545,53 @@ class TestSubmitPipelined:
         assert [d.result() for d in defs] == [want] * 6
         assert flushes == [2, 2, 2], flushes
 
+    def test_topn_sees_write_to_highest_candidate(self, env):
+        """Regression: the padded candidate matrix must route writes to
+        the REAL slot of the highest candidate id (a pad row duplicating
+        it would swallow the patch and serve stale counts)."""
+        holder, ex = env
+        idx = holder.create_index("r")
+        f = idx.create_field("f")
+        for row, n_bits in [(1, 5), (2, 9), (5, 7)]:  # 3 rows → pads to 4
+            for c in range(n_bits):
+                f.set_bit(row, c)
+        (pairs,) = ex.execute("r", "TopN(f, n=5)")
+        assert dict((p.id, p.count) for p in pairs)[5] == 7
+        # write to the HIGHEST candidate id, then re-query
+        for c in range(20, 25):
+            f.set_bit(5, c)
+        (pairs,) = ex.execute("r", "TopN(f, n=5)")
+        assert dict((p.id, p.count) for p in pairs)[5] == 12
+
+    def test_submit_topn_pipelines_phase2(self, env, monkeypatch):
+        """Pipelined TopNs micro-batch their phase-2 recounts: a stream
+        of same-field TopNs (same padded candidate shape) dispatches as
+        ONE countrows program, with results matching execute()."""
+        holder, ex = env
+        setup_stars(holder)
+        flushes = []
+        orig = ex._program_batched
+
+        def counting(structure, rk, lr, ns, nq):
+            flushes.append((rk, nq))
+            return orig(structure, rk, lr, ns, nq)
+
+        monkeypatch.setattr(ex, "_program_batched", counting)
+        want = ex.execute("repos", "TopN(stargazer, n=2)")[0]
+        pqls = ["TopN(stargazer, n=2)", "TopN(stargazer, n=3)",
+                "TopN(stargazer, n=2)"]
+        defs = [ex.submit("repos", p)[0] for p in pqls]
+        got = [d.result() for d in defs]
+        assert [(p.id, p.count) for p in got[0]] == [
+            (p.id, p.count) for p in want
+        ]
+        assert [(p.id, p.count) for p in got[2]] == [
+            (p.id, p.count) for p in want
+        ]
+        assert len(got[1]) == 3
+        # all three phase-2 recounts rode one countrows dispatch
+        assert ("countrows", 3) in flushes, flushes
+
     def test_submit_microbatch_mixed_shapes_group_separately(self, env):
         """Different program shapes (plain vs Shift trees) land in
         different groups and both resolve correctly."""

@@ -92,6 +92,27 @@ class TestKeyedQueries:
         )
         assert n == 1
 
+    def test_clear_row_and_store_with_row_keys(self, env):
+        """ClearRow/Store translate keyed rows like every other write
+        (ClearRow of an unknown key is a no-op False; Store creates the
+        target row key)."""
+        holder, ex = env
+        holder.create_index("users", keys=True).create_field(
+            "likes", FieldOptions(keys=True)
+        )
+        ex.execute("users", 'Set("alice", likes="pizza")')
+        ex.execute("users", 'Set("bob", likes="pizza")')
+        assert ex.execute("users", 'ClearRow(likes="nothing")') == [False]
+        # Store the pizza row under a NEW row key
+        assert ex.execute(
+            "users", 'Store(Row(likes="pizza"), likes="popular")'
+        ) == [True]
+        (res,) = ex.execute("users", 'Row(likes="popular")')
+        assert sorted(res.keys) == ["alice", "bob"]
+        assert ex.execute("users", 'ClearRow(likes="pizza")') == [True]
+        (res,) = ex.execute("users", 'Row(likes="pizza")')
+        assert res.columns().size == 0
+
     def test_unknown_key_reads_empty(self, env):
         holder, ex = env
         holder.create_index("users", keys=True).create_field(
