@@ -952,8 +952,8 @@ class Executor:
         )
 
         def finish(packed) -> list[Pair]:
-            # packed [2, padded] split sums; the pad slice drops the
-            # repeated candidate's duplicate count
+            # packed [2, padded] split sums; the [:n_real] slice drops
+            # the all-zero pad rows (always zero counts)
             totals = batch.merge_split(np.asarray(packed))[:n_real]
             # threshold= : minimum global count to be included
             # (SURVEY-LOW surface, Appendix B — the upstream arg's exact
@@ -1374,10 +1374,9 @@ class Executor:
         field = idx.field(field_name)
         if field is None:
             raise PQLError(f"field {field_name!r} not found")
-        if not isinstance(row, int):
-            row = self._translate_row(idx, field, row, create=False)
-            if row is None:
-                return False  # unknown row key: nothing to clear
+        row = self._translate_row(idx, field, row, create=False)
+        if row is None:
+            return False  # unknown row key: nothing to clear
         _check_row(row)
         view = field.view(VIEW_STANDARD)
         changed = False
@@ -1421,10 +1420,14 @@ class Executor:
         field_name, row = self._row_field_and_value(call)
         field = idx.field(field_name)
         if field is None:
+            # validate BEFORE the implicit create so a rejected query
+            # leaves no phantom field behind (an implicitly created
+            # field has keys=false, so a string row can never translate)
+            _check_row(row)
             field = idx.create_field(field_name)
-        if not isinstance(row, int):
+        else:
             row = self._translate_row(idx, field, row, create=True)
-        _check_row(row)
+            _check_row(row)
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return True

@@ -545,6 +545,20 @@ class TestSubmitPipelined:
         assert [d.result() for d in defs] == [want] * 6
         assert flushes == [2, 2, 2], flushes
 
+    def test_store_rejected_row_leaves_no_phantom_field(self, env):
+        """A Store with an invalid row must not implicitly create its
+        target field (rejected queries leave no schema side effects)."""
+        holder, ex = env
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        ex.execute("i", "Set(1, f=1)")
+        with pytest.raises(PQLError):
+            ex.execute("i", "Store(Row(f=1), g=-3)")
+        assert idx.field("g") is None
+        with pytest.raises(PQLError):  # string row: implicit field has no keys
+            ex.execute("i", 'Store(Row(f=1), g="name")')
+        assert idx.field("g") is None
+
     def test_topn_sees_write_to_highest_candidate(self, env):
         """Regression: the padded candidate matrix must route writes to
         the REAL slot of the highest candidate id (a pad row duplicating

@@ -92,6 +92,23 @@ def test_writers_queries_antientropy_snapshot(cluster2):
             req("POST", f"{base[1]}/index/i/query", b"TopN(f, n=4)",
                 "text/plain")
 
+    def pipelined_submitter():
+        """Micro-batched submit streams racing the writers: leaves are
+        captured at enqueue and writes only add bits, so resolved counts
+        must be non-decreasing in submit order; TopN rides the same
+        pipeline (countrows micro-batch + candidate-matrix patching)."""
+        ex = servers[0].api.executor.local
+        last = 0
+        while not stop.is_set():
+            defs = [ex.submit("i", "Count(Row(f=1))")[0] for _ in range(8)]
+            topn = ex.submit("i", "TopN(f, n=4)")[0]
+            for d in defs:
+                n = d.result()
+                assert n >= last, (n, last)
+                last = n
+            pairs = topn.result()
+            assert all(p.count > 0 for p in pairs)
+
     def anti_entropy():
         while not stop.is_set():
             for s in servers:
@@ -110,7 +127,8 @@ def test_writers_queries_antientropy_snapshot(cluster2):
 
     writers = [threading.Thread(target=guard(writer(w))) for w in range(N_WRITERS)]
     aux = [threading.Thread(target=guard(fn), daemon=True)
-           for fn in (querier, anti_entropy, snapshotter)]
+           for fn in (querier, pipelined_submitter, anti_entropy,
+                      snapshotter)]
     for t in writers + aux:
         t.start()
     for t in writers:
