@@ -44,6 +44,13 @@ from pilosa_tpu.storage.view import VIEW_STANDARD, views_by_time_range
 # value unverifiable, Appendix B).
 TOPN_CANDIDATE_FACTOR = 4
 
+# HBM budget (per device) for one TopN phase-2 candidate matrix chunk. A
+# candidate row costs shards×2^15 words ≈ 128 MiB/candidate at 1024
+# shards, so an unchunked 64-candidate matrix would be 8 GiB — larger
+# than the residency budget. Chunks are power-of-two candidate counts so
+# a pipelined TopN stream still buckets into shared program shapes.
+TOPN_MATRIX_BUDGET_BYTES = 1 << 30
+
 # GroupBy cross-products at or below this size are evaluated in a single
 # level (one device sync); larger ones use per-dimension prefix pruning
 # (one sync per dimension). Memory is bounded separately, by
@@ -930,10 +937,14 @@ class Executor:
             return Deferred(value=[])
 
         # phase 2: exact recount of every candidate across all shards —
-        # one batched program over the stacked candidate matrix. The
-        # candidate axis pads to a power of two with ZERO rows (zeros
-        # match no write event, so the residency patch routing stays
-        # exact) so pipelined TopNs bucket into shared shapes.
+        # countrows programs over stacked candidate matrices. The
+        # candidate axis is CHUNKED to the per-device matrix byte budget
+        # (a candidate row costs shards×128KiB; see
+        # TOPN_MATRIX_BUDGET_BYTES) and each chunk pads to the chunk's
+        # power-of-two size with ZERO rows (zeros match no write event,
+        # so the residency patch routing stays exact) — so chunks of one
+        # query AND pipelined TopN streams bucket into shared shapes and
+        # micro-batch together.
         n_real = len(candidates)
         specs: list = []
         scalars: list = []
@@ -942,19 +953,47 @@ class Executor:
         )
         node = ("countrows", len(specs), filt_node)
         block = self._shard_block(shard_list)
-        matrix = batch.stacked_matrix(
-            idx, field_name, view, candidates, block, self._leaf_put(block),
-            pad_rows=next_pow2(n_real) - n_real,
+        bytes_per_cand = (
+            block.padded * WORDS_PER_SHARD * 4 // self.arg_shard_factor
         )
-        leaves, scalar_ints = self._eval_operands(
-            idx, _Compiled(node, specs, scalars), block,
-            extra_leaves=(matrix,),
+        chunk_rows = max(
+            1, min(next_pow2(n_real),
+                   TOPN_MATRIX_BUDGET_BYTES // max(bytes_per_cand, 1))
         )
+        chunk_rows = 1 << (chunk_rows.bit_length() - 1)  # round down to pow2
 
-        def finish(packed) -> list[Pair]:
-            # packed [2, padded] split sums; the [:n_real] slice drops
-            # the all-zero pad rows (always zero counts)
-            totals = batch.merge_split(np.asarray(packed))[:n_real]
+        # filter leaves/scalars are chunk-invariant: resolve once
+        base_leaves, scalar_ints = self._eval_operands(
+            idx, _Compiled(node, specs, scalars), block,
+        ) if specs else ([], tuple(int(s) for s in scalars))
+        put = self._leaf_put(block)
+
+        reads = []  # one (chunk_candidates, result thunk) per chunk
+        for lo in range(0, n_real, chunk_rows):
+            chunk = candidates[lo:lo + chunk_rows]
+            matrix = batch.stacked_matrix(
+                idx, field_name, view, chunk, block, put,
+                pad_rows=chunk_rows - len(chunk),
+            )
+            leaves = base_leaves + [matrix]
+            read = (self._microbatch_enqueue(node, "countrows", leaves,
+                                             scalar_ints)
+                    if pipeline else None)
+            if read is None:
+                packed = self._dispatch(node, "countrows", leaves,
+                                        scalar_ints)
+                read = (lambda p: lambda: np.asarray(p))(packed)
+            reads.append((chunk, read))
+
+        def finish() -> list[Pair]:
+            # each chunk's packed [2, chunk_rows] split sums; the slice
+            # drops the all-zero pad rows (always zero counts)
+            totals: list[int] = []
+            for chunk, read in reads:
+                totals.extend(
+                    batch.merge_split(np.asarray(read()))[:len(chunk)]
+                    .tolist()
+                )
             # threshold= : minimum global count to be included
             # (SURVEY-LOW surface, Appendix B — the upstream arg's exact
             # version gate is unverifiable with the mount empty;
@@ -966,7 +1005,7 @@ class Executor:
             floor = max(1, int(call.arg("threshold", 0) or 0))
             order = sorted(
                 (int(-c), r)
-                for r, c in zip(candidates, totals.tolist()) if c >= floor
+                for r, c in zip(candidates, totals) if c >= floor
             )
             if n:
                 order = order[:n]
@@ -974,13 +1013,7 @@ class Executor:
                 idx, field, [Pair(r, -negc) for negc, r in order]
             )
 
-        if pipeline:
-            read = self._microbatch_enqueue(node, "countrows", leaves,
-                                            scalar_ints)
-            if read is not None:
-                return Deferred(lambda: finish(read()))
-        packed = self._dispatch(node, "countrows", leaves, scalar_ints)
-        return Deferred(lambda: finish(np.asarray(packed)))
+        return Deferred(finish)
 
     @staticmethod
     def _filter_topn_candidates(field, call: Call, candidates: list[int]) -> list[int]:

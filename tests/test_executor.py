@@ -577,6 +577,29 @@ class TestSubmitPipelined:
         (pairs,) = ex.execute("r", "TopN(f, n=5)")
         assert dict((p.id, p.count) for p in pairs)[5] == 12
 
+    def test_topn_matrix_chunking_tiny_budget(self, env, monkeypatch):
+        """A matrix byte budget so small every chunk holds one candidate
+        must still produce identical TopN results (chunk concat)."""
+        import pilosa_tpu.executor.executor as ex_mod
+
+        holder, ex = env
+        idx = holder.create_index("r")
+        f = idx.create_field("f")
+        for row, n_bits in [(1, 5), (2, 9), (3, 7), (4, 3)]:
+            for c in range(n_bits):
+                f.set_bit(row, c)
+        (want,) = ex.execute("r", "TopN(f, n=4)")
+        monkeypatch.setattr(ex_mod, "TOPN_MATRIX_BUDGET_BYTES", 1)
+        (got,) = ex.execute("r", "TopN(f, n=4)")
+        assert [(p.id, p.count) for p in got] == [
+            (p.id, p.count) for p in want
+        ]
+        # pipelined too
+        d = ex.submit("r", "TopN(f, n=4)")[0]
+        assert [(p.id, p.count) for p in d.result()] == [
+            (p.id, p.count) for p in want
+        ]
+
     def test_submit_topn_pipelines_phase2(self, env, monkeypatch):
         """Pipelined TopNs micro-batch their phase-2 recounts: a stream
         of same-field TopNs (same padded candidate shape) dispatches as
