@@ -280,9 +280,13 @@ class Executor:
         Sum/Min/Max, AND TopN's phase-2 recount (candidate lists pad to
         power-of-two buckets so same-field TopN streams share shapes) —
         are additionally coalesced into micro-batched dispatches (see
-        _microbatch_enqueue) and stay in flight until resolved; other
-        call types evaluate eagerly at submit time and return an
-        already-resolved Deferred.
+        _microbatch_enqueue) and stay in flight until resolved. Dense
+        single-level GroupBys enqueue their level program at submit time
+        with the readback deferred to result(); pruned (multi-level)
+        GroupBys defer ALL dispatch to result() (each level's readback
+        gates the next level's candidates). Remaining call types
+        evaluate eagerly at submit time and return an already-resolved
+        Deferred.
         """
         idx = self.holder.index(index_name)
         if idx is None:
@@ -300,6 +304,9 @@ class Executor:
                                                        pipeline=True))
             elif call.name == "TopN":
                 out.append(self._submit_topn(idx, call, shards, pipeline=True))
+            elif call.name == "GroupBy":
+                out.append(self._submit_groupby(idx, call, shards,
+                                                pipeline=True))
             else:
                 out.append(Deferred(value=self._execute_call(idx, call, shards)))
         return out
@@ -1183,6 +1190,10 @@ class Executor:
         return out
 
     def _execute_groupby(self, idx: Index, call: Call, shards=None) -> list[GroupCount]:
+        return self._submit_groupby(idx, call, shards).result()
+
+    def _submit_groupby(self, idx: Index, call: Call, shards=None,
+                        pipeline: bool = False) -> "Deferred":
         """GroupBy as batched device programs with level pruning.
 
         The reference recurses per shard over the dimension cross-product,
@@ -1195,15 +1206,22 @@ class Executor:
         pruning). Chunking inside a level is byte-budgeted
         (batch.GROUPBY_MASK_BUDGET_BYTES) so the dense group masks never
         outgrow HBM.
+
+        Pipelined (submit): the common dense single-level case enqueues
+        its level program WITHOUT the blocking readback — the host sync
+        moves into ``Deferred.result()``, overlapping the round trip
+        with whatever the serving loop enqueues next. The pruning path
+        needs a readback per level to choose the next level's
+        candidates, so it defers the whole evaluation to ``result()``.
         """
         limit, filt_call, agg_field, dims, having = self._groupby_prelude(
             idx, call, shards
         )
         if not dims:
-            return []
+            return Deferred(value=[])
         shard_list = self._shards(idx, shards)
         if not shard_list:
-            return []
+            return Deferred(value=[])
 
         specs: list = []
         scalars: list = []
@@ -1237,25 +1255,63 @@ class Executor:
         for n in sizes:
             total_groups *= n
 
+        def collect(cand, counts_arr, agg_arrs) -> list[GroupCount]:
+            counts: dict[tuple, int] = {}
+            sums: dict[tuple, int] = {}
+            base = agg_field.options.base if agg_field is not None else 0
+            for j in range(cand.shape[0]):
+                c = int(counts_arr[j])
+                if c <= 0:
+                    continue
+                gkey = tuple(
+                    dims[d][1][int(cand[j, d])] for d in range(cand.shape[1])
+                )
+                counts[gkey] = c
+                if agg_arrs is not None:
+                    n = int(agg_arrs[0][j])
+                    pc = agg_arrs[1][:, j].tolist()
+                    sums[gkey] = (
+                        sum(int(v) << b for b, v in enumerate(pc)) + base * n
+                    )
+            return self._groupby_result(
+                idx, dims, counts, sums, agg_field, limit, having=having,
+            )
+
         if total_groups <= GROUPBY_DENSE_MAX_GROUPS:
-            # small cross-product: evaluate every group in one level
+            # small cross-product: every group in one level; the level
+            # program is enqueued NOW, the readback waits for result()
             cand = np.zeros((1, 0), np.int32)
             for n in sizes:
                 cand = _index_cross(cand, n)
-            counts_arr, agg_arrs = self._groupby_eval_level(
-                idx, block, filt_leaves, filt_node, scalars, dim_mats,
-                cand, planes, agg_field,
+            packed, layout = self._groupby_level_enqueue(
+                block, filt_leaves, filt_node, scalars, dim_mats, cand,
+                planes, agg_field,
             )
-        else:
+            has_agg = planes is not None
+            depth = agg_field.options.bit_depth if has_agg else 0
+
+            def finish() -> list[GroupCount]:
+                counts_arr, agg_arrs = _groupby_level_unpack(
+                    np.asarray(packed), layout, cand.shape[0], has_agg,
+                    depth,
+                )
+                return collect(cand, counts_arr, agg_arrs)
+
+            if pipeline:
+                return Deferred(finish)
+            return Deferred(value=finish())
+
+        def run_pruned() -> list[GroupCount]:
             # prefix pruning: extend one dimension at a time, dropping
-            # empty prefixes after each level (AND only shrinks groups)
+            # empty prefixes after each level (AND only shrinks groups);
+            # each level's readback gates the next level's candidates
             cand = np.zeros((1, 0), np.int32)
             counts_arr, agg_arrs = None, None
             for k in range(len(dims)):
                 cand = _index_cross(cand, sizes[k])
                 last = k == len(dims) - 1
                 counts_arr, agg_arrs = self._groupby_eval_level(
-                    idx, block, filt_leaves, filt_node, scalars,
+                    block, filt_leaves, filt_node, scalars,
                     dim_mats[: k + 1], cand,
                     planes if last else None,
                     agg_field if last else None,
@@ -1267,32 +1323,33 @@ class Executor:
                     agg_arrs = (agg_arrs[0][keep], agg_arrs[1][:, keep])
                 if cand.shape[0] == 0:
                     return []
+            return collect(cand, counts_arr, agg_arrs)
 
-        counts: dict[tuple, int] = {}
-        sums: dict[tuple, int] = {}
-        base = agg_field.options.base if agg_field is not None else 0
-        for j in range(cand.shape[0]):
-            c = int(counts_arr[j])
-            if c <= 0:
-                continue
-            gkey = tuple(
-                dims[d][1][int(cand[j, d])] for d in range(cand.shape[1])
-            )
-            counts[gkey] = c
-            if agg_arrs is not None:
-                n = int(agg_arrs[0][j])
-                pc = agg_arrs[1][:, j].tolist()
-                sums[gkey] = sum(int(v) << b for b, v in enumerate(pc)) + base * n
-        return self._groupby_result(
-            idx, dims, counts, sums, agg_field, limit, having=having,
-        )
+        if pipeline:
+            return Deferred(run_pruned)
+        return Deferred(value=run_pruned())
 
-    def _groupby_eval_level(self, idx: Index, block, filt_leaves, filt_node,
+    def _groupby_eval_level(self, block, filt_leaves, filt_node,
                             scalars, dim_mats, cand: np.ndarray, planes,
                             agg_field):
-        """Evaluate one pruning level: per-candidate counts (plus BSI
-        aggregate partials on the final level), chunked to the mask byte
-        budget, all chunks concatenated on device → ONE readback."""
+        """Evaluate one pruning level: enqueue + blocking readback."""
+        packed, layout = self._groupby_level_enqueue(
+            block, filt_leaves, filt_node, scalars, dim_mats, cand,
+            planes, agg_field,
+        )
+        has_agg = planes is not None
+        depth = agg_field.options.bit_depth if has_agg else 0
+        return _groupby_level_unpack(
+            np.asarray(packed), layout, cand.shape[0], has_agg, depth
+        )
+
+    def _groupby_level_enqueue(self, block, filt_leaves, filt_node,
+                               scalars, dim_mats, cand: np.ndarray, planes,
+                               agg_field):
+        """Dispatch one level's per-candidate counts (plus BSI aggregate
+        partials on the final level), chunked to the mask byte budget,
+        all chunks concatenated on device. Returns (device packed array,
+        chunk layout) — no host sync."""
         import jax.numpy as jnp
 
         n_gather = len(dim_mats)
@@ -1326,33 +1383,7 @@ class Executor:
             layout.append((padded, actual))
 
         packed = jnp.concatenate(packs) if len(packs) > 1 else packs[0]
-        host = np.asarray(packed)
-
-        def take2(off: int, n: int, padded: int) -> np.ndarray:
-            """Merge one split-sum section [2·padded] → int64[n]."""
-            return batch.merge_split(
-                host[off:off + 2 * padded].reshape(2, padded)[:, :n]
-            )
-
-        counts = np.zeros(c_total, np.int64)
-        n_g = np.zeros(c_total, np.int64) if has_agg else None
-        pc = np.zeros((depth, c_total), np.int64) if has_agg else None
-        off = out_off = 0
-        for padded, actual in layout:
-            counts[out_off:out_off + actual] = take2(off, actual, padded)
-            if has_agg:
-                n_g[out_off:out_off + actual] = take2(
-                    off + 2 * padded, actual, padded
-                )
-                pc_flat = host[off + 4 * padded:off + (4 + 2 * depth) * padded]
-                pc[:, out_off:out_off + actual] = batch.merge_split(
-                    pc_flat.reshape(2, depth, padded)[:, :, :actual]
-                )
-                off += (4 + 2 * depth) * padded
-            else:
-                off += 2 * padded
-            out_off += actual
-        return counts, (n_g, pc) if has_agg else None
+        return packed, layout
 
     # ---------------------------------------------------------------- writes
 
@@ -1471,6 +1502,38 @@ class Executor:
             frag = field.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
             frag.write_row_words(int(row), host[i])
         return True
+
+
+def _groupby_level_unpack(host: np.ndarray, layout, c_total: int,
+                          has_agg: bool, depth: int):
+    """Unpack a level's concatenated chunk sections (host side):
+    per-candidate counts, plus (n, plane counts) with an aggregate."""
+
+    def take2(off: int, n: int, padded: int) -> np.ndarray:
+        """Merge one split-sum section [2·padded] → int64[n]."""
+        return batch.merge_split(
+            host[off:off + 2 * padded].reshape(2, padded)[:, :n]
+        )
+
+    counts = np.zeros(c_total, np.int64)
+    n_g = np.zeros(c_total, np.int64) if has_agg else None
+    pc = np.zeros((depth, c_total), np.int64) if has_agg else None
+    off = out_off = 0
+    for padded, actual in layout:
+        counts[out_off:out_off + actual] = take2(off, actual, padded)
+        if has_agg:
+            n_g[out_off:out_off + actual] = take2(
+                off + 2 * padded, actual, padded
+            )
+            pc_flat = host[off + 4 * padded:off + (4 + 2 * depth) * padded]
+            pc[:, out_off:out_off + actual] = batch.merge_split(
+                pc_flat.reshape(2, depth, padded)[:, :, :actual]
+            )
+            off += (4 + 2 * depth) * padded
+        else:
+            off += 2 * padded
+        out_off += actual
+    return counts, (n_g, pc) if has_agg else None
 
 
 def column_attr_sets(idx: Index, res: RowResult) -> list[dict]:

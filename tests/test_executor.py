@@ -629,6 +629,30 @@ class TestSubmitPipelined:
         # all three phase-2 recounts rode one countrows dispatch
         assert ("countrows", 3) in flushes, flushes
 
+    def test_submit_groupby_defers_readback(self, env, monkeypatch):
+        """Pipelined dense GroupBys enqueue their level program at
+        submit time but perform the host readback only at result():
+        submit() must not call np.asarray on the packed result."""
+        import pilosa_tpu.executor.executor as ex_mod
+
+        holder, ex = env
+        setup_stars(holder)
+        want = ex.execute("repos", "GroupBy(Rows(stargazer))")[0]
+
+        unpacks = []
+        real_unpack = ex_mod._groupby_level_unpack
+
+        def counting_unpack(*a, **k):
+            unpacks.append(1)
+            return real_unpack(*a, **k)
+
+        monkeypatch.setattr(ex_mod, "_groupby_level_unpack", counting_unpack)
+        d = ex.submit("repos", "GroupBy(Rows(stargazer))")[0]
+        assert unpacks == []  # no readback at submit time
+        got = d.result()
+        assert unpacks == [1]
+        assert [g.to_json() for g in got] == [g.to_json() for g in want]
+
     def test_submit_microbatch_mixed_shapes_group_separately(self, env):
         """Different program shapes (plain vs Shift trees) land in
         different groups and both resolve correctly."""
