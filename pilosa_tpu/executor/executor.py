@@ -189,10 +189,13 @@ def _node_has_const0(node) -> bool:
 class Deferred:
     """Handle for a pipelined query result (Executor.submit).
 
-    The device program is already enqueued; ``result()`` performs the
-    blocking host readback (and any host-side finalization). Because a
-    single device's stream is ordered, resolving the LAST Deferred of a
-    submitted pipeline implies every earlier program has completed.
+    For most pipelined calls the device program is already enqueued and
+    ``result()`` performs only the blocking host readback (plus host
+    finalization); because a single device's stream is ordered,
+    resolving the LAST such Deferred implies every earlier program has
+    completed. Exception: calls whose evaluation needs intermediate
+    readbacks (pruned multi-level GroupBy) defer their dispatch into
+    ``result()`` too — see Executor.submit's per-call contract.
     """
 
     __slots__ = ("_finalize", "_value")

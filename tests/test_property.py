@@ -157,6 +157,31 @@ def test_randomized_workload_vs_oracle(tmp_path, seed):
             (groups,) = ex.execute("i", "GroupBy(Rows(f))")
             got = {g.group[0]["rowID"]: g.count for g in groups}
             assert got == {r: len(c) for r, c in oracle.sets.items() if c}
+
+            # round-4 surface: TopN(threshold=) and GroupBy(having=)
+            # against the same oracle, with a random floor
+            thr = int(rng.integers(1, 40))
+            (pairs,) = ex.execute("i", f"TopN(f, threshold={thr})")
+            assert [(p.id, p.count) for p in pairs] == [
+                (r, n) for r, n in want_pairs if n >= thr
+            ]
+            (groups,) = ex.execute(
+                "i", f"GroupBy(Rows(f), having=Condition(count >= {thr}))"
+            )
+            got = {g.group[0]["rowID"]: g.count for g in groups}
+            assert got == {r: len(c) for r, c in oracle.sets.items()
+                           if len(c) >= thr}
+
+            # pipelined submit() answers exactly as execute() (quiescent
+            # holder: leaves captured at enqueue match)
+            from pilosa_tpu.executor.result import result_to_json
+
+            pqls = [f"Count({random_expr(rng)[0]})" for _ in range(6)]
+            pqls += ["TopN(f)", "GroupBy(Rows(f))", 'Sum(field="v")']
+            defs = [ex.submit("i", p)[0] for p in pqls]
+            for p, d in zip(pqls, defs):
+                want_r = result_to_json(ex.execute("i", p)[0])
+                assert result_to_json(d.result()) == want_r, p
     finally:
         holder.close()
 
