@@ -729,12 +729,17 @@ class Cluster:
             for owner in self.shard_nodes(index_name, s):
                 if owner.state == STATE_DEGRADED or owner.id in have_ids:
                     continue
-                src = next((n for n in live_sources if n.id != owner.id), None)
-                if src is None:
+                usable = [n for n in live_sources if n.id != owner.id]
+                if not usable:
                     continue
+                # extra live holders ride along as fallbacks, tried by
+                # the receiver when the primary source errors mid-move
+                # (fetch_fragments) — same contract as the self-join
+                # inventory
                 instructions.setdefault(owner.id, []).append({
                     "index": index_name, "field": f, "view": v, "shard": s,
-                    "from": src.uri,
+                    "from": usable[0].uri,
+                    "fallbacks": [n.uri for n in usable[1:]],
                 })
         if not instructions:
             # A coordinator can die between broadcasting RESIZING and

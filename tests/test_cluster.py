@@ -574,6 +574,65 @@ class TestResizeAndReReplication:
             for s in servers:
                 s.close()
 
+    def test_resize_instruction_uses_fallback_source(self, tmp_path):
+        """Coordinator instructions carry extra live holders as
+        fallbacks; a receiver whose primary source errors mid-move pulls
+        the fragment from a fallback instead of losing it (same contract
+        as the self-join inventory)."""
+        import numpy as np
+
+        from pilosa_tpu.parallel.client import ClientError, InternalClient
+
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            coord = next(s for s in servers
+                         if s.api.cluster.is_acting_coordinator)
+            peers = [s for s in servers if s is not coord]
+            # BOTH peers hold shard 3's fragment; the coordinator (an
+            # owner for some shard under replicaN=2) may need to fetch it
+            for p in peers:
+                fp = p.holder.index("i").field("f")
+                fp.view("standard", create=True).fragment(
+                    3, create=True
+                ).bulk_import(np.asarray([2, 2], np.uint64),
+                              np.asarray([5, 9], np.uint64))
+
+            owners = coord.api.cluster.shard_nodes("i", 3)
+
+            def has_frag(s):
+                v = s.holder.index("i").field("f").view("standard")
+                return v is not None and v.fragment(3) is not None
+
+            receivers = [s for s in servers
+                         if any(n.id == s.api.cluster.local.id
+                                for n in owners) and not has_frag(s)]
+            if not receivers:
+                pytest.skip("ring gave shard 3 to its holders only")
+            # break the FIRST peer's data endpoint for everyone
+            broken_uri = uri(peers[0])
+            real_fd = InternalClient.fragment_data
+
+            def flaky(client, node_uri, *a, **k):
+                if node_uri == broken_uri:
+                    raise ClientError("injected")
+                return real_fd(client, node_uri, *a, **k)
+
+            InternalClient.fragment_data = flaky
+            try:
+                coord.api.cluster.coordinate_resize()
+            finally:
+                InternalClient.fragment_data = real_fd
+            for r in receivers:
+                frag = (r.holder.index("i").field("f")
+                        .view("standard").fragment(3))
+                assert frag is not None and frag.count() == 2, (
+                    r.config.name)
+        finally:
+            for s in servers:
+                s.close()
+
     def test_queries_deferred_while_resizing(self, tmp_path):
         import threading
         import time as _time
