@@ -238,3 +238,36 @@ class TestPilosaLayout:
         assert frag.row_words(2) is not None
         assert frag.contains(2, 1) and frag.contains(2, 4)
         holder.close()
+
+
+class TestFormatStability:
+    def test_serialize_golden_bytes(self):
+        """On-disk format stability: the exact serialized bytes for a
+        fixed bitmap must never change silently — files written by one
+        build must open in the next (both the native layout and the
+        upstream-pilosa layout; run/array/bitmap container mix)."""
+        import hashlib
+
+        from pilosa_tpu.roaring.format import serialize, serialize_pilosa
+
+        ids = np.concatenate([
+            np.asarray(
+                [0, 1, 2, 100000, (2 << 20) + 5, (1 << 40) + 7], np.uint64
+            ),
+            # 5000 ids in one 2^16 range: forces a BITMAP container so the
+            # dense writer path is pinned too (run + array + bitmap mix)
+            (np.arange(5000, dtype=np.uint64) * 13) % 65536 + (3 << 16),
+        ])
+        bm = RoaringBitmap.from_ids(ids)
+        from pilosa_tpu.roaring.bitmap import ARRAY, BITMAP, RUN
+
+        kinds = {bm.container(k).kind for k in bm.keys}
+        assert kinds == {ARRAY, BITMAP, RUN}
+        own = serialize(bm)
+        up = serialize_pilosa(bm)
+        assert hashlib.sha256(own).hexdigest() == (
+            "45403260f0bdaaffcc1ee2bff7b23d9bb72e406be0ff326542718fa6b9d56a2e"
+        ), "native layout changed — bump the format version instead"
+        assert hashlib.sha256(up).hexdigest() == (
+            "c86eb3f56769bb1305f59b5f68dea81990ca0e7992d7c137b47d9495974dda0c"
+        ), "upstream-layout writer changed — verify against real pilosa files"
