@@ -156,7 +156,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         if proto_in:
             from pilosa_tpu.wire.serializer import decode_query_request
 
-            pql, shards, remote = decode_query_request(raw)
+            pql, shards, remote, opts = decode_query_request(raw)
         else:
             pql = raw.decode()
             shards = None
@@ -165,13 +165,14 @@ class HTTPHandler(BaseHTTPRequestHandler):
                     _int_param(s, "shards") for s in query["shards"][0].split(",")
                 ]
             remote = bool(query and query.get("remote", ["false"])[0] == "true")
-
-        # request-level result options (reference handler query args)
-        opts = {
+            opts = {}
+        # request-level result options also ride URL params for either
+        # body encoding (reference handler query args)
+        opts.update({
             k: True for k in ("columnAttrs", "excludeColumns",
                               "excludeRowAttrs")
             if query and query.get(k, ["false"])[0] == "true"
-        }
+        })
 
         if not proto_out:
             self._json(self.api.query(index, pql, shards=shards,

@@ -119,13 +119,23 @@ def encode_error(message: str) -> bytes:
 
 
 def decode_query_request(data: bytes):
+    """Returns (pql, shards, remote, opts) — opts holds the true
+    request-level result options under their URL-param names."""
     p = pb2()
     req = p.QueryRequest()
     req.ParseFromString(data)
+    opts = {}
+    if req.column_attrs:
+        opts["columnAttrs"] = True
+    if req.exclude_columns:
+        opts["excludeColumns"] = True
+    if req.exclude_row_attrs:
+        opts["excludeRowAttrs"] = True
     return (
         req.query,
         list(req.shards) if req.shards else None,
         req.remote,
+        opts,
     )
 
 

@@ -243,3 +243,31 @@ def test_column_attrs_survive_protobuf():
     ]
     (got,) = decode_results_json(encode_results([row]))["results"]
     assert got["columnAttrs"] == result_to_json(row)["columnAttrs"]
+
+
+@requires_proto
+def test_protobuf_request_carries_result_options(node):
+    """Protobuf clients set request-level result options as QueryRequest
+    fields (reference QueryRequest ColumnAttrs/ExcludeColumns/
+    ExcludeRowAttrs), equivalent to the JSON surface's URL params."""
+    import json
+
+    from pilosa_tpu.wire import pb2
+
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/query",
+        b'Set(1, f=1) SetColumnAttrs(1, city="nyc") '
+        b'SetRowAttrs(f, 1, team="blue")')
+    p = pb2()
+    qr = p.QueryRequest(query="Row(f=1)", column_attrs=True,
+                        exclude_row_attrs=True)
+    raw, ct = praw(
+        "POST", f"{node}/index/i/query", qr.SerializeToString(),
+        content_type="application/x-protobuf",
+    )
+    assert ct == "application/json"
+    (out,) = json.loads(raw)["results"]
+    assert out["attrs"] == {}  # excludeRowAttrs
+    assert out["columns"] == [1]
+    assert out["columnAttrs"] == [{"id": 1, "attrs": {"city": "nyc"}}]
