@@ -29,7 +29,7 @@ from pilosa_tpu.executor import batch, expr
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.pql import Call, Condition, parse
 from pilosa_tpu.pql.ast import Query
-from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD, next_pow2, position, shard_of
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD, next_pow2, position, shard_of
 from pilosa_tpu.storage import residency
 from pilosa_tpu.storage.field import (
     BSI_EXISTS_ROW,

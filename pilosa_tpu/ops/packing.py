@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
 def pack_bits(bit_positions, n_bits: int = SHARD_WIDTH) -> np.ndarray:

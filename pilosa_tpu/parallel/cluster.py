@@ -31,7 +31,6 @@ import time
 import uuid
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
-from pilosa_tpu.storage.view import VIEW_STANDARD
 from pilosa_tpu.utils.pool import concurrent_map
 
 PARTITION_N = 256

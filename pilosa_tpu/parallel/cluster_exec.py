@@ -31,7 +31,7 @@ from pilosa_tpu.parallel.client import ClientError
 from pilosa_tpu.parallel.cluster import Cluster, Node
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.pql.ast import Query
-from pilosa_tpu.shardwidth import SHARD_WIDTH, position, shard_of
+from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_of
 from pilosa_tpu.utils.pool import concurrent_map, run_concurrently
 
 _WRITE_BROADCAST = {"SetRowAttrs", "SetColumnAttrs"}

@@ -29,7 +29,7 @@ from pilosa_tpu.roaring.format import (
     replay_ops,
     serialize,
 )
-from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
 

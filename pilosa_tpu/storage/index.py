@@ -12,7 +12,7 @@ import json
 import os
 import shutil
 
-from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, position, shard_of
+from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.storage.field import Field, FieldOptions, TYPE_SET
 from pilosa_tpu.storage.view import VIEW_STANDARD
 
