@@ -284,10 +284,11 @@ class Executor:
         power-of-two buckets so same-field TopN streams share shapes) —
         are additionally coalesced into micro-batched dispatches (see
         _microbatch_enqueue) and stay in flight until resolved. Dense
-        single-level GroupBys enqueue their level program at submit time
-        with the readback deferred to result(); pruned (multi-level)
-        GroupBys defer ALL dispatch to result() (each level's readback
-        gates the next level's candidates). Remaining call types
+        single-level GroupBys and row-materializing bitmap calls enqueue
+        their programs at submit time with the readback deferred to
+        result(); pruned (multi-level) GroupBys defer ALL dispatch to
+        result() (each level's readback gates the next level's
+        candidates). Remaining call types (writes, host-only reads)
         evaluate eagerly at submit time and return an already-resolved
         Deferred.
         """
@@ -310,6 +311,9 @@ class Executor:
             elif call.name == "GroupBy":
                 out.append(self._submit_groupby(idx, call, shards,
                                                 pipeline=True))
+            elif call.name in _BITMAP_CALLS:
+                out.append(self._submit_bitmap(idx, call, shards,
+                                               pipeline=True))
             else:
                 out.append(Deferred(value=self._execute_call(idx, call, shards)))
         return out
@@ -569,22 +573,49 @@ class Executor:
     # --------------------------------------------------------- bitmap calls
 
     def _execute_bitmap(self, idx: Index, call: Call, shards=None) -> RowResult:
+        return self._submit_bitmap(idx, call, shards).result()
+
+    def _submit_bitmap(self, idx: Index, call: Call, shards=None,
+                       pipeline: bool = False) -> "Deferred":
+        """Row-materializing calls: the fused program is enqueued at
+        submit time; the [padded, words] readback (the only multi-row
+        device→host transfer in the system) happens at result()."""
         compiled = self._compile_cached(idx, call)
         shard_list = self._shards(idx, shards)
         if not shard_list:
-            return self._finish_row_result(idx, call, RowResult({}))
+            return Deferred(
+                value=self._finish_row_result(idx, call, RowResult({}))
+            )
         block = self._shard_block(shard_list)
         stacked = self._batched_eval(idx, compiled, block, "row")
-        host = np.asarray(stacked)
-        segments = {}
-        for i, shard in enumerate(block.shards):
-            if host[i].any():
-                # copy: a view would pin the whole [padded, words] readback
-                segments[shard] = host[i].copy()
-        return self._finish_row_result(idx, call, RowResult(segments))
+        # row attrs snapshot at SUBMIT time, like the bitmap data (a
+        # SetRowAttrs between submit and result must not tear the
+        # result); column-key translation stays at result() — the
+        # translate log is append-only, so ids→keys cannot change
+        attrs = self._row_result_attrs(idx, call)
 
-    def _finish_row_result(self, idx: Index, call: Call, res: RowResult) -> RowResult:
-        """Attach row attrs (plain Row calls) and translated column keys."""
+        def finish() -> RowResult:
+            host = np.asarray(stacked)
+            segments = {}
+            for i, shard in enumerate(block.shards):
+                if host[i].any():
+                    # copy: a view would pin the whole padded readback
+                    segments[shard] = host[i].copy()
+            res = RowResult(segments, attrs=attrs)
+            if idx.keys:
+                res.keys = [
+                    k for k in self._column_keys(idx, res.columns().tolist())
+                    if k is not None
+                ]
+            return res
+
+        if pipeline:
+            return Deferred(finish)
+        return Deferred(value=finish())
+
+    def _row_result_attrs(self, idx: Index, call: Call) -> dict:
+        """Row attrs for a plain Row call (reference: Row results carry
+        the row's attribute set)."""
         if call.name == "Row" and call.condition_field()[0] is None:
             try:
                 field_name, row = self._row_field_and_value(call)
@@ -592,9 +623,14 @@ class Executor:
                 if field is not None and field.row_attrs is not None:
                     row_id = self._translate_row(idx, field, row, create=False)
                     if row_id is not None:
-                        res.attrs = field.row_attrs.attrs(row_id)
+                        return field.row_attrs.attrs(row_id)
             except PQLError:
                 pass
+        return {}
+
+    def _finish_row_result(self, idx: Index, call: Call, res: RowResult) -> RowResult:
+        """Attach row attrs (plain Row calls) and translated column keys."""
+        res.attrs = self._row_result_attrs(idx, call) or res.attrs
         if idx.keys:
             res.keys = [
                 k for k in self._column_keys(idx, res.columns().tolist())

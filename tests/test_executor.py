@@ -477,11 +477,48 @@ class TestSubmitPipelined:
             (d,) = ex.submit("vals", f'{name}(field="n")')
             assert d.result() == want
 
-    def test_submit_non_reduction_is_eager(self, env):
+    def test_submit_row_defers_readback(self, env, monkeypatch):
+        """Pipelined bitmap calls enqueue their program at submit but
+        perform the [padded, words] readback only at result()."""
         holder, ex = env
         _, data, _ = setup_stars(holder)
+        reads = []
+        real_asarray = np.asarray
+
+        def counting_asarray(x, *a, **k):
+            import jax
+
+            if isinstance(x, jax.Array):
+                reads.append(type(x).__name__)
+            return real_asarray(x, *a, **k)
+
+        monkeypatch.setattr(
+            "pilosa_tpu.executor.executor.np.asarray", counting_asarray
+        )
         (d,) = ex.submit("repos", "Row(stargazer=1)")
+        assert reads == []  # no device readback at submit time
         assert d.result().columns().tolist() == data[1]
+        assert len(reads) == 1
+
+    def test_submit_writes_and_host_reads_stay_eager(self, env):
+        """Writes and host-only reads must execute AT submit time (an
+        already-resolved Deferred) — read-your-writes ordering within a
+        submitted stream depends on it."""
+        holder, ex = env
+        setup_stars(holder)
+        (d,) = ex.submit("repos", "Set(999, stargazer=1)")
+        assert d._finalize is None  # already resolved
+        assert d.result() is True
+        # the write is visible to a submit enqueued right after
+        (d2,) = ex.submit("repos", "Count(Row(stargazer=1))")
+        (rows,) = ex.submit("repos", "Rows(stargazer)")
+        assert rows._finalize is None  # host-only read: eager
+        assert 999 in set(
+            ex.execute("repos", "Row(stargazer=1)")[0].columns().tolist()
+        )
+        assert d2.result() == ex.execute(
+            "repos", "Count(Row(stargazer=1))"
+        )[0]
 
     def test_submit_count_microbatch_coalesces(self, env):
         """Pipelined same-shape Counts dispatch as ONE micro-batched
