@@ -687,19 +687,10 @@ class Executor:
         return bool((words[pos // 32] >> np.uint32(pos % 32)) & np.uint32(1))
 
     def _execute_options(self, idx: Index, call: Call, shards=None):
-        if len(call.children) != 1:
-            raise PQLError("Options requires one child call")
-        opt_shards = call.arg("shards")
-        if opt_shards is not None:
-            shards = [int(s) for s in opt_shards]
-        res = self._execute_call(idx, call.children[0], shards)
-        if not isinstance(res, RowResult):
-            return res
-        if call.arg("columnAttrs"):
-            res.column_attrs = column_attr_sets(idx, res)
-        if call.arg("excludeColumns"):
-            return strip_columns(res)
-        return res
+        res = self._execute_call(
+            idx, options_child(call), options_restrict_shards(call, shards)
+        )
+        return apply_options_result(idx, call, res)
 
     # -------------------------------------------------------------- compile
 
@@ -1573,6 +1564,39 @@ def _groupby_level_unpack(host: np.ndarray, layout, c_total: int,
             off += 2 * padded
         out_off += actual
     return counts, (n_g, pc) if has_agg else None
+
+
+def options_child(call: Call) -> Call:
+    """Validate and return an Options() call's single child."""
+    if len(call.children) != 1:
+        raise PQLError("Options requires one child call")
+    return call.children[0]
+
+
+def options_restrict_shards(call: Call, shards):
+    """Apply Options(shards=) to an engine-supplied shard list. The two
+    INTERSECT: an engine list (a remote sub-query's per-node assignment,
+    or a request-level ?shards= param) must never be widened by the
+    user restriction — overriding it would make every replica evaluate
+    the full user set and double-count in the cross-node merge. Shared
+    by the single-node executor and the cluster layer so the semantics
+    cannot drift."""
+    opt = call.arg("shards")
+    if opt is None:
+        return shards
+    opt = sorted(int(s) for s in opt)
+    return opt if shards is None else sorted(set(opt) & set(shards))
+
+
+def apply_options_result(idx: Index, call: Call, res):
+    """The result-side tail of Options(): columnAttrs / excludeColumns
+    on row-materializing results (applied after any cross-node merge)."""
+    if isinstance(res, RowResult):
+        if call.arg("columnAttrs"):
+            res.column_attrs = column_attr_sets(idx, res)
+        if call.arg("excludeColumns"):
+            return strip_columns(res)
+    return res
 
 
 def column_attr_sets(idx: Index, res: RowResult) -> list[dict]:

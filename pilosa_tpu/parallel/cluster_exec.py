@@ -23,7 +23,10 @@ from pilosa_tpu.executor import Executor
 from pilosa_tpu.executor.executor import (
     PQLError,
     TOPN_CANDIDATE_FACTOR,
+    apply_options_result,
     having_predicate,
+    options_child,
+    options_restrict_shards,
 )
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.ops.packing import pack_bits
@@ -176,6 +179,20 @@ class ClusterExecutor:
             for out in outs:
                 result = result or out
             return result
+
+        if name == "Options":
+            # Unwrap at the CLUSTER layer: _reduce dispatches on the
+            # child's name (an Options-wrapped Count would otherwise
+            # fall through and drop every remote partial), the shards=
+            # restriction narrows the routed universe BEFORE fan-out
+            # (intersecting any engine-supplied list, same helper as the
+            # single-node executor), and the result options apply after
+            # the cross-node merge.
+            res = self._execute_call(
+                idx, options_child(call),
+                options_restrict_shards(call, shards),
+            )
+            return apply_options_result(idx, call, res)
 
         shard_list = shards if shards is not None else self._all_shards(idx.name)
         local, groups = self._route(idx.name, shard_list)

@@ -182,6 +182,40 @@ class TestDistributedQueries:
             {"group": [{"field": "a", "rowID": 1}], "count": 12}
         ]
 
+    def test_options_shards_no_double_count_with_replication(self, tmp_path):
+        """Options(shards=) on a replicated cluster: a remote sub-query
+        must evaluate only its ASSIGNED slice of the user's shard set —
+        overriding the assignment with the full user set makes every
+        replica evaluate shards it holds as a SECONDARY too, double-
+        counting them in the merge (3 nodes, replicaN=2: remote groups
+        overlap through replication)."""
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            n_shards = 8
+            cols = [s * SHARD_WIDTH + 1 for s in range(n_shards)]
+            req("POST", f"{uri(servers[0])}/index/i/field/f/import",
+                {"rows": [1] * len(cols), "columns": cols})
+            all_shards = list(range(n_shards))
+            pql = f"Options(Count(Row(f=1)), shards={all_shards})".encode()
+            for s in servers:  # every coordinator sees the exact count
+                out = req("POST", f"{uri(s)}/index/i/query", pql)
+                assert out["results"] == [n_shards], (s.config.name, out)
+            out = req("POST", f"{uri(servers[0])}/index/i/query",
+                      b"Options(Count(Row(f=1)), shards=[0, 3, 5])")
+            assert out["results"] == [3], out
+            # a request-level ?shards= restriction INTERSECTS the
+            # Options(shards=) set (never widened), same as single-node
+            out = req("POST",
+                      f"{uri(servers[0])}/index/i/query?shards=0,1",
+                      f"Options(Count(Row(f=1)), shards={all_shards})"
+                      .encode())
+            assert out["results"] == [2], out
+        finally:
+            for s in servers:
+                s.close()
+
     def test_bsi_sum_across_nodes(self, cluster3):
         req("POST", f"{uri(cluster3[0])}/index/i", {})
         req("POST", f"{uri(cluster3[0])}/index/i/field/v",
