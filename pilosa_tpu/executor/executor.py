@@ -341,7 +341,7 @@ class Executor:
         if name == "GroupBy":
             return self._execute_groupby(idx, call, shards)
         if name == "IncludesColumn":
-            return self._execute_includes_column(idx, call)
+            return self._execute_includes_column(idx, call, shards)
         if name == "SetRowAttrs":
             return self._execute_set_row_attrs(idx, call)
         if name == "SetColumnAttrs":
@@ -675,13 +675,19 @@ class Executor:
             packed = self._batched_eval(idx, compiled, block, reduce_kind)
         return Deferred(lambda: finish(np.asarray(packed)))
 
-    def _execute_includes_column(self, idx: Index, call: Call) -> bool:
+    def _execute_includes_column(self, idx: Index, call: Call,
+                                 shards=None) -> bool:
         col = call.arg("column")
         if col is None:
             raise PQLError("IncludesColumn requires column=")
         if len(call.children) != 1:
             raise PQLError("IncludesColumn requires one child call")
+        col = self._translate_col(idx, col, create=False)
+        if col is None:
+            return False  # unknown column key: not included
         shard, pos = shard_of(col), position(col)
+        if shards is not None and shard not in shards:
+            return False  # Options(shards=) excludes the column's shard
         compiled = self._compile_cached(idx, call.children[0])
         words = np.asarray(compiled.eval(idx, shard))
         return bool((words[pos // 32] >> np.uint32(pos % 32)) & np.uint32(1))
@@ -1584,7 +1590,7 @@ def options_restrict_shards(call: Call, shards):
     opt = call.arg("shards")
     if opt is None:
         return shards
-    opt = sorted(int(s) for s in opt)
+    opt = sorted({int(s) for s in opt})  # dedup: each shard counts once
     return opt if shards is None else sorted(set(opt) & set(shards))
 
 

@@ -877,3 +877,30 @@ class TestSubmitBSIAggregates:
             t.join(timeout=60)
         assert not errors, errors[0]
         assert ex.execute("repos", "Count(Row(f=1))")[0] == 3
+
+
+class TestOptionsShardEdges:
+    def test_options_duplicate_shards_count_once(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        for s in range(3):
+            f.set_bit(1, s * SHARD_WIDTH + 1)
+        assert ex.execute("i", "Options(Count(Row(f=1)), shards=[2, 2, 2])") == [1]
+        assert ex.execute("i", "Options(Count(Row(f=1)), shards=[0, 1, 1])") == [2]
+
+    def test_options_shards_restricts_includes_column(self, env):
+        holder, ex = env
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        col = 2 * SHARD_WIDTH + 7  # shard 2
+        f.set_bit(1, col)
+        assert ex.execute(
+            "i", f"IncludesColumn(Row(f=1), column={col})"
+        ) == [True]
+        assert ex.execute(
+            "i", f"Options(IncludesColumn(Row(f=1), column={col}), shards=[0])"
+        ) == [False]
+        assert ex.execute(
+            "i", f"Options(IncludesColumn(Row(f=1), column={col}), shards=[2])"
+        ) == [True]

@@ -187,3 +187,19 @@ class TestAttrCalls:
         ex.execute("users", 'SetRowAttrs(likes, "pizza", cuisine="italian")')
         (res,) = ex.execute("users", 'Row(likes="pizza")')
         assert res.attrs == {"cuisine": "italian"}
+
+
+def test_includes_column_with_keys(env):
+    """IncludesColumn(column=) accepts column keys on a keyed index;
+    unknown keys resolve to False (not an error)."""
+    holder, ex = env
+    holder.create_index("users", keys=True).create_field(
+        "likes", FieldOptions(keys=True)
+    )
+    ex.execute("users", 'Set("alice", likes="pizza")')
+    assert ex.execute(
+        "users", 'IncludesColumn(Row(likes="pizza"), column="alice")'
+    ) == [True]
+    assert ex.execute(
+        "users", 'IncludesColumn(Row(likes="pizza"), column="ghost")'
+    ) == [False]
