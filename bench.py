@@ -38,10 +38,39 @@ from __future__ import annotations
 import argparse
 import itertools
 import json
+import os
 import tempfile
+import threading
 import time
 
 import numpy as np
+
+# If the device backend neither initializes nor fails within this long
+# (observed failure mode of the tunneled relay: ~25 min hang at init,
+# then UNAVAILABLE), emit a diagnostic JSON line instead of hanging the
+# driver forever. Generous vs the ~40 s worst-case first compile.
+DEVICE_WATCHDOG_SECONDS = 900.0
+
+
+def _device_watchdog() -> threading.Event:
+    """Arm a watchdog for backend init; set() the returned event once the
+    first device op completes."""
+    ready = threading.Event()
+
+    def bark() -> None:
+        if not ready.wait(DEVICE_WATCHDOG_SECONDS):
+            print(json.dumps({
+                "metric": "pql_intersect_count_cols_per_sec_1B",
+                "value": 0, "unit": "columns/sec/chip", "vs_baseline": 0,
+                "error": (
+                    "device backend failed to initialize within "
+                    f"{DEVICE_WATCHDOG_SECONDS:.0f}s (tunnel/relay down?)"
+                ),
+            }), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=bark, daemon=True, name="device-watchdog").start()
+    return ready
 
 N_COLS = 1 << 30  # one billion columns per query
 K_ROWS = 8  # distinct rows per field (2 GiB HBM in stacked leaves)
@@ -238,6 +267,12 @@ def main() -> None:
     n_cols = n_shards << 20
     n_words = n_cols // 32
 
+    ready = _device_watchdog()
+    import jax
+    import jax.numpy as jnp
+
+    jnp.add(1, 1).block_until_ready()  # first device op: backend is up
+    ready.set()  # a slow-but-alive backend is allowed to take its time
     a = _make_rows(K_ROWS, n_words, seed=1)
     b = _make_rows(K_ROWS, n_words, seed=2)
     kernel_dt, kernel_ref = bench_kernel(a, b)

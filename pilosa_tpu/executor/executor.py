@@ -675,8 +675,12 @@ class Executor:
             packed = self._batched_eval(idx, compiled, block, reduce_kind)
         return Deferred(lambda: finish(np.asarray(packed)))
 
-    def _execute_includes_column(self, idx: Index, call: Call,
-                                 shards=None) -> bool:
+    def includes_target(self, idx: Index, call: Call, shards=None):
+        """Resolve IncludesColumn's target: (numeric column, shard), or
+        None when the answer is trivially False (unknown column key, or
+        an Options(shards=) restriction excluding the column's shard).
+        Shared by the single-node and cluster dispatch paths so the
+        key/shard semantics cannot drift."""
         col = call.arg("column")
         if col is None:
             raise PQLError("IncludesColumn requires column=")
@@ -684,10 +688,19 @@ class Executor:
             raise PQLError("IncludesColumn requires one child call")
         col = self._translate_col(idx, col, create=False)
         if col is None:
-            return False  # unknown column key: not included
-        shard, pos = shard_of(col), position(col)
+            return None  # unknown column key: not included
+        shard = shard_of(col)
         if shards is not None and shard not in shards:
-            return False  # Options(shards=) excludes the column's shard
+            return None  # Options(shards=) excludes the column's shard
+        return col, shard
+
+    def _execute_includes_column(self, idx: Index, call: Call,
+                                 shards=None) -> bool:
+        target = self.includes_target(idx, call, shards)
+        if target is None:
+            return False
+        col, shard = target
+        pos = position(col)
         compiled = self._compile_cached(idx, call.children[0])
         words = np.asarray(compiled.eval(idx, shard))
         return bool((words[pos // 32] >> np.uint32(pos % 32)) & np.uint32(1))

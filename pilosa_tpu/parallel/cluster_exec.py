@@ -418,19 +418,14 @@ class ClusterExecutor:
         return self.local._finish_pairs(idx, field, pairs)
 
     def _execute_includes(self, idx, call: Call, shards=None):
-        col = call.arg("column")
-        if col is None:
-            raise PQLError("IncludesColumn requires column=")
-        col = self.local._translate_col(idx, col, create=False)
-        if col is None:
-            return False  # unknown column key: not included
+        target = self.local.includes_target(idx, call, shards)
+        if target is None:
+            return False
+        col, shard = target
         # forward the NUMERIC column (a lagging translate replica on the
         # target could otherwise fail to resolve the key)
         call = Call(call.name, {**call.args, "column": int(col)},
                     call.children)
-        shard = shard_of(int(col))
-        if shards is not None and shard not in shards:
-            return False  # Options(shards=) excludes the column's shard
         if self.cluster.owns_shard(idx.name, shard):
             return self.local._execute_call(idx, call)
         node = self.cluster.primary_for_shard(idx.name, shard)

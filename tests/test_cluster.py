@@ -216,6 +216,27 @@ class TestDistributedQueries:
             for s in servers:
                 s.close()
 
+    def test_includes_column_across_nodes(self, cluster3):
+        """IncludesColumn routes to the column's shard owner; it honors
+        Options(shards=) restrictions and keyed columns cluster-wide."""
+        req("POST", f"{uri(cluster3[0])}/index/i", {})
+        req("POST", f"{uri(cluster3[0])}/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 3 for s in range(6)]
+        req("POST", f"{uri(cluster3[0])}/index/i/field/f/import",
+            {"rows": [1] * len(cols), "columns": cols})
+        target = 4 * SHARD_WIDTH + 3  # shard 4, wherever it lives
+        for s in cluster3:  # answer identical from every coordinator
+            out = req("POST", f"{uri(s)}/index/i/query",
+                      f"IncludesColumn(Row(f=1), column={target})".encode())
+            assert out["results"] == [True], s.config.name
+        out = req("POST", f"{uri(cluster3[1])}/index/i/query",
+                  f"Options(IncludesColumn(Row(f=1), column={target}), "
+                  f"shards=[0, 1])".encode())
+        assert out["results"] == [False]
+        out = req("POST", f"{uri(cluster3[1])}/index/i/query",
+                  f"IncludesColumn(Row(f=1), column={target + 1})".encode())
+        assert out["results"] == [False]
+
     def test_bsi_sum_across_nodes(self, cluster3):
         req("POST", f"{uri(cluster3[0])}/index/i", {})
         req("POST", f"{uri(cluster3[0])}/index/i/field/v",
