@@ -51,6 +51,11 @@ import numpy as np
 # driver forever. Generous vs the ~40 s worst-case first compile.
 DEVICE_WATCHDOG_SECONDS = 900.0
 
+# Headline metric identity, shared by the result line and the watchdog's
+# diagnostic line so a rename can't leave the failure under a stale key.
+METRIC_NAME = "pql_intersect_count_cols_per_sec_1B"
+METRIC_UNIT = "columns/sec/chip"
+
 
 def _device_watchdog() -> threading.Event:
     """Arm a watchdog for backend init; set() the returned event once the
@@ -60,8 +65,8 @@ def _device_watchdog() -> threading.Event:
     def bark() -> None:
         if not ready.wait(DEVICE_WATCHDOG_SECONDS):
             print(json.dumps({
-                "metric": "pql_intersect_count_cols_per_sec_1B",
-                "value": 0, "unit": "columns/sec/chip", "vs_baseline": 0,
+                "metric": METRIC_NAME,
+                "value": 0, "unit": METRIC_UNIT, "vs_baseline": 0,
                 "error": (
                     "device backend failed to initialize within "
                     f"{DEVICE_WATCHDOG_SECONDS:.0f}s (tunnel/relay down?)"
@@ -297,9 +302,9 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "pql_intersect_count_cols_per_sec_1B",
+                "metric": METRIC_NAME,
                 "value": round(exec_cols_per_sec, 1),
-                "unit": "columns/sec/chip",
+                "unit": METRIC_UNIT,
                 "vs_baseline": round(cpu_dt_per_col * exec_cols_per_sec, 2),
                 "kernel_cols_per_sec": round(kernel_cols_per_sec, 1),
                 "executor_vs_kernel": round(

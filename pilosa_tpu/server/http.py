@@ -53,6 +53,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
     ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
     ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
+    ("GET", re.compile(r"^/internal/fragment/nodes$"), "get_fragment_nodes"),
     ("GET", re.compile(r"^/internal/fragments$"), "get_fragments_catalog"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
     ("GET", re.compile(r"^/internal/attrs/blocks$"), "get_attr_blocks"),
@@ -340,6 +341,15 @@ class HTTPHandler(BaseHTTPRequestHandler):
         frag = v.fragment(shard) if v else None
         blocks = frag.blocks() if frag else []
         self._json({"blocks": [{"block": b, "checksum": c} for b, c in blocks]})
+
+    def get_fragment_nodes(self, query=None):
+        """Which nodes own a shard (reference /internal/fragment/nodes —
+        clients use it to route imports/queries directly to owners)."""
+        index = (query.get("index") or [""])[0]
+        shard_param = (query.get("shard") or [None])[0]
+        if shard_param is None:
+            raise ApiError("shard param required", 400)
+        self._json(self.api.shard_nodes(index, _int_param(shard_param, "shard")))
 
     def get_fragment_data(self, query=None):
         index = (query.get("index") or [""])[0]

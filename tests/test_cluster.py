@@ -1235,3 +1235,21 @@ class TestAsyncSelfJoin:
         assert c.state == "RESIZING"
         c.handle_message({"type": "cluster-state", "state": "NORMAL"})
         assert c.state == "NORMAL"
+
+
+class TestFragmentNodesRoute:
+    def test_fragment_nodes_lists_owners(self, tmp_path):
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            out = req("GET",
+                      f"{uri(servers[0])}/internal/fragment/nodes"
+                      f"?index=i&shard=5")
+            ids = {n["id"] for n in out}
+            assert len(ids) == 2  # replicaN owners
+            want = {n.id for n in
+                    servers[0].api.cluster.shard_nodes("i", 5)}
+            assert ids == want
+        finally:
+            for s in servers:
+                s.close()

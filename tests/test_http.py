@@ -351,3 +351,11 @@ def test_request_level_query_options(node):
               b"Row(f=1)")["results"][0]
     assert out["columns"] == [] and out["attrs"] == {"team": "blue"}
     assert out["columnAttrs"] == [{"id": 1, "attrs": {"city": "nyc"}}]
+
+
+def test_fragment_nodes_route(node):
+    """GET /internal/fragment/nodes reports shard ownership (reference
+    clients route imports/queries with it)."""
+    req("POST", f"{node}/index/i", {})
+    out = req("GET", f"{node}/internal/fragment/nodes?index=i&shard=3")
+    assert isinstance(out, list) and out and "uri" in out[0]
