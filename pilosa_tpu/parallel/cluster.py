@@ -921,8 +921,12 @@ class Cluster:
         """Diff + union attr-store blocks against every peer (reference
         attr-block sync — SURVEY.md §3.5). Attrs are replicated everywhere
         (they are tiny), matching the reference's attr stores living beside
-        every fragment owner."""
+        every fragment owner. Peers are walked CONCURRENTLY per store —
+        this runs inside the gated self-join path, where serial per-peer
+        RTTs would extend the query-blocking window; merge_block
+        serializes on the store's own lock."""
         merged = 0
+        peers = [n for n in self.sorted_nodes() if n.id != self.local.id]
         for index_name, idx in list(self.holder.indexes.items()):
             stores = [("", idx.column_attrs)]
             stores += [
@@ -930,25 +934,39 @@ class Cluster:
                 for fname, f in idx.fields.items()
                 if f.row_attrs is not None
             ]
-            for node in self.sorted_nodes():
-                if node.id == self.local.id:
+            for field_name, store in stores:
+                if store is None:
                     continue
-                for field_name, store in stores:
-                    if store is None:
-                        continue
+                local = dict(store.blocks())
+                # one fetch per DISTINCT peer version of a block: attrs
+                # replicate everywhere, so N-1 peers usually advertise
+                # the same checksum for a stale local block — without
+                # the claim set every peer would redundantly fetch and
+                # merge it. Divergent versions (different checksums)
+                # still all merge.
+                claimed: set[tuple] = set()
+                claim_lock = threading.Lock()
+
+                def sync_peer(node, field_name=field_name, store=store,
+                              local=local, claimed=claimed,
+                              claim_lock=claim_lock):
+                    n = 0
                     try:
                         peer = self.client._call(
                             "GET",
-                            f"{node.uri}/internal/attrs/blocks?index={index_name}"
-                            f"&field={field_name}",
+                            f"{node.uri}/internal/attrs/blocks"
+                            f"?index={index_name}&field={field_name}",
                         )
                     except ClientError:
-                        continue
-                    local = dict(store.blocks())
+                        return 0
                     for entry in peer.get("blocks", []):
                         block, checksum = entry["block"], entry["checksum"]
                         if local.get(block) == checksum:
                             continue
+                        with claim_lock:
+                            if (block, checksum) in claimed:
+                                continue
+                            claimed.add((block, checksum))
                         try:
                             data = self.client._call(
                                 "GET",
@@ -959,5 +977,8 @@ class Cluster:
                         except ClientError:
                             continue
                         store.merge_block(data.get("attrs", {}))
-                        merged += 1
+                        n += 1
+                    return n
+
+                merged += sum(concurrent_map(sync_peer, peers))
         return merged
