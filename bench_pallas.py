@@ -37,7 +37,11 @@ TRIALS = 3
 HBM_PEAK = 819e9
 
 
-def pallas_intersect_count(block_w: int):
+def pallas_intersect_count(block_w: int, rows: int = R, words: int = W,
+                           interpret: bool = False):
+    """Pallas grid kernel for per-row sum(popcount(a & (b ^ salt))).
+    ``interpret=True`` runs the kernel logic on any backend (the CI test
+    pins it against a numpy oracle without TPU hardware)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -61,18 +65,19 @@ def pallas_intersect_count(block_w: int):
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(W // block_w,),
+        grid=(words // block_w,),
         in_specs=[
-            pl.BlockSpec((R, block_w), lambda w, s: (0, w)),
-            pl.BlockSpec((R, block_w), lambda w, s: (0, w)),
+            pl.BlockSpec((rows, block_w), lambda w, s: (0, w)),
+            pl.BlockSpec((rows, block_w), lambda w, s: (0, w)),
         ],
-        out_specs=pl.BlockSpec((R, 1), lambda w, s: (0, 0)),
+        out_specs=pl.BlockSpec((rows, 1), lambda w, s: (0, 0)),
     )
     return jax.jit(
         lambda a, b, salt: pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((R, 1), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((rows, 1), jnp.int32),
             grid_spec=grid_spec,
+            interpret=interpret,
         )(salt, a, b)
     )
 

@@ -1,0 +1,18 @@
+"""Pin the bench_pallas kernel logic in interpret mode (runs on the CPU
+backend; the on-chip timing comparison is bench_pallas.py proper)."""
+
+import numpy as np
+
+from bench_pallas import pallas_intersect_count
+
+
+def test_pallas_kernel_matches_numpy_oracle():
+    rows, words, bw = 8, 4096, 512
+    fn = pallas_intersect_count(bw, rows=rows, words=words, interpret=True)
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 1 << 32, (rows, words), dtype=np.uint32)
+    b = rng.integers(0, 1 << 32, (rows, words), dtype=np.uint32)
+    for salt in (0, 7):
+        got = np.asarray(fn(a, b, np.full(1, salt, np.uint32))).ravel()
+        want = np.bitwise_count(a & (b ^ np.uint32(salt))).sum(axis=1)
+        assert np.array_equal(got.astype(np.int64), want.astype(np.int64))
