@@ -508,12 +508,19 @@ COUNT_CHUNK_WORDS = 1 << 18
 
 def count_elementwise_sub(structure, leaf_ranks: tuple):
     """For a ('count', sub) structure whose tree is purely elementwise
-    over rank-1 word leaves (and/or/xor/diff/flipall/leaf/const0 — no
-    shift, whose bit motion is per-shard, and no BSI ops), return
-    ``sub``; else None. Such counts need no per-shard vmap: bit position
-    never matters, so the whole stacked block reduces as one flat array
-    in wider chunks (COUNT_CHUNK_WORDS) — the per-shard row width of
-    2^15 words costs measurable reduction overhead on TPU."""
+    over rank-1 word leaves (and/or/xor/diff/leaf/const0 — no shift,
+    whose bit motion is per-shard, and no BSI ops), return ``sub``; else
+    None. Such counts need no per-shard vmap: bit position never
+    matters, so the whole stacked block reduces as one flat array in
+    wider chunks (COUNT_CHUNK_WORDS) — the per-shard row width of 2^15
+    words costs measurable reduction overhead on TPU.
+
+    ``flipall`` deliberately DISQUALIFIES: the stacked block pads its
+    shard axis to a power of two with zero slots, and an unmasked NOT
+    turns those into all-ones words that the flat reduction would count.
+    The compiler never emits it (Not lowers to diff(exists, x), masked
+    by construction), so excluding it costs nothing and removes the
+    latent hazard for hand-built trees (ADVICE r4)."""
     if not structure or structure[0] != "count":
         return None
     if any(r != 1 for r in leaf_ranks):
@@ -524,7 +531,7 @@ def count_elementwise_sub(structure, leaf_ranks: tuple):
             return True
         if n[0] in ("leaf", "const0"):
             return True
-        if n[0] in ("and", "or", "xor", "diff", "flipall"):
+        if n[0] in ("and", "or", "xor", "diff"):
             return all(ok(c) for c in n[1:])
         return False
 

@@ -1638,12 +1638,30 @@ def strip_columns(res: RowResult) -> RowResult:
     return out
 
 
+def _condition_value(v):
+    """Numeric coercion for Condition thresholds: int and float pass
+    through untruncated (``count < 1.5`` must keep count==1 groups —
+    int(1.5) → ``< 1`` would drop them), quoted numerics parse, junk
+    raises PQLError (→ HTTP 400) instead of a bare TypeError."""
+    if isinstance(v, (int, float)):
+        return v
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            raise PQLError(
+                f"condition value {v!r} is not numeric"
+            ) from None
+
+
 def condition_test(cond: Condition, val: int) -> bool:
     """Evaluate a PQL Condition against a scalar (having= filters)."""
     if cond.op == "><":
         lo, hi = cond.value
-        return int(lo) <= val <= int(hi)
-    ref = int(cond.value)
+        return _condition_value(lo) <= val <= _condition_value(hi)
+    ref = _condition_value(cond.value)
     return {
         "<": val < ref, "<=": val <= ref, ">": val > ref, ">=": val >= ref,
         "==": val == ref, "!=": val != ref,

@@ -21,6 +21,7 @@ import numpy as np
 
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.executor.executor import (
+    Deferred,
     PQLError,
     TOPN_CANDIDATE_FACTOR,
     apply_options_result,
@@ -35,7 +36,7 @@ from pilosa_tpu.parallel.cluster import Cluster, Node
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.pql.ast import Query
 from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_of
-from pilosa_tpu.utils.pool import concurrent_map, run_concurrently
+from pilosa_tpu.utils.pool import concurrent_map, run_concurrently, spawn
 
 _WRITE_BROADCAST = {"SetRowAttrs", "SetColumnAttrs"}
 _SHARDS_TTL = 3.0
@@ -90,6 +91,103 @@ class ClusterExecutor:
         if idx is None:
             raise PQLError(f"index {index_name!r} not found")
         return [self._execute_call(idx, call, shards) for call in query.calls]
+
+    def submit(self, index_name: str, query, shards=None, remote: bool = False):
+        """Pipelined cluster execution: one ``Deferred`` per call.
+
+        The cluster analog of ``Executor.submit`` (the reference serves
+        concurrent queries through per-request mapReduce goroutines —
+        SURVEY.md §2 #12/§3.2; on a TPU backend the scarce resource is
+        DISPATCHES, so the stream must coalesce instead of merely
+        interleave). Per call: local shards enqueue through the wrapped
+        executor's pipelined ``submit`` — so a stream of cluster queries
+        micro-batches on-device exactly like a single-node stream — while
+        the remote fan-out STARTS on a background thread at submit time
+        (``spawn``); ``result()`` joins both and runs the cross-node
+        reduce. When every routed shard is local (single-node cluster,
+        full replication) the call delegates wholesale to the wrapped
+        executor and pays zero cluster overhead. Writes and point reads
+        (IncludesColumn) keep their eager routed semantics.
+        """
+        if remote:
+            # peer sub-query: strictly local, still pipelined
+            return self.local.submit(index_name, query, shards=shards)
+        if isinstance(query, str):
+            query = parse(query)
+        elif isinstance(query, Call):
+            query = Query([query])
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise PQLError(f"index {index_name!r} not found")
+        if not self.cluster.wait_until_normal(0):
+            # Cluster is RESIZING: the deferral wait must burn on the
+            # CALLER's thread at result() — concurrent requests then wait
+            # in parallel, and a serving pipeline's dispatcher (which
+            # calls submit, never result) stays unblocked.
+            def deferred(call):
+                def finalize():
+                    if not self.cluster.wait_until_normal(_RESIZE_WAIT):
+                        raise PQLError(
+                            "cluster is resizing; query deferred past timeout"
+                        )
+                    return self._execute_call(idx, call, shards)
+
+                return Deferred(finalize)
+
+            return [deferred(call) for call in query.calls]
+        return [self._submit_call(idx, call, shards) for call in query.calls]
+
+    def _submit_call(self, idx, call: Call, shards=None) -> Deferred:
+        name = call.name
+        if name == "Options":
+            inner = self._submit_call(
+                idx, options_child(call), options_restrict_shards(call, shards)
+            )
+            return Deferred(
+                lambda: apply_options_result(idx, call, inner.result())
+            )
+        if name == "IncludesColumn":
+            # a READ with a possible remote hop: start it on a background
+            # thread NOW so a slow shard owner cannot convoy a serving
+            # pipeline's dispatcher; result() joins
+            return Deferred(spawn(
+                lambda: self._execute_call(idx, call, shards)
+            ))
+        if name in ("Set", "Clear", "Store", "ClearRow") or name in _WRITE_BROADCAST:
+            # writes keep eager in-order semantics at submit time
+            return Deferred(value=self._execute_call(idx, call, shards))
+        shard_list = shards if shards is not None else self._all_shards(idx.name)
+        local, groups = self._route(idx.name, shard_list)
+        if not groups:
+            return self.local.submit(idx.name, call, shards=local)[0]
+        if name == "TopN":
+            return self._submit_topn(idx, call, local, groups)
+        having = None
+        if name == "GroupBy":
+            having = having_predicate(
+                call, has_agg=isinstance(call.arg("aggregate"), Call)
+            )
+        mapped = call
+        if name in ("Rows", "GroupBy") and (
+            call.arg("limit") or having is not None
+        ):
+            mapped = Call(
+                name,
+                {k: v for k, v in call.args.items()
+                 if k not in ("limit", "having")},
+                call.children,
+            )
+        # local program enqueues on the device stream NOW; remote fan-out
+        # departs on a background thread NOW; nothing blocks until result()
+        local_def = self.local.submit(idx.name, mapped, shards=local)[0]
+        remote_join = spawn(lambda: self._map_remote(idx.name, mapped, groups))
+
+        def finalize():
+            local_res = local_def.result()
+            partials = remote_join()
+            return self._reduce(idx, call, local_res, partials, having=having)
+
+        return Deferred(finalize)
 
     # -------------------------------------------------------- shard routing
 
@@ -375,47 +473,56 @@ class ClusterExecutor:
     # ----------------------------------------------------------------- TopN
 
     def _execute_topn(self, idx, call: Call, local, groups):
+        return self._submit_topn(idx, call, local, groups).result()
+
+    def _submit_topn(self, idx, call: Call, local, groups) -> Deferred:
+        """Two-phase distributed TopN, pipelined: phase 1 (overfetched
+        candidates) enqueues locally and departs remotely at SUBMIT time;
+        phase 2 (exact recount of the merged candidate set) must wait for
+        phase-1 readbacks, so it runs inside result()."""
         n = call.arg("n", 10)
         # threshold= filters on GLOBAL counts, so it is stripped from
         # every mapped sub-query (a per-node floor would drop candidates
         # whose cross-node sum qualifies) and applied after the merge
         mapped_args = {k: v for k, v in call.args.items() if k != "threshold"}
         explicit_ids = call.arg("ids")
+        local1 = remote1 = None
         if explicit_ids is None:
-            # phase 1: overfetched candidates from every node (local
-            # evaluation overlapping the remote fan-out)
             overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
             phase1 = Call("TopN", {**mapped_args, "n": overfetch}, call.children)
-            candidates: set[int] = set()
-            local_pairs, remote1 = run_concurrently(
-                lambda: self.local._execute_call(idx, phase1, local),
-                lambda: self._map_remote(idx.name, phase1, groups),
+            local1 = self.local.submit(idx.name, phase1, shards=local)[0]
+            remote1 = spawn(lambda: self._map_remote(idx.name, phase1, groups))
+
+        def finalize():
+            if explicit_ids is None:
+                candidates = {p.id for p in local1.result()}
+                for p in remote1():
+                    candidates.update(pair["id"] for pair in p)
+                if not candidates:
+                    return []
+                ids = sorted(candidates)
+            else:
+                ids = sorted(int(i) for i in explicit_ids)
+            # phase 2: exact recount of the merged candidate set everywhere
+            phase2 = Call("TopN", {**mapped_args, "ids": ids, "n": 0},
+                          call.children)
+            totals: dict[int, int] = {}
+            local2, remote2 = run_concurrently(
+                lambda: self.local._execute_call(idx, phase2, local),
+                lambda: self._map_remote(idx.name, phase2, groups),
             )
-            candidates.update(p.id for p in local_pairs)
-            for p in remote1:
-                candidates.update(pair["id"] for pair in p)
-            if not candidates:
-                return []
-            ids = sorted(candidates)
-        else:
-            ids = sorted(int(i) for i in explicit_ids)
-        # phase 2: exact recount of the merged candidate set everywhere
-        phase2 = Call("TopN", {**mapped_args, "ids": ids, "n": 0}, call.children)
-        totals: dict[int, int] = {}
-        local2, remote2 = run_concurrently(
-            lambda: self.local._execute_call(idx, phase2, local),
-            lambda: self._map_remote(idx.name, phase2, groups),
-        )
-        for p in local2:
-            totals[p.id] = totals.get(p.id, 0) + p.count
-        for partial in remote2:
-            for pair in partial:
-                totals[pair["id"]] = totals.get(pair["id"], 0) + pair["count"]
-        floor = max(1, int(call.arg("threshold", 0) or 0))
-        order = sorted((-c, r) for r, c in totals.items() if c >= floor)
-        pairs = [Pair(r, -negc) for negc, r in order[: n or len(order)]]
-        field = idx.field(call.arg("_field") or call.arg("field"))
-        return self.local._finish_pairs(idx, field, pairs)
+            for p in local2:
+                totals[p.id] = totals.get(p.id, 0) + p.count
+            for partial in remote2:
+                for pair in partial:
+                    totals[pair["id"]] = totals.get(pair["id"], 0) + pair["count"]
+            floor = max(1, int(call.arg("threshold", 0) or 0))
+            order = sorted((-c, r) for r, c in totals.items() if c >= floor)
+            pairs = [Pair(r, -negc) for negc, r in order[: n or len(order)]]
+            field = idx.field(call.arg("_field") or call.arg("field"))
+            return self.local._finish_pairs(idx, field, pairs)
+
+        return Deferred(finalize)
 
     def _execute_includes(self, idx, call: Call, shards=None):
         target = self.local.includes_target(idx, call, shards)

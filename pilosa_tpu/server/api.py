@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import collections
 import datetime as dt
+import threading
 
 import numpy as np
 
@@ -44,6 +45,13 @@ class API:
         # reference max-writes-per-request server knob: reject queries
         # carrying more write calls than this (0 = unlimited)
         self.max_writes_per_request: int = 5000
+        # Coalescing serving pipeline (server/pipeline.py): read-only
+        # requests ride Executor.submit through a wave-forming queue so
+        # concurrent HTTP clients share micro-batched dispatches. Set
+        # False to serve every request through blocking execute().
+        self.serve_pipelined: bool = True
+        self._pipeline = None  # created lazily on first pipelined query
+        self._pipeline_lock = threading.Lock()
 
     # ---------------------------------------------------------------- query
 
@@ -58,20 +66,54 @@ class API:
         t0 = time.perf_counter()
         try:
             query = pql
-            if isinstance(pql, str) and self.max_writes_per_request > 0:
+            if isinstance(pql, str):
                 from pilosa_tpu.pql import parse
 
                 query = parse(pql)
-                writes = len(query.write_calls())
-                if writes > self.max_writes_per_request:
-                    raise ApiError(
-                        f"too many writes in request: {writes} > "
-                        f"max-writes-per-request {self.max_writes_per_request}"
-                    )
+            writes = (len(query.write_calls())
+                      if hasattr(query, "write_calls") else 1)
+            if 0 < self.max_writes_per_request < writes:
+                raise ApiError(
+                    f"too many writes in request: {writes} > "
+                    f"max-writes-per-request {self.max_writes_per_request}"
+                )
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
                 kwargs["remote"] = remote
-            results = self.executor.execute(index, query, **kwargs)
+            # Read-only requests ride the coalescing pipeline (waves of
+            # concurrent requests share micro-batched dispatches — see
+            # server/pipeline.py); requests carrying writes keep the
+            # eager path so write routing/broadcast semantics and
+            # request-thread concurrency are unchanged.
+            if (writes == 0 and self.serve_pipelined
+                    and hasattr(self.executor, "submit")):
+                if self._pipeline is None:
+                    with self._pipeline_lock:
+                        if self._pipeline is None:
+                            from pilosa_tpu.server.pipeline import (
+                                QueryPipeline,
+                            )
+
+                            self._pipeline = QueryPipeline(self)
+                deferreds = self._pipeline.run(index, query, kwargs)
+                # Same stats/trace surface as Executor.execute — the
+                # timer here observes resolve latency (submission already
+                # happened in the wave), i.e. what this request actually
+                # waited on device+merge for.
+                from pilosa_tpu.utils.stats import global_stats
+                from pilosa_tpu.utils.tracing import global_tracer
+
+                stats = global_stats()
+                results = []
+                with global_tracer().span("executor.Execute", index=index):
+                    for call, d in zip(query.calls, deferreds):
+                        with global_tracer().span(
+                            f"execute{call.name}"
+                        ), stats.timer("query", {"call": call.name}):
+                            results.append(d.result())
+                        stats.count("queries", 1, {"call": call.name})
+            else:
+                results = self.executor.execute(index, query, **kwargs)
             if opts:
                 results = self._apply_request_opts(index, results, opts)
             return results

@@ -450,7 +450,14 @@ def _int_param(value: str, name: str) -> int:
 
 def make_http_server(api: API, bind: str = "localhost", port: int = 10101):
     handler = type("BoundHandler", (HTTPHandler,), {"api": api})
-    server = ThreadingHTTPServer((bind, port), handler)
+    # socketserver's default listen backlog (5) resets connections under
+    # a concurrent client wave — exactly the traffic shape the coalescing
+    # query pipeline exists to serve (server/pipeline.py)
+    server_cls = type(
+        "PilosaHTTPServer", (ThreadingHTTPServer,),
+        {"request_queue_size": 128},
+    )
+    server = server_cls((bind, port), handler)
     return server
 
 

@@ -1,0 +1,95 @@
+"""Coalescing query pipeline for the serving path.
+
+The reference serves N concurrent HTTP queries with ~linear scaling
+because each request's mapReduce runs in its own goroutines and the
+compute device IS the host CPU (SURVEY.md §2 #12, §3.2). On a TPU
+backend the scarce resource is DISPATCHES: every host→device round trip
+pays a fixed latency floor (tens of ms through a tunneled runtime), so N
+concurrent requests that each dispatch alone serialize into N floors no
+matter how many handler threads the HTTP server has.
+
+This stage restores the reference's concurrency profile the TPU way:
+
+- Request threads enqueue and block on a Future; a single dispatcher
+  thread drains the queue in WAVES and pushes every waiting request
+  through ``executor.submit`` BEFORE any result is resolved. Same-shape
+  reductions across the wave coalesce into micro-batched device programs
+  (executor/batch.py), so the whole wave shares dispatches.
+- The dispatcher hands back the per-call ``Deferred`` handles; each
+  REQUEST thread resolves its own. Readbacks and cross-node fan-outs
+  therefore run concurrently across requests, and one slow peer cannot
+  convoy the queue behind it — the dispatcher never blocks on I/O.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+
+
+class QueryPipeline:
+    """Wave-coalescing front end over ``executor.submit``.
+
+    Created lazily by the API façade; reads ``api.executor`` at dispatch
+    time so the server can swap in DistExecutor/ClusterExecutor after
+    construction (server.py wiring) without re-plumbing.
+    """
+
+    def __init__(self, api):
+        self._api = api
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self.waves = 0          # dispatch waves formed (observability)
+        self.coalesced = 0      # requests that shared a wave with others
+
+    # ------------------------------------------------------------- frontend
+
+    def run(self, index: str, query, kwargs: dict) -> list:
+        """Queue one request; returns its per-call Deferreds once the
+        whole wave containing it has been submitted. The caller resolves
+        them (concurrently across request threads)."""
+        self._ensure_thread()
+        fut: Future = Future()
+        self._q.put((index, query, kwargs, fut))
+        return fut.result()
+
+    # ----------------------------------------------------------- dispatcher
+
+    def _ensure_thread(self):
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="query-pipeline"
+                )
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            wave = [item]
+            while True:
+                try:
+                    wave.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            executor = self._api.executor
+            self.waves += 1
+            if len(wave) > 1:
+                self.coalesced += len(wave)
+            # Submit the ENTIRE wave before completing any future: the
+            # executor's micro-batcher flushes a pending group on its
+            # first result(), so a request thread resuming early would
+            # split the wave's shared dispatch.
+            done = []
+            for index, q, kwargs, fut in wave:
+                try:
+                    done.append((fut, executor.submit(index, q, **kwargs)))
+                except BaseException as e:
+                    fut.set_exception(e)
+            for fut, defs in done:
+                fut.set_result(defs)

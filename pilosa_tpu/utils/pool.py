@@ -10,6 +10,7 @@ and pool construction cost (~100 µs) is noise against network RTTs.
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 
 # Wide enough to cover every peer of a realistically sized cluster in one
@@ -30,6 +31,35 @@ def concurrent_map(fn, items, max_workers: int = MAX_FANOUT) -> list:
         return [fn(x) for x in items]
     with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
         return list(pool.map(fn, items))
+
+
+def spawn(thunk):
+    """Start ``thunk`` on a daemon thread NOW; returns a ``join()`` that
+    blocks for (and re-raises from) it.
+
+    The asymmetric sibling of run_concurrently, for pipelined execution
+    (ClusterExecutor.submit): the remote fan-out must START at submit
+    time but be AWAITED at result() time, so device enqueue, remote HTTP,
+    and the caller's other submits all overlap.
+    """
+    box: dict = {}
+
+    def run():
+        try:
+            box["value"] = thunk()
+        except BaseException as e:  # joined and re-raised on the caller
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+    def join():
+        t.join()
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    return join
 
 
 def run_concurrently(*thunks) -> list:

@@ -56,9 +56,13 @@ class TestCountFastPath:
     def test_classification(self):
         and_tree = ("count", ("and", ("leaf", 0), ("leaf", 1)))
         assert batch.count_elementwise_sub(and_tree, (1, 1)) == and_tree[1]
-        deep = ("count", ("diff", ("or", ("leaf", 0), ("flipall", ("leaf", 1))),
+        deep = ("count", ("diff", ("or", ("leaf", 0), ("leaf", 1)),
                           ("xor", ("leaf", 2), ("const0",))))
         assert batch.count_elementwise_sub(deep, (1, 1, 1)) == deep[1]
+        # flipall would count the stacked block's zero-padded slots as
+        # all-ones under the flat reduction: never fast-path it
+        flipped = ("count", ("and", ("leaf", 0), ("flipall", ("leaf", 1))))
+        assert batch.count_elementwise_sub(flipped, (1, 1)) is None
         # shift moves bits across word boundaries per shard: no fast path
         shifted = ("count", ("and", ("shift", ("leaf", 0), 0), ("leaf", 1)))
         assert batch.count_elementwise_sub(shifted, (1, 1)) is None

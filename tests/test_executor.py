@@ -335,6 +335,29 @@ class TestTopNRowsGroupBy:
             "GroupBy(Rows(f), Rows(g), limit=1, having=Condition(count == 18))",
         )
         assert [(g.group[0]["rowID"], g.count) for g in groups] == [(4, 18)]
+        # float thresholds must not truncate: count < 3.5 keeps the
+        # count==3 group (int(3.5) → "< 3" would drop it) — ADVICE r4
+        (groups,) = ex.execute(
+            "r", "GroupBy(Rows(f), Rows(g), having=Condition(count < 3.5))"
+        )
+        assert {g.group[0]["rowID"]: g.count for g in groups} == {1: 3}
+        (groups,) = ex.execute(
+            "r", "GroupBy(Rows(f), Rows(g), having=Condition(count >< [3.0, 10.5]))"
+        )
+        assert {g.group[0]["rowID"] for g in groups} == {1, 3}
+
+    def test_condition_value_coercion(self):
+        """Quoted numeric thresholds coerce; junk raises PQLError (not a
+        bare TypeError that would 500 at the HTTP layer)."""
+        from pilosa_tpu.executor.executor import PQLError, condition_test
+        from pilosa_tpu.pql.ast import Condition
+
+        assert condition_test(Condition(">", "5"), 6)
+        assert not condition_test(Condition(">", "5"), 5)
+        assert condition_test(Condition("<", "1.5"), 1)
+        assert condition_test(Condition("><", ["3", "10.5"]), 10)
+        with pytest.raises(PQLError, match="not numeric"):
+            condition_test(Condition(">", "abc"), 1)
 
     def test_groupby_having_sum_requires_aggregate(self, env):
         from pilosa_tpu.executor.executor import PQLError

@@ -1,0 +1,286 @@
+"""Serving-path pipelining: ClusterExecutor.submit + the coalescing
+HTTP query pipeline (server/pipeline.py).
+
+The reference serves N concurrent queries with ~linear throughput via
+per-request mapReduce goroutines (SURVEY.md §2 #12, §3.2). On a TPU
+backend the equivalent property is DISPATCH sharing: concurrent requests
+must coalesce into micro-batched device programs instead of each paying
+the host→device latency floor. These tests pin (a) result equivalence
+between the pipelined and eager paths, over HTTP and in-process, and
+(b) the coalescing itself, by counting batched-program builds.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Server, ServerConfig
+from pilosa_tpu.server.pipeline import QueryPipeline
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def req(method, url, body=None):
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        r.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(r) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def make_cluster(tmp_path, n, replica_n=1, use_mesh=False):
+    servers = []
+    for i in range(n):
+        seeds = [f"http://localhost:{servers[0].port}"] if servers else []
+        cfg = ServerConfig(
+            data_dir=str(tmp_path / f"pnode{i}"), port=0, name=f"n{i}",
+            replica_n=replica_n, seeds=seeds, anti_entropy_interval=0,
+            heartbeat_interval=0, use_mesh=use_mesh,
+        )
+        servers.append(Server(cfg).open())
+    return servers
+
+
+def uri(s):
+    return f"http://localhost:{s.port}"
+
+
+READ_QUERIES = [
+    "Count(Row(f=1))",
+    "Row(f=2)",
+    "Union(Row(f=1), Row(f=2))",
+    "Count(Intersect(Row(f=1), Row(f=2)))",
+    'Sum(Row(f=1), field="v")',
+    'Min(field="v")',
+    'Max(field="v")',
+    "TopN(f, n=3)",
+    "TopN(f, n=10, threshold=15)",
+    "Rows(f)",
+    "Rows(f, limit=1)",
+    "GroupBy(Rows(f))",
+    "GroupBy(Rows(f), having=Condition(count > 8))",
+    "Options(Count(Row(f=1)), shards=[0, 2])",
+    "Count(Not(Row(f=1)))",
+]
+
+
+def seed(node0):
+    """Schema + bits over 6 shards + a BSI field, through node 0."""
+    req("POST", f"{uri(node0)}/index/i", {"options": {"trackExistence": True}})
+    req("POST", f"{uri(node0)}/index/i/field/f", {})
+    req("POST", f"{uri(node0)}/index/i/field/v",
+        {"options": {"type": "int", "min": 0, "max": 1000}})
+    for row, per_shard in [(1, 4), (2, 2)]:
+        cols = [
+            s * SHARD_WIDTH + row * 100 + c
+            for s in range(6) for c in range(per_shard)
+        ]
+        req("POST", f"{uri(node0)}/index/i/field/f/import",
+            {"rows": [row] * len(cols), "columns": cols})
+    vcols = [s * SHARD_WIDTH + 100 for s in range(6)]
+    req("POST", f"{uri(node0)}/index/i/field/v/import-value",
+        {"columns": vcols, "values": [(s + 1) * 7 for s in range(6)]})
+
+
+class TestClusterSubmit:
+    """ClusterExecutor.submit: pipelined results == eager execute, with
+    real remote fan-out (3 nodes, shards spread across them)."""
+
+    def test_submit_matches_execute_across_nodes(self, tmp_path):
+        servers = make_cluster(tmp_path, 3)
+        try:
+            seed(servers[0])
+            ex = servers[1].api.executor  # a non-coordinator node
+            want = [ex.execute("i", q)[0] for q in READ_QUERIES]
+            # submit the WHOLE stream first, then resolve — the remote
+            # fan-outs and local enqueues of all queries overlap
+            defs = [ex.submit("i", q)[0] for q in READ_QUERIES]
+            got = [d.result() for d in defs]
+            from pilosa_tpu.executor.result import result_to_json
+
+            for q, g, w in zip(READ_QUERIES, got, want):
+                assert result_to_json(g) == result_to_json(w), q
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_submit_remote_flag_stays_local(self, tmp_path):
+        """remote=True sub-queries must evaluate strictly locally (no
+        re-fan-out), same as execute(remote=True)."""
+        servers = make_cluster(tmp_path, 2)
+        try:
+            seed(servers[0])
+            for s in servers:
+                local_shards = sorted(
+                    s.holder.index("i").available_shards()
+                )
+                want = s.api.executor.execute(
+                    "i", "Count(Row(f=1))", shards=local_shards, remote=True
+                )
+                got = [
+                    d.result() for d in s.api.executor.submit(
+                        "i", "Count(Row(f=1))", shards=local_shards,
+                        remote=True,
+                    )
+                ]
+                assert got == want
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestHTTPServing:
+    """Concurrent HTTP clients against one server: results must equal
+    serial execution and the wave pipeline must coalesce dispatches."""
+
+    N_THREADS = 24
+
+    def _concurrent(self, url, queries):
+        results = [None] * len(queries)
+        errors = []
+        gate = threading.Event()
+
+        def worker(k, q):
+            gate.wait(10)
+            try:
+                results[k] = req("POST", url, q.encode())
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append((q, e))
+
+        threads = [
+            threading.Thread(target=worker, args=(k, q))
+            for k, q in enumerate(queries)
+        ]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors[:3]
+        return results
+
+    def test_concurrent_load_matches_serial_mesh_on(self, tmp_path):
+        """The VERDICT load test: mesh-backed single-node server, N
+        concurrent clients, per-query results identical to serial."""
+        servers = make_cluster(tmp_path, 1, use_mesh=True)
+        try:
+            seed(servers[0])
+            url = f"{uri(servers[0])}/index/i/query"
+            queries = [
+                READ_QUERIES[k % len(READ_QUERIES)]
+                for k in range(self.N_THREADS)
+            ]
+            serial = [req("POST", url, q.encode()) for q in queries]
+            concurrent = self._concurrent(url, queries)
+            assert concurrent == serial
+            pipe = servers[0].api._pipeline
+            assert pipe is not None and pipe.waves >= 1
+        finally:
+            servers[0].close()
+
+    def test_wave_coalesces_same_shape_counts(self, tmp_path):
+        """Deterministic dispatch accounting: hold the wave gate until
+        every request is queued, then count batched-program builds — 32
+        same-shape Counts must share micro-batched dispatches instead of
+        paying 32."""
+        servers = make_cluster(tmp_path, 1, use_mesh=True)
+        try:
+            seed(servers[0])
+            api = servers[0].api
+            n = 32
+
+            class Gated(QueryPipeline):
+                def __init__(self, api, expected):
+                    super().__init__(api)
+                    self.expected = expected
+                    self.arrived = 0
+                    self.alock = threading.Lock()
+                    self.gate = threading.Event()
+
+                def run(self, index, query, kwargs):
+                    with self.alock:
+                        self.arrived += 1
+                        if self.arrived >= self.expected:
+                            self.gate.set()
+                    self.gate.wait(30)
+                    return super().run(index, query, kwargs)
+
+            dist = api.executor.local
+            url = f"{uri(servers[0])}/index/i/query"
+            queries = [
+                f"Count(Intersect(Row(f={1 + (k % 2)}), Row(f=2)))"
+                for k in range(n)
+            ]
+            serial_want = req("POST", url, queries[0].encode())
+            api._pipeline = Gated(api, n)
+
+            builds = []
+            orig = dist._program_batched
+
+            def counting(structure, rk, lr, ns, nq):
+                builds.append(nq)
+                return orig(structure, rk, lr, ns, nq)
+
+            dist._program_batched = counting
+            out = self._concurrent(url, queries)
+            dist._program_batched = orig
+            for k, q in enumerate(queries):
+                if q == queries[0]:
+                    assert out[k] == serial_want
+            # all queries went through batched programs, in far fewer
+            # dispatches than queries (ideally 1-4 waves)
+            assert sum(builds) == n, builds
+            assert len(builds) <= n // 2, builds
+        finally:
+            servers[0].close()
+
+    def test_mixed_reads_and_writes_concurrent(self, tmp_path):
+        """Writes take the eager routed path, reads the pipeline —
+        interleaved concurrent traffic must neither deadlock nor lose
+        writes."""
+        servers = make_cluster(tmp_path, 1, use_mesh=False)
+        try:
+            seed(servers[0])
+            url = f"{uri(servers[0])}/index/i/query"
+            ops = []
+            for k in range(16):
+                if k % 4 == 0:
+                    ops.append(f"Set({7 * SHARD_WIDTH + k}, f=9)")
+                else:
+                    ops.append("Count(Row(f=1))")
+            out = self._concurrent(url, ops)
+            for k, op in enumerate(ops):
+                if op.startswith("Set"):
+                    assert out[k] == {"results": [True]}
+            final = req("POST", url, b"Count(Row(f=9))")
+            assert final == {"results": [4]}
+        finally:
+            servers[0].close()
+
+    def test_pipeline_disabled_fallback(self, tmp_path):
+        servers = make_cluster(tmp_path, 1, use_mesh=False)
+        try:
+            seed(servers[0])
+            servers[0].api.serve_pipelined = False
+            url = f"{uri(servers[0])}/index/i/query"
+            out = req("POST", url, b"Count(Row(f=1))")
+            assert out == {"results": [24]}
+            assert servers[0].api._pipeline is None
+        finally:
+            servers[0].close()
+
+    def test_error_propagates_through_pipeline(self, tmp_path):
+        servers = make_cluster(tmp_path, 1, use_mesh=False)
+        try:
+            seed(servers[0])
+            url = f"{uri(servers[0])}/index/i/query"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                req("POST", url, b"Count(Row(nosuch=1))")
+            assert ei.value.code == 400
+            # the pipeline survives the error and keeps serving
+            out = req("POST", url, b"Count(Row(f=1))")
+            assert out == {"results": [24]}
+        finally:
+            servers[0].close()
