@@ -214,6 +214,27 @@ class Deferred:
 # ----------------------------------------------------------------- executor
 
 
+def instrument_calls(index_name: str, calls, run_one) -> list:
+    """Stats/trace envelope around a query's calls: one
+    ``executor.Execute`` span per query, per-call ``execute<Name>`` spans
+    and ``query``/``queries`` stats. Shared by eager execution and the
+    serving pipeline's resolve loop (server/api.py) so span and stat
+    names cannot drift between the two paths."""
+    from pilosa_tpu.utils.stats import global_stats
+    from pilosa_tpu.utils.tracing import global_tracer
+
+    stats = global_stats()
+    out = []
+    with global_tracer().span("executor.Execute", index=index_name):
+        for call in calls:
+            with global_tracer().span(f"execute{call.name}"), stats.timer(
+                "query", {"call": call.name}
+            ):
+                out.append(run_one(call))
+            stats.count("queries", 1, {"call": call.name})
+    return out
+
+
 class Executor:
     # Queries per micro-batched dispatch (see _microbatch_enqueue).
     MICROBATCH_MAX = 16
@@ -249,9 +270,6 @@ class Executor:
     # ------------------------------------------------------------ top level
 
     def execute(self, index_name: str, query, shards=None):
-        from pilosa_tpu.utils.stats import global_stats
-        from pilosa_tpu.utils.tracing import global_tracer
-
         idx = self.holder.index(index_name)
         if idx is None:
             raise PQLError(f"index {index_name!r} not found")
@@ -259,16 +277,10 @@ class Executor:
             query = parse(query)
         elif isinstance(query, Call):
             query = Query([query])
-        stats = global_stats()
-        out = []
-        with global_tracer().span("executor.Execute", index=index_name):
-            for call in query.calls:
-                with global_tracer().span(f"execute{call.name}"), stats.timer(
-                    "query", {"call": call.name}
-                ):
-                    out.append(self._execute_call(idx, call, shards))
-                stats.count("queries", 1, {"call": call.name})
-        return out
+        return instrument_calls(
+            index_name, query.calls,
+            lambda call: self._execute_call(idx, call, shards),
+        )
 
     def submit(self, index_name: str, query, shards=None):
         """Pipelined execution: parse, compile, and ENQUEUE each call's

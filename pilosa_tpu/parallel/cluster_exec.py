@@ -151,7 +151,7 @@ class ClusterExecutor:
             # thread NOW so a slow shard owner cannot convoy a serving
             # pipeline's dispatcher; result() joins
             return Deferred(spawn(
-                lambda: self._execute_call(idx, call, shards)
+                lambda: self._execute_includes(idx, call, shards)
             ))
         if name in ("Set", "Clear", "Store", "ClearRow") or name in _WRITE_BROADCAST:
             # writes keep eager in-order semantics at submit time
@@ -177,10 +177,12 @@ class ClusterExecutor:
                  if k not in ("limit", "having")},
                 call.children,
             )
-        # local program enqueues on the device stream NOW; remote fan-out
-        # departs on a background thread NOW; nothing blocks until result()
-        local_def = self.local.submit(idx.name, mapped, shards=local)[0]
+        # remote fan-out departs on a background thread FIRST (calls
+        # whose local submit is eager — Rows — would otherwise serialize
+        # ahead of it), then the local program enqueues on the device
+        # stream; nothing blocks until result()
         remote_join = spawn(lambda: self._map_remote(idx.name, mapped, groups))
+        local_def = self.local.submit(idx.name, mapped, shards=local)[0]
 
         def finalize():
             local_res = local_def.result()
@@ -237,18 +239,85 @@ class ClusterExecutor:
             remote.setdefault(target.id, (target, []))[1].append(shard)
         return local, list(remote.values())
 
-    def _map_remote(self, index_name: str, call: Call, groups):
+    def _route_all_replicas(self, index_name: str, shards: list[int]):
+        """Group shards by EVERY replica that holds them. Row-wide writes
+        (Store/ClearRow) must reach all owners like point writes do —
+        routing them to one executing replica per shard (the read path's
+        _route) leaves the other replicas' copies of the row stale, and
+        replicas diverge until (or past: union repair cannot remove
+        cleared bits) the next anti-entropy pass. Found by the
+        randomized cluster property test (replica_n=2)."""
+        local: list[int] = []
+        remote: dict[str, tuple[Node, list[int]]] = {}
+        for shard in shards:
+            for n in self.cluster.shard_nodes(index_name, shard):
+                if n.id == self.cluster.local.id:
+                    local.append(shard)
+                else:
+                    remote.setdefault(n.id, (n, []))[1].append(shard)
+        return local, list(remote.values())
+
+    def _map_remote(self, index_name: str, call: Call, groups, _depth=0):
         """One CONCURRENT sub-query per remote node (reference mapReduce:
-        one goroutine per remote node — SURVEY.md §2 #12); returns raw
-        JSON partials in group order. Any node's failure propagates."""
+        one goroutine per remote node — SURVEY.md §2 #12); returns a flat
+        list of raw JSON partials (shard coverage exact; group order
+        immaterial to every reducer).
+
+        Replica fallback: a node that fails its sub-query is marked
+        DEGRADED and its shards are re-routed to surviving NORMAL
+        replicas (recursing once per hop, bounded); the query only fails
+        when some shard has no live replica left. Reads therefore
+        tolerate single-replica faults the way the reference's
+        mapReduce retry loop does."""
         pql = call.to_pql()
 
         def one(group):
             node, shard_group = group
-            out = self.cluster.client.query_node(
-                node.uri, index_name, pql, shard_group, remote=True
-            )
-            return out["results"][0]
+            try:
+                out = self.cluster.client.query_node(
+                    node.uri, index_name, pql, shard_group, remote=True
+                )
+                return [out["results"][0]]
+            except ClientError:
+                node.state = "DEGRADED"
+                if _depth >= 2:
+                    raise
+                retry: dict[str, tuple[Node, list[int]]] = {}
+                for shard in shard_group:
+                    alts = [
+                        n for n in self.cluster.shard_nodes(index_name, shard)
+                        if n.id != node.id and n.state == "NORMAL"
+                    ]
+                    if not alts:
+                        raise  # no live replica holds this shard
+                    retry.setdefault(alts[0].id, (alts[0], []))[1].append(shard)
+                return self._map_remote(
+                    index_name, call, list(retry.values()), _depth + 1
+                )
+
+        return [p for chunk in concurrent_map(one, groups) for p in chunk]
+
+    def _map_remote_tolerant(self, index_name: str, call: Call, groups):
+        """Row-wide write fan-out (Store/ClearRow): every replica is
+        already a direct target, so there is nothing to fall back to — a
+        replica unreachable at write time is marked DEGRADED and skipped
+        (exactly like point writes in _execute_routed_write), the live
+        replicas' write stands, and re-replication repairs the divergence
+        when the node returns. Failing the whole request after some
+        replicas already applied it would leave the SAME divergence plus
+        a client told to retry."""
+        pql = call.to_pql()
+
+        def one(group):
+            node, shard_group = group
+            try:
+                out = self.cluster.client.query_node(
+                    node.uri, index_name, pql, shard_group, remote=True
+                )
+                return out["results"][0]
+            except ClientError:
+                node.state = "DEGRADED"
+                return False
 
         return concurrent_map(one, groups)
 
@@ -265,71 +334,25 @@ class ClusterExecutor:
             )
             return res
         if name in ("Store", "ClearRow"):
-            # row-wide writes execute on every shard owner, concurrently
-            # (local evaluation overlaps the remote fan-out)
+            # row-wide writes execute on EVERY replica of every shard,
+            # concurrently (local evaluation overlaps the remote fan-out)
             shard_list = shards if shards is not None else self._all_shards(idx.name)
-            local, groups = self._route(idx.name, shard_list)
+            local, groups = self._route_all_replicas(idx.name, shard_list)
             result, outs = run_concurrently(
                 lambda: (self.local._execute_call(idx, call, local)
                          if local else False),
-                lambda: self._map_remote(idx.name, call, groups),
+                lambda: self._map_remote_tolerant(idx.name, call, groups),
             )
             for out in outs:
                 result = result or out
             return result
 
-        if name == "Options":
-            # Unwrap at the CLUSTER layer: _reduce dispatches on the
-            # child's name (an Options-wrapped Count would otherwise
-            # fall through and drop every remote partial), the shards=
-            # restriction narrows the routed universe BEFORE fan-out
-            # (intersecting any engine-supplied list, same helper as the
-            # single-node executor), and the result options apply after
-            # the cross-node merge.
-            res = self._execute_call(
-                idx, options_child(call),
-                options_restrict_shards(call, shards),
-            )
-            return apply_options_result(idx, call, res)
-
-        shard_list = shards if shards is not None else self._all_shards(idx.name)
-        local, groups = self._route(idx.name, shard_list)
-
-        if name == "TopN":
-            return self._execute_topn(idx, call, local, groups)
-        if name == "IncludesColumn":
-            return self._execute_includes(idx, call, shards)
-
-        # Rows/GroupBy: limit (and GroupBy's having) must apply AFTER the
-        # cross-node merge — a per-node filter would drop partial groups
-        # whose merged count qualifies — so strip them from the mapped
-        # call and re-apply in _reduce. The having predicate is built
-        # BEFORE the map phase so a malformed condition errors without
-        # wasting the distributed scan (matching the executor's eager
-        # validation in _groupby_prelude).
-        having = None
-        if name == "GroupBy":
-            having = having_predicate(
-                call, has_agg=isinstance(call.arg("aggregate"), Call)
-            )
-        mapped = call
-        if name in ("Rows", "GroupBy") and (
-            call.arg("limit") or having is not None
-        ):
-            mapped = Call(
-                name,
-                {k: v for k, v in call.args.items()
-                 if k not in ("limit", "having")},
-                call.children,
-            )
-        # local map phase overlaps the remote fan-out (reference
-        # mapReduce: local mapper goroutines and remote sub-queries share
-        # one errgroup) — wall time is max(local, slowest peer), not sum
-        local_res, partials = run_concurrently(
-            lambda: self.local._execute_call(idx, mapped, local),
-            lambda: self._map_remote(idx.name, mapped, groups) if groups else [],
-        )
-        return self._reduce(idx, call, local_res, partials, having=having)
+        # Reads (Options, TopN, IncludesColumn, and the generic
+        # map→reduce family) share ONE orchestration: the pipelined
+        # _submit_call, resolved immediately. submit's enqueue/spawn
+        # overlap gives eager execution the same max(local, slowest peer)
+        # wall time run_concurrently did, and the two paths cannot drift.
+        return self._submit_call(idx, call, shards).result()
 
     # --------------------------------------------------------------- writes
 
@@ -472,9 +495,6 @@ class ClusterExecutor:
 
     # ----------------------------------------------------------------- TopN
 
-    def _execute_topn(self, idx, call: Call, local, groups):
-        return self._submit_topn(idx, call, local, groups).result()
-
     def _submit_topn(self, idx, call: Call, local, groups) -> Deferred:
         """Two-phase distributed TopN, pipelined: phase 1 (overfetched
         candidates) enqueues locally and departs remotely at SUBMIT time;
@@ -490,8 +510,8 @@ class ClusterExecutor:
         if explicit_ids is None:
             overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
             phase1 = Call("TopN", {**mapped_args, "n": overfetch}, call.children)
-            local1 = self.local.submit(idx.name, phase1, shards=local)[0]
             remote1 = spawn(lambda: self._map_remote(idx.name, phase1, groups))
+            local1 = self.local.submit(idx.name, phase1, shards=local)[0]
 
         def finalize():
             if explicit_ids is None:

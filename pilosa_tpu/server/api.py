@@ -96,22 +96,16 @@ class API:
 
                             self._pipeline = QueryPipeline(self)
                 deferreds = self._pipeline.run(index, query, kwargs)
-                # Same stats/trace surface as Executor.execute — the
-                # timer here observes resolve latency (submission already
-                # happened in the wave), i.e. what this request actually
-                # waited on device+merge for.
-                from pilosa_tpu.utils.stats import global_stats
-                from pilosa_tpu.utils.tracing import global_tracer
+                # Same stats/trace envelope as Executor.execute (shared
+                # helper) — the timer here observes resolve latency,
+                # i.e. what this request actually waited for.
+                from pilosa_tpu.executor.executor import instrument_calls
 
-                stats = global_stats()
-                results = []
-                with global_tracer().span("executor.Execute", index=index):
-                    for call, d in zip(query.calls, deferreds):
-                        with global_tracer().span(
-                            f"execute{call.name}"
-                        ), stats.timer("query", {"call": call.name}):
-                            results.append(d.result())
-                        stats.count("queries", 1, {"call": call.name})
+                handles = iter(deferreds)
+                results = instrument_calls(
+                    index, query.calls,
+                    lambda call: next(handles).result(),
+                )
             else:
                 results = self.executor.execute(index, query, **kwargs)
             if opts:

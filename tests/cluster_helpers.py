@@ -1,0 +1,71 @@
+"""Shared in-process cluster fixtures: HTTP helper, server boot, and a
+deterministic multi-shard seed. Used by the serving-pipeline,
+cluster-of-meshes, and randomized-churn suites so the request encoding,
+ServerConfig surface, and seed layout live in ONE place."""
+
+import json
+import urllib.request
+
+from pilosa_tpu.server import Server, ServerConfig
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def req(method, url, body=None):
+    data = (body if isinstance(body, (bytes, type(None)))
+            else json.dumps(body).encode())
+    r = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        r.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def uri(s: Server) -> str:
+    return f"http://localhost:{s.port}"
+
+
+def make_cluster(tmp_path, n, replica_n=1, use_mesh=False, prefix="node"):
+    servers = []
+    for i in range(n):
+        seeds = [uri(servers[0])] if servers else []
+        servers.append(Server(ServerConfig(
+            data_dir=str(tmp_path / f"{prefix}{i}"), port=0,
+            name=f"{prefix[0]}{i}", replica_n=replica_n, seeds=seeds,
+            anti_entropy_interval=0, heartbeat_interval=0,
+            use_mesh=use_mesh,
+        )).open())
+    return servers
+
+
+def join_node(tmp_path, seed_server, use_mesh=False, replica_n=1,
+              name="late", prefix="latenode"):
+    """Boot one more node seeded off ``seed_server`` (join-resize)."""
+    return Server(ServerConfig(
+        data_dir=str(tmp_path / prefix), port=0, name=name,
+        replica_n=replica_n, seeds=[uri(seed_server)],
+        anti_entropy_interval=0, heartbeat_interval=0, use_mesh=use_mesh,
+    )).open()
+
+
+def seed(node0, n_shards=6):
+    """Schema + bits over ``n_shards`` shards + a BSI field.
+
+    Layout (per shard s): row 1 holds cols {s*SW+100..103}, row 2 holds
+    {s*SW+100..101} (a SUBSET of row 1, so intersections are
+    non-trivial), and BSI field v maps col s*SW+100 -> (s+1)*7.
+    """
+    req("POST", f"{uri(node0)}/index/i",
+        {"options": {"trackExistence": True}})
+    req("POST", f"{uri(node0)}/index/i/field/f", {})
+    req("POST", f"{uri(node0)}/index/i/field/v",
+        {"options": {"type": "int", "min": 0, "max": 1000}})
+    for row, per_shard in [(1, 4), (2, 2)]:
+        cols = [
+            s * SHARD_WIDTH + 100 + c
+            for s in range(n_shards) for c in range(per_shard)
+        ]
+        req("POST", f"{uri(node0)}/index/i/field/f/import",
+            {"rows": [row] * len(cols), "columns": cols})
+    req("POST", f"{uri(node0)}/index/i/field/v/import-value",
+        {"columns": [s * SHARD_WIDTH + 100 for s in range(n_shards)],
+         "values": [(s + 1) * 7 for s in range(n_shards)]})

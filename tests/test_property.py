@@ -20,20 +20,32 @@ from pilosa_tpu.parallel.dist import DistExecutor
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.storage import FieldOptions, Holder
 
-N_SHARDS = 3
+N_SHARDS = 5
 COL_SPACE = N_SHARDS * SHARD_WIDTH
 ROWS = [1, 2, 3, 7]
+MUTEX_ROWS = [0, 1, 2]
 INT_MIN, INT_MAX = -50, 1000
+# time-quantum workload: a small pool of timestamps spanning Y/M/D/H
+# boundaries so the YMDH view cover is exercised on every granularity
+TIMESTAMPS = [
+    "2019-01-15T00:00", "2019-01-15T07:00", "2019-03-02T00:00",
+    "2019-12-31T23:00", "2020-01-01T00:00", "2021-06-30T12:00",
+]
 
 
 class Oracle:
-    """Pure-python model: field -> row -> set of columns; int field ->
-    col -> value; the index existence set."""
+    """Pure-python model: set field row -> cols; mutex/bool col -> row;
+    time (row, ts) -> cols; int col -> value; row/col attrs; existence."""
 
     def __init__(self):
         self.sets: dict[int, set[int]] = {r: set() for r in ROWS}
         self.values: dict[int, int] = {}
         self.exists: set[int] = set()
+        self.mutex: dict[int, int] = {}          # col -> row
+        self.bools: dict[int, int] = {}          # col -> 0/1
+        self.time: dict[tuple, set] = {}         # (row, ts) -> cols
+        self.row_attrs: dict[int, dict] = {}     # f row -> attrs
+        self.col_attrs: dict[int, dict] = {}     # col -> attrs
 
     def set_bit(self, row, col):
         self.sets[row].add(col)
@@ -46,24 +58,84 @@ class Oracle:
         self.values[col] = val
         self.exists.add(col)
 
+    def set_mutex(self, row, col):
+        self.mutex[col] = row
+        self.exists.add(col)
+
+    def set_bool(self, row, col):
+        self.bools[col] = row
+        self.exists.add(col)
+
+    def set_time(self, row, col, ts):
+        self.time.setdefault((row, ts), set()).add(col)
+        self.exists.add(col)
+
+    def mutex_row(self, row):
+        return {c for c, r in self.mutex.items() if r == row}
+
+    def bool_row(self, row):
+        return {c for c, r in self.bools.items() if r == row}
+
+    def time_row(self, row, lo, hi):
+        """Columns of ``row`` with any event timestamp in [lo, hi) —
+        the executor's view cover treats ``to=`` as exclusive."""
+        return {
+            c for (r, ts), cols in self.time.items() if r == row
+            for c in cols if lo <= ts < hi
+        }
+
 
 def random_workload(rng, ex, index, oracle, n_ops=120):
-    """Random Set/Clear/value writes through PQL."""
+    """Random writes through PQL over every field type: set bits, mutex
+    and bool single-value semantics, time-quantum events, BSI values,
+    row/column attrs, and row-wide Store/ClearRow."""
     for _ in range(n_ops):
         col = int(rng.integers(0, COL_SPACE))
         op = rng.random()
-        if op < 0.55:
+        if op < 0.40:
             row = int(rng.choice(ROWS))
             ex.execute(index, f"Set({col}, f={row})")
             oracle.set_bit(row, col)
-        elif op < 0.75:
+        elif op < 0.55:
             row = int(rng.choice(ROWS))
             ex.execute(index, f"Clear({col}, f={row})")
             oracle.clear_bit(row, col)
-        else:
+        elif op < 0.68:
             val = int(rng.integers(INT_MIN, INT_MAX + 1))
             ex.execute(index, f"Set({col}, v={val})")
             oracle.set_value(col, val)
+        elif op < 0.76:
+            row = int(rng.choice(MUTEX_ROWS))
+            ex.execute(index, f"Set({col}, m={row})")
+            oracle.set_mutex(row, col)
+        elif op < 0.82:
+            row = int(rng.integers(0, 2))
+            ex.execute(index, f"Set({col}, b={'true' if row else 'false'})")
+            oracle.set_bool(row, col)
+        elif op < 0.90:
+            row = int(rng.choice(ROWS))
+            ts = TIMESTAMPS[int(rng.integers(0, len(TIMESTAMPS)))]
+            ex.execute(index, f"Set({col}, t={row}, timestamp='{ts}')")
+            oracle.set_time(row, col, ts)
+        elif op < 0.94:
+            row = int(rng.choice(ROWS))
+            v = int(rng.integers(0, 100))
+            ex.execute(index, f'SetRowAttrs(f, {row}, rank={v}, hot=true)')
+            oracle.row_attrs.setdefault(row, {}).update(
+                {"rank": v, "hot": True}
+            )
+        elif op < 0.97:
+            v = int(rng.integers(0, 100))
+            ex.execute(index, f'SetColumnAttrs({col}, score={v})')
+            oracle.col_attrs.setdefault(col, {}).update({"score": v})
+        elif op < 0.985:
+            src, dst = (int(r) for r in rng.choice(ROWS, 2, replace=False))
+            ex.execute(index, f"Store(Row(f={src}), f={dst})")
+            oracle.sets[dst] = set(oracle.sets[src])
+        else:
+            row = int(rng.choice(ROWS))
+            ex.execute(index, f"ClearRow(f={row})")
+            oracle.sets[row] = set()
 
 
 def random_expr(rng, depth=0):
@@ -98,7 +170,53 @@ def make_env(tmp_path, name):
     idx = holder.create_index("i", track_existence=True)
     idx.create_field("f")
     idx.create_field("v", FieldOptions(type="int", min=INT_MIN, max=INT_MAX))
+    idx.create_field("m", FieldOptions(type="mutex"))
+    idx.create_field("b", FieldOptions(type="bool"))
+    idx.create_field("t", FieldOptions(type="time", time_quantum="YMDH"))
     return holder
+
+
+def check_field_types(rng, ex, oracle):
+    """Field-type invariants vs the oracle: mutex/bool single-value
+    rows, time-quantum range cover, row/column attrs."""
+    for row in MUTEX_ROWS:
+        (res,) = ex.execute("i", f"Row(m={row})")
+        assert set(res.columns().tolist()) == oracle.mutex_row(row), row
+    for word, row in [("true", 1), ("false", 0)]:
+        (res,) = ex.execute("i", f"Row(b={word})")
+        assert set(res.columns().tolist()) == oracle.bool_row(row), word
+    # time ranges at every granularity the quantum generates (plus a
+    # random window); standard view must hold the union of all events
+    windows = [
+        ("2019-01-01T00:00", "2019-12-31T23:00"),
+        ("2019-01-15T00:00", "2019-01-15T07:00"),
+        ("2019-03-01T00:00", "2020-06-01T00:00"),
+        tuple(sorted(
+            TIMESTAMPS[i] for i in rng.choice(len(TIMESTAMPS), 2,
+                                              replace=False)
+        )),
+    ]
+    for row in ROWS:
+        for lo, hi in windows:
+            (res,) = ex.execute(
+                "i", f"Row(t={row}, from='{lo}', to='{hi}')"
+            )
+            assert set(res.columns().tolist()) == oracle.time_row(
+                row, lo, hi
+            ), (row, lo, hi)
+        (res,) = ex.execute("i", f"Row(t={row})")
+        want = {
+            c for (r, _), cols in oracle.time.items() if r == row
+            for c in cols
+        }
+        assert set(res.columns().tolist()) == want, row
+    # attrs ride the row result; column attrs read back per column
+    for row, attrs in oracle.row_attrs.items():
+        (res,) = ex.execute("i", f"Row(f={row})")
+        assert res.attrs == attrs, row
+    idx = ex.holder.index("i")
+    for col, attrs in oracle.col_attrs.items():
+        assert idx.column_attrs.attrs(col) == attrs, col
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
@@ -109,7 +227,8 @@ def test_randomized_workload_vs_oracle(tmp_path, seed):
     oracle = Oracle()
     try:
         for round_ in range(3):
-            random_workload(rng, ex, "i", oracle, n_ops=60)
+            random_workload(rng, ex, "i", oracle, n_ops=150)
+            check_field_types(rng, ex, oracle)
 
             # bitmap expressions + counts
             for _ in range(6):
@@ -158,6 +277,20 @@ def test_randomized_workload_vs_oracle(tmp_path, seed):
             got = {g.group[0]["rowID"]: g.count for g in groups}
             assert got == {r: len(c) for r, c in oracle.sets.items() if c}
 
+            # Options(shards=): a random shard subset restricts the
+            # evaluated universe exactly
+            subset = sorted(
+                int(s) for s in rng.choice(N_SHARDS, 2, replace=False)
+            )
+            pql, ev = random_expr(rng)
+            want_cols = {
+                c for c in ev(oracle) if c // SHARD_WIDTH in subset
+            }
+            (n,) = ex.execute(
+                "i", f"Options(Count({pql}), shards={subset})"
+            )
+            assert n == len(want_cols), (pql, subset)
+
             # round-4 surface: TopN(threshold=) and GroupBy(having=)
             # against the same oracle, with a random floor
             thr = int(rng.integers(1, 40))
@@ -186,6 +319,87 @@ def test_randomized_workload_vs_oracle(tmp_path, seed):
         holder.close()
 
 
+def test_cluster_randomized_with_membership_churn(tmp_path):
+    """Randomized workload against a REPLICATED cluster with membership
+    churn in the middle: writes through alternating nodes, a third node
+    joins mid-workload (async resize), a node leaves gracefully after —
+    and at every stage the read surface matches the oracle from every
+    live node (SURVEY §4's quick-check-vs-oracle lesson applied to the
+    cluster layer)."""
+    from cluster_helpers import join_node, make_cluster, req
+
+    def http_ex(servers, rng):
+        """Executor facade that routes each PQL via a random node."""
+        class _E:
+            def execute(self, index, pql):
+                s = servers[int(rng.integers(0, len(servers)))]
+                return req(
+                    "POST",
+                    f"http://localhost:{s.port}/index/{index}/query",
+                    pql.encode(),
+                )["results"]
+        return _E()
+
+    def check(servers, oracle):
+        for s in servers:
+            url = f"http://localhost:{s.port}/index/i/query"
+            for row in ROWS:
+                out = req("POST", url, f"Count(Row(f={row}))".encode())
+                assert out["results"] == [len(oracle.sets[row])], (
+                    s.config.name, row,
+                )
+            out = req("POST", url, b"Row(f=1)")
+            assert out["results"][0]["columns"] == sorted(
+                oracle.sets[1]
+            ), s.config.name
+            if oracle.values:
+                out = req("POST", url, b'Sum(field="v")')
+                assert out["results"][0] == {
+                    "value": sum(oracle.values.values()),
+                    "count": len(oracle.values),
+                }, s.config.name
+            for row in MUTEX_ROWS:
+                out = req("POST", url, f"Count(Row(m={row}))".encode())
+                assert out["results"] == [len(oracle.mutex_row(row))]
+
+    rng = np.random.default_rng(99)
+    servers = make_cluster(tmp_path, 2, replica_n=2, prefix="cnode")
+    try:
+        base = f"http://localhost:{servers[0].port}"
+        req("POST", f"{base}/index/i", {"options": {"trackExistence": True}})
+        req("POST", f"{base}/index/i/field/f", {})
+        req("POST", f"{base}/index/i/field/v",
+            {"options": {"type": "int", "min": INT_MIN, "max": INT_MAX}})
+        req("POST", f"{base}/index/i/field/m", {"options": {"type": "mutex"}})
+        req("POST", f"{base}/index/i/field/b", {"options": {"type": "bool"}})
+        req("POST", f"{base}/index/i/field/t",
+            {"options": {"type": "time", "timeQuantum": "YMDH"}})
+
+        oracle = Oracle()
+        random_workload(rng, http_ex(servers, rng), "i", oracle, n_ops=80)
+        check(servers, oracle)
+
+        # a third node joins mid-workload; the async resize must finish
+        # and the data must keep matching the oracle from ALL nodes
+        late = join_node(tmp_path, servers[0], replica_n=2,
+                         name="c2", prefix="cnode2")
+        servers.append(late)
+        assert late.api.cluster.wait_until_normal(30)
+        random_workload(rng, http_ex(servers, rng), "i", oracle, n_ops=80)
+        check(servers, oracle)
+
+        # graceful leave: survivors must still answer for every shard
+        leaver = servers.pop()
+        leaver.api.cluster.leave()
+        leaver.close()
+        assert servers[0].api.cluster.wait_until_normal(30)
+        random_workload(rng, http_ex(servers, rng), "i", oracle, n_ops=40)
+        check(servers, oracle)
+    finally:
+        for s in servers:
+            s.close()
+
+
 @pytest.mark.parametrize("seed", [10, 11])
 def test_local_and_mesh_executors_agree(tmp_path, seed):
     """The same random workload produces identical results from the
@@ -196,12 +410,17 @@ def test_local_and_mesh_executors_agree(tmp_path, seed):
     dx = DistExecutor(holder)
     oracle = Oracle()
     try:
-        random_workload(rng, ex, "i", oracle, n_ops=100)
+        random_workload(rng, ex, "i", oracle, n_ops=150)
         queries = [random_expr(rng)[0] for _ in range(5)]
         queries += [f"Count({random_expr(rng)[0]})" for _ in range(5)]
         queries += ["All()", "TopN(f)", "Rows(f)", "GroupBy(Rows(f))",
                     'Sum(field="v")', 'Min(field="v")', 'Max(field="v")',
-                    "Range(v > 100)", "Count(Range(v <= 0))"]
+                    "Range(v > 100)", "Count(Range(v <= 0))",
+                    "Row(m=1)", "Count(Row(m=2))", "Row(b=true)",
+                    "Row(b=false)", "Rows(m)", "GroupBy(Rows(b))",
+                    "Row(t=1)",
+                    "Row(t=7, from='2019-01-01T00:00', to='2020-01-01T00:00')",
+                    "Union(Row(m=0), Row(b=true), Row(f=1))"]
         for pql in queries:
             (a,) = ex.execute("i", pql)
             (b,) = dx.execute("i", pql)

@@ -10,42 +10,14 @@ between the pipelined and eager paths, over HTTP and in-process, and
 (b) the coalescing itself, by counting batched-program builds.
 """
 
-import json
 import threading
 import urllib.request
 
 import pytest
 
-from pilosa_tpu.server import Server, ServerConfig
+from cluster_helpers import make_cluster, req, seed, uri
 from pilosa_tpu.server.pipeline import QueryPipeline
 from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-
-def req(method, url, body=None):
-    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
-    r = urllib.request.Request(url, data=data, method=method)
-    if data is not None:
-        r.add_header("Content-Type", "application/json")
-    with urllib.request.urlopen(r) as resp:
-        return json.loads(resp.read() or b"{}")
-
-
-def make_cluster(tmp_path, n, replica_n=1, use_mesh=False):
-    servers = []
-    for i in range(n):
-        seeds = [f"http://localhost:{servers[0].port}"] if servers else []
-        cfg = ServerConfig(
-            data_dir=str(tmp_path / f"pnode{i}"), port=0, name=f"n{i}",
-            replica_n=replica_n, seeds=seeds, anti_entropy_interval=0,
-            heartbeat_interval=0, use_mesh=use_mesh,
-        )
-        servers.append(Server(cfg).open())
-    return servers
-
-
-def uri(s):
-    return f"http://localhost:{s.port}"
-
 
 READ_QUERIES = [
     "Count(Row(f=1))",
@@ -64,24 +36,6 @@ READ_QUERIES = [
     "Options(Count(Row(f=1)), shards=[0, 2])",
     "Count(Not(Row(f=1)))",
 ]
-
-
-def seed(node0):
-    """Schema + bits over 6 shards + a BSI field, through node 0."""
-    req("POST", f"{uri(node0)}/index/i", {"options": {"trackExistence": True}})
-    req("POST", f"{uri(node0)}/index/i/field/f", {})
-    req("POST", f"{uri(node0)}/index/i/field/v",
-        {"options": {"type": "int", "min": 0, "max": 1000}})
-    for row, per_shard in [(1, 4), (2, 2)]:
-        cols = [
-            s * SHARD_WIDTH + row * 100 + c
-            for s in range(6) for c in range(per_shard)
-        ]
-        req("POST", f"{uri(node0)}/index/i/field/f/import",
-            {"rows": [row] * len(cols), "columns": cols})
-    vcols = [s * SHARD_WIDTH + 100 for s in range(6)]
-    req("POST", f"{uri(node0)}/index/i/field/v/import-value",
-        {"columns": vcols, "values": [(s + 1) * 7 for s in range(6)]})
 
 
 class TestClusterSubmit:
@@ -258,6 +212,74 @@ class TestHTTPServing:
             assert final == {"results": [4]}
         finally:
             servers[0].close()
+
+    def test_read_falls_back_to_surviving_replica(self, tmp_path):
+        """A replica that fails its sub-query is marked DEGRADED and its
+        shards are retried on surviving replicas — a single-replica
+        fault must not 500 a read when live replicas hold the data."""
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            n_shards = 16
+            seed(servers[0], n_shards=n_shards)
+            url = f"{uri(servers[0])}/index/i/query"
+            assert req("POST", url, b"Count(Row(f=1))") == {
+                "results": [4 * n_shards]
+            }
+            # pick the victim DETERMINISTICALLY: a node that node 0's
+            # router would actually target first for some shard it does
+            # not replicate itself (ring assignment is deterministic)
+            cluster0 = servers[0].api.cluster
+            routed_first = set()
+            for s in range(n_shards):
+                ns = cluster0.shard_nodes("i", s)
+                if not any(n.id == "n0" for n in ns):
+                    routed_first.add(ns[0].id)
+            assert routed_first, "every shard is local to n0?"
+            victim = next(s for s in servers[1:]
+                          if s.api.cluster.local.id in routed_first)
+            victim._http.shutdown()
+            victim._http.server_close()
+            for q, want in [
+                (b"Count(Row(f=1))", [4 * n_shards]),
+                (b"TopN(f, n=2)",
+                 [[{"id": 1, "count": 4 * n_shards},
+                   {"id": 2, "count": 2 * n_shards}]]),
+            ]:
+                assert req("POST", url, q) == {"results": want}, q
+            states = {
+                n.id: n.state
+                for n in servers[0].api.cluster.sorted_nodes()
+            }
+            assert states[victim.api.cluster.local.id] == "DEGRADED", states
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+    def test_rowwide_write_tolerates_dead_replica(self, tmp_path):
+        """Store/ClearRow skip an unreachable replica (DEGRADED) instead
+        of 500ing after the live replicas already applied the write."""
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            seed(servers[0], n_shards=8)
+            victim = servers[2]
+            victim._http.shutdown()
+            victim._http.server_close()
+            url = f"{uri(servers[0])}/index/i/query"
+            assert req("POST", url, b"Store(Row(f=1), f=9)") == {
+                "results": [True]
+            }
+            assert req("POST", url, b"ClearRow(f=2)") == {"results": [True]}
+            assert req("POST", url, b"Count(Row(f=9))") == {"results": [32]}
+            assert req("POST", url, b"Count(Row(f=2))") == {"results": [0]}
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
 
     def test_pipeline_disabled_fallback(self, tmp_path):
         servers = make_cluster(tmp_path, 1, use_mesh=False)
