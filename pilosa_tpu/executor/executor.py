@@ -537,6 +537,10 @@ class Executor:
                     self.microbatch_max,
                     self.microbatch_arg_budget // max(per_query, 1),
                 ))
+                # floor to a power of two: the flush pads batches to
+                # pow2 sizes, so a non-pow2 cap (budget-derived, e.g. 5)
+                # would reintroduce an unbounded program-shape family
+                limit = 1 << (limit.bit_length() - 1)
                 group = self._pending[key] = {"rows": [], "out": None,
                                               "limit": limit}
             i = len(group["rows"])
@@ -566,18 +570,28 @@ class Executor:
                                       n_scalars, n_queries)
 
     def _flush_group_locked(self, key, group) -> None:
-        """Dispatch a pending group as one program (caller holds _mb_lock)."""
+        """Dispatch a pending group as one program (caller holds _mb_lock).
+
+        The batch axis pads to the next power of two (duplicating the
+        last row — same array objects, so no host copies) and readers
+        index only the real rows. Without this, a serving wave of K
+        concurrent queries dispatches a K-row program for EVERY distinct
+        K, and XLA compiles each batch size from scratch — a wave
+        pipeline under varied load would spend its time in the compiler.
+        Padding bounds the shape family to {1,2,4,8,16} per structure."""
         if group["out"] is not None:
             return
         node, reduce_kind, shapes, n_scalars = key
         rows = group["rows"]
+        n_prog = min(group["limit"], next_pow2(len(rows)))
+        padded = rows + [rows[-1]] * (n_prog - len(rows))
         fn = self._program_batched(
             node, reduce_kind, tuple(len(s) - 1 for s in shapes),
-            n_scalars, len(rows),
+            n_scalars, n_prog,
         )
-        args = [leaf for leaves, _ in rows for leaf in leaves]
+        args = [leaf for leaves, _ in padded for leaf in leaves]
         if n_scalars:
-            args.append(np.asarray([s for _, s in rows], np.int32))
+            args.append(np.asarray([s for _, s in padded], np.int32))
         group["out"] = fn(*args)
         if self._pending.get(key) is group:
             del self._pending[key]

@@ -17,7 +17,20 @@ import urllib.request
 
 
 class ClientError(Exception):
-    pass
+    """Peer RPC failure. ``status`` is the HTTP status code, or None for
+    transport-level faults (connection refused/reset, DNS, timeout).
+    ``is_node_fault`` distinguishes 'the NODE is unhealthy' (transport or
+    5xx — retry another replica, mark DEGRADED) from 'the QUERY is bad'
+    (4xx — deterministic, every replica would answer the same; must
+    propagate, never degrade a healthy node)."""
+
+    def __init__(self, message: str, status: int | None = None):
+        super().__init__(message)
+        self.status = status
+
+    @property
+    def is_node_fault(self) -> bool:
+        return self.status is None or self.status >= 500
 
 
 class InternalClient:
@@ -75,7 +88,9 @@ class InternalClient:
                     detail = body.decode(errors="replace")
             else:
                 detail = body.decode(errors="replace")
-            raise ClientError(f"{method} {url}: HTTP {e.code}: {detail}") from e
+            raise ClientError(
+                f"{method} {url}: HTTP {e.code}: {detail}", status=e.code
+            ) from e
         except urllib.error.URLError as e:
             raise ClientError(f"{method} {url}: {e.reason}") from e
         return data if raw else json.loads(data or b"{}")
@@ -110,7 +125,10 @@ class InternalClient:
             else:
                 out = decode_results_json(raw)
                 if "error" in out:
-                    raise ClientError(f"POST {url}: {out['error']}")
+                    # query-level error in a 200 protobuf envelope:
+                    # deterministic, not a node fault
+                    raise ClientError(f"POST {url}: {out['error']}",
+                                      status=400)
                 return out
         return self._call("POST", url, pql.encode(),
                           content_type="text/plain")

@@ -278,7 +278,9 @@ class ClusterExecutor:
                     node.uri, index_name, pql, shard_group, remote=True
                 )
                 return [out["results"][0]]
-            except ClientError:
+            except ClientError as e:
+                if not e.is_node_fault:
+                    raise  # deterministic query error: every replica agrees
                 node.state = "DEGRADED"
                 if _depth >= 2:
                     raise
@@ -302,10 +304,17 @@ class ClusterExecutor:
         already a direct target, so there is nothing to fall back to — a
         replica unreachable at write time is marked DEGRADED and skipped
         (exactly like point writes in _execute_routed_write), the live
-        replicas' write stands, and re-replication repairs the divergence
-        when the node returns. Failing the whole request after some
+        replicas' write stands. Failing the whole request after some
         replicas already applied it would leave the SAME divergence plus
-        a client told to retry."""
+        a client told to retry. Deterministic (4xx) errors DO propagate —
+        every replica would reject identically, so nothing was applied
+        anywhere and the client must see the error.
+
+        Divergence window: identical to a missed point write — the
+        skipped replica is repaired when heartbeat death detection
+        re-owns its shards or a join/re-fetch replaces its fragments;
+        until then anti-entropy's union repair can resurface bits a
+        ClearRow removed (documented in docs/PQL.md note 5)."""
         pql = call.to_pql()
 
         def one(group):
@@ -315,7 +324,9 @@ class ClusterExecutor:
                     node.uri, index_name, pql, shard_group, remote=True
                 )
                 return out["results"][0]
-            except ClientError:
+            except ClientError as e:
+                if not e.is_node_fault:
+                    raise
                 node.state = "DEGRADED"
                 return False
 
@@ -381,7 +392,9 @@ class ClusterExecutor:
                         node.uri, idx.name, call.to_pql(), [shard], remote=True
                     )
                     result = bool(out["results"][0]) or result
-                except ClientError:
+                except ClientError as e:
+                    if not e.is_node_fault:
+                        raise  # deterministic rejection, not a dead node
                     node.state = "DEGRADED"
         return result
 

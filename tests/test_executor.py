@@ -686,8 +686,10 @@ class TestSubmitPipelined:
             (p.id, p.count) for p in want
         ]
         assert len(got[1]) == 3
-        # all three phase-2 recounts rode one countrows dispatch
-        assert ("countrows", 3) in flushes, flushes
+        # all three phase-2 recounts rode ONE countrows dispatch (the
+        # batch axis pads 3 -> 4, the next power of two)
+        assert ("countrows", 4) in flushes, flushes
+        assert len([f for f in flushes if f[0] == "countrows"]) == 1
 
     def test_submit_groupby_defers_readback(self, env, monkeypatch):
         """Pipelined dense GroupBys enqueue their level program at

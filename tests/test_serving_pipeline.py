@@ -184,9 +184,11 @@ class TestHTTPServing:
                 if q == queries[0]:
                     assert out[k] == serial_want
             # all queries went through batched programs, in far fewer
-            # dispatches than queries (ideally 1-4 waves)
-            assert sum(builds) == n, builds
+            # dispatches than queries (ideally 1-4 waves); batch sizes
+            # pad to powers of two (at most 2x the real rows)
+            assert n <= sum(builds) <= 2 * n, builds
             assert len(builds) <= n // 2, builds
+            assert all(b & (b - 1) == 0 for b in builds), builds
         finally:
             servers[0].close()
 
@@ -280,6 +282,40 @@ class TestHTTPServing:
                     s.close()
                 except Exception:
                     pass
+
+    def test_4xx_from_replica_is_not_a_node_fault(self, tmp_path,
+                                                  monkeypatch):
+        """A deterministic query rejection (HTTP 4xx) from a remote
+        replica must propagate to the client — every replica would
+        answer identically, so retrying siblings and DEGRADING the
+        healthy node would poison routing for one bad query."""
+        from pilosa_tpu.parallel.client import ClientError, InternalClient
+
+        servers = make_cluster(tmp_path, 3, replica_n=1)
+        try:
+            seed(servers[0], n_shards=8)
+            real = InternalClient.query_node
+            calls = {"n": 0}
+
+            def reject(client, uri, index, pql, shards, remote=True):
+                if "Count" in pql:
+                    calls["n"] += 1
+                    raise ClientError("injected 400", status=400)
+                return real(client, uri, index, pql, shards, remote=remote)
+
+            monkeypatch.setattr(InternalClient, "query_node", reject)
+            url = f"{uri(servers[0])}/index/i/query"
+            with pytest.raises(urllib.error.HTTPError):
+                req("POST", url, b"Count(Row(f=1))")
+            # exactly the first-choice replicas were tried — no retries
+            # against siblings, and nobody got degraded
+            assert calls["n"] >= 1
+            states = {n.id: n.state
+                      for n in servers[0].api.cluster.sorted_nodes()}
+            assert all(s == "NORMAL" for s in states.values()), states
+        finally:
+            for s in servers:
+                s.close()
 
     def test_pipeline_disabled_fallback(self, tmp_path):
         servers = make_cluster(tmp_path, 1, use_mesh=False)
