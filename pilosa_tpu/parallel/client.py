@@ -60,7 +60,7 @@ class InternalClient:
 
     @staticmethod
     def _is_406(err: "ClientError") -> bool:
-        return "HTTP 406" in str(err)
+        return err.status == 406
 
     def _call(self, method: str, url: str, body: bytes | None = None,
               content_type: str = "application/json", raw: bool = False,
@@ -125,10 +125,12 @@ class InternalClient:
             else:
                 out = decode_results_json(raw)
                 if "error" in out:
-                    # query-level error in a 200 protobuf envelope:
-                    # deterministic, not a node fault
-                    raise ClientError(f"POST {url}: {out['error']}",
-                                      status=400)
+                    # error text inside a 200 protobuf envelope: our own
+                    # server never produces this (ApiErrors ride 4xx
+                    # status even in protobuf), so it can only be an
+                    # odd/older peer — classify as a node fault (status
+                    # None) so the caller keeps its replica fallback
+                    raise ClientError(f"POST {url}: {out['error']}")
                 return out
         return self._call("POST", url, pql.encode(),
                           content_type="text/plain")

@@ -279,11 +279,24 @@ class ClusterExecutor:
                 )
                 return [out["results"][0]]
             except ClientError as e:
-                if not e.is_node_fault:
-                    raise  # deterministic query error: every replica agrees
-                node.state = "DEGRADED"
+                # Transport/5xx: the NODE is sick — degrade it and retry
+                # siblings. 404: ambiguous — 'index/field not found' can
+                # mean a schema-lagging replica, so retry siblings but do
+                # NOT degrade a healthy node. Other 4xx: deterministic
+                # query errors every replica would repeat — surface as
+                # PQLError (HTTP 400), never 'internal'.
+                if e.is_node_fault:
+                    node.state = "DEGRADED"
+                elif e.status != 404:
+                    raise PQLError(str(e)) from e
+
+                def give_up():
+                    if e.is_node_fault:
+                        raise e
+                    raise PQLError(str(e)) from e
+
                 if _depth >= 2:
-                    raise
+                    give_up()
                 retry: dict[str, tuple[Node, list[int]]] = {}
                 for shard in shard_group:
                     alts = [
@@ -291,7 +304,7 @@ class ClusterExecutor:
                         if n.id != node.id and n.state == "NORMAL"
                     ]
                     if not alts:
-                        raise  # no live replica holds this shard
+                        give_up()  # no live replica holds this shard
                     retry.setdefault(alts[0].id, (alts[0], []))[1].append(shard)
                 return self._map_remote(
                     index_name, call, list(retry.values()), _depth + 1
@@ -325,10 +338,14 @@ class ClusterExecutor:
                 )
                 return out["results"][0]
             except ClientError as e:
-                if not e.is_node_fault:
-                    raise
-                node.state = "DEGRADED"
-                return False
+                if e.is_node_fault:
+                    node.state = "DEGRADED"
+                    return False
+                if e.status == 404:
+                    # schema-lagging replica: skip it (no health signal);
+                    # schema sync + anti-entropy catch it up
+                    return False
+                raise PQLError(str(e)) from e
 
         return concurrent_map(one, groups)
 
@@ -393,9 +410,10 @@ class ClusterExecutor:
                     )
                     result = bool(out["results"][0]) or result
                 except ClientError as e:
-                    if not e.is_node_fault:
-                        raise  # deterministic rejection, not a dead node
-                    node.state = "DEGRADED"
+                    if e.is_node_fault:
+                        node.state = "DEGRADED"
+                    elif e.status != 404:  # 404 = schema lag: skip quietly
+                        raise PQLError(str(e)) from e
         return result
 
     # --------------------------------------------------------------- reduce

@@ -305,11 +305,54 @@ class TestHTTPServing:
 
             monkeypatch.setattr(InternalClient, "query_node", reject)
             url = f"{uri(servers[0])}/index/i/query"
-            with pytest.raises(urllib.error.HTTPError):
+            with pytest.raises(urllib.error.HTTPError) as ei:
                 req("POST", url, b"Count(Row(f=1))")
-            # exactly the first-choice replicas were tried — no retries
-            # against siblings, and nobody got degraded
-            assert calls["n"] >= 1
+            # surfaces as a CLIENT error (400), not 'internal' 500
+            assert ei.value.code == 400, ei.value.code
+            # only first-choice replicas were tried — 2 remote groups
+            # from node 0 (nodes n1 and n2), no sibling retries
+            assert 1 <= calls["n"] <= 2, calls
+            states = {n.id: n.state
+                      for n in servers[0].api.cluster.sorted_nodes()}
+            assert all(s == "NORMAL" for s in states.values()), states
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_404_schema_lag_retries_sibling_without_degrading(
+        self, tmp_path, monkeypatch
+    ):
+        """A 404 from a replica is ambiguous (could be schema lag, not a
+        bad query): the read must retry the shard's sibling replica and
+        succeed, and the lagging node must NOT be marked DEGRADED."""
+        from pilosa_tpu.parallel.client import ClientError, InternalClient
+
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            n_shards = 16
+            seed(servers[0], n_shards=n_shards)
+            cluster0 = servers[0].api.cluster
+            routed_first = set()
+            for s in range(n_shards):
+                ns = cluster0.shard_nodes("i", s)
+                if not any(n.id == "n0" for n in ns):
+                    routed_first.add(ns[0].id)
+            victim = next(s for s in servers[1:]
+                          if s.api.cluster.local.id in routed_first)
+            victim_port = victim.port
+            real = InternalClient.query_node
+
+            def lag(client, node_uri, index, pql, shards, remote=True):
+                if str(victim_port) in node_uri and "Count" in pql:
+                    raise ClientError("index 'i' not found", status=404)
+                return real(client, node_uri, index, pql, shards,
+                            remote=remote)
+
+            monkeypatch.setattr(InternalClient, "query_node", lag)
+            url = f"{uri(servers[0])}/index/i/query"
+            assert req("POST", url, b"Count(Row(f=1))") == {
+                "results": [4 * n_shards]
+            }
             states = {n.id: n.state
                       for n in servers[0].api.cluster.sorted_nodes()}
             assert all(s == "NORMAL" for s in states.values()), states
@@ -326,6 +369,44 @@ class TestHTTPServing:
             out = req("POST", url, b"Count(Row(f=1))")
             assert out == {"results": [24]}
             assert servers[0].api._pipeline is None
+        finally:
+            servers[0].close()
+
+    def test_bad_query_in_wave_does_not_poison_wavemates(self, tmp_path):
+        """One request erroring at submit time (unknown field) must fail
+        ALONE; the other requests coalesced into the same wave still
+        resolve correctly."""
+        servers = make_cluster(tmp_path, 1, use_mesh=False)
+        try:
+            seed(servers[0])
+            url = f"{uri(servers[0])}/index/i/query"
+            queries = (["Count(Row(f=1))"] * 6
+                       + ["Count(Row(nosuch=1))"]
+                       + ["Count(Row(f=2))"] * 5)
+            results = [None] * len(queries)
+            gate = threading.Event()
+
+            def worker(k, q):
+                gate.wait(10)
+                try:
+                    results[k] = req("POST", url, q.encode())
+                except urllib.error.HTTPError as e:
+                    results[k] = ("http-error", e.code)
+
+            threads = [threading.Thread(target=worker, args=(k, q))
+                       for k, q in enumerate(queries)]
+            for t in threads:
+                t.start()
+            gate.set()
+            for t in threads:
+                t.join(60)
+            for q, r in zip(queries, results):
+                if "nosuch" in q:
+                    assert r == ("http-error", 400), r
+                elif "f=1" in q:
+                    assert r == {"results": [24]}, (q, r)
+                else:
+                    assert r == {"results": [12]}, (q, r)
         finally:
             servers[0].close()
 
