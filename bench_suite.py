@@ -434,6 +434,122 @@ def config_serving(n_shards: int = 8, n_clients: int = 16,
             server.close()
 
 
+def config_import(n_shards: int = 8, rows_per_shard: int = 4,
+                  density: float = 0.05) -> dict:
+    """Bulk-import throughput — the reference's write-path hot loop
+    (SURVEY §3.3 fragment.bulkImport). Measures three layers so the cost
+    split is visible: (a) fragment.bulk_import engine rate (sorted id
+    stream → roaring containers + op log), (b) the HTTP JSON import
+    route end to end, and (c) the binary import-roaring route (the
+    reference's fast path). Verified by exact Count afterwards."""
+    import json as _json
+    import urllib.request
+
+    from pilosa_tpu.roaring import RoaringBitmap
+    from pilosa_tpu.roaring.format import serialize
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    rng = np.random.default_rng(13)
+    n = int(SHARD_WIDTH * density)
+    per_shard = [
+        np.sort(rng.choice(SHARD_WIDTH, n, replace=False)).astype(np.uint64)
+        for _ in range(n_shards)
+    ]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        server = Server(ServerConfig(
+            data_dir=tmp, port=0, name="imp", anti_entropy_interval=0,
+            heartbeat_interval=0,
+        )).open()
+        try:
+            idx = server.holder.create_index("b")
+            f = idx.create_field("eng")
+            # (a) engine layer
+            t0 = time.perf_counter()
+            total_bits = 0
+            for shard, cols in enumerate(per_shard):
+                frag = f.view(VIEW_STANDARD, create=True).fragment(
+                    shard, create=True
+                )
+                for row in range(1, rows_per_shard + 1):
+                    frag.bulk_import(
+                        np.full(cols.size, row, np.uint64), cols
+                    )
+                    total_bits += cols.size
+            engine_s = time.perf_counter() - t0
+
+            url = f"http://localhost:{server.port}"
+            idx.create_field("http")
+
+            def post(path, body, binary=False):
+                data = body if binary else _json.dumps(body).encode()
+                r = urllib.request.Request(url + path, data=data,
+                                           method="POST")
+                if binary:
+                    r.add_header("Content-Type",
+                                 "application/octet-stream")
+                with urllib.request.urlopen(r, timeout=300) as resp:
+                    return _json.loads(resp.read() or b"{}")
+
+            # (b) HTTP JSON route
+            t0 = time.perf_counter()
+            http_bits = 0
+            for shard, cols in enumerate(per_shard):
+                base = shard * SHARD_WIDTH
+                for row in range(1, rows_per_shard + 1):
+                    post("/index/b/field/http/import", {
+                        "rows": [row] * cols.size,
+                        "columns": (cols + base).tolist(),
+                    })
+                    http_bits += cols.size
+            http_s = time.perf_counter() - t0
+
+            # (c) binary roaring route (one bitmap per shard carrying
+            # every row's bits as row<<20|pos ids)
+            idx.create_field("roar")
+            payloads = []
+            for shard, cols in enumerate(per_shard):
+                ids = np.concatenate([
+                    (np.uint64(row) << np.uint64(20)) + cols
+                    for row in range(1, rows_per_shard + 1)
+                ])
+                bm = RoaringBitmap()
+                bm.add_ids(ids)
+                payloads.append(serialize(bm))
+            t0 = time.perf_counter()
+            for shard, payload in enumerate(payloads):
+                post(f"/index/b/field/roar/import-roaring/{shard}",
+                     payload, binary=True)
+            roaring_s = time.perf_counter() - t0
+
+            ok = True
+            for fname in ("eng", "http", "roar"):
+                for row in (1, rows_per_shard):
+                    r = urllib.request.Request(
+                        f"{url}/index/b/query",
+                        data=f"Count(Row({fname}={row}))".encode(),
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(r, timeout=300) as resp:
+                        got = _json.loads(resp.read())["results"][0]
+                    ok = ok and got == n * n_shards
+
+            return {
+                "config": "import",
+                "metric": "bulk_import_bits_per_sec_engine",
+                "value": round(total_bits / engine_s, 1),
+                "unit": "bits/sec",
+                "http_json_bits_per_sec": round(http_bits / http_s, 1),
+                "http_roaring_bits_per_sec": round(total_bits / roaring_s, 1),
+                "bits_per_field": total_bits, "shards": n_shards,
+                "ok": bool(ok),
+            }
+        finally:
+            server.close()
+
+
 def _spawn_cpu_mesh_entry() -> None:
     """Run config5_mesh_cpu8 in a subprocess pinned to an 8-device
     virtual CPU platform (the axon TPU plugin would otherwise own the
@@ -467,7 +583,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--full", action="store_true",
                         help="billion-column scale (real TPU)")
-    parser.add_argument("--configs", default="1,2,3,4,5,mesh8,serving")
+    parser.add_argument("--configs", default="1,2,3,4,5,mesh8,serving,import")
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -488,6 +604,10 @@ def main() -> None:
         "serving": lambda: config_serving(
             n_shards=64 if args.full else 8,
             n_queries=256 if args.full else 64,
+        ),
+        "import": lambda: config_import(
+            n_shards=32 if args.full else 8,
+            density=0.2 if args.full else 0.05,
         ),
     }
     floor = None  # lazy: touching the device backend can BLOCK when the

@@ -13,34 +13,64 @@ import os
 
 import numpy as np
 
-_lib = None
+_lib = None  # None = not tried yet; False = unavailable (cached); else CDLL
 
 
 def _load():
     global _lib
-    if _lib is not None or os.environ.get("PILOSA_TPU_NO_NATIVE") == "1":
-        return _lib
+    if _lib is not None:
+        return _lib or None
+    if os.environ.get("PILOSA_TPU_NO_NATIVE") == "1":
+        return None
     from pilosa_tpu.native.build import build
 
-    path = build()
-    if path is None:
+    try:
+        path = build()
+        if path is None:
+            _lib = False  # cache the miss: this runs in per-container
+            return None   # hot loops, a PATH scan per call would bite
+        lib = ctypes.CDLL(path)
+        if not hasattr(lib, "union_sorted_u16"):
+            # Stale .so predating the sorted-set symbols. dlopen caches
+            # by path, so re-loading the rebuilt file at the SAME path
+            # returns the stale handle — rebuild to a fresh temp name.
+            import shutil
+            import tempfile
+
+            src = build(force=True)
+            if src is None:
+                _lib = False
+                return None
+            fresh = tempfile.NamedTemporaryFile(
+                suffix=".so", delete=False
+            ).name
+            shutil.copy2(src, fresh)
+            lib = ctypes.CDLL(fresh)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.pack_positions.argtypes = [u64p, ctypes.c_int64, u32p,
+                                       ctypes.c_int64]
+        lib.pack_positions.restype = None
+        lib.unpack_positions.argtypes = [
+            u32p, ctypes.c_int64, ctypes.c_uint64, u64p, ctypes.c_int64,
+        ]
+        lib.unpack_positions.restype = ctypes.c_int64
+        lib.popcount_words.argtypes = [u32p, ctypes.c_int64]
+        lib.popcount_words.restype = ctypes.c_uint64
+        lib.or_words.argtypes = [u32p, u32p, ctypes.c_int64]
+        lib.or_words.restype = None
+        lib.runs_to_words.argtypes = [u16p, ctypes.c_int64, u32p]
+        lib.runs_to_words.restype = None
+        lib.union_sorted_u16.argtypes = [u16p, ctypes.c_int64, u16p,
+                                         ctypes.c_int64, u16p]
+        lib.union_sorted_u16.restype = ctypes.c_int64
+        lib.diff_sorted_u16.argtypes = [u16p, ctypes.c_int64, u16p,
+                                        ctypes.c_int64, u16p]
+        lib.diff_sorted_u16.restype = ctypes.c_int64
+    except (OSError, AttributeError):
+        _lib = False  # unusable library: permanent numpy fallback
         return None
-    lib = ctypes.CDLL(path)
-    u64p = ctypes.POINTER(ctypes.c_uint64)
-    u32p = ctypes.POINTER(ctypes.c_uint32)
-    u16p = ctypes.POINTER(ctypes.c_uint16)
-    lib.pack_positions.argtypes = [u64p, ctypes.c_int64, u32p, ctypes.c_int64]
-    lib.pack_positions.restype = None
-    lib.unpack_positions.argtypes = [
-        u32p, ctypes.c_int64, ctypes.c_uint64, u64p, ctypes.c_int64,
-    ]
-    lib.unpack_positions.restype = ctypes.c_int64
-    lib.popcount_words.argtypes = [u32p, ctypes.c_int64]
-    lib.popcount_words.restype = ctypes.c_uint64
-    lib.or_words.argtypes = [u32p, u32p, ctypes.c_int64]
-    lib.or_words.restype = None
-    lib.runs_to_words.argtypes = [u16p, ctypes.c_int64, u32p]
-    lib.runs_to_words.restype = None
     _lib = lib
     return _lib
 
@@ -98,3 +128,33 @@ def runs_to_words(runs: np.ndarray) -> np.ndarray | None:
     lib.runs_to_words(_ptr(runs, ctypes.c_uint16), runs.shape[0],
                       _ptr(out, ctypes.c_uint32))
     return out
+
+
+def union_sorted_u16(a: np.ndarray, b: np.ndarray) -> np.ndarray | None:
+    """Union of two sorted unique uint16 arrays (two-pointer merge)."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, np.uint16)
+    b = np.ascontiguousarray(b, np.uint16)
+    out = np.empty(a.size + b.size, np.uint16)
+    n = lib.union_sorted_u16(_ptr(a, ctypes.c_uint16), a.size,
+                             _ptr(b, ctypes.c_uint16), b.size,
+                             _ptr(out, ctypes.c_uint16))
+    # copy: a view would pin the oversized merge buffer for the life of
+    # the container that stores the result
+    return out[:n].copy()
+
+
+def diff_sorted_u16(a: np.ndarray, b: np.ndarray) -> np.ndarray | None:
+    """a \\ b for sorted unique uint16 arrays."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, np.uint16)
+    b = np.ascontiguousarray(b, np.uint16)
+    out = np.empty(a.size, np.uint16)
+    n = lib.diff_sorted_u16(_ptr(a, ctypes.c_uint16), a.size,
+                            _ptr(b, ctypes.c_uint16), b.size,
+                            _ptr(out, ctypes.c_uint16))
+    return out[:n].copy()

@@ -78,4 +78,35 @@ void runs_to_words(const uint16_t* runs, int64_t n_runs, uint32_t* words) {
     }
 }
 
+// Union of two SORTED UNIQUE uint16 arrays (two-pointer merge) — the
+// ARRAY-container bulk-import path. `out` must hold na+nb; returns the
+// merged length. Replaces np.union1d's concat+sort (O((n+m)log(n+m)))
+// with O(n+m).
+int64_t union_sorted_u16(const uint16_t* a, int64_t na,
+                         const uint16_t* b, int64_t nb, uint16_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        const uint16_t x = a[i], y = b[j];
+        if (x < y)      { out[k++] = x; ++i; }
+        else if (y < x) { out[k++] = y; ++j; }
+        else            { out[k++] = x; ++i; ++j; }
+    }
+    while (i < na) out[k++] = a[i++];
+    while (j < nb) out[k++] = b[j++];
+    return k;
+}
+
+// a \ b for SORTED UNIQUE uint16 arrays — the remove path. `out` must
+// hold na; returns the result length.
+int64_t diff_sorted_u16(const uint16_t* a, int64_t na,
+                        const uint16_t* b, int64_t nb, uint16_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na) {
+        while (j < nb && b[j] < a[i]) ++j;
+        if (j < nb && b[j] == a[i]) { ++i; continue; }
+        out[k++] = a[i++];
+    }
+    return k;
+}
+
 }  // extern "C"

@@ -15,6 +15,8 @@ import bisect
 
 import numpy as np
 
+from pilosa_tpu import native
+
 ARRAY = 1
 BITMAP = 2
 RUN = 3
@@ -234,9 +236,16 @@ class RoaringBitmap:
         return self._merge(ids, remove=True)
 
     def _merge(self, ids, remove: bool) -> int:
-        ids = np.unique(np.asarray(ids, dtype=np.uint64))
+        ids = np.asarray(ids, dtype=np.uint64)
         if ids.size == 0:
             return 0
+        # bulk imports arrive pre-sorted ((row<<20)+sorted positions per
+        # row); skip np.unique's unconditional O(n log n) sort for them
+        # and dedupe sorted input with one vectorized compare
+        if ids.size > 1:
+            if not bool(np.all(ids[1:] >= ids[:-1])):
+                ids = np.sort(ids)
+            ids = ids[np.concatenate(([True], ids[1:] != ids[:-1]))]
         hi = (ids >> np.uint64(16)).astype(np.int64)
         lows = (ids & np.uint64(0xFFFF)).astype(np.uint16)
         boundaries = np.concatenate(
@@ -267,10 +276,18 @@ class RoaringBitmap:
                 delta = int(batch.size)
             if delta is None:
                 existing = c.lows() if c is not None else np.empty(0, np.uint16)
+                # both sides are sorted unique (container invariant;
+                # batch is a slice of the deduped sorted ids) — the
+                # native two-pointer merge beats union1d's concat+sort
                 if remove:
-                    new = np.setdiff1d(existing, batch, assume_unique=True)
+                    new = native.diff_sorted_u16(existing, batch)
+                    if new is None:
+                        new = np.setdiff1d(existing, batch,
+                                           assume_unique=True)
                 else:
-                    new = np.union1d(existing, batch)
+                    new = native.union_sorted_u16(existing, batch)
+                    if new is None:
+                        new = np.union1d(existing, batch)
                 delta = abs(int(new.size) - int(existing.size))
                 if delta and new.size == 0:
                     self._containers.pop(key, None)

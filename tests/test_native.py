@@ -67,3 +67,31 @@ def test_packing_api_works_with_or_without_native(monkeypatch):
     np.testing.assert_array_equal(
         packing.unpack_bits(without), ids.astype(np.uint64)
     )
+
+
+def test_sorted_set_ops_match_numpy():
+    """union/diff_sorted_u16 (the ARRAY-container import hot path) match
+    the numpy set ops they replace, including empty and disjoint edges."""
+    rng = np.random.default_rng(17)
+    cases = [
+        (np.empty(0, np.uint16), np.empty(0, np.uint16)),
+        (np.array([3], np.uint16), np.empty(0, np.uint16)),
+        (np.empty(0, np.uint16), np.array([9], np.uint16)),
+        (np.array([1, 2, 3], np.uint16), np.array([4, 5], np.uint16)),
+        (np.array([0, 65535], np.uint16), np.array([0, 65535], np.uint16)),
+    ]
+    for _ in range(20):
+        a = np.unique(rng.choice(1 << 16, rng.integers(0, 4000),
+                                 replace=False).astype(np.uint16))
+        b = np.unique(rng.choice(1 << 16, rng.integers(0, 4000),
+                                 replace=False).astype(np.uint16))
+        cases.append((a, b))
+    for a, b in cases:
+        got_u = native.union_sorted_u16(a, b)
+        got_d = native.diff_sorted_u16(a, b)
+        if got_u is None:  # no toolchain: numpy fallback covers it
+            continue
+        np.testing.assert_array_equal(got_u, np.union1d(a, b))
+        np.testing.assert_array_equal(
+            got_d, np.setdiff1d(a, b, assume_unique=True)
+        )
