@@ -483,8 +483,9 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
             url = f"http://localhost:{server.port}"
             idx.create_field("http")
 
-            def post(path, body, binary=False):
-                data = body if binary else _json.dumps(body).encode()
+            def post(path, body, binary=False, raw=False):
+                data = (body if binary or raw
+                        else _json.dumps(body).encode())
                 r = urllib.request.Request(url + path, data=data,
                                            method="POST")
                 if binary:
@@ -493,18 +494,52 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
                 with urllib.request.urlopen(r, timeout=300) as resp:
                     return _json.loads(resp.read() or b"{}")
 
-            # (b) HTTP JSON route
-            t0 = time.perf_counter()
+            # (b) HTTP JSON route — bodies pre-encoded OUTSIDE the timer
+            # like the protobuf/roaring routes, so the published numbers
+            # compare server-side route cost, not client encode cost
+            json_bodies = []
             http_bits = 0
             for shard, cols in enumerate(per_shard):
                 base = shard * SHARD_WIDTH
                 for row in range(1, rows_per_shard + 1):
-                    post("/index/b/field/http/import", {
+                    json_bodies.append(_json.dumps({
                         "rows": [row] * cols.size,
                         "columns": (cols + base).tolist(),
-                    })
+                    }).encode())
                     http_bits += cols.size
+            t0 = time.perf_counter()
+            for body in json_bodies:
+                post("/index/b/field/http/import", body, binary=False,
+                     raw=True)
             http_s = time.perf_counter() - t0
+
+            # (b2) protobuf import route — the reference's actual client
+            # path (ImportRequest bodies)
+            from pilosa_tpu import wire
+
+            proto_s = None
+            if wire.available():
+                from pilosa_tpu.wire.serializer import encode_import_request
+
+                idx.create_field("pb")
+                bodies = []
+                for shard, cols in enumerate(per_shard):
+                    base = shard * SHARD_WIDTH
+                    for row in range(1, rows_per_shard + 1):
+                        bodies.append(encode_import_request(
+                            "b", "pb", np.full(cols.size, row, np.uint64),
+                            cols + base,
+                        ))
+                t0 = time.perf_counter()
+                for body in bodies:
+                    r = urllib.request.Request(
+                        f"{url}/index/b/field/pb/import", data=body,
+                        method="POST",
+                    )
+                    r.add_header("Content-Type", "application/x-protobuf")
+                    with urllib.request.urlopen(r, timeout=300):
+                        pass
+                proto_s = time.perf_counter() - t0
 
             # (c) binary roaring route (one bitmap per shard carrying
             # every row's bits as row<<20|pos ids)
@@ -525,7 +560,10 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
             roaring_s = time.perf_counter() - t0
 
             ok = True
-            for fname in ("eng", "http", "roar"):
+            checked = ["eng", "http", "roar"] + (
+                ["pb"] if proto_s is not None else []
+            )
+            for fname in checked:
                 for row in (1, rows_per_shard):
                     r = urllib.request.Request(
                         f"{url}/index/b/query",
@@ -536,7 +574,7 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
                         got = _json.loads(resp.read())["results"][0]
                     ok = ok and got == n * n_shards
 
-            return {
+            out = {
                 "config": "import",
                 "metric": "bulk_import_bits_per_sec_engine",
                 "value": round(total_bits / engine_s, 1),
@@ -546,6 +584,11 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
                 "bits_per_field": total_bits, "shards": n_shards,
                 "ok": bool(ok),
             }
+            if proto_s is not None:
+                out["http_protobuf_bits_per_sec"] = round(
+                    total_bits / proto_s, 1
+                )
+            return out
         finally:
             server.close()
 

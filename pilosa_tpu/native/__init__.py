@@ -46,6 +46,7 @@ def _load():
             ).name
             shutil.copy2(src, fresh)
             lib = ctypes.CDLL(fresh)
+            os.unlink(fresh)  # mapping survives the unlink (Linux)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         u32p = ctypes.POINTER(ctypes.c_uint32)
         u16p = ctypes.POINTER(ctypes.c_uint16)

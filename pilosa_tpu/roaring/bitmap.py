@@ -236,7 +236,7 @@ class RoaringBitmap:
         return self._merge(ids, remove=True)
 
     def _merge(self, ids, remove: bool) -> int:
-        ids = np.asarray(ids, dtype=np.uint64)
+        ids = np.atleast_1d(np.asarray(ids, dtype=np.uint64))
         if ids.size == 0:
             return 0
         # bulk imports arrive pre-sorted ((row<<20)+sorted positions per

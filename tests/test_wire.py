@@ -3,6 +3,7 @@
 
 import urllib.request
 
+import numpy as np
 import pytest
 
 from pilosa_tpu import wire
@@ -182,15 +183,18 @@ def test_import_request_encoders_roundtrip():
         timestamps=["2019-01-15T00:00", None, ""], clear=True,
     )
     rows, cols, ts, clear = decode_import_request(body)
-    assert rows == [1, 2, 3]
-    assert cols == [10, 20, 1 << 40]
+    # decoders return numpy (the import path consumes arrays directly)
+    assert rows.dtype == np.uint64 and rows.tolist() == [1, 2, 3]
+    assert cols.tolist() == [10, 20, 1 << 40]
     assert ts == ["2019-01-15T00:00", "", ""]  # None -> "" (= no timestamp)
     assert clear is True
 
     body = encode_import_value_request("i", "v", [5, 6], [-7, 1 << 40],
                                        clear=False)
     cols, values, clear = decode_import_value_request(body)
-    assert (cols, values, clear) == ([5, 6], [-7, 1 << 40], False)
+    assert cols.tolist() == [5, 6]
+    assert values.dtype == np.int64 and values.tolist() == [-7, 1 << 40]
+    assert clear is False
 
 
 @requires_proto
