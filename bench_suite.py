@@ -449,6 +449,7 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
     from pilosa_tpu.roaring.format import serialize
     from pilosa_tpu.server import Server, ServerConfig
     from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import FieldOptions
     from pilosa_tpu.storage.view import VIEW_STANDARD
 
     rng = np.random.default_rng(13)
@@ -574,6 +575,21 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
                         got = _json.loads(resp.read())["results"][0]
                     ok = ok and got == n * n_shards
 
+            # (d) BSI value import — batched bit-plane writes
+            # (field.import_values / fragment.import_bsi)
+            vfield = idx.create_field(
+                "val", FieldOptions(type="int", min=0, max=100000)
+            )
+            n_vals = total_bits // 2
+            vcols = rng.choice(n_shards * SHARD_WIDTH, n_vals,
+                               replace=False).astype(np.uint64)
+            vvals = rng.integers(0, 100000, n_vals, dtype=np.int64)
+            t0 = time.perf_counter()
+            vfield.import_values(vcols, vvals)
+            values_s = time.perf_counter() - t0
+            vprobe = int(vcols[0])
+            ok = ok and vfield.value(vprobe) == (int(vvals[0]), True)
+
             out = {
                 "config": "import",
                 "metric": "bulk_import_bits_per_sec_engine",
@@ -588,6 +604,7 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
                 out["http_protobuf_bits_per_sec"] = round(
                     total_bits / proto_s, 1
                 )
+            out["bsi_values_per_sec"] = round(n_vals / values_s, 1)
             return out
         finally:
             server.close()

@@ -226,6 +226,32 @@ class RoaringBitmap:
                 out[i] = c.dense_words32()
         return out.reshape(-1)
 
+    def range_ids(self, start: int, stop: int) -> np.ndarray:
+        """Sorted ids in [start, stop) — walks only the containers
+        overlapping the range. The whole-bitmap ``to_ids()`` is O(total
+        population); per-row probes (import_bsi membership, row_columns)
+        must not pay that on large fragments."""
+        if stop <= start or not self.keys:
+            return np.empty(0, np.uint64)
+        lo_key = start >> 16
+        hi_key = (stop - 1) >> 16
+        i = bisect.bisect_left(self.keys, lo_key)
+        parts = []
+        while i < len(self.keys) and self.keys[i] <= hi_key:
+            key = self.keys[i]
+            c = self.container(key)
+            if c is not None and c.n:
+                parts.append(
+                    (np.uint64(key) << np.uint64(16))
+                    + c.lows().astype(np.uint64)
+                )
+            i += 1
+        if not parts:
+            return np.empty(0, np.uint64)
+        ids = np.concatenate(parts)
+        # trim partial edge containers (cheap vs re-slicing per part)
+        return ids[(ids >= np.uint64(start)) & (ids < np.uint64(stop))]
+
     # --- mutation (op-log replay + write path) ---
 
     def add_ids(self, ids) -> int:

@@ -223,8 +223,11 @@ class API:
         # validate BEFORE routing: the roaring bulk route ships pre-built
         # bitmaps that the receiving end cannot re-validate, so bad input
         # must 400 here, not corrupt or 500 downstream
-        rows_i = np.asarray(rows, dtype=np.int64)
-        columns_i = np.asarray(columns, dtype=np.int64)
+        try:
+            rows_i = np.asarray(rows, dtype=np.int64)
+            columns_i = np.asarray(columns, dtype=np.int64)
+        except OverflowError as e:
+            raise ApiError(f"row/column id out of range: {e}") from e
         if rows_i.shape != columns_i.shape:
             raise ApiError("rows and columns must be the same length")
         if rows_i.size and (rows_i.min() < 0 or columns_i.min() < 0):
@@ -370,16 +373,25 @@ class API:
             raise ApiError(f"field {field!r} is not an int field")
         if len(columns) != len(values):
             raise ApiError("columns and values must be the same length")
-        changed = 0
-        for col, val in zip(columns, values):
-            if int(col) < 0:
-                raise ApiError(f"column {col} is negative")
-            try:
-                if clear:
+        try:
+            cols_i = np.asarray(columns, dtype=np.int64)
+        except OverflowError as e:  # ids beyond int64: clean 400, not 500
+            raise ApiError(f"column id out of range: {e}") from e
+        if cols_i.size and cols_i.min() < 0:
+            raise ApiError(f"column {int(cols_i.min())} is negative")
+        if clear:
+            changed = 0
+            for col in cols_i.tolist():
+                try:
                     changed += fld.clear_value(int(col))
-                else:
-                    changed += fld.set_value(int(col), int(val))
-            except ValueError as e:
+                except ValueError as e:
+                    raise ApiError(str(e)) from e
+        else:
+            try:
+                changed = fld.import_values(
+                    cols_i.astype(np.uint64), values
+                )
+            except (ValueError, OverflowError) as e:
                 raise ApiError(str(e)) from e
         if not clear:
             idx.mark_columns_exist([int(c) for c in columns])

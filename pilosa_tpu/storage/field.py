@@ -235,6 +235,52 @@ class Field:
                 changed |= frag.clear_bit(BSI_OFFSET_ROW + i, pos)
         return changed
 
+    def import_values(self, columns, values) -> int:
+        """Batched BSI import (reference field.importValue — SURVEY.md
+        §3.3): validates and offset-encodes the whole batch, groups by
+        shard, and writes each shard's planes through ONE locked
+        fragment pass (Fragment.import_bsi) instead of per-column
+        set_value's 1+depth locked ops. Duplicate columns keep the LAST
+        value (matching a sequential set_value loop). Returns the number
+        of columns whose value changed."""
+        import numpy as np
+
+        from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_groups
+
+        if self.options.type != TYPE_INT:
+            raise ValueError("import_values on non-int field")
+        columns = np.atleast_1d(np.asarray(columns, np.uint64))
+        values = np.atleast_1d(np.asarray(values, np.int64))
+        if columns.size == 0:
+            return 0
+        bad = (values < self.options.min) | (values > self.options.max)
+        if bad.any():
+            v = int(values[bad][0])
+            raise ValueError(
+                f"value {v} outside field range "
+                f"[{self.options.min}, {self.options.max}]"
+            )
+        # keep-last dedupe: np.unique keeps the FIRST occurrence, so
+        # dedupe the reversed array and map indices back
+        rev_cols = columns[::-1]
+        _, first_in_rev = np.unique(rev_cols, return_index=True)
+        keep = np.sort(columns.size - 1 - first_in_rev)
+        columns, values = columns[keep], values[keep]
+        stored = (values - self.options.base).astype(np.uint64)
+        view = self.view(self.bsi_view_name(), create=True)
+        order, bounds, shards_sorted = shard_groups(columns)
+        cols_s, stored_s = columns[order], stored[order]
+        changed = 0
+        for i in range(bounds.size - 1):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            frag = view.fragment(int(shards_sorted[lo]), create=True)
+            changed += frag.import_bsi(
+                cols_s[lo:hi] & np.uint64(SHARD_WIDTH - 1),
+                stored_s[lo:hi], self.options.bit_depth,
+                exists_row=BSI_EXISTS_ROW, offset_row=BSI_OFFSET_ROW,
+            )
+        return changed
+
     def value(self, column: int) -> tuple[int, bool]:
         """Read one column's BSI value host-side (reference field.Value)."""
         if self.options.type != TYPE_INT:

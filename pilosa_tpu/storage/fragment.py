@@ -181,9 +181,8 @@ class Fragment:
     def row_columns(self, row: int) -> np.ndarray:
         """Sorted in-shard column positions set in ``row``."""
         base = row << 20
-        ids = self.bitmap.to_ids()
-        sel = ids[(ids >= base) & (ids < base + SHARD_WIDTH)]
-        return (sel - np.uint64(base)).astype(np.uint64)
+        ids = self.bitmap.range_ids(base, base + SHARD_WIDTH)
+        return (ids - np.uint64(base)).astype(np.uint64)
 
     def count_row(self, row: int) -> int:
         base = row << 20
@@ -284,6 +283,76 @@ class Fragment:
                 self._log_op(OP_ADD, ids)
                 self._after_rows_added(rows, positions)
             return changed
+
+    def import_bsi(self, positions: np.ndarray, stored: np.ndarray,
+                   bit_depth: int, exists_row: int = 0,
+                   offset_row: int = 2) -> int:
+        """Batched BSI write (reference fragment.importValue — SURVEY.md
+        §3.3): one lock + one add pass + one remove pass for a whole
+        (position, stored-value) batch, in place of per-column
+        ``set_value``'s per-bit fragment ops (1 + depth locked ops and
+        op-log appends per column). ``positions`` must be duplicate-free
+        (callers dedupe keep-last). Returns the number of COLUMNS whose
+        existence or stored value changed — the same count a set_value
+        loop would report."""
+        positions = np.asarray(positions, np.uint64)
+        stored = np.asarray(stored, np.uint64)
+        if positions.size and int(positions.max()) >= SHARD_WIDTH:
+            raise ValueError("position out of shard range")
+        with self.lock:
+
+            def member(row: int) -> np.ndarray:
+                base = row << 20
+                cols = self.bitmap.range_ids(base, base + SHARD_WIDTH)
+                if cols.size == 0:
+                    return np.zeros(positions.size, bool)
+                cols = cols - np.uint64(base)
+                idx = np.searchsorted(cols, positions)
+                idx_c = np.minimum(idx, cols.size - 1)
+                return (idx < cols.size) & (cols[idx_c] == positions)
+
+            add_parts: list = []
+            rem_parts: list = []
+            rows_added: list = []
+            rows_removed: list = []
+            exists_new = ~member(exists_row)
+            changed_cols = exists_new.copy()
+            if exists_new.any():
+                p = positions[exists_new]
+                add_parts.append(
+                    (np.uint64(exists_row) << np.uint64(20)) + p
+                )
+                rows_added.append((exists_row, p))
+            for i in range(bit_depth):
+                row = offset_row + i
+                desired = ((stored >> np.uint64(i)) & np.uint64(1)) == 1
+                cur = member(row)
+                add_m = desired & ~cur
+                rem_m = ~desired & cur
+                if add_m.any():
+                    p = positions[add_m]
+                    add_parts.append((np.uint64(row) << np.uint64(20)) + p)
+                    rows_added.append((row, p))
+                if rem_m.any():
+                    p = positions[rem_m]
+                    rem_parts.append((np.uint64(row) << np.uint64(20)) + p)
+                    rows_removed.append((row, p))
+                changed_cols |= add_m | rem_m
+            if not changed_cols.any():
+                return 0
+            if add_parts:
+                ids = np.sort(np.concatenate(add_parts))
+                self.bitmap.add_ids(ids)
+                self._log_op(OP_ADD, ids)
+            if rem_parts:
+                ids = np.sort(np.concatenate(rem_parts))
+                self.bitmap.remove_ids(ids)
+                self._log_op(OP_REMOVE, ids)
+            for row, p in rows_added:
+                self._after_row_write(int(row), positions=p, added=True)
+            for row, p in rows_removed:
+                self._after_row_write(int(row), positions=p, added=False)
+            return int(changed_cols.sum())
 
     def import_roaring(self, data: bytes) -> int:
         """Union a serialized roaring bitmap into this fragment (reference

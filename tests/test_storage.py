@@ -375,3 +375,57 @@ class TestRowCounts:
         pairs = f.view("standard").fragment(0).top(5)
         assert len(pairs) == 5 and calls["n"] == 0
         holder.close()
+
+
+class TestBatchedBSIImport:
+    def _mk(self, tmp_path, lo=-10, hi=1000):
+        from pilosa_tpu.storage.field import Field
+
+        return Field(
+            str(tmp_path / "v"), "i", "v",
+            FieldOptions(type="int", min=lo, max=hi),
+        ).open()
+
+    def test_matches_set_value_loop(self, tmp_path):
+        """import_values == a sequential set_value loop: same final
+        values, same changed count, incl. overwrites of existing columns
+        and in-batch duplicates (last wins)."""
+        import numpy as np
+
+        rng = np.random.default_rng(5)
+        a = self._mk(tmp_path / "a")
+        b = self._mk(tmp_path / "b")
+        cols = rng.integers(0, 3 * (1 << 20), 400, dtype=np.uint64)
+        vals = rng.integers(-10, 1001, 400, dtype=np.int64)
+        # two waves so the second overwrites some of the first
+        for wave in (slice(0, 250), slice(150, 400)):
+            loop_changed = 0
+            seen = {}
+            for c, v in zip(cols[wave].tolist(), vals[wave].tolist()):
+                loop_changed += a.set_value(int(c), int(v))
+                seen[int(c)] = int(v)
+            batch_changed = b.import_values(cols[wave], vals[wave])
+            assert batch_changed == loop_changed
+            for c, v in seen.items():
+                assert a.value(c) == (v, True)
+                assert b.value(c) == (v, True), c
+        a.close()
+        b.close()
+
+    def test_duplicate_columns_last_wins(self, tmp_path):
+        f = self._mk(tmp_path)
+        assert f.import_values([7, 7, 7], [5, 900, 42]) == 1
+        assert f.value(7) == (42, True)
+        # unchanged re-import reports zero
+        assert f.import_values([7], [42]) == 0
+        f.close()
+
+    def test_range_validation(self, tmp_path):
+        import pytest
+
+        f = self._mk(tmp_path)
+        with pytest.raises(ValueError, match="outside field range"):
+            f.import_values([1, 2], [5, 2000])
+        # nothing applied
+        assert f.value(1) == (0, False)
+        f.close()
