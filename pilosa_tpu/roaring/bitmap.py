@@ -252,6 +252,43 @@ class RoaringBitmap:
         # trim partial edge containers (cheap vs re-slicing per part)
         return ids[(ids >= np.uint64(start)) & (ids < np.uint64(stop))]
 
+    def contains_lows(self, key: int, lows: np.ndarray) -> np.ndarray:
+        """Vectorized membership of uint16 lows in ONE container, probed
+        in place (no decode): ARRAY by searchsorted, BITMAP by word bit
+        test, RUN by interval search."""
+        c = self._containers.get(key)
+        if c is None or c.n == 0:
+            return np.zeros(lows.size, bool)
+        if c.kind == ARRAY:
+            idx = np.searchsorted(c.data, lows)
+            idx_c = np.minimum(idx, c.data.size - 1)
+            return (idx < c.data.size) & (c.data[idx_c] == lows)
+        if c.kind == BITMAP:
+            w = c.data  # uint64 words
+            word = w[(lows >> np.uint16(6)).astype(np.int64)]
+            bit = (lows & np.uint16(63)).astype(np.uint64)
+            return ((word >> bit) & np.uint64(1)).astype(bool)
+        starts = c.data[:, 0]
+        lasts = c.data[:, 1]
+        i = np.searchsorted(starts, lows, side="right") - 1
+        ok = i >= 0
+        i_c = np.maximum(i, 0)
+        return ok & (lows <= lasts[i_c])
+
+    def row_member(self, row: int, positions: np.ndarray) -> np.ndarray:
+        """Vectorized membership of in-shard positions in one row.
+        Probes only the containers the positions land in — O(batch·log)
+        per row, independent of the row's population (the import hot
+        paths must not decode whole rows to clear a handful of bits)."""
+        ids = (np.uint64(row) << np.uint64(20)) + positions
+        his = (ids >> np.uint64(16)).astype(np.int64)
+        lows = (ids & np.uint64(0xFFFF)).astype(np.uint16)
+        out = np.zeros(positions.size, bool)
+        for key in np.unique(his).tolist():
+            m = his == key
+            out[m] = self.contains_lows(int(key), lows[m])
+        return out
+
     # --- mutation (op-log replay + write path) ---
 
     def add_ids(self, ids) -> int:

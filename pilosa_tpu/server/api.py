@@ -18,7 +18,12 @@ from pilosa_tpu.executor import Executor
 from pilosa_tpu.executor.result import result_to_json
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, shard_groups
 from pilosa_tpu.storage import FieldOptions, Holder
-from pilosa_tpu.storage.field import TYPE_INT, TYPE_TIME
+from pilosa_tpu.storage.field import (
+    TYPE_BOOL,
+    TYPE_INT,
+    TYPE_MUTEX,
+    TYPE_TIME,
+)
 from pilosa_tpu.storage.view import VIEW_STANDARD
 
 
@@ -234,6 +239,9 @@ class API:
             raise ApiError("rows and columns must be non-negative")
         if timestamps is not None and len(timestamps) != rows_i.size:
             raise ApiError("timestamps must match rows length")
+        if (fld.options.type == TYPE_BOOL and rows_i.size
+                and rows_i.max() > 1):
+            raise ApiError("bool field rows must be 0 (false) or 1 (true)")
         if not remote and self.cluster is not None and len(self.cluster.nodes) > 1:
             return self._route_import(
                 index, field, rows, columns, timestamps, clear, values=None
@@ -257,15 +265,37 @@ class API:
                     )
                 continue
             frag = fld.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
-            changed += frag.bulk_import(rows[lo:hi], pos)
+            if fld.options.type in (TYPE_MUTEX, TYPE_BOOL):
+                # single-value fields: the mutex-aware path clears each
+                # column's previous row in the same pass — plain
+                # bulk_import would leave columns set in several rows
+                changed += frag.import_mutex(rows[lo:hi], pos)
+            else:
+                changed += frag.bulk_import(rows[lo:hi], pos)
             if ts_sorted is not None and fld.options.type == TYPE_TIME:
+                # group the timestamped bits by quantum VIEW and write
+                # each view's batch with one bulk_import (the standard
+                # view already got them above) — a per-bit set_bit loop
+                # re-walks view creation and re-writes standard per bit
+                from pilosa_tpu.storage.view import views_for_time
+
+                by_view: dict[str, list] = {}
                 for j, ts in enumerate(ts_sorted[lo:hi]):
-                    if ts:
-                        fld.set_bit(
-                            int(rows[lo + j]),
-                            int(columns[lo + j]),
-                            timestamp=_parse_ts(ts),
-                        )
+                    if not ts:
+                        continue
+                    for vname in views_for_time(
+                        VIEW_STANDARD, fld.options.time_quantum,
+                        _parse_ts(ts),
+                    ):
+                        by_view.setdefault(vname, []).append(lo + j)
+                for vname, idxs in by_view.items():
+                    sel = np.asarray(idxs, np.int64)
+                    vfrag = fld.view(vname, create=True).fragment(
+                        shard, create=True
+                    )
+                    vfrag.bulk_import(
+                        rows[sel], columns[sel] & np.uint64(SHARD_WIDTH - 1)
+                    )
         if not clear:
             idx.mark_columns_exist(columns)
             if self.cluster is not None:
@@ -305,7 +335,15 @@ class API:
                     timestamps=pick(list(timestamps), li) if timestamps else None,
                     clear=clear, remote=True,
                 )
-            bulk_roaring = timestamps is None and not clear
+            # mutex/bool batches must NOT ride the roaring route: its
+            # receiver unions blindly, so a remote replica would keep a
+            # column's previous row set (single-value invariant broken,
+            # replicas diverged) while the local replica cleared it via
+            # import_mutex — ship them as import_bits so the remote end
+            # re-runs the mutex-aware path
+            fld_type = self._field(self._index(index), field).options.type
+            bulk_roaring = (timestamps is None and not clear
+                            and fld_type not in (TYPE_MUTEX, TYPE_BOOL))
             for node, idxs in remote_batches.values():
                 if bulk_roaring:
                     # plain set-bit batches ship as per-shard roaring

@@ -21,6 +21,7 @@ from __future__ import annotations
 import datetime as dt
 import json
 import os
+import threading
 
 from pilosa_tpu.shardwidth import position, shard_of
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE
@@ -111,6 +112,9 @@ class Field:
         self.name = name
         self.options = options or FieldOptions()
         self.views: dict[str, View] = {}
+        # serializes first-write view creation (see View._create_lock:
+        # unlocked check-then-create loses concurrent writers' bits)
+        self._create_lock = threading.Lock()
         self.row_attrs = None  # AttrStore, opened in open()
 
     # ------------------------------------------------------------- lifecycle
@@ -160,15 +164,18 @@ class Field:
     def view(self, name: str, create: bool = False) -> View | None:
         v = self.views.get(name)
         if v is None and create:
-            v = View(
-                os.path.join(self.path, "views", name),
-                self.index,
-                self.name,
-                name,
-                cache_type=self.options.cache_type,
-                cache_size=self.options.cache_size,
-            ).open()
-            self.views[name] = v
+            with self._create_lock:
+                v = self.views.get(name)
+                if v is None:
+                    v = View(
+                        os.path.join(self.path, "views", name),
+                        self.index,
+                        self.name,
+                        name,
+                        cache_type=self.options.cache_type,
+                        cache_size=self.options.cache_size,
+                    ).open()
+                    self.views[name] = v
         return v
 
     def bsi_view_name(self) -> str:

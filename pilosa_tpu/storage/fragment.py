@@ -284,6 +284,68 @@ class Fragment:
                 self._after_rows_added(rows, positions)
             return changed
 
+    def import_mutex(self, rows: np.ndarray, positions: np.ndarray) -> int:
+        """Mutex-aware bulk import (reference fragment.bulkImportMutex —
+        SURVEY.md §3.3): each imported column's previous row clears in
+        the same locked pass, preserving the single-value invariant that
+        plain ``bulk_import`` would silently break. Duplicate positions
+        keep the LAST row (sequential set_bit semantics). Returns the
+        number of columns whose bit was newly added (a moved column
+        counts once; a no-op re-set counts zero — matching set_bit)."""
+        rows = np.asarray(rows, np.uint64)
+        positions = np.asarray(positions, np.uint64)
+        if rows.shape != positions.shape:
+            raise ValueError("rows and positions must have identical shape")
+        if positions.size == 0:
+            return 0
+        if int(positions.max()) >= SHARD_WIDTH:
+            raise ValueError("position out of shard range")
+        rev = positions[::-1]
+        _, first_in_rev = np.unique(rev, return_index=True)
+        keep = np.sort(positions.size - 1 - first_in_rev)
+        rows, positions = rows[keep], positions[keep]
+        with self.lock:
+            member_cache: dict = {}
+
+            def member(r: int) -> np.ndarray:
+                m = member_cache.get(r)
+                if m is None:
+                    m = self.bitmap.row_member(r, positions)
+                    member_cache[r] = m
+                return m
+
+            add_parts: list = []
+            rem_parts: list = []
+            rows_added: list = []
+            rows_removed: list = []
+            for r in self.row_ids():
+                rem_m = member(r) & (rows != np.uint64(r))
+                if rem_m.any():
+                    p = positions[rem_m]
+                    rem_parts.append((np.uint64(r) << np.uint64(20)) + p)
+                    rows_removed.append((int(r), p))
+            changed = 0
+            for r in np.unique(rows).tolist():
+                add_m = (rows == np.uint64(r)) & ~member(int(r))
+                if add_m.any():
+                    p = positions[add_m]
+                    add_parts.append((np.uint64(r) << np.uint64(20)) + p)
+                    rows_added.append((int(r), p))
+                    changed += int(add_m.sum())
+            if add_parts:
+                ids = np.sort(np.concatenate(add_parts))
+                self.bitmap.add_ids(ids)
+                self._log_op(OP_ADD, ids)
+            if rem_parts:
+                ids = np.sort(np.concatenate(rem_parts))
+                self.bitmap.remove_ids(ids)
+                self._log_op(OP_REMOVE, ids)
+            for r, p in rows_added:
+                self._after_row_write(r, positions=p, added=True)
+            for r, p in rows_removed:
+                self._after_row_write(r, positions=p, added=False)
+            return changed
+
     def import_bsi(self, positions: np.ndarray, stored: np.ndarray,
                    bit_depth: int, exists_row: int = 0,
                    offset_row: int = 2) -> int:
@@ -302,14 +364,7 @@ class Fragment:
         with self.lock:
 
             def member(row: int) -> np.ndarray:
-                base = row << 20
-                cols = self.bitmap.range_ids(base, base + SHARD_WIDTH)
-                if cols.size == 0:
-                    return np.zeros(positions.size, bool)
-                cols = cols - np.uint64(base)
-                idx = np.searchsorted(cols, positions)
-                idx_c = np.minimum(idx, cols.size - 1)
-                return (idx < cols.size) & (cols[idx_c] == positions)
+                return self.bitmap.row_member(row, positions)
 
             add_parts: list = []
             rem_parts: list = []

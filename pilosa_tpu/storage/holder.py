@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 
 from pilosa_tpu.storage.index import Index, _validate_name
 from pilosa_tpu.storage.translate import TranslateStore
@@ -18,6 +19,7 @@ class Holder:
     def __init__(self, data_dir: str):
         self.data_dir = os.path.expanduser(data_dir)
         self.indexes: dict[str, Index] = {}
+        self._create_lock = threading.Lock()
         self.translate: TranslateStore | None = None
         self._open = False
 
@@ -41,15 +43,16 @@ class Holder:
         self._open = False
 
     def create_index(self, name: str, keys: bool = False, track_existence: bool = True) -> Index:
-        if name in self.indexes:
-            raise ValueError(f"index {name!r} already exists")
-        _validate_name(name)
-        idx = Index(
-            os.path.join(self.data_dir, name), name, keys=keys,
-            track_existence=track_existence,
-        ).open()
-        self.indexes[name] = idx
-        return idx
+        with self._create_lock:
+            if name in self.indexes:
+                raise ValueError(f"index {name!r} already exists")
+            _validate_name(name)
+            idx = Index(
+                os.path.join(self.data_dir, name), name, keys=keys,
+                track_existence=track_existence,
+            ).open()
+            self.indexes[name] = idx
+            return idx
 
     def index(self, name: str) -> Index | None:
         return self.indexes.get(name)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu.storage.field import Field, FieldOptions, TYPE_SET
@@ -26,6 +27,9 @@ class Index:
         self.keys = keys
         self.track_existence = track_existence
         self.fields: dict[str, Field] = {}
+        # serializes field creation (implicit creation via Store() can
+        # race under the threaded server; see View._create_lock)
+        self._create_lock = threading.Lock()
         self.column_attrs = None  # AttrStore, opened in open()
         # schema epoch: bumped on field create/delete so cached query
         # plans (executor._plan_cache) revalidate with one int compare
@@ -70,13 +74,16 @@ class Index:
     # ---------------------------------------------------------------- fields
 
     def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
-        if name in self.fields:
-            raise ValueError(f"field {name!r} already exists")
-        _validate_name(name, allow_internal=name == EXISTENCE_FIELD)
-        field = Field(os.path.join(self.path, name), self.name, name, options).open()
-        self.fields[name] = field
-        self.plan_epoch += 1
-        return field
+        with self._create_lock:
+            if name in self.fields:
+                raise ValueError(f"field {name!r} already exists")
+            _validate_name(name, allow_internal=name == EXISTENCE_FIELD)
+            field = Field(
+                os.path.join(self.path, name), self.name, name, options
+            ).open()
+            self.fields[name] = field
+            self.plan_epoch += 1
+            return field
 
     def field(self, name: str) -> Field | None:
         return self.fields.get(name)

@@ -1253,3 +1253,43 @@ class TestFragmentNodesRoute:
         finally:
             for s in servers:
                 s.close()
+
+
+class TestMutexImportRouting:
+    def test_clustered_mutex_import_preserves_single_value(self, tmp_path):
+        """Routed mutex imports must NOT ride the roaring union route:
+        the receiver would keep a column's previous row set while the
+        sender's replica cleared it — replica divergence plus a broken
+        single-value invariant on the remote owner."""
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/m",
+                {"options": {"type": "mutex"}})
+            cols = [s * SHARD_WIDTH + 5 for s in range(6)]
+            req("POST", f"{uri(servers[0])}/index/i/field/m/import",
+                {"rows": [1] * len(cols), "columns": cols})
+            # re-import the same columns under a DIFFERENT row via a
+            # different node: every replica must move them, not union
+            req("POST", f"{uri(servers[1])}/index/i/field/m/import",
+                {"rows": [2] * len(cols), "columns": cols})
+            for s in servers:
+                url = f"{uri(s)}/index/i/query"
+                out = req("POST", url, b"Count(Row(m=1))")
+                assert out == {"results": [0]}, s.config.name
+                out = req("POST", url, b"Row(m=2)")
+                assert out["results"][0]["columns"] == cols, s.config.name
+            # and the fragments themselves agree on every replica
+            for s in servers:
+                f = s.holder.index("i").field("m")
+                view = f.view("standard")
+                if view is None:
+                    continue
+                for shard in range(6):
+                    frag = view.fragment(shard)
+                    if frag is None:
+                        continue
+                    assert not frag.contains(1, 5), (s.config.name, shard)
+        finally:
+            for s in servers:
+                s.close()

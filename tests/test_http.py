@@ -359,3 +359,30 @@ def test_fragment_nodes_route(node):
     req("POST", f"{node}/index/i", {})
     out = req("GET", f"{node}/internal/fragment/nodes?index=i&shard=3")
     assert isinstance(out, list) and out and "uri" in out[0]
+
+
+def test_import_with_timestamps_lands_in_time_views(node):
+    """Timestamped bulk import writes the standard view AND each quantum
+    view (batched per view, not per bit); Row(from=, to=) sees them."""
+    req("POST", f"{node}/index/t", {})
+    req("POST", f"{node}/index/t/field/ev",
+        {"options": {"type": "time", "timeQuantum": "YMD"}})
+    out = req("POST", f"{node}/index/t/field/ev/import", {
+        "rows": [1, 1, 1, 2],
+        "columns": [10, 11, 12, 10],
+        "timestamps": ["2019-01-15T00:00", "2019-03-02T00:00",
+                       None, "2019-01-15T00:00"],
+    })
+    assert out["changed"] == 4
+    out = req("POST", f"{node}/index/t/query", b"Row(ev=1)")
+    assert out["results"][0]["columns"] == [10, 11, 12]
+    out = req("POST", f"{node}/index/t/query",
+              b"Row(ev=1, from='2019-01-01T00:00', to='2019-02-01T00:00')")
+    assert out["results"][0]["columns"] == [10]
+    out = req("POST", f"{node}/index/t/query",
+              b"Row(ev=1, from='2019-01-01T00:00', to='2019-12-31T00:00')")
+    assert out["results"][0]["columns"] == [10, 11]
+    # the un-timestamped bit exists only in standard
+    out = req("POST", f"{node}/index/t/query",
+              b"Row(ev=2, from='2019-01-01T00:00', to='2019-02-01T00:00')")
+    assert out["results"][0]["columns"] == [10]

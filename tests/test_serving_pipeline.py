@@ -360,6 +360,27 @@ class TestHTTPServing:
             for s in servers:
                 s.close()
 
+    def test_concurrent_first_writes_create_one_fragment(self, tmp_path):
+        """Concurrent FIRST writes into brand-new shards/views must all
+        land in one Fragment per path: the old unlocked check-then-create
+        handed racing writer threads distinct Fragment objects for the
+        same file and silently dropped the losers' acknowledged bits
+        (reproduced ~1-in-10 under the mixed-traffic load test)."""
+        servers = make_cluster(tmp_path, 1, use_mesh=False)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/g", {})
+            url = f"{uri(servers[0])}/index/i/query"
+            for round_ in range(6):  # fresh shards each round
+                base = (50 + round_) * SHARD_WIDTH
+                ops = [f"Set({base + k}, g={round_})" for k in range(12)]
+                out = self._concurrent(url, ops)
+                assert all(r == {"results": [True]} for r in out), out
+                final = req("POST", url, f"Count(Row(g={round_}))".encode())
+                assert final == {"results": [12]}, (round_, final)
+        finally:
+            servers[0].close()
+
     def test_pipeline_disabled_fallback(self, tmp_path):
         servers = make_cluster(tmp_path, 1, use_mesh=False)
         try:

@@ -429,3 +429,54 @@ class TestBatchedBSIImport:
         # nothing applied
         assert f.value(1) == (0, False)
         f.close()
+
+
+class TestMutexBulkImport:
+    def test_import_clears_previous_rows(self, tmp_path):
+        """Bulk import into a mutex field preserves the single-value
+        invariant: each imported column's previous row is cleared
+        (reference bulkImportMutex). Previously plain bulk_import left
+        columns set in SEVERAL rows."""
+        import numpy as np
+
+        from pilosa_tpu.storage.field import Field
+
+        f = Field(str(tmp_path / "m"), "i", "m",
+                  FieldOptions(type="mutex")).open()
+        frag = f.view("standard", create=True).fragment(0, create=True)
+        for col, row in [(5, 1), (6, 1), (7, 2)]:
+            f.set_bit(row, col)
+        # move 5 -> row 2, keep 6, add 8 -> row 3; duplicate col 9 keeps last
+        changed = frag.import_mutex(
+            np.array([2, 1, 3, 1, 2], np.uint64),
+            np.array([5, 6, 8, 9, 9], np.uint64),
+        )
+        assert changed == 3  # 5 moved, 8 new, 9 new (6 was a no-op)
+        got = {r: frag.row_columns(r).tolist() for r in frag.row_ids()}
+        got = {r: c for r, c in got.items() if c}
+        assert got == {1: [6], 2: [5, 7, 9], 3: [8]}
+        f.close()
+
+    def test_api_routes_mutex_and_bool_imports(self, tmp_path):
+        from pilosa_tpu.server.api import API, ApiError
+
+        holder = Holder(str(tmp_path / "h")).open()
+        idx = holder.create_index("i")
+        idx.create_field("m", FieldOptions(type="mutex"))
+        idx.create_field("b", FieldOptions(type="bool"))
+        api = API(holder)
+        from pilosa_tpu.executor import Executor
+
+        ex = Executor(holder)
+        ex.execute("i", "Set(5, m=1)")
+        api.import_bits("i", "m", [2], [5])
+        assert ex.execute("i", "Row(m=1)")[0].columns().tolist() == []
+        assert ex.execute("i", "Row(m=2)")[0].columns().tolist() == [5]
+        api.import_bits("i", "b", [1, 0, 1], [10, 11, 10])
+        assert ex.execute("i", "Row(b=true)")[0].columns().tolist() == [10]
+        assert ex.execute("i", "Row(b=false)")[0].columns().tolist() == [11]
+        import pytest
+
+        with pytest.raises(ApiError, match="bool field rows"):
+            api.import_bits("i", "b", [2], [12])
+        holder.close()
