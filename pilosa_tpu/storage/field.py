@@ -144,7 +144,7 @@ class Field:
         return self
 
     def close(self) -> None:
-        for v in self.views.values():
+        for v in list(self.views.values()):
             v.close()
         if self.row_attrs is not None:
             self.row_attrs.close()
@@ -183,7 +183,7 @@ class Field:
 
     def available_shards(self) -> list[int]:
         shards: set[int] = set()
-        for v in self.views.values():
+        for v in list(self.views.values()):
             shards.update(v.available_shards())
         return sorted(shards)
 
@@ -213,7 +213,7 @@ class Field:
     def clear_bit(self, row: int, column: int) -> bool:
         shard, pos = shard_of(column), position(column)
         changed = False
-        for v in self.views.values():
+        for v in list(self.views.values()):
             if v.name == self.bsi_view_name():
                 continue
             frag = v.fragment(shard)

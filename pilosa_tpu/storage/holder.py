@@ -36,7 +36,7 @@ class Holder:
         return self
 
     def close(self) -> None:
-        for idx in self.indexes.values():
+        for idx in list(self.indexes.values()):
             idx.close()
         if self.translate:
             self.translate.close()
@@ -65,4 +65,4 @@ class Holder:
         shutil.rmtree(idx.path, ignore_errors=True)
 
     def schema(self) -> list[dict]:
-        return [idx.schema() for _, idx in sorted(self.indexes.items())]
+        return [idx.schema() for _, idx in sorted(list(self.indexes.items()))]

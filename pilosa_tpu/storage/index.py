@@ -62,7 +62,7 @@ class Index:
         return self
 
     def close(self) -> None:
-        for f in self.fields.values():
+        for f in list(self.fields.values()):
             f.close()
         if self.column_attrs is not None:
             self.column_attrs.close()
@@ -98,7 +98,7 @@ class Index:
         self._shards_memo = None  # deletes can shrink the shard set
 
     def public_fields(self) -> list[Field]:
-        return [f for n, f in sorted(self.fields.items()) if not n.startswith("_")]
+        return [f for n, f in sorted(list(self.fields.items())) if not n.startswith("_")]
 
     # ------------------------------------------------------------- existence
 
@@ -143,14 +143,14 @@ class Index:
         the memo in O(fields x views). The per-query set-union + sort
         otherwise shows up on the pipelined submit path."""
         n_frags = 0
-        for f in self.fields.values():
+        for f in list(self.fields.values()):
             for v in f.views.values():
                 n_frags += len(v.fragments)
         memo = self._shards_memo
         if memo is not None and memo[0] == n_frags:
             return memo[1]
         shards: set[int] = set()
-        for f in self.fields.values():
+        for f in list(self.fields.values()):
             shards.update(f.available_shards())
         out = sorted(shards)
         self._shards_memo = (n_frags, out)
