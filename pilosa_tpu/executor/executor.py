@@ -1738,6 +1738,27 @@ _BITMAP_CALLS = {
     "Range",
 }
 
+# Call types whose submit() ENQUEUES device work without blocking —
+# the only ones a serving pipeline should coalesce. Everything else
+# (Rows and other host-eager reads) evaluates fully inside submit(), so
+# routing it through a single dispatcher thread would serialize work
+# that N handler threads previously overlapped.
+_PIPELINED_CALLS = (
+    {"Count", "Sum", "Min", "Max", "TopN", "GroupBy"} | _BITMAP_CALLS
+)
+
+
+def pipeline_coalescable(query) -> bool:
+    """True when every call in the query micro-batches under submit()
+    (Options unwraps to its child for the purpose)."""
+    def one(call) -> bool:
+        if call.name == "Options":
+            return bool(call.children) and one(call.children[0])
+        return call.name in _PIPELINED_CALLS
+
+    calls = getattr(query, "calls", None)
+    return calls is not None and all(one(c) for c in calls)
+
 
 def _index_cross(cand: np.ndarray, n: int) -> np.ndarray:
     """Extend candidate index tuples [P, k] by every index of the next

@@ -931,7 +931,7 @@ class Cluster:
             stores = [("", idx.column_attrs)]
             stores += [
                 (fname, f.row_attrs)
-                for fname, f in idx.fields.items()
+                for fname, f in list(idx.fields.items())
                 if f.row_attrs is not None
             ]
             for field_name, store in stores:

@@ -85,12 +85,16 @@ class API:
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
                 kwargs["remote"] = remote
-            # Read-only requests ride the coalescing pipeline (waves of
-            # concurrent requests share micro-batched dispatches — see
-            # server/pipeline.py); requests carrying writes keep the
-            # eager path so write routing/broadcast semantics and
-            # request-thread concurrency are unchanged.
+            # Read-only MICRO-BATCHABLE requests ride the coalescing
+            # pipeline (waves of concurrent requests share device
+            # dispatches — see server/pipeline.py). Requests carrying
+            # writes, and host-eager reads (Rows etc.) that submit()
+            # would evaluate fully on the dispatcher thread, keep the
+            # eager path so request-thread concurrency is unchanged.
+            from pilosa_tpu.executor.executor import pipeline_coalescable
+
             if (writes == 0 and self.serve_pipelined
+                    and pipeline_coalescable(query)
                     and hasattr(self.executor, "submit")):
                 if self._pipeline is None:
                     with self._pipeline_lock:

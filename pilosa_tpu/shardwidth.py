@@ -49,3 +49,16 @@ def shard_groups(columns):
         ([0], np.nonzero(np.diff(shards_sorted))[0] + 1, [cols.size])
     )
     return order, bounds, shards_sorted
+
+
+def keep_last_unique(keys):
+    """Sorted indices selecting the LAST occurrence of each unique key —
+    the sequential last-write-wins semantics batched writes must match
+    (np.unique keeps the FIRST, so dedupe the reversed array and map the
+    indices back). Shared by Field.import_values and
+    Fragment.import_mutex."""
+    import numpy as np
+
+    keys = np.asarray(keys)
+    _, first_in_rev = np.unique(keys[::-1], return_index=True)
+    return np.sort(keys.size - 1 - first_in_rev)

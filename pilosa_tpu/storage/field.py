@@ -252,7 +252,11 @@ class Field:
         of columns whose value changed."""
         import numpy as np
 
-        from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_groups
+        from pilosa_tpu.shardwidth import (
+            SHARD_WIDTH,
+            keep_last_unique,
+            shard_groups,
+        )
 
         if self.options.type != TYPE_INT:
             raise ValueError("import_values on non-int field")
@@ -267,11 +271,7 @@ class Field:
                 f"value {v} outside field range "
                 f"[{self.options.min}, {self.options.max}]"
             )
-        # keep-last dedupe: np.unique keeps the FIRST occurrence, so
-        # dedupe the reversed array and map indices back
-        rev_cols = columns[::-1]
-        _, first_in_rev = np.unique(rev_cols, return_index=True)
-        keep = np.sort(columns.size - 1 - first_in_rev)
+        keep = keep_last_unique(columns)
         columns, values = columns[keep], values[keep]
         stored = (values - self.options.base).astype(np.uint64)
         view = self.view(self.bsi_view_name(), create=True)

@@ -29,7 +29,7 @@ from pilosa_tpu.roaring.format import (
     replay_ops,
     serialize,
 )
-from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.shardwidth import SHARD_WIDTH, keep_last_unique
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
 
@@ -300,9 +300,7 @@ class Fragment:
             return 0
         if int(positions.max()) >= SHARD_WIDTH:
             raise ValueError("position out of shard range")
-        rev = positions[::-1]
-        _, first_in_rev = np.unique(rev, return_index=True)
-        keep = np.sort(positions.size - 1 - first_in_rev)
+        keep = keep_last_unique(positions)
         rows, positions = rows[keep], positions[keep]
         with self.lock:
             member_cache: dict = {}

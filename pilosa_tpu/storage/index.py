@@ -144,7 +144,7 @@ class Index:
         otherwise shows up on the pipelined submit path."""
         n_frags = 0
         for f in list(self.fields.values()):
-            for v in f.views.values():
+            for v in list(f.views.values()):
                 n_frags += len(v.fragments)
         memo = self._shards_memo
         if memo is not None and memo[0] == n_frags:
