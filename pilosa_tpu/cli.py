@@ -235,9 +235,9 @@ def cmd_inspect(args) -> int:
     holder = Holder(args.data_dir).open()
     kind_names = {ARRAY: "array", BITMAP: "bitmap", RUN: "run"}
     for iname, idx in sorted(holder.indexes.items()):
-        for fname, field in sorted(list(idx.fields.items())):
-            for vname, view in sorted(list(field.views.items())):
-                for shard, frag in sorted(list(view.fragments.items())):
+        for fname, field in sorted(idx.fields.items()):
+            for vname, view in sorted(field.views.items()):
+                for shard, frag in sorted(view.fragments.items()):
                     kinds = {"array": 0, "bitmap": 0, "run": 0}
                     for key in frag.bitmap.keys:
                         kinds[kind_names[frag.bitmap.container(key).kind]] += 1

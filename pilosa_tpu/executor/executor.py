@@ -311,24 +311,32 @@ class Executor:
             query = parse(query)
         elif isinstance(query, Call):
             query = Query([query])
-        out = []
-        for call in query.calls:
-            if call.name == "Count":
-                out.append(self._submit_count(idx, call, shards, pipeline=True))
-            elif call.name in ("Sum", "Min", "Max"):
-                out.append(self._submit_bsi_aggregate(idx, call, shards,
-                                                       pipeline=True))
-            elif call.name == "TopN":
-                out.append(self._submit_topn(idx, call, shards, pipeline=True))
-            elif call.name == "GroupBy":
-                out.append(self._submit_groupby(idx, call, shards,
-                                                pipeline=True))
-            elif call.name in _BITMAP_CALLS:
-                out.append(self._submit_bitmap(idx, call, shards,
-                                               pipeline=True))
-            else:
-                out.append(Deferred(value=self._execute_call(idx, call, shards)))
-        return out
+        return [self._submit_one(idx, call, shards) for call in query.calls]
+
+    def _submit_one(self, idx: Index, call: Call, shards=None) -> "Deferred":
+        if call.name == "Count":
+            return self._submit_count(idx, call, shards, pipeline=True)
+        if call.name in ("Sum", "Min", "Max"):
+            return self._submit_bsi_aggregate(idx, call, shards,
+                                              pipeline=True)
+        if call.name == "TopN":
+            return self._submit_topn(idx, call, shards, pipeline=True)
+        if call.name == "GroupBy":
+            return self._submit_groupby(idx, call, shards, pipeline=True)
+        if call.name in _BITMAP_CALLS:
+            return self._submit_bitmap(idx, call, shards, pipeline=True)
+        if call.name == "Options" and call.children:
+            # unwrap so the CHILD pipelines (a serving wave of
+            # Options-wrapped Counts must coalesce, not evaluate eagerly
+            # on the dispatcher); result options apply at resolve time
+            inner = self._submit_one(
+                idx, options_child(call),
+                options_restrict_shards(call, shards),
+            )
+            return Deferred(
+                lambda: apply_options_result(idx, call, inner.result())
+            )
+        return Deferred(value=self._execute_call(idx, call, shards))
 
     def _execute_call(self, idx: Index, call: Call, shards=None):
         name = call.name

@@ -909,7 +909,18 @@ class Cluster:
                         if bm.count():
                             if frag is None:
                                 frag = view.fragment(shard, create=True)
-                            added = frag.import_roaring_bitmap(bm)
+                            if field.options.type in ("mutex", "bool"):
+                                # single-value fields: union repair would
+                                # resurrect rows a newer import cleared;
+                                # conflicting columns keep the local row
+                                added = frag.add_ids_mutex(bm.to_ids())
+                            elif view_name.startswith("bsig_"):
+                                # BSI planes: per-column all-or-nothing —
+                                # unioning stale planes into a newer
+                                # value would fabricate values
+                                added = frag.add_ids_value(bm.to_ids())
+                            else:
+                                added = frag.import_roaring_bitmap(bm)
                             if added:
                                 repaired["bits"] += added
                                 repaired["fragments"] += 1

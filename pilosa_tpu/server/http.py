@@ -395,8 +395,8 @@ class HTTPHandler(BaseHTTPRequestHandler):
         index = (query.get("index") or [""])[0]
         idx = self.api._index(index)
         out = []
-        for fname, fld in sorted(list(idx.fields.items())):
-            for vname, view in sorted(list(fld.views.items())):
+        for fname, fld in sorted(idx.fields.items()):
+            for vname, view in sorted(fld.views.items()):
                 for shard in sorted(view.fragments):
                     out.append({"field": fname, "view": vname, "shard": shard})
         self._json({"fragments": out})

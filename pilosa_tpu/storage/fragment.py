@@ -29,7 +29,11 @@ from pilosa_tpu.roaring.format import (
     replay_ops,
     serialize,
 )
-from pilosa_tpu.shardwidth import SHARD_WIDTH, keep_last_unique
+from pilosa_tpu.shardwidth import (
+    SHARD_WIDTH,
+    SHARD_WIDTH_EXP,
+    keep_last_unique,
+)
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
 
@@ -417,6 +421,46 @@ class Fragment:
     def import_roaring_bitmap(self, other) -> int:
         """Union an already-parsed RoaringBitmap into this fragment."""
         return self.add_ids(other.to_ids())
+
+    def add_ids_mutex(self, ids) -> int:
+        """Anti-entropy repair into a SINGLE-VALUE field's fragment: add
+        only bits for columns not already set in a different row locally.
+        A pure union would resurrect rows a newer import cleared,
+        breaking the mutex invariant on this replica; conflicting
+        columns keep the LOCAL row (each replica stays self-consistent,
+        and the divergence heals on the next write to the column, which
+        clears other rows on every replica)."""
+        ids = np.asarray(ids, np.uint64)
+        if ids.size == 0:
+            return 0
+        # incoming duplicates for one column (a peer already holding a
+        # double-set) collapse to one candidate row
+        pos = ids & np.uint64(SHARD_WIDTH - 1)
+        ids = ids[keep_last_unique(pos)]
+        pos = ids & np.uint64(SHARD_WIDTH - 1)
+        rows = ids >> np.uint64(SHARD_WIDTH_EXP)
+        with self.lock:
+            keep = np.ones(ids.size, bool)
+            for r in self.row_ids():
+                local = self.bitmap.row_member(r, pos)
+                keep &= ~(local & (rows != np.uint64(r)))
+            ids = ids[keep]
+            return self.add_ids(ids) if ids.size else 0
+
+    def add_ids_value(self, ids, exists_row: int = 0) -> int:
+        """Anti-entropy repair into a BSI fragment: per COLUMN
+        all-or-nothing. A column whose exists bit is set locally keeps
+        its whole local value — unioning a peer's stale planes into a
+        newer value would splice together a value no client ever wrote.
+        Columns absent locally adopt the peer's planes wholesale."""
+        ids = np.asarray(ids, np.uint64)
+        if ids.size == 0:
+            return 0
+        pos = ids & np.uint64(SHARD_WIDTH - 1)
+        with self.lock:
+            local_exists = self.bitmap.row_member(exists_row, pos)
+            ids = ids[~local_exists]
+            return self.add_ids(ids) if ids.size else 0
 
     def add_ids(self, ids) -> int:
         """Union raw bit ids under the fragment lock (import-roaring,

@@ -65,4 +65,4 @@ class Holder:
         shutil.rmtree(idx.path, ignore_errors=True)
 
     def schema(self) -> list[dict]:
-        return [idx.schema() for _, idx in sorted(list(self.indexes.items()))]
+        return [idx.schema() for _, idx in sorted(self.indexes.items())]

@@ -98,7 +98,7 @@ class Index:
         self._shards_memo = None  # deletes can shrink the shard set
 
     def public_fields(self) -> list[Field]:
-        return [f for n, f in sorted(list(self.fields.items())) if not n.startswith("_")]
+        return [f for n, f in sorted(self.fields.items()) if not n.startswith("_")]
 
     # ------------------------------------------------------------- existence
 

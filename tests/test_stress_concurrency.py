@@ -51,6 +51,9 @@ def test_writers_queries_antientropy_snapshot(cluster2):
     base = [f"http://localhost:{s.port}" for s in servers]
     req("POST", f"{base[0]}/index/i", {})
     req("POST", f"{base[0]}/index/i/field/f", {})
+    req("POST", f"{base[0]}/index/i/field/v",
+        {"options": {"type": "int", "min": 0, "max": 100000}})
+    req("POST", f"{base[0]}/index/i/field/m", {"options": {"type": "mutex"}})
 
     errors: list[BaseException] = []
     stop = threading.Event()
@@ -76,6 +79,35 @@ def test_writers_queries_antientropy_snapshot(cluster2):
                 cols = [c if c % 2 else c + SHARD_WIDTH for c in cols]
                 req("POST", f"{base[b % 2]}/index/i/field/f/import",
                     {"rows": [1] * len(cols), "columns": cols})
+                if stop.is_set():
+                    return
+        return go
+
+    # batched BSI imports racing everything else: writer-disjoint column
+    # ranges at a fixed offset; value = writer*100+batch (exact oracle)
+    BSI_BASE = 4 * SHARD_WIDTH
+
+    def bsi_writer(w: int):
+        def go():
+            for b in range(BATCHES_PER_WRITER):
+                lo = BSI_BASE + (w * BATCHES_PER_WRITER + b) * 50
+                cols = list(range(lo, lo + 50))
+                req("POST", f"{base[b % 2]}/index/i/field/v/import-value",
+                    {"columns": cols, "values": [w * 100 + b] * 50})
+                if stop.is_set():
+                    return
+        return go
+
+    # mutex imports: each writer owns a column range and re-imports it
+    # under successive rows; the LAST batch's row must win everywhere
+    MUTEX_BASE = 6 * SHARD_WIDTH
+
+    def mutex_writer(w: int):
+        def go():
+            cols = list(range(MUTEX_BASE + w * 100, MUTEX_BASE + w * 100 + 100))
+            for b in range(BATCHES_PER_WRITER):
+                req("POST", f"{base[b % 2]}/index/i/field/m/import",
+                    {"rows": [b % 3] * len(cols), "columns": cols})
                 if stop.is_set():
                     return
         return go
@@ -126,6 +158,10 @@ def test_writers_queries_antientropy_snapshot(cluster2):
                     frag.snapshot()
 
     writers = [threading.Thread(target=guard(writer(w))) for w in range(N_WRITERS)]
+    writers += [threading.Thread(target=guard(bsi_writer(w)))
+                for w in range(2)]
+    writers += [threading.Thread(target=guard(mutex_writer(w)))
+                for w in range(2)]
     aux = [threading.Thread(target=guard(fn), daemon=True)
            for fn in (querier, pipelined_submitter, anti_entropy,
                       snapshotter)]
@@ -143,7 +179,29 @@ def test_writers_queries_antientropy_snapshot(cluster2):
     for s in servers:
         s.api.cluster.sync_holder()
     want = N_WRITERS * BATCHES_PER_WRITER * BITS_PER_BATCH
+    bsi_want_cols = 2 * BATCHES_PER_WRITER * 50
+    bsi_want_sum = sum(
+        (w * 100 + b) * 50
+        for w in range(2) for b in range(BATCHES_PER_WRITER)
+    )
+    final_row = (BATCHES_PER_WRITER - 1) % 3
     for b in base:
         out = req("POST", f"{b}/index/i/query", b"Count(Row(f=1))",
                   "text/plain")
         assert out["results"] == [want]
+        out = req("POST", f"{b}/index/i/query", b'Sum(field="v")',
+                  "text/plain")
+        assert out["results"][0] == {
+            "value": bsi_want_sum, "count": bsi_want_cols,
+        }
+        # single-value invariant held on every replica: each mutex
+        # column sits in exactly its LAST imported row
+        out = req("POST", f"{b}/index/i/query",
+                  f"Count(Row(m={final_row}))".encode(), "text/plain")
+        assert out["results"] == [200]
+        for other in range(3):
+            if other == final_row:
+                continue
+            out = req("POST", f"{b}/index/i/query",
+                      f"Count(Row(m={other}))".encode(), "text/plain")
+            assert out["results"] == [0], other
