@@ -873,6 +873,13 @@ class TestClusterRaces:
         NORMAL or peers stay gated forever."""
         servers = make_cluster(tmp_path, 2)
         try:
+            # let the join-time background fetch settle first: while a
+            # local fetch job is in flight, _command_state correctly
+            # DEFERS a NORMAL command (the job's completion restores it),
+            # so injecting the scenario early makes the final assert race
+            # the join job rather than test the failover path
+            for s in servers:
+                assert s.api.cluster.wait_until_normal(30)
             coord = next(s for s in servers
                          if s.api.cluster.is_acting_coordinator)
             # simulate the dead coordinator's last act reaching only the
