@@ -914,7 +914,7 @@ class Cluster:
                                 # resurrect rows a newer import cleared;
                                 # conflicting columns keep the local row
                                 added = frag.add_ids_mutex(bm.to_ids())
-                            elif view_name.startswith("bsig_"):
+                            elif view_name == field.bsi_view_name():
                                 # BSI planes: per-column all-or-nothing —
                                 # unioning stale planes into a newer
                                 # value would fabricate values

@@ -334,19 +334,28 @@ class Fragment:
                     add_parts.append((np.uint64(r) << np.uint64(20)) + p)
                     rows_added.append((int(r), p))
                     changed += int(add_m.sum())
-            if add_parts:
-                ids = np.sort(np.concatenate(add_parts))
-                self.bitmap.add_ids(ids)
-                self._log_op(OP_ADD, ids)
-            if rem_parts:
-                ids = np.sort(np.concatenate(rem_parts))
-                self.bitmap.remove_ids(ids)
-                self._log_op(OP_REMOVE, ids)
-            for r, p in rows_added:
-                self._after_row_write(r, positions=p, added=True)
-            for r, p in rows_removed:
-                self._after_row_write(r, positions=p, added=False)
+            self._apply_batch_locked(add_parts, rem_parts,
+                                     rows_added, rows_removed)
             return changed
+
+    def _apply_batch_locked(self, add_parts, rem_parts,
+                            rows_added, rows_removed) -> None:
+        """Shared tail of the batched import paths (caller holds the
+        fragment lock): one sorted add pass + one sorted remove pass,
+        each logged as a single op record, then per-row residency/cache
+        bookkeeping."""
+        if add_parts:
+            ids = np.sort(np.concatenate(add_parts))
+            self.bitmap.add_ids(ids)
+            self._log_op(OP_ADD, ids)
+        if rem_parts:
+            ids = np.sort(np.concatenate(rem_parts))
+            self.bitmap.remove_ids(ids)
+            self._log_op(OP_REMOVE, ids)
+        for r, p in rows_added:
+            self._after_row_write(int(r), positions=p, added=True)
+        for r, p in rows_removed:
+            self._after_row_write(int(r), positions=p, added=False)
 
     def import_bsi(self, positions: np.ndarray, stored: np.ndarray,
                    bit_depth: int, exists_row: int = 0,
@@ -364,15 +373,11 @@ class Fragment:
         if positions.size and int(positions.max()) >= SHARD_WIDTH:
             raise ValueError("position out of shard range")
         with self.lock:
-
-            def member(row: int) -> np.ndarray:
-                return self.bitmap.row_member(row, positions)
-
             add_parts: list = []
             rem_parts: list = []
             rows_added: list = []
             rows_removed: list = []
-            exists_new = ~member(exists_row)
+            exists_new = ~self.bitmap.row_member(exists_row, positions)
             changed_cols = exists_new.copy()
             if exists_new.any():
                 p = positions[exists_new]
@@ -383,7 +388,7 @@ class Fragment:
             for i in range(bit_depth):
                 row = offset_row + i
                 desired = ((stored >> np.uint64(i)) & np.uint64(1)) == 1
-                cur = member(row)
+                cur = self.bitmap.row_member(row, positions)
                 add_m = desired & ~cur
                 rem_m = ~desired & cur
                 if add_m.any():
@@ -397,18 +402,8 @@ class Fragment:
                 changed_cols |= add_m | rem_m
             if not changed_cols.any():
                 return 0
-            if add_parts:
-                ids = np.sort(np.concatenate(add_parts))
-                self.bitmap.add_ids(ids)
-                self._log_op(OP_ADD, ids)
-            if rem_parts:
-                ids = np.sort(np.concatenate(rem_parts))
-                self.bitmap.remove_ids(ids)
-                self._log_op(OP_REMOVE, ids)
-            for row, p in rows_added:
-                self._after_row_write(int(row), positions=p, added=True)
-            for row, p in rows_removed:
-                self._after_row_write(int(row), positions=p, added=False)
+            self._apply_batch_locked(add_parts, rem_parts,
+                                     rows_added, rows_removed)
             return int(changed_cols.sum())
 
     def import_roaring(self, data: bytes) -> int:
