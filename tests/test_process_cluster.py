@@ -1,0 +1,132 @@
+"""Two REAL OS processes form a cluster via the CLI entry point.
+
+The in-process `make_cluster` suites share a Python heap, so a whole
+class of bugs (state accidentally shared through module globals, env
+leakage, CLI flag plumbing) can't surface there. This boots two
+`python -m pilosa_tpu server` subprocesses — the exact artifact an
+operator runs — joins them over loopback HTTP, and drives writes,
+distributed queries, a routed mutex import, and a restart-resume.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def req(method, url, body=None):
+    data = (body if isinstance(body, (bytes, type(None)))
+            else json.dumps(body).encode())
+    r = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_server(tmp_path, name, port, seed_port=None):
+    # config rides the TOML file (exercising the config-file path);
+    # bind/port/data-dir ride CLI flags (flags > file precedence)
+    cfg = tmp_path / f"{name}.toml"
+    seeds = (f'seeds = ["http://127.0.0.1:{seed_port}"]\n'
+             if seed_port is not None else "")
+    cfg.write_text(
+        f'name = "{name}"\n'
+        "anti-entropy-interval = 0.0\n"
+        "heartbeat-interval = 0.0\n"
+        + seeds
+    )
+    args = [
+        sys.executable, "-m", "pilosa_tpu", "server",
+        "--config", str(cfg),
+        "--data-dir", str(tmp_path / name), "--bind", "127.0.0.1",
+        "--port", str(port),
+    ]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        args, env=os.environ.copy(), cwd=repo_root,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    base = f"http://127.0.0.1:{port}"
+    for _ in range(120):
+        if proc.poll() is not None:
+            raise AssertionError(f"server {name} exited rc={proc.returncode}")
+        try:
+            req("GET", f"{base}/status")
+            return proc, base
+        except Exception:
+            time.sleep(0.25)
+    proc.terminate()
+    raise AssertionError(f"server {name} never served /status")
+
+
+def wait_members(base, want, timeout=20):
+    """Poll /status until the membership set converges (join handling
+    is asynchronous relative to the joiner's own /status coming up)."""
+    deadline = time.time() + timeout
+    seen = set()
+    while time.time() < deadline:
+        seen = {n["id"] for n in req("GET", f"{base}/status")["nodes"]}
+        if seen == want:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"{base}: members {seen} != {want}")
+
+
+def test_two_process_cluster_end_to_end(tmp_path):
+    p0 = p1 = None
+    port0, port1 = free_port(), free_port()
+    try:
+        p0, b0 = spawn_server(tmp_path, "p0", port0)
+        p1, b1 = spawn_server(tmp_path, "p1", port1, seed_port=port0)
+        for b in (b0, b1):
+            wait_members(b, {"p0", "p1"})
+
+        req("POST", f"{b0}/index/i", {})
+        req("POST", f"{b0}/index/i/field/f", {})
+        req("POST", f"{b0}/index/i/field/m", {"options": {"type": "mutex"}})
+        cols = [s * SHARD_WIDTH + 3 for s in range(6)]
+        req("POST", f"{b0}/index/i/field/f/import",
+            {"rows": [1] * len(cols), "columns": cols})
+        # schema broadcast reached the peer process; queries fan out
+        for b in (b1, b0):
+            out = req("POST", f"{b}/index/i/query", b"Count(Row(f=1))")
+            assert out == {"results": [6]}, b
+        # routed mutex import through the PEER, then move the rows
+        req("POST", f"{b1}/index/i/field/m/import",
+            {"rows": [1] * len(cols), "columns": cols})
+        req("POST", f"{b1}/index/i/field/m/import",
+            {"rows": [2] * len(cols), "columns": cols})
+        for b in (b0, b1):
+            assert req("POST", f"{b}/index/i/query",
+                       b"Count(Row(m=1))") == {"results": [0]}, b
+            assert req("POST", f"{b}/index/i/query",
+                       b"Count(Row(m=2))") == {"results": [6]}, b
+
+        # restart the seed process: holder reopen = checkpoint resume,
+        # and the restarted node must rejoin and serve
+        p0.terminate()
+        p0.wait(15)
+        p0, b0 = spawn_server(tmp_path, "p0", port0, seed_port=port1)
+        wait_members(b0, {"p0", "p1"})
+        out = req("POST", f"{b0}/index/i/query", b"Count(Row(f=1))")
+        assert out == {"results": [6]}
+    finally:
+        for p in (p0, p1):
+            if p is not None:
+                p.terminate()
+        for p in (p0, p1):
+            if p is not None:
+                try:
+                    p.wait(15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
