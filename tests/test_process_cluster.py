@@ -112,6 +112,25 @@ def test_two_process_cluster_end_to_end(tmp_path):
             assert req("POST", f"{b}/index/i/query",
                        b"Count(Row(m=2))") == {"results": [6]}, b
 
+        # keyed index across processes: keys allocate on the coordinator
+        # and resolve from either node
+        req("POST", f"{b0}/index/people", {"options": {"keys": True}})
+        req("POST", f"{b0}/index/people/field/likes",
+            {"options": {"keys": True}})
+        req("POST", f"{b1}/index/people/query",
+            b'Set("alice", likes="pizza")')
+        req("POST", f"{b0}/index/people/query",
+            b'Set("bob", likes="pizza")')
+        req("POST", f"{b1}/index/people/query",
+            b'Set("alice", likes="sushi")')
+        for b in (b0, b1):
+            out = req("POST", f"{b}/index/people/query",
+                      b'Row(likes="pizza")')
+            assert sorted(out["results"][0]["keys"]) == ["alice", "bob"], b
+            out = req("POST", f"{b}/index/people/query",
+                      b'Count(Row(likes="sushi"))')
+            assert out == {"results": [1]}, b
+
         # restart the seed process: holder reopen = checkpoint resume,
         # and the restarted node must rejoin and serve
         p0.terminate()
