@@ -21,12 +21,33 @@ from pilosa_tpu import __version__
 DEFAULT_HOST = "http://localhost:10101"
 
 _DEFAULT_TOML = """\
+# pilosa-tpu server configuration. Precedence: flags > PILOSA_TPU_* env
+# vars > this file > defaults (env var names: key uppercased, dashes ->
+# underscores, e.g. PILOSA_TPU_ANTI_ENTROPY_INTERVAL).
 data-dir = "~/.pilosa_tpu"
 bind = "localhost"
 port = 10101
-anti-entropy-interval = 600.0
-replica-n = 1
+# name = "node-<port>"        # stable node id in the cluster
+# advertise = ""              # URI peers should use (default: bind:port)
+# seeds = ["http://host:10101"]  # join an existing cluster via any member
+replica-n = 1                 # replicas per shard
+anti-entropy-interval = 600.0 # seconds; 0 disables the repair ticker
+heartbeat-interval = 5.0      # seconds; 0 disables death detection
+# use-mesh = true             # force the device-mesh executor (default:
+                              # auto - mesh when >1 JAX device)
+# device-budget-bytes = 0     # HBM residency budget; 0 = auto
+long-query-time = 0.0         # log queries slower than this; 0 = off
+max-writes-per-request = 5000 # reject larger write batches; 0 = unlimited
+tracing = false               # span collection on /debug/traces
+# statsd = "127.0.0.1:8125"   # statsd UDP sink (Prometheus /metrics is
+                              # always on)
+# diagnostics-endpoint = ""   # phone-home URL; empty = off
 verbose = false
+
+# [tls]
+# certificate = "/path/node.crt"
+# key = "/path/node.key"
+# skip-verify = false         # accept self-signed peer certs
 """
 
 
