@@ -20,14 +20,32 @@ question open; re-run this harness when the op or toolchain changes.
 
 Prints one JSON line per variant; correctness is asserted against the
 XLA reference counts before any timing is reported.
+
+Operands are generated ON DEVICE (jax.random.bits) rather than uploaded:
+a 2 GiB host→device transfer through the degraded tunnel was observed
+to stall past a 25-minute timeout (round 5), while generation costs two
+device-side PRNG programs. Correctness gating is two-level: the XLA
+kernel's counts are pinned against numpy at a small shape (1 MiB slice
+readback), and every Pallas variant must match the XLA kernel's counts
+at the full shape.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
+
+
+def _stage(msg: str) -> None:
+    """Progress marker on stderr so a tunnel stall is attributable."""
+    print(f"[bench_pallas +{time.monotonic() - _T0:.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
 
 R = 8
 N_COLS = 1 << 30
@@ -127,11 +145,14 @@ def bench(fn, a, b, name, wrap, expect=None):
 def main() -> None:
     import jax
     import jax.numpy as jnp
-    from jax import lax
+    from jax import lax, random
 
-    rng = np.random.default_rng(1)
-    a = jax.device_put(rng.integers(0, 1 << 32, (R, W), dtype=np.uint32))
-    b = jax.device_put(rng.integers(0, 1 << 32, (R, W), dtype=np.uint32))
+    _stage("importing jax / first device op")
+    jnp.add(1, 1).block_until_ready()
+    _stage("generating operands on device")
+    bits = jax.jit(lambda k: random.bits(k, (R, W), jnp.uint32))
+    a = bits(random.key(1))
+    b = bits(random.key(2))
     jax.block_until_ready((a, b))
 
     @jax.jit
@@ -140,13 +161,34 @@ def main() -> None:
             lax.population_count(a & (b ^ salt)).astype(jnp.uint32), axis=1
         )
 
+    # small-shape numpy gate: the same fused op on a 1 MiB slice readback
+    # pins the XLA kernel against the host before the full-shape ratios
+    # (full operands never leave the device).
+    _stage("small-shape numpy correctness gate")
+    w_small = 1 << 15
+    a_s = np.asarray(a[:, :w_small])
+    b_s = np.asarray(b[:, :w_small])
+    got = np.asarray(xla_kernel(a[:, :w_small], b[:, :w_small],
+                                jnp.uint32(5)))
+    want = np.bitwise_count(a_s & (b_s ^ np.uint32(5))).sum(
+        axis=1, dtype=np.uint64
+    )
+    if not np.array_equal(got.astype(np.uint64), want):
+        print(json.dumps({"variant": "xla_small_gate",
+                          "error": f"{got.tolist()} != {want.tolist()}"}),
+              flush=True)
+        return
+
     scalar = lambda s: jnp.uint32(s)  # noqa: E731
     vec1 = lambda s: np.full(1, s, np.uint32)  # noqa: E731
 
+    _stage("timing xla variant")
     ref = bench(xla_kernel, a, b, "xla", scalar)
     for bw in (1 << 15, 1 << 16, 1 << 17):
+        _stage(f"timing pallas bw={bw}")
         bench(pallas_intersect_count(bw), a, b, f"pallas_bw{bw}", vec1,
               expect=ref)
+    _stage("timing xla drift bracket")
     bench(xla_kernel, a, b, "xla_rerun", scalar)
 
 

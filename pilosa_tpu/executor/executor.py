@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import datetime as dt
+import math
 import threading
 import weakref
 from typing import Callable
@@ -883,7 +884,24 @@ class Executor:
         base = field.options.base
         depth = field.options.bit_depth
         max_stored = (1 << depth) - 1
-        pred = int(cond.value) - base
+        value = cond.value
+        op = cond.op
+        # isinstance check, not float(value): fractional predicates only
+        # ever arrive as parser floats, and float(huge_int) overflows
+        # where the pred>max_stored clamp below handles it fine.
+        if isinstance(value, float) and not value.is_integer():
+            # Stored values are integers, so a fractional predicate maps
+            # exactly onto the integer lattice: x < 1.5 ⇔ x <= 1,
+            # x > 1.5 ⇔ x >= 2, and ==/!= degenerate. Plain int() would
+            # turn x < 1.5 into x < 1, wrongly excluding x == 1.
+            if op == "==":
+                return ("const0",)
+            if op == "!=":
+                return self._bsi_exists_node(field, specs)
+            fl = math.floor(value)
+            value, op = (fl, "<=") if op in ("<", "<=") else (fl + 1, ">=")
+        pred = int(value) - base
+        cond = Condition(op, value)
         exists = self._bsi_exists_node(field, specs)
         # range-clamp: out-of-range predicates degenerate to empty/universe
         if pred < 0:

@@ -180,6 +180,40 @@ class TestBSI:
         want = sorted(c for c, v in self.values.items() if 0 <= v <= 42)
         assert res.columns().tolist() == want
 
+    @pytest.mark.parametrize(
+        "op,py",
+        [("<", lambda v, p: v < p), ("<=", lambda v, p: v <= p),
+         (">", lambda v, p: v > p), (">=", lambda v, p: v >= p),
+         ("==", lambda v, p: v == p), ("!=", lambda v, p: v != p)],
+    )
+    @pytest.mark.parametrize("pred", [-50.5, 0.5, 10.5, 499.5])
+    def test_range_fractional_predicate(self, env, op, py, pred):
+        # Stored values are integers; a fractional predicate must map onto
+        # the integer lattice exactly (x < 10.5 ⇔ x <= 10, never x < 10).
+        holder, ex = env
+        self.setup_fares(holder)
+        (res,) = ex.execute("taxi", f"Range(fare {op} {pred})")
+        want = sorted(c for c, v in self.values.items() if py(v, pred))
+        assert res.columns().tolist() == want, f"fare {op} {pred}"
+
+    def test_range_huge_predicate(self, env):
+        # Predicates beyond float range must hit the out-of-range clamp,
+        # not crash (float(10**400) raises OverflowError).
+        holder, ex = env
+        self.setup_fares(holder)
+        huge = 10 ** 400
+        (res,) = ex.execute("taxi", f"Range(fare < {huge})")
+        assert res.columns().tolist() == sorted(self.values)
+        (res,) = ex.execute("taxi", f"Range(fare > {huge})")
+        assert res.columns().tolist() == []
+
+    def test_between_fractional(self, env):
+        holder, ex = env
+        self.setup_fares(holder)
+        (res,) = ex.execute("taxi", "Range(fare >< [0.5, 42.5])")
+        want = sorted(c for c, v in self.values.items() if 0.5 <= v <= 42.5)
+        assert res.columns().tolist() == want
+
     def test_row_condition_alias(self, env):
         holder, ex = env
         self.setup_fares(holder)
