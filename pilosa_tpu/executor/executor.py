@@ -267,6 +267,21 @@ class Executor:
         self._plan_cache: dict = {}
         # shard-list identity -> ShardBlock (LRU); see _shard_block
         self._block_memo: collections.OrderedDict = collections.OrderedDict()
+        # (plan identity, block identity) -> assembled device operands,
+        # valid for ONE residency generation; see _eval_operands. The
+        # listener drops entries (and their device-array references)
+        # EAGERLY on every bump so a residency eviction actually frees
+        # HBM instead of waiting for the next query's validity check.
+        self._operand_memo: dict = {}
+        self._operand_memo_gen = -1
+        residency.global_row_cache().add_generation_listener(
+            self._clear_operand_memo
+        )
+
+    def _clear_operand_memo(self) -> None:
+        """Generation listener (called under the residency lock — must
+        stay lock-free and cheap)."""
+        self._operand_memo.clear()
 
     # ------------------------------------------------------------ top level
 
@@ -484,10 +499,37 @@ class Executor:
         )
 
     def _eval_operands(self, idx: Index, compiled: _Compiled, block,
-                       extra_leaves=()):
+                       extra_leaves=(), memoize: bool = True):
         """Resolve a compiled query's device leaves; scalars stay host
         ints (converted at dispatch — the micro-batch path ships a whole
-        group's scalars as one array)."""
+        group's scalars as one array).
+
+        Repeat (plan, block) assemblies are memoized for the duration of
+        one residency generation: per-leaf cache lookups cost ~10 us of
+        lock+LRU bookkeeping per query, which at micro-batched dispatch
+        rates is a measurable slice of the serving path's host budget.
+        Any write/evict/invalidate bumps the generation (residency.py),
+        which eagerly clears the memo (generation listener registered in
+        __init__). Correctness does not rest on the clears: every entry
+        carries the generation read BEFORE its assembly and a hit must
+        match the CURRENT generation, so a racing store of pre-write
+        leaves into a just-cleared memo (assembler thread preempted
+        across a write) produces an entry that can never be served.
+        Identity (`is`) checks guard against id() reuse after
+        plan-cache or block-memo eviction. Callers whose plan objects
+        are per-call (not plan-cache residents) pass memoize=False so
+        dead entries don't accumulate."""
+        memoize = memoize and not extra_leaves
+        if memoize:
+            gen = residency.global_row_cache().generation
+            if gen != self._operand_memo_gen:
+                self._operand_memo.clear()
+                self._operand_memo_gen = gen
+            mkey = (id(compiled), id(block))
+            hit = self._operand_memo.get(mkey)
+            if (hit is not None and hit[0] is compiled
+                    and hit[1] is block and hit[4] == gen):
+                return hit[2], hit[3]
         put = self._leaf_put(block)
         leaves = [
             batch.stacked_leaf(idx, spec, block, put) for spec in compiled.specs
@@ -495,7 +537,12 @@ class Executor:
         leaves.extend(extra_leaves)
         if not leaves:
             leaves = [batch.stacked_leaf(idx, _ZeroSpec(), block, put)]
-        return leaves, tuple(int(s) for s in compiled.scalars)
+        scalars = tuple(int(s) for s in compiled.scalars)
+        if memoize:
+            if len(self._operand_memo) >= 512:
+                self._operand_memo.clear()
+            self._operand_memo[mkey] = (compiled, block, leaves, scalars, gen)
+        return leaves, scalars
 
     def _dispatch(self, node, reduce_kind: str, leaves, scalars):
         import jax.numpy as jnp
@@ -898,6 +945,12 @@ class Executor:
                 return ("const0",)
             if op == "!=":
                 return self._bsi_exists_node(field, specs)
+            if math.isinf(value):
+                # a ~310+-digit literal with a fractional part parses to
+                # ±inf; floor() would raise, so clamp directly
+                everything = (value > 0) == (op in ("<", "<="))
+                return (self._bsi_exists_node(field, specs) if everything
+                        else ("const0",))
             fl = math.floor(value)
             value, op = (fl, "<=") if op in ("<", "<=") else (fl + 1, ">=")
         pred = int(value) - base
@@ -1072,7 +1125,7 @@ class Executor:
 
         # filter leaves/scalars are chunk-invariant: resolve once
         base_leaves, scalar_ints = self._eval_operands(
-            idx, _Compiled(node, specs, scalars), block,
+            idx, _Compiled(node, specs, scalars), block, memoize=False,
         ) if specs else ([], tuple(int(s) for s in scalars))
         put = self._leaf_put(block)
 

@@ -34,6 +34,7 @@ re-decode they were demoted to avoid).
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
 from functools import partial
 from typing import Callable
@@ -141,6 +142,18 @@ class DeviceRowCache:
         self.decompressions = 0
         self.updates = 0  # in-place scatter updates of derived entries
         self.write_events = 0  # fragment mutations routed through apply_write
+        # Snapshot validity counter: bumped whenever an entry is removed
+        # or a dense array replaced (write patch, invalidate, evict,
+        # demote, clear). Holders of (key -> array) snapshots taken
+        # OUTSIDE this cache (the executor's operand memo) may serve
+        # them only while generation is unchanged; additions never bump
+        # (they cannot stale an existing snapshot). Listeners are
+        # weakly-held zero-arg callables invoked on every bump so
+        # snapshot holders drop their array references EAGERLY — an
+        # eviction must actually free HBM, not wait for the holder's
+        # next lazy validity check.
+        self.generation = 0
+        self._gen_listeners: list = []
         # derived-entry dependency registry: a stacked leaf registers an
         # updater under a (index, field) tag; apply_write routes each
         # fragment mutation to exactly the tagged entries
@@ -168,6 +181,26 @@ class DeviceRowCache:
     @property
     def compressed_bytes(self) -> int:
         return self._compressed_bytes
+
+    def add_generation_listener(self, fn) -> None:
+        """Register a bound method invoked (under the cache lock) on
+        every generation bump; held via WeakMethod so registrants can be
+        garbage-collected. Listeners must be lock-free and cheap (the
+        executor's is a dict.clear)."""
+        with self._lock:
+            self._gen_listeners.append(weakref.WeakMethod(fn))
+
+    def _bump_generation(self) -> None:
+        """Caller holds the lock. Bump + notify snapshot holders."""
+        self.generation += 1
+        if self._gen_listeners:
+            live = []
+            for ref in self._gen_listeners:
+                cb = ref()
+                if cb is not None:
+                    cb()
+                    live.append(ref)
+            self._gen_listeners = live
 
     def _lookup_locked(self, key: tuple):
         """Dense hit or compressed→dense promotion; None on miss.
@@ -319,6 +352,8 @@ class DeviceRowCache:
             centry = self._compressed.pop(key, None)
             if centry is not None:
                 self._compressed_bytes -= centry.nbytes
+            if entry is not None or centry is not None:
+                self._bump_generation()
             self._drop_updater(key)
 
     def invalidate_fragment(self, frag_id: tuple) -> None:
@@ -400,6 +435,7 @@ class DeviceRowCache:
                     # occupancy may have changed; don't demote later
                     entry.block_idx = None
                     self.updates += 1
+                    self._bump_generation()
                 else:
                     self.invalidate(key)
 
@@ -444,6 +480,7 @@ class DeviceRowCache:
 
     def clear(self) -> None:
         with self._lock:
+            self._bump_generation()
             self._rows.clear()
             self._compressed.clear()
             self._updaters.clear()
@@ -460,6 +497,7 @@ class DeviceRowCache:
         while self.bytes_used > self.budget_bytes and len(self._rows) > 1:
             key, entry = self._rows.popitem(last=False)
             self._bytes -= entry.arr.nbytes
+            self._bump_generation()
             if entry.block_idx is not None:
                 self._demote(key, entry)  # key stays resident (compressed)
             else:
@@ -468,6 +506,7 @@ class DeviceRowCache:
         while self.bytes_used > self.budget_bytes and self._compressed:
             key, centry = self._compressed.popitem(last=False)
             self._compressed_bytes -= centry.nbytes
+            self._bump_generation()
             self.evictions += 1
             self._drop_updater(key)
 

@@ -207,6 +207,22 @@ class TestBSI:
         (res,) = ex.execute("taxi", f"Range(fare > {huge})")
         assert res.columns().tolist() == []
 
+    def test_range_infinite_fractional_predicate(self, env):
+        # A ~330-digit literal WITH a fractional part parses to float
+        # +/-inf; math.floor(inf) would raise, so the inf clamp must
+        # short-circuit to universe/empty.
+        holder, ex = env
+        self.setup_fares(holder)
+        big = "9" * 330 + ".5"
+        every = sorted(self.values)
+        for op, want in (("<", every), ("<=", every), (">", []), (">=", []),
+                         ("==", []), ("!=", every)):
+            (res,) = ex.execute("taxi", f"Range(fare {op} {big})")
+            assert res.columns().tolist() == want, f"fare {op} inf"
+        for op, want in (("<", []), ("<=", []), (">", every), (">=", every)):
+            (res,) = ex.execute("taxi", f"Range(fare {op} -{big})")
+            assert res.columns().tolist() == want, f"fare {op} -inf"
+
     def test_between_fractional(self, env):
         holder, ex = env
         self.setup_fares(holder)
@@ -556,6 +572,80 @@ class TestSubmitPipelined:
         assert reads == []  # no device readback at submit time
         assert d.result().columns().tolist() == data[1]
         assert len(reads) == 1
+
+    def test_operand_memo_reuses_assembly_until_write(self, env):
+        """Steady-state repeat queries hit the operand memo; any write
+        bumps the residency generation, whose listener clears the memo
+        EAGERLY (so evictions actually free HBM), and the next assembly
+        picks up the patched leaves."""
+        from pilosa_tpu.storage import residency
+
+        holder, ex = env
+        setup_stars(holder)
+        pql = "Count(Row(stargazer=1))"
+        before = ex.execute("repos", pql)[0]
+        assert ex.execute("repos", pql)[0] == before
+        assert len(ex._operand_memo) >= 1  # warmed
+        entry_count = len(ex._operand_memo)
+        gen0 = residency.global_row_cache().generation
+        ex.execute("repos", pql)
+        assert len(ex._operand_memo) == entry_count  # hit, no growth
+        assert residency.global_row_cache().generation == gen0
+        ex.execute("repos", "Set(424242, stargazer=1)")
+        assert residency.global_row_cache().generation > gen0
+        assert len(ex._operand_memo) == 0  # listener cleared eagerly
+        assert ex.execute("repos", pql)[0] == before + 1
+
+    def test_operand_memo_rejects_stale_generation_entry(self, env):
+        """A racing store can insert an entry assembled under an old
+        generation AFTER the clear (assembler preempted across a write);
+        the per-entry generation tag must keep it from ever being
+        served."""
+        from pilosa_tpu.storage import residency
+
+        holder, ex = env
+        setup_stars(holder)
+        pql = "Count(Row(stargazer=1))"
+        before = ex.execute("repos", pql)[0]
+        ex.execute("repos", pql)  # warm the memo
+        (mkey, entry), = [(k, v) for k, v in ex._operand_memo.items()][:1]
+        # simulate the race: re-insert the pre-write entry with its OLD
+        # generation tag after a write cleared the memo
+        ex.execute("repos", "Set(424243, stargazer=1)")
+        assert len(ex._operand_memo) == 0
+        ex._operand_memo[mkey] = entry
+        ex._operand_memo_gen = residency.global_row_cache().generation
+        assert ex.execute("repos", pql)[0] == before + 1  # not served stale
+
+    def test_topn_does_not_pollute_operand_memo(self, env):
+        """TopN phase 2 builds a per-call _Compiled; memoize=False keeps
+        those dead-on-arrival entries out of the memo."""
+        holder, ex = env
+        idx = holder.create_index("i")
+        f = idx.create_field("f", FieldOptions(cache_type="ranked"))
+        for row in range(5):
+            for col in range(row + 1):
+                f.set_bit(row, col)
+        ex.execute("i", "TopN(f, n=3)")
+        n0 = len(ex._operand_memo)
+        for _ in range(5):
+            ex.execute("i", "TopN(f, n=3)")
+        assert len(ex._operand_memo) == n0  # no per-call growth
+
+    def test_submit_snapshots_leaves_against_later_writes(self, env):
+        """A pipelined read captures its leaves at submit time: a write
+        landing between submit and the (lazy) flush patches the residency
+        cache functionally, so the in-flight query still answers from its
+        submit-time snapshot while a post-write submit sees the write."""
+        holder, ex = env
+        _, data, _ = setup_stars(holder)
+        pql = "Count(Row(stargazer=1))"
+        before = ex.execute("repos", pql)[0]
+        (d_old,) = ex.submit("repos", pql)  # enqueued, not yet flushed
+        ex.execute("repos", "Set(999999, stargazer=1)")  # lands pre-flush
+        (d_new,) = ex.submit("repos", pql)
+        assert d_old.result() == before
+        assert d_new.result() == before + 1
 
     def test_submit_writes_and_host_reads_stay_eager(self, env):
         """Writes and host-only reads must execute AT submit time (an
