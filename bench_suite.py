@@ -610,6 +610,91 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
             server.close()
 
 
+def config_hostpath(n_shards: int = 8) -> dict:
+    """Host-side cost of the pipelined submit path, device excluded.
+
+    The executor-vs-kernel ratio is bounded by how fast the HOST can
+    feed micro-batched dispatches (parse -> plan cache -> operand memo ->
+    micro-batch group), so this config times `Executor.submit` with the
+    batched program stubbed out: pure framework cost per query, in
+    microseconds, with the operand memo on and off. CPU-representative
+    (no device work is dispatched); tracked so a serving-path host
+    regression shows up as a number, not a vibe."""
+    import itertools
+    import tempfile
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import Holder
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    K = 8
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp).open()
+        idx = holder.create_index("b")
+        rows = np.repeat(np.arange(1, K + 1, dtype=np.uint64), 64)
+        for fname in ("a", "b"):
+            f = idx.create_field(fname)
+            view = f.view(VIEW_STANDARD, create=True)
+            for shard in range(n_shards):
+                cols = rng.integers(0, SHARD_WIDTH, rows.size,
+                                    dtype=np.uint64)
+                view.fragment(shard, create=True).bulk_import(rows, cols)
+
+        def pql(k, j):
+            return f"Count(Intersect(Row(a={k}), Row(b={j})))"
+
+        def combo(g):
+            n = K * K
+            c = (5 * g + g // n) % n
+            return 1 + c // K, 1 + c % K
+
+        def measure(memo_on: bool) -> float:
+            ex = Executor(holder)
+            if not memo_on:
+                # disable by forcing the per-plan bypass
+                orig = ex._eval_operands
+                ex._eval_operands = (
+                    lambda idx, c, b, extra_leaves=(), memoize=True:
+                    orig(idx, c, b, extra_leaves, memoize=False)
+                )
+            for k in range(1, K + 1):
+                ex.execute("b", pql(k, k))
+            g = itertools.count(0)
+            warm = [ex.submit("b", pql(*combo(next(g))))[0]
+                    for _ in range(70)]
+            warm[-1].result()
+            stub = np.zeros((ex.microbatch_max, 2), np.int32)
+            ex._program_batched = lambda *a, **k: (lambda *args: stub)
+            n = 4096
+            best = float("inf")
+            for _ in range(4):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    ex.submit("b", pql(*combo(next(g))))
+                best = min(best, (time.perf_counter() - t0) / n)
+            return best
+
+        on = measure(True)
+        off = measure(False)
+        holder.close()
+    return {
+        "config": "hostpath",
+        "metric": "submit_host_us_per_query",
+        "value": round(on * 1e6, 1),
+        "unit": "us/query",
+        "memo_off_us": round(off * 1e6, 1),
+        "per_dispatch_ms_at_16": round(on * 16 * 1e3, 3),
+        "shards": n_shards,
+        "ok": True,
+        "note": ("Executor.submit with the batched device program stubbed: "
+                 "parse + plan cache + operand memo + micro-batch group "
+                 "cost per query. memo_off_us re-measures with the operand "
+                 "memo bypassed (the delta is what the memo buys)."),
+    }
+
+
 def _spawn_cpu_mesh_entry() -> None:
     """Run config5_mesh_cpu8 in a subprocess pinned to an 8-device
     virtual CPU platform (the axon TPU plugin would otherwise own the
@@ -643,7 +728,9 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--full", action="store_true",
                         help="billion-column scale (real TPU)")
-    parser.add_argument("--configs", default="1,2,3,4,5,mesh8,serving,import")
+    parser.add_argument(
+        "--configs", default="1,2,3,4,5,mesh8,serving,import,hostpath"
+    )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -669,6 +756,7 @@ def main() -> None:
             n_shards=32 if args.full else 8,
             density=0.2 if args.full else 0.05,
         ),
+        "hostpath": lambda: config_hostpath(n_shards=8),
     }
     floor = None  # lazy: touching the device backend can BLOCK when the
     # relay is down, and mesh8/serving don't need the floor measurement

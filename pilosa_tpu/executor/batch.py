@@ -332,6 +332,32 @@ def _make_probe(block: ShardBlock, match, row_pos_of, decode_row,
     return probe
 
 
+def leaf_key(idx, spec, block: ShardBlock) -> tuple:
+    """Residency key for a compiled spec's stacked leaf (must stay in
+    lockstep with stacked_leaf below — the executor's operand memo uses
+    these keys to re-touch LRU positions on memo hits)."""
+    from pilosa_tpu.executor.executor import (
+        PQLError,
+        _PlanesSpec,
+        _RowSpec,
+        _ZeroSpec,
+    )
+
+    if isinstance(spec, _RowSpec):
+        return ("stack", idx.name, spec.field, spec.views, spec.row,
+                block.key())
+    if isinstance(spec, _PlanesSpec):
+        return ("stackp", idx.name, spec.field, 2 + spec.depth, block.key())
+    if isinstance(spec, _ZeroSpec):
+        return ("stackz", block.key())
+    raise PQLError(f"unknown leaf spec {type(spec).__name__}")
+
+
+def leaf_keys(idx, specs, block: ShardBlock) -> tuple:
+    """Residency keys for a plan's leaves (operand-memo LRU re-touch)."""
+    return tuple(leaf_key(idx, s, block) for s in specs)
+
+
 def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
     """Device-resident stacked leaf for a compiled spec, via the residency
     LRU. ``device_put`` overrides placement (mesh sharding)."""
@@ -344,8 +370,7 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
 
     cache = residency.global_row_cache()
     if isinstance(spec, _RowSpec):
-        key = ("stack", idx.name, spec.field, spec.views, spec.row,
-               block.key())
+        key = leaf_key(idx, spec, block)
 
         def decode():
             return block.stack(lambda shard: host_row(idx, spec, shard),
@@ -367,7 +392,7 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
         # the query resolves to zeros instead of a dead dereference
         depth = 2 + spec.depth
         bsi_view = view_name_bsi(spec.field)
-        key = ("stackp", idx.name, spec.field, depth, block.key())
+        key = leaf_key(idx, spec, block)
 
         def decode():
             return block.stack(
@@ -392,7 +417,7 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
                 delta_on_clear=True,
             )
     elif isinstance(spec, _ZeroSpec):
-        key = ("stackz", block.key())
+        key = leaf_key(idx, spec, block)
 
         def decode():
             return np.zeros((block.host_rows, WORDS_PER_SHARD), np.uint32)

@@ -159,12 +159,20 @@ def _zeros_planes(rows: int):
 
 
 class _Compiled:
-    """A bitmap call compiled to (structure, leaf specs, scalars)."""
+    """A bitmap call compiled to (structure, leaf specs, scalars).
+
+    ``memoizable`` is set by _compile_cached exactly when the plan was
+    placed in the plan cache: only those objects have a stable identity
+    across repeat queries, so only their operand assemblies are worth
+    (and safe to bound) memoizing — per-call plans (TopN phase 2,
+    const0-degenerate trees) would fill the operand memo with
+    dead-on-arrival entries."""
 
     def __init__(self, node, specs, scalars):
         self.node = node
         self.specs = specs
         self.scalars = scalars
+        self.memoizable = False
 
 
     def eval(self, idx: Index, shard: int):
@@ -268,15 +276,17 @@ class Executor:
         # shard-list identity -> ShardBlock (LRU); see _shard_block
         self._block_memo: collections.OrderedDict = collections.OrderedDict()
         # (plan identity, block identity) -> assembled device operands,
-        # valid for ONE residency generation; see _eval_operands. The
-        # listener drops entries (and their device-array references)
-        # EAGERLY on every bump so a residency eviction actually frees
-        # HBM instead of waiting for the next query's validity check.
+        # valid for ONE residency generation; see _eval_operands. A
+        # listener on the row cache drops entries (and their
+        # device-array references) EAGERLY on every generation bump so
+        # a residency eviction actually frees HBM instead of waiting
+        # for the next query's validity check; it is (re-)registered
+        # lazily against whatever cache is globally live, because
+        # set_global_row_cache can swap the cache after this executor
+        # was built (Server.open's budget-sized cache).
         self._operand_memo: dict = {}
         self._operand_memo_gen = -1
-        residency.global_row_cache().add_generation_listener(
-            self._clear_operand_memo
-        )
+        self._listened_cache = None
 
     def _clear_operand_memo(self) -> None:
         """Generation listener (called under the residency lock — must
@@ -516,12 +526,26 @@ class Executor:
         leaves into a just-cleared memo (assembler thread preempted
         across a write) produces an entry that can never be served.
         Identity (`is`) checks guard against id() reuse after
-        plan-cache or block-memo eviction. Callers whose plan objects
-        are per-call (not plan-cache residents) pass memoize=False so
-        dead entries don't accumulate."""
-        memoize = memoize and not extra_leaves
+        plan-cache or block-memo eviction. Only plan-cache-resident
+        plans (compiled.memoizable) are memoized — per-call plan
+        objects (TopN phase 2, const0-degenerate trees) would fill the
+        memo with dead-on-arrival entries whose wholesale clear at the
+        size bound evicts the hot entries the memo exists for. A hit
+        re-touches its leaves' residency LRU position (entry[5]): a
+        served-on-every-query leaf must not look LRU-cold and become
+        the first eviction victim under pressure."""
+        memoize = memoize and not extra_leaves and compiled.memoizable
         if memoize:
-            gen = residency.global_row_cache().generation
+            cache = residency.global_row_cache()
+            if cache is not self._listened_cache:
+                # the global cache can be swapped after construction
+                # (Server.open's budget-sized cache); re-home the eager
+                # clear listener so evictions on the LIVE cache drop our
+                # array references, and dump entries from the old one
+                cache.add_generation_listener(self._clear_operand_memo)
+                self._listened_cache = cache
+                self._operand_memo.clear()
+            gen = cache.generation
             if gen != self._operand_memo_gen:
                 self._operand_memo.clear()
                 self._operand_memo_gen = gen
@@ -529,6 +553,7 @@ class Executor:
             hit = self._operand_memo.get(mkey)
             if (hit is not None and hit[0] is compiled
                     and hit[1] is block and hit[4] == gen):
+                cache.touch(hit[5])
                 return hit[2], hit[3]
         put = self._leaf_put(block)
         leaves = [
@@ -541,7 +566,9 @@ class Executor:
         if memoize:
             if len(self._operand_memo) >= 512:
                 self._operand_memo.clear()
-            self._operand_memo[mkey] = (compiled, block, leaves, scalars, gen)
+            leaf_keys = batch.leaf_keys(idx, compiled.specs, block)
+            self._operand_memo[mkey] = (compiled, block, leaves, scalars,
+                                        gen, leaf_keys)
         return leaves, scalars
 
     def _dispatch(self, node, reduce_kind: str, leaves, scalars):
@@ -829,6 +856,7 @@ class Executor:
                 self._plan_cache.clear()
             self._plan_cache[key] = (call, weakref.ref(idx), epoch,
                                      compiled)
+            compiled.memoizable = True
         return compiled
 
     def _compile(self, idx: Index, call: Call, wrap: str | None = None) -> _Compiled:

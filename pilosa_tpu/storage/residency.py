@@ -182,6 +182,17 @@ class DeviceRowCache:
     def compressed_bytes(self) -> int:
         return self._compressed_bytes
 
+    def touch(self, keys) -> None:
+        """Refresh LRU positions without fetching (executor operand-memo
+        hits: the leaves are served from the memo, but they must not
+        look LRU-cold and become eviction's first victims)."""
+        with self._lock:
+            for key in keys:
+                if key in self._rows:
+                    self._rows.move_to_end(key)
+                elif key in self._compressed:
+                    self._compressed.move_to_end(key)
+
     def add_generation_listener(self, fn) -> None:
         """Register a bound method invoked (under the cache lock) on
         every generation bump; held via WeakMethod so registrants can be

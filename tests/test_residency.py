@@ -234,3 +234,50 @@ def test_updaters_dropped_with_entries():
     cache.register_updater(("gone",), ("i", "f"), lambda ev: None)
     assert not cache._updaters
     cache.apply_write(WriteEvent("i", "f", "standard", 0, 1))  # no crash
+
+
+def test_touch_refreshes_lru_position():
+    """touch() keeps served-from-memo leaves from looking LRU-cold:
+    under pressure the UNtouched entry must be the eviction victim."""
+    rng = np.random.default_rng(11)
+    cache = DeviceRowCache(budget_bytes=300 << 10)  # two rows fit
+    hot = CountingDecoder(sparse_row(rng, 20))
+    cold = CountingDecoder(sparse_row(rng, 20))
+    cache.get_row(("hot",), hot)
+    cache.get_row(("cold",), cold)  # insertion order: hot is LRU-oldest
+    cache.touch([("hot",), ("missing",)])  # missing keys are ignored
+    gen0 = cache.generation
+    cache.get_row(("new",), CountingDecoder(sparse_row(rng, 20)))  # over budget
+    assert cache.generation > gen0  # eviction bumped
+    cache.get_row(("hot",), hot)
+    assert hot.calls == 1  # survived: touched after cold
+    cache.get_row(("cold",), cold)
+    assert cold.calls == 2  # evicted: it was the LRU-coldest
+
+
+def test_generation_listener_fires_and_rehomes_on_swap():
+    """Executor memo integration: the generation listener clears the
+    memo eagerly, and a set_global_row_cache swap re-homes it to the
+    live cache on the next memoized assembly."""
+    from pilosa_tpu.storage import residency as res_mod
+
+    calls = []
+
+    class L:
+        def cb(self):
+            calls.append(1)
+
+    old = res_mod.global_row_cache()
+    try:
+        c1 = DeviceRowCache(budget_bytes=1 << 20)
+        listener = L()
+        c1.add_generation_listener(listener.cb)
+        c1.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
+        c1.invalidate(("x",))
+        assert calls == [1]  # bump fired the listener
+        del listener
+        c1.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
+        c1.invalidate(("x",))
+        assert calls == [1]  # weakly held: dead listener dropped
+    finally:
+        res_mod.set_global_row_cache(old)
