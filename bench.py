@@ -89,11 +89,20 @@ K_ROWS = 8  # distinct rows per field (2 GiB HBM in stacked leaves)
 HBM_PEAK_BYTES_PER_SEC = 819e9
 BITS_PER_ROW_SHARD = 512  # set bits per (row, shard); throughput is
                           # density-independent (dense words on device)
-KERNEL_ITERS = 96
-EXEC_ITERS = 256
-TRIALS = 10  # best-of: the tunneled backend's throughput wanders ±25%
-             # across seconds; each executor trial costs ~0.2s, so ten
-             # trials buy a much tighter recorded best for ~2s
+KERNEL_ITERS = 256
+EXEC_ITERS = 2048  # = 8 × KERNEL_ITERS: the kernel computes all K_ROWS
+                   # row-queries per call, so equal-depth loops would
+                   # amortize the final readback 8× better per COLUMN on
+                   # the kernel side and the executor/kernel ratio would
+                   # mostly measure that artifact. 8:1 equalizes the RTT
+                   # share per column (~10% of a trial at 80 ms RTT).
+TRIALS = 8  # best-of: the tunneled backend's throughput wanders ±25%
+            # across seconds. Depths are also sized so the one blocking
+            # final readback (~80 ms tunnel RTT, reported as
+            # rtt_floor_ms) stays near ~10% of a trial's wall: at the
+            # r4/r5 depths (96/256) it was 25-35% of every measured
+            # number, and the "executor vs kernel" gap was mostly the
+            # RTT-share difference between the two loops, not the paths.
 
 
 # ------------------------------------------------------------ raw kernel path

@@ -9,8 +9,13 @@ drifts ±25% between runs — only same-run ratios mean anything):
      per-row sum(popcount(a & (b ^ salt))) over uint32[R, W];
   2. a Pallas grid kernel for the same op at several VMEM block sizes
      (R-row operand blocks, grid over the word axis, accumulating
-     per-row partial counts in the revisited output block);
-  3. the XLA kernel again, to bracket in-run drift.
+     per-row partial counts in the revisited output block).
+
+Timing is INTERLEAVED: each trial runs one pipelined pass of every
+variant back-to-back, so all variants sample the same seconds of tunnel
+drift; best-of-TRIALS per variant. (The earlier sequential schedule
+measured the same XLA kernel at 1.25e12 then 1.60e12 cols/s within one
+process — larger than any XLA-vs-Pallas gap it was trying to resolve.)
 
 History: the round-2 measurement (README "Kernel strategy") found
 parity — Pallas 287-319 GB/s vs XLA 309-333 GB/s interleaved — and the
@@ -50,8 +55,8 @@ _T0 = time.monotonic()
 R = 8
 N_COLS = 1 << 30
 W = N_COLS // 32  # 2^25 words per row
-ITERS = 32
-TRIALS = 3
+ITERS = 64
+TRIALS = 6
 HBM_PEAK = 819e9
 
 
@@ -100,46 +105,63 @@ def pallas_intersect_count(block_w: int, rows: int = R, words: int = W,
     )
 
 
-def bench(fn, a, b, name, wrap, expect=None):
-    """Compile, check counts against ``expect`` (BEFORE any timing is
-    reported — a wrong variant prints an error line and no numbers),
-    then time. Errors never abort the harness: the remaining variants
-    and the closing drift bracket still run. cols_per_sec counts all R
-    row-queries per call, the same unit as bench.py's
-    kernel_cols_per_sec (K_ROWS · n_cols / dt)."""
-    salt = 0
-    try:
-        ref = np.asarray(fn(a, b, wrap(salt)))  # compile + reference
-    except Exception as e:  # noqa: BLE001 — report and keep comparing
-        print(json.dumps({
-            "variant": name, "error": f"{type(e).__name__}: {e}"
-        }), flush=True)
-        return None
-    if expect is not None and not np.array_equal(
-        ref.ravel().astype(np.int64), expect.astype(np.int64)
-    ):
-        print(json.dumps({
-            "variant": name,
-            "error": f"wrong counts: {ref.ravel().tolist()} != {expect.tolist()}",
-        }), flush=True)
-        return None
-    salt += 1
-    best = float("inf")
-    for _ in range(TRIALS):
+class Variant:
+    """One kernel variant: compile + correctness-gate up front, then the
+    harness interleaves timing passes round-robin across variants so
+    every variant samples the SAME seconds of tunnel drift — the r5
+    sequential run measured the XLA kernel at 1.25e12 then 1.60e12
+    within one process, larger than any XLA-vs-Pallas gap."""
+
+    def __init__(self, fn, name, wrap):
+        self.fn, self.name, self.wrap = fn, name, wrap
+        self.salt = 0
+        self.best = float("inf")
+        self.ok = False
+
+    def compile_and_gate(self, a, b, expect=None):
+        """Compile + reference counts (BEFORE any timing is reported — a
+        wrong variant prints an error line and no numbers). Errors never
+        abort the harness: the remaining variants still compare."""
+        try:
+            ref = np.asarray(self.fn(a, b, self.wrap(self.salt)))
+        except Exception as e:  # noqa: BLE001 — report and keep comparing
+            print(json.dumps({
+                "variant": self.name, "error": f"{type(e).__name__}: {e}"
+            }), flush=True)
+            return None
+        if expect is not None and not np.array_equal(
+            ref.ravel().astype(np.int64), expect.astype(np.int64)
+        ):
+            print(json.dumps({
+                "variant": self.name,
+                "error":
+                    f"wrong counts: {ref.ravel().tolist()} != {expect.tolist()}",
+            }), flush=True)
+            return None
+        self.salt += 1
+        self.ok = True
+        return ref.ravel()
+
+    def timed_pass(self, a, b):
+        """One pipelined pass of ITERS calls; keeps the best per-call dt.
+        cols_per_sec counts all R row-queries per call, the same unit as
+        bench.py's kernel_cols_per_sec (K_ROWS · n_cols / dt)."""
         t0 = time.perf_counter()
         out = None
         for _ in range(ITERS):
-            out = fn(a, b, wrap(salt))
-            salt += 1
+            out = self.fn(a, b, self.wrap(self.salt))
+            self.salt += 1
         np.asarray(out)  # stream-ordered: last done => all done
-        best = min(best, (time.perf_counter() - t0) / ITERS)
-    rate = R * N_COLS / best
-    print(json.dumps({
-        "variant": name, "cols_per_sec": round(rate, 1),
-        "hbm_bytes_per_sec": round(rate / 4, 1),
-        "frac_hbm_peak": round((rate / 4) / HBM_PEAK, 3),
-    }), flush=True)
-    return ref.ravel()
+        self.best = min(self.best, (time.perf_counter() - t0) / ITERS)
+
+    def report(self) -> None:
+        rate = R * N_COLS / self.best
+        print(json.dumps({
+            "variant": self.name, "cols_per_sec": round(rate, 1),
+            "hbm_bytes_per_sec": round(rate / 4, 1),
+            "frac_hbm_peak": round((rate / 4) / HBM_PEAK, 3),
+            "iters": ITERS, "trials": TRIALS, "schedule": "interleaved",
+        }), flush=True)
 
 
 def main() -> None:
@@ -182,14 +204,36 @@ def main() -> None:
     scalar = lambda s: jnp.uint32(s)  # noqa: E731
     vec1 = lambda s: np.full(1, s, np.uint32)  # noqa: E731
 
-    _stage("timing xla variant")
-    ref = bench(xla_kernel, a, b, "xla", scalar)
-    for bw in (1 << 15, 1 << 16, 1 << 17):
-        _stage(f"timing pallas bw={bw}")
-        bench(pallas_intersect_count(bw), a, b, f"pallas_bw{bw}", vec1,
-              expect=ref)
-    _stage("timing xla drift bracket")
-    bench(xla_kernel, a, b, "xla_rerun", scalar)
+    variants = [Variant(xla_kernel, "xla", scalar)]
+    for bw in (1 << 15, 1 << 16, 1 << 17, 1 << 18):
+        variants.append(
+            Variant(pallas_intersect_count(bw), f"pallas_bw{bw}", vec1)
+        )
+
+    _stage("compiling + gating variants")
+    ref = variants[0].compile_and_gate(a, b)
+    # ref=None (xla failed to compile) degrades the Pallas gates to
+    # ungated rather than aborting: a broken reference variant must not
+    # cost the run its remaining data points (errors never abort).
+    for v in variants[1:]:
+        v.compile_and_gate(a, b, expect=ref)
+    live = [v for v in variants if v.ok]
+    if not live:
+        return
+
+    # try/finally: a mid-run relay death (it happened twice this round)
+    # must not lose the best-of-N-so-far data already held for every
+    # variant — report whatever has at least one completed pass.
+    try:
+        for t in range(TRIALS):
+            _stage(f"interleaved trial {t + 1}/{TRIALS} "
+                   f"({', '.join(v.name for v in live)})")
+            for v in live:
+                v.timed_pass(a, b)
+    finally:
+        for v in live:
+            if v.best < float("inf"):
+                v.report()
 
 
 if __name__ == "__main__":

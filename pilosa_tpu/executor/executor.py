@@ -287,6 +287,9 @@ class Executor:
         self._operand_memo: dict = {}
         self._operand_memo_gen = -1
         self._listened_cache = None
+        # guards the re-home check-then-register below: two serving
+        # threads racing it would both register the clear listener
+        self._rehome_lock = threading.Lock()
 
     def _clear_operand_memo(self) -> None:
         """Generation listener (called under the residency lock — must
@@ -541,10 +544,23 @@ class Executor:
                 # the global cache can be swapped after construction
                 # (Server.open's budget-sized cache); re-home the eager
                 # clear listener so evictions on the LIVE cache drop our
-                # array references, and dump entries from the old one
-                cache.add_generation_listener(self._clear_operand_memo)
-                self._listened_cache = cache
-                self._operand_memo.clear()
+                # array references, and dump entries from the old one.
+                # Unregister from the old cache first: its bumps would
+                # otherwise keep clearing a memo that no longer tracks
+                # it, and a swap-back would stack duplicate listeners.
+                # Locked double-check: concurrent serving threads racing
+                # the swap must not both register.
+                with self._rehome_lock:
+                    if cache is not self._listened_cache:
+                        if self._listened_cache is not None:
+                            self._listened_cache.remove_generation_listener(
+                                self._clear_operand_memo
+                            )
+                        cache.add_generation_listener(
+                            self._clear_operand_memo
+                        )
+                        self._listened_cache = cache
+                        self._operand_memo.clear()
             gen = cache.generation
             if gen != self._operand_memo_gen:
                 self._operand_memo.clear()

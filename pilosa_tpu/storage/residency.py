@@ -201,6 +201,20 @@ class DeviceRowCache:
         with self._lock:
             self._gen_listeners.append(weakref.WeakMethod(fn))
 
+    def remove_generation_listener(self, fn) -> None:
+        """Unregister ``fn`` (and drop dead refs). Re-homing callers
+        (the executor when the global cache is swapped) must remove
+        themselves from the OLD cache: a still-registered listener
+        would keep wholesale-clearing state that now tracks the new
+        cache, and a swap-back would stack duplicate registrations."""
+        with self._lock:
+            live = []
+            for ref in self._gen_listeners:
+                cb = ref()  # bind once: a second ref() could race GC
+                if cb is not None and cb != fn:
+                    live.append(ref)
+            self._gen_listeners = live
+
     def _bump_generation(self) -> None:
         """Caller holds the lock. Bump + notify snapshot holders."""
         self.generation += 1

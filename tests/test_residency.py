@@ -255,29 +255,78 @@ def test_touch_refreshes_lru_position():
     assert cold.calls == 2  # evicted: it was the LRU-coldest
 
 
-def test_generation_listener_fires_and_rehomes_on_swap():
-    """Executor memo integration: the generation listener clears the
-    memo eagerly, and a set_global_row_cache swap re-homes it to the
-    live cache on the next memoized assembly."""
-    from pilosa_tpu.storage import residency as res_mod
-
+def test_generation_listener_weakly_held():
+    """Listener mechanics: fires on a bump, dead registrants dropped,
+    remove_generation_listener unregisters."""
     calls = []
 
     class L:
         def cb(self):
             calls.append(1)
 
+    c1 = DeviceRowCache(budget_bytes=1 << 20)
+    listener = L()
+    c1.add_generation_listener(listener.cb)
+    c1.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
+    c1.invalidate(("x",))
+    assert calls == [1]  # bump fired the listener
+    c1.remove_generation_listener(listener.cb)
+    c1.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
+    c1.invalidate(("x",))
+    assert calls == [1]  # removed: no further calls
+    keeper = L()
+    c1.add_generation_listener(keeper.cb)
+    listener2 = L()
+    c1.add_generation_listener(listener2.cb)
+    del listener2
+    c1.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
+    c1.invalidate(("x",))
+    assert calls == [1, 1]  # weakly held: dead listener dropped, live kept
+
+
+def test_executor_memo_rehomes_on_cache_swap(tmp_path):
+    """Executor re-home integration (executor.py _eval_operands): after
+    set_global_row_cache swaps the live cache, (a) the memo is cleared
+    and rebuilt against the NEW cache, (b) the listener moves — bumps on
+    the OLD cache no longer clear the live memo, (c) a swap-back does
+    not stack duplicate registrations."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage import Holder
+    from pilosa_tpu.storage import residency as res_mod
+
+    holder = Holder(str(tmp_path / "data")).open()
     old = res_mod.global_row_cache()
     try:
-        c1 = DeviceRowCache(budget_bytes=1 << 20)
-        listener = L()
-        c1.add_generation_listener(listener.cb)
+        f = holder.create_index("i").create_field("f")
+        f.set_bit(1, 3)
+        f.set_bit(1, 99)
+        ex = Executor(holder)
+        c1 = DeviceRowCache(budget_bytes=8 << 20)
+        res_mod.set_global_row_cache(c1)
+        assert ex.execute("i", "Count(Row(f=1))") == [2]
+        assert ex.execute("i", "Count(Row(f=1))") == [2]  # memo hit path
+        assert ex._listened_cache is c1 and ex._operand_memo
+
+        c2 = DeviceRowCache(budget_bytes=8 << 20)
+        res_mod.set_global_row_cache(c2)
+        assert ex.execute("i", "Count(Row(f=1))") == [2]
+        assert ex._listened_cache is c2 and ex._operand_memo
+        # (b) old-cache bumps must NOT clear the memo tracking c2
         c1.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
         c1.invalidate(("x",))
-        assert calls == [1]  # bump fired the listener
-        del listener
-        c1.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
-        c1.invalidate(("x",))
-        assert calls == [1]  # weakly held: dead listener dropped
+        assert ex._operand_memo, "stale cache bump cleared the live memo"
+        # ...while a bump on the LIVE cache still clears it eagerly
+        c2.get_row(("x",), CountingDecoder(sparse_row(np.random.default_rng(1), 20)))
+        c2.invalidate(("x",))
+        assert not ex._operand_memo
+
+        # (c) swap-back: exactly one live registration per cache
+        res_mod.set_global_row_cache(c1)
+        assert ex.execute("i", "Count(Row(f=1))") == [2]
+        assert ex._listened_cache is c1
+        alive = [r for r in c1._gen_listeners if r() is not None]
+        assert len(alive) == 1
+        assert not [r for r in c2._gen_listeners if r() is not None]
     finally:
         res_mod.set_global_row_cache(old)
+        holder.close()
