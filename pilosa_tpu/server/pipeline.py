@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 
 
@@ -36,11 +37,29 @@ class QueryPipeline:
     construction (server.py wiring) without re-plumbing.
     """
 
+    # Adaptive gather (see _loop): once the inter-arrival gap drops
+    # under PRESSURE_GAP_S the dispatcher holds a forming wave open for
+    # up to GATHER_WINDOW_S (or until GATHER_CAP requests) so closed-
+    # loop clients arriving a millisecond apart share a dispatch. Under
+    # pressure the added latency is bounded by the window; with sparse
+    # traffic the gap check keeps the zero-wait fast path.
+    GATHER_WINDOW_S = 0.002
+    # Just under the ~5 ms inter-arrival gap of 16 closed-loop clients
+    # on an ~80 ms-RTT tunnel: measured on-chip, 16 clients lose ~6% to
+    # a window that cannot grow their waves, while 64/128 clients
+    # (1-2 ms gaps) gain 0/+27% from it — the gate should open between
+    # those regimes.
+    PRESSURE_GAP_S = 0.004
+    GATHER_CAP = 16  # window-phase fallback when no executor is wired;
+                     # the live executor's microbatch_max wins otherwise
+
     def __init__(self, api):
         self._api = api
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
+        self._last_arrival = 0.0
+        self._recent_gap = float("inf")  # gap between the last 2 arrivals
         self.waves = 0          # dispatch waves formed (observability)
         self.coalesced = 0      # requests that shared a wave with others
 
@@ -51,6 +70,10 @@ class QueryPipeline:
         whole wave containing it has been submitted. The caller resolves
         them (concurrently across request threads)."""
         self._ensure_thread()
+        now = time.monotonic()
+        # benign races: both fields are plain floats read heuristically
+        self._recent_gap = now - self._last_arrival
+        self._last_arrival = now
         fut: Future = Future()
         self._q.put((index, query, kwargs, fut))
         return fut.result()
@@ -72,11 +95,7 @@ class QueryPipeline:
         while True:
             item = self._q.get()
             wave = [item]
-            while True:
-                try:
-                    wave.append(self._q.get_nowait())
-                except queue.Empty:
-                    break
+            self._gather(wave)
             executor = self._api.executor
             self.waves += 1
             if len(wave) > 1:
@@ -93,3 +112,42 @@ class QueryPipeline:
                     fut.set_exception(e)
             for fut, defs in done:
                 fut.set_result(defs)
+
+    def _gather(self, wave: list) -> None:
+        """Grow a forming wave: greedy drain, then — only while arrivals
+        are close together (concurrent load) — hold the wave open up to
+        GATHER_WINDOW_S for stragglers.
+
+        Why the window matters: under saturation each dispatch carries a
+        fixed host+runtime cost, and a drain-only dispatcher outruns the
+        arrival rate, so waves degenerate to ~1 request and throughput
+        caps at 1/dispatch-cost no matter how many clients pile on
+        (measured: 128 concurrent clients scored BELOW 64). Holding the
+        wave open for ~an inter-arrival gap converts concurrency into
+        batch size instead. The pressure gate keeps sparse traffic on
+        the zero-wait path."""
+        while True:
+            # unbounded: already-queued requests are free to take, and a
+            # mixed-shape backlog needs the whole wave in one submit to
+            # fill per-shape micro-batch groups (capping here would
+            # split shapes across waves and flush partial groups)
+            try:
+                wave.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        if self._recent_gap >= self.PRESSURE_GAP_S:
+            return
+        # WAITING past one full micro-batch buys nothing, so the window
+        # phase caps at the live executor's batch limit (falls back to
+        # the class constant when unwired, e.g. unit tests)
+        cap = getattr(getattr(self._api, "executor", None),
+                      "microbatch_max", None) or self.GATHER_CAP
+        deadline = time.monotonic() + self.GATHER_WINDOW_S
+        while len(wave) < cap:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            try:
+                wave.append(self._q.get(timeout=left))
+            except queue.Empty:
+                return

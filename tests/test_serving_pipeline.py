@@ -192,6 +192,59 @@ class TestHTTPServing:
         finally:
             servers[0].close()
 
+    def test_gather_window_coalesces_under_pressure(self):
+        """_gather unit behavior: under pressure (small inter-arrival
+        gap) the dispatcher holds the wave open and absorbs stragglers;
+        with sparse traffic it returns immediately with no window wait.
+        Generous timings so a loaded CI box cannot flake the assertion
+        in the strict direction (stretched sleeps only ADD stragglers
+        to the window)."""
+        import time as _time
+
+        pipe = QueryPipeline(api=None)
+        pipe.GATHER_WINDOW_S = 0.25
+        pipe._recent_gap = 0.0  # pressure: arrivals back-to-back
+        for i in range(3):
+            pipe._q.put(i)  # already queued: greedy drain picks up
+
+        def feeder():
+            for i in range(5):
+                _time.sleep(0.01)
+                pipe._q.put(100 + i)
+
+        t = threading.Thread(target=feeder)
+        t.start()
+        wave = [pipe._q.get()]
+        pipe._gather(wave)
+        t.join()
+        # 1 + 2 drained + stragglers caught inside the 250 ms window;
+        # floor not equality: a stretched CI scheduler can push late
+        # feeder puts past the deadline, never add extras
+        assert 4 <= len(wave) <= 8, len(wave)
+
+        pipe._recent_gap = 1.0  # sparse: no pressure
+        pipe._q.put(1)
+        wave = [pipe._q.get()]
+        t0 = _time.monotonic()
+        pipe._gather(wave)
+        assert _time.monotonic() - t0 < 0.05  # zero-wait fast path
+        assert len(wave) == 1
+
+        # already-queued items are free: the greedy drain is unbounded
+        # (a mixed-shape backlog must reach one submit), while the
+        # WINDOW phase stops waiting at the cap
+        pipe._recent_gap = 0.0
+        n = pipe.GATHER_CAP + 5
+        for i in range(n):
+            pipe._q.put(i)
+        wave = [pipe._q.get()]
+        t0 = _time.monotonic()
+        pipe._gather(wave)
+        assert len(wave) == n, len(wave)  # all n drained, none left
+        # and the full wave means the window never opened (no 2 ms wait
+        # beyond at most one timed get)
+        assert _time.monotonic() - t0 < 0.1
+
     def test_mixed_reads_and_writes_concurrent(self, tmp_path):
         """Writes take the eager routed path, reads the pipeline —
         interleaved concurrent traffic must neither deadlock nor lose
