@@ -514,6 +514,16 @@ class API:
     def version(self) -> dict:
         return {"version": __version__}
 
+    def recalculate_caches(self) -> None:
+        """Node-local authoritative recount of every fragment's TopN row
+        cache (reference ``POST /recalculate-caches`` — same per-node
+        semantics: callers hit each node they want recalculated)."""
+        for idx in list(self.holder.indexes.values()):
+            for field in list(idx.fields.values()):
+                for view in list(field.views.values()):
+                    for frag in list(view.fragments.values()):
+                        frag.recalculate_cache()
+
     def max_shards(self) -> dict:
         return {
             "standard": {

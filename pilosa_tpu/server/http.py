@@ -48,6 +48,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/version$"), "get_version"),
     ("GET", re.compile(r"^/export$"), "get_export"),
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
+    ("POST", re.compile(r"^/recalculate-caches$"), "post_recalculate_caches"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
     ("GET", re.compile(r"^/internal/shards/list$"), "get_shards_list"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
@@ -277,6 +278,15 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
     def get_version(self, query=None):
         self._json(self.api.version())
+
+    def post_recalculate_caches(self, query=None):
+        """Reference parity: authoritative per-node TopN cache recount;
+        204 No Content on success, as upstream."""
+        self._body()  # drain: unread bytes would corrupt keep-alive reuse
+        self.api.recalculate_caches()
+        # RFC 7230 §3.3.2: no Content-Length on a 204
+        self.send_response(204)
+        self.end_headers()
 
     def get_metrics(self, query=None):
         from pilosa_tpu.storage.residency import global_row_cache

@@ -575,6 +575,22 @@ class Fragment:
 
     # -------------------------------------------------------------- TopN feed
 
+    def recalculate_cache(self) -> None:
+        """Rebuild the TopN row cache from exact container cardinalities
+        and persist it (reference ``POST /recalculate-caches`` —
+        fragment.RecalculateCache). Every write path maintains the cache
+        incrementally; this is the authoritative recount for anything
+        that drifted (a crash between bitmap flush and cache save, a
+        hand-edited data dir)."""
+        with self.lock:
+            fresh = new_row_cache(self.row_cache.kind,
+                                  self.row_cache.max_size)
+            rows, counts = self.row_counts()
+            for r, c in zip(rows.tolist(), counts.tolist()):
+                fresh.bulk_add(r, c)
+            self.row_cache = fresh
+            self.row_cache.save(self._cache_path())
+
     def top(self, n: int = 10, row_ids=None):
         """Local TopN candidates: (row, count) pairs from the ranked cache,
         counts exact (recomputed) — phase 1 of the reference's two-phase
