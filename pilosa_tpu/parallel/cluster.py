@@ -270,6 +270,12 @@ class Cluster:
             idx = self.holder.index(message["index"])
             if idx is not None and idx.field(message["field"]) is not None:
                 idx.delete_field(message["field"])
+        elif kind == "recalculate-caches":
+            # reference RecalculateCachesMessage: each receiver recounts
+            # its own fragments' TopN caches (local-only apply — the
+            # originator already broadcast to every peer)
+            if self.api is not None:
+                self.api.recalculate_caches(remote=True)
         elif kind == "forward-query":
             # a write forwarded verbatim (attr calls); apply locally
             if self.api is not None:

@@ -514,10 +514,13 @@ class API:
     def version(self) -> dict:
         return {"version": __version__}
 
-    def recalculate_caches(self) -> None:
-        """Node-local authoritative recount of every fragment's TopN row
-        cache (reference ``POST /recalculate-caches`` — same per-node
-        semantics: callers hit each node they want recalculated)."""
+    def recalculate_caches(self, remote: bool = False) -> None:
+        """Authoritative recount of every fragment's TopN row cache
+        (reference ``POST /recalculate-caches`` → api.RecalculateCaches:
+        broadcast to peers, then recount locally). ``remote=True`` marks
+        a peer-originated message: apply locally only, no re-broadcast."""
+        if not remote:
+            self._broadcast({"type": "recalculate-caches"})
         for idx in list(self.holder.indexes.values()):
             for field in list(idx.fields.values()):
                 for view in list(field.views.values()):

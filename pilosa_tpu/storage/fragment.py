@@ -583,6 +583,9 @@ class Fragment:
         that drifted (a crash between bitmap flush and cache save, a
         hand-edited data dir)."""
         with self.lock:
+            if not self._open:
+                return  # racing index delete: nothing to repair, and
+                        # save() would raise inside the removed dir
             fresh = new_row_cache(self.row_cache.kind,
                                   self.row_cache.max_size)
             rows, counts = self.row_counts()

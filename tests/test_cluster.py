@@ -91,6 +91,36 @@ class TestMembership:
             assert schema["indexes"][0]["name"] == "repos"
             assert schema["indexes"][0]["fields"][0]["name"] == "stargazer"
 
+    def test_recalculate_caches_broadcasts(self, cluster3):
+        """POST /recalculate-caches to ONE node repairs drifted TopN
+        caches on EVERY node (reference api.RecalculateCaches: SendSync
+        then local recount)."""
+        req("POST", f"{uri(cluster3[0])}/index/i", {})
+        req("POST", f"{uri(cluster3[0])}/index/i/field/f", {})
+        for shard in range(6):  # bits spread across all three nodes
+            cols = [shard * SHARD_WIDTH + c for c in range(4)]
+            req("POST", f"{uri(cluster3[shard % 3])}/index/i/field/f/import",
+                {"rows": [1] * len(cols), "columns": cols})
+        # drift every node's caches for its local fragments of field f
+        drifted = []
+        for s in cluster3:
+            for view in s.holder.indexes["i"].fields["f"].views.values():
+                for frag in view.fragments.values():
+                    frag.row_cache.bulk_add(1, 12345)
+                    frag.row_cache.bulk_add(77, 9)  # phantom
+                    drifted.append(frag)
+        assert drifted
+        r = urllib.request.Request(
+            f"{uri(cluster3[2])}/recalculate-caches", data=b"",
+            method="POST",
+        )
+        with urllib.request.urlopen(r) as resp:
+            assert resp.status == 204
+        for frag in drifted:
+            assert frag.row_cache.get(77) is None, frag.frag_id
+            c = frag.row_cache.get(1)
+            assert c is None or c != 12345, frag.frag_id
+
 
 class TestDistributedQueries:
     def seed_data(self, cluster3):

@@ -319,13 +319,15 @@ def test_randomized_workload_vs_oracle(tmp_path, seed):
         holder.close()
 
 
-def test_cluster_randomized_with_membership_churn(tmp_path):
+@pytest.mark.parametrize("seed", [99])
+def test_cluster_randomized_with_membership_churn(tmp_path, seed):
     """Randomized workload against a REPLICATED cluster with membership
     churn in the middle: writes through alternating nodes, a third node
     joins mid-workload (async resize), a node leaves gracefully after —
     and at every stage the read surface matches the oracle from every
     live node (SURVEY §4's quick-check-vs-oracle lesson applied to the
-    cluster layer)."""
+    cluster layer). Parametrized by seed so fuzz campaigns can sweep
+    fresh workloads (CI pins one)."""
     from cluster_helpers import join_node, make_cluster, req
 
     def http_ex(servers, rng):
@@ -362,7 +364,7 @@ def test_cluster_randomized_with_membership_churn(tmp_path):
                 out = req("POST", url, f"Count(Row(m={row}))".encode())
                 assert out["results"] == [len(oracle.mutex_row(row))]
 
-    rng = np.random.default_rng(99)
+    rng = np.random.default_rng(seed)
     servers = make_cluster(tmp_path, 2, replica_n=2, prefix="cnode")
     try:
         base = f"http://localhost:{servers[0].port}"
