@@ -343,11 +343,18 @@ def leaf_key(idx, spec, block: ShardBlock) -> tuple:
         _ZeroSpec,
     )
 
+    # idx.scope (the holder-unique data-dir path) leads every key: two
+    # Holders in one process (in-process clusters, embedded multi-server)
+    # hold DIFFERENT replicas' data under identical index/field names, and
+    # a shared-cache hit across them served one node a stale copy of
+    # another's row (membership-churn property sweep). The zero leaf
+    # stays unscoped: all-zero content is identical everywhere.
     if isinstance(spec, _RowSpec):
-        return ("stack", idx.name, spec.field, spec.views, spec.row,
-                block.key())
+        return ("stack", idx.scope, idx.name, spec.field, spec.views,
+                spec.row, block.key())
     if isinstance(spec, _PlanesSpec):
-        return ("stackp", idx.name, spec.field, 2 + spec.depth, block.key())
+        return ("stackp", idx.scope, idx.name, spec.field, 2 + spec.depth,
+                block.key())
     if isinstance(spec, _ZeroSpec):
         return ("stackz", block.key())
     raise PQLError(f"unknown leaf spec {type(spec).__name__}")
@@ -426,7 +433,8 @@ def stacked_leaf(idx, spec, block: ShardBlock, device_put=None):
     else:
         raise PQLError(f"unknown leaf spec {type(spec).__name__}")
 
-    return cache.get_or_build(key, (idx.name, spec.field), probe, decode,
+    return cache.get_or_build(key, (idx.scope, idx.name, spec.field),
+                               probe, decode,
                               device_put=device_put)
 
 
@@ -441,8 +449,8 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
     cache = residency.global_row_cache()
     view_name = view.name if view is not None else None
     n_rows = len(row_ids) + pad_rows
-    key = ("stackm", idx.name, field_name, view_name, tuple(row_ids),
-           pad_rows, block.key())
+    key = ("stackm", idx.scope, idx.name, field_name, view_name,
+           tuple(row_ids), pad_rows, block.key())
 
     def live_view():
         # resolve by NAME at decode time, never through the captured
@@ -484,7 +492,8 @@ def stacked_matrix(idx, field_name: str, view, row_ids, block: ShardBlock,
             delta_on_clear=True,
         )
 
-    return cache.get_or_build(key, (idx.name, field_name), probe, decode,
+    return cache.get_or_build(key, (idx.scope, idx.name, field_name),
+                               probe, decode,
                               device_put=device_put)
 
 

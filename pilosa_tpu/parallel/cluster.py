@@ -156,6 +156,127 @@ class Cluster:
             if self._local_fetch_jobs <= 0:
                 self.state = self._commanded_state
 
+    # How long the coordinator waits for every member to drain to NORMAL
+    # before the post-resize cleanup. A member still RESIZING runs its
+    # own gated self-join fetch, which may be SOURCING from fragments the
+    # cleanup would delete; on timeout the cleanup is skipped entirely
+    # (safe: stale copies only mislead after a LATER ownership change,
+    # and the next resize retries the cleanup). Runs under _resize_lock,
+    # so the timeout also bounds how long a follow-on resize can be
+    # delayed behind an undrainable peer.
+    CLEANUP_DRAIN_TIMEOUT = 15.0
+
+    def _broadcast_cleanup(self) -> None:
+        """End-of-resize holder cleanup, coordinator-initiated: every
+        member drops fragments for shards it no longer owns. Runs ONLY
+        after (a) every receiver reported resize-complete AND (b) every
+        member's /status shows NORMAL — a joiner's self-join inventory
+        fetch is a separate background job that outlives the
+        instruction-resize, and deleting its source fragments mid-fetch
+        loses sole copies (exactly what happened when cleanup ran at
+        resize-complete time in the join test). The message carries the
+        membership the coordinator resized against: a receiver whose
+        member view disagrees (missed join/leave broadcast) skips, so a
+        stale ring can never compute wrong ownership and delete a sole
+        surviving copy."""
+        with self._lock:
+            members = sorted(self.nodes)
+            # Poll EVERY peer, including DEGRADED ones: a transient
+            # failure (missed instruction ack, heartbeat blip) marks a
+            # LIVE node DEGRADED while its gated self-join fetch is
+            # still in flight — skipping it here would let cleanup
+            # delete the sole source copy that fetch is about to pull
+            # (fatal at replica_n=1). An actually-dead peer never
+            # reports NORMAL, so the deadline below converts it into a
+            # conservative cleanup skip; the timeout bounds how long a
+            # follow-on resize can stall behind it.
+            peers = [n for n in self.nodes.values()
+                     if n.id != self.local.id]
+        deadline = time.monotonic() + self.CLEANUP_DRAIN_TIMEOUT
+        pending = {p.id: p for p in peers}
+        while pending:
+            with self._lock:
+                if sorted(self.nodes) != members:
+                    return  # membership changed mid-drain: the new
+                            # event's own resize will clean up instead
+            for pid, node in list(pending.items()):
+                try:
+                    st = self.client.status(node.uri)
+                except ClientError:
+                    continue  # unreachable: retry until the deadline
+                if st.get("state") == STATE_NORMAL:
+                    del pending[pid]
+            if not pending:
+                break
+            if time.monotonic() >= deadline:
+                if self.logger is not None:
+                    self.logger.info(
+                        "skipping post-resize cleanup: %s still draining",
+                        sorted(pending),
+                    )
+                return
+            time.sleep(0.1)
+        try:
+            self.cleanup_unowned(members)
+        except Exception as e:  # noqa: BLE001 — must not wedge the resize
+            self._log_exception("post-resize holder cleanup", e)
+        self._broadcast({"type": "resize-cleanup", "members": members})
+
+    def cleanup_unowned(self, members: list[str] | None = None) -> int:
+        """Reference post-resize holder cleanup: delete fragments for
+        shards this node no longer owns. Without this, a node that loses
+        a shard during churn keeps an era-frozen copy; when a later
+        resize returns ownership, the missing-only fetch skips the held
+        fragment and the node serves stale data (set-field union repair
+        cannot remove the stale-extra bits, and Store/ClearRow computed
+        from the stale replica poison healthy ones — found by the
+        seed-swept membership-churn property test). ``members`` is the
+        coordinator's post-resize membership; mismatch with the local
+        view means this node's ring is stale and deleting by it could
+        destroy a sole copy — skip. Returns #fragments removed."""
+        if self.holder is None:
+            return 0
+        with self._lock:
+            local_members = sorted(self.nodes)
+        if self.local.id not in local_members:
+            return 0  # departed (leave()): never self-wipe on exit
+        if members is not None and sorted(members) != local_members:
+            if self.logger is not None:
+                self.logger.info(
+                    "skipping post-resize cleanup: membership %s != "
+                    "coordinator's %s", local_members, sorted(members),
+                )
+            return 0
+        removed = 0
+        for index_name, idx in list(self.holder.indexes.items()):
+            owned: dict[int, bool] = {}
+            for field in list(idx.fields.values()):
+                for view in list(field.views.values()):
+                    view_removed = 0
+                    for shard in list(view.fragments):
+                        mine = owned.get(shard)
+                        if mine is None:
+                            mine = any(
+                                n.id == self.local.id
+                                for n in self.shard_nodes(index_name, shard)
+                            )
+                            owned[shard] = mine
+                        if not mine:
+                            view.remove_fragment(
+                                shard, invalidate_derived=False
+                            )
+                            view_removed += 1
+                    if view_removed:
+                        # one derived-entry purge per field, not per shard
+                        view.invalidate_derived_entries()
+                        removed += view_removed
+        if removed and self.logger is not None:
+            self.logger.info(
+                "post-resize cleanup: removed %d non-owned fragments",
+                removed,
+            )
+        return removed
+
     def _log_exception(self, what: str, exc: BaseException) -> None:
         logger = self.logger
         if logger is not None:
@@ -270,6 +391,11 @@ class Cluster:
             idx = self.holder.index(message["index"])
             if idx is not None and idx.field(message["field"]) is not None:
                 idx.delete_field(message["field"])
+        elif kind == "resize-cleanup":
+            try:
+                self.cleanup_unowned(message.get("members"))
+            except Exception as e:  # noqa: BLE001
+                self._log_exception("post-resize holder cleanup", e)
         elif kind == "recalculate-caches":
             # reference RecalculateCachesMessage: each receiver recounts
             # its own fragments' TopN caches (local-only apply — the
@@ -442,39 +568,51 @@ class Cluster:
         status = self.client.status(seed_uri)
         for n in status.get("nodes", []):
             self.nodes[n["id"]] = Node(n["id"], n["uri"])
-        # announce to everyone (including seed)
-        for node in self.sorted_nodes():
-            if node.id == self.local.id:
-                continue
-            try:
-                self.client.send_message(
-                    node.uri,
-                    {"type": "node-join", "id": self.local.id, "uri": self.local.uri},
-                )
-            except ClientError:
-                pass
-        # adopt schema from the seed
-        schema = self.client.schema(seed_uri)
-        for idx_schema in schema.get("indexes", []):
-            self.handle_message(
-                {
-                    "type": "create-index",
-                    "index": idx_schema["name"],
-                    **idx_schema.get("options", {}),
-                }
-            )
-            for f in idx_schema.get("fields", []):
+        # Gate BEFORE announcing: the announce triggers the coordinator's
+        # resize, whose post-resize cleanup waits for every member to
+        # drain to NORMAL — this node must never be observable as NORMAL
+        # in the window between its instruction-job finishing and its
+        # self-join inventory fetch starting, or the cleanup could delete
+        # the very fragments that fetch is about to pull.
+        self._begin_local_fetch()
+        try:
+            # announce to everyone (including seed)
+            for node in self.sorted_nodes():
+                if node.id == self.local.id:
+                    continue
+                try:
+                    self.client.send_message(
+                        node.uri,
+                        {"type": "node-join", "id": self.local.id,
+                         "uri": self.local.uri},
+                    )
+                except ClientError:
+                    pass
+            # adopt schema from the seed
+            schema = self.client.schema(seed_uri)
+            for idx_schema in schema.get("indexes", []):
                 self.handle_message(
                     {
-                        "type": "create-field",
+                        "type": "create-index",
                         "index": idx_schema["name"],
-                        "field": f["name"],
-                        "options": f.get("options", {}),
+                        **idx_schema.get("options", {}),
                     }
                 )
-        self.resize_fetch_async()
+                for f in idx_schema.get("fields", []):
+                    self.handle_message(
+                        {
+                            "type": "create-field",
+                            "index": idx_schema["name"],
+                            "field": f["name"],
+                            "options": f.get("options", {}),
+                        }
+                    )
+            self.resize_fetch_async(pre_gated=True)
+        except BaseException:
+            self._end_local_fetch()
+            raise
 
-    def resize_fetch_async(self) -> threading.Thread:
+    def resize_fetch_async(self, pre_gated: bool = False) -> threading.Thread:
         """Self-join fetch as a background job — the async pattern the
         instruction-driven resize path uses (_run_resize_job): the joiner
         flips to RESIZING immediately (queries gate on wait_until_normal)
@@ -483,16 +621,22 @@ class Cluster:
         concurrently. Unlike the instruction path, no keepalives are
         sent: this is the pull-based fallback — no coordinator is
         awaiting a completion report, and progress is observable as
-        state=RESIZING in /status."""
-        self._begin_local_fetch()  # gate queries before returning
+        state=RESIZING in /status. ``pre_gated``: the caller already
+        holds the local-fetch gate (join() gates before announcing) and
+        hands it to the fetch thread — exactly one begin per end."""
+        if not pre_gated:
+            self._begin_local_fetch()  # gate queries before returning
         t = threading.Thread(target=self._resize_fetch_gated, daemon=True,
                              name="self-join-fetch")
         try:
             t.start()
         except BaseException:
-            # the thread never ran, so the gate would never drain and the
-            # node would sit RESIZING forever
-            self._end_local_fetch()
+            # the thread never ran, so the gate would never drain and
+            # the node would sit RESIZING forever. pre_gated: the
+            # CALLER's exception handler releases its own begin — ending
+            # here too would double-decrement and un-gate a later fetch
+            if not pre_gated:
+                self._end_local_fetch()
             raise
         return t
 
@@ -756,6 +900,10 @@ class Cluster:
             # THIS node while reaching others — idempotent and serialized
             # under _resize_lock, so always safe.
             self._broadcast_state(STATE_NORMAL)
+            # a leave can complete with nothing to move (survivors
+            # already hold everything) yet still change ownership —
+            # non-owned leftovers must go now, not at the next resize
+            self._broadcast_cleanup()
             return {}
         job = uuid.uuid4().hex
         with self._resize_cv:
@@ -807,6 +955,7 @@ class Cluster:
                 self._resize_job = None
                 self._resize_pending = set()
             self._broadcast_state(STATE_NORMAL)
+            self._broadcast_cleanup()
         return instructions
 
     def _broadcast_state(self, state: str) -> None:

@@ -106,8 +106,10 @@ class FieldOptions:
 
 
 class Field:
-    def __init__(self, path: str, index: str, name: str, options: FieldOptions | None = None):
+    def __init__(self, path: str, index: str, name: str,
+                 options: FieldOptions | None = None, scope: str = ""):
         self.path = path
+        self.scope = scope
         self.index = index
         self.name = name
         self.options = options or FieldOptions()
@@ -137,6 +139,7 @@ class Field:
                     name,
                     cache_type=self.options.cache_type,
                     cache_size=self.options.cache_size,
+                    scope=self.scope,
                 ).open()
         from pilosa_tpu.storage.attrs import AttrStore
 
@@ -153,7 +156,9 @@ class Field:
         # deleted and recreated under the same name
         from pilosa_tpu.storage import residency
 
-        residency.global_row_cache().invalidate_tag((self.index, self.name))
+        residency.global_row_cache().invalidate_tag(
+            (self.scope, self.index, self.name)
+        )
 
     def _save_meta(self) -> None:
         with open(os.path.join(self.path, ".meta"), "w") as f:
@@ -174,6 +179,7 @@ class Field:
                         name,
                         cache_type=self.options.cache_type,
                         cache_size=self.options.cache_size,
+                        scope=self.scope,
                     ).open()
                     self.views[name] = v
         return v

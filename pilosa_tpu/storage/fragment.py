@@ -58,13 +58,19 @@ class Fragment:
         cache_type: str = CACHE_TYPE_RANKED,
         cache_size: int = DEFAULT_CACHE_SIZE,
         snapshot_threshold: int = DEFAULT_SNAPSHOT_OP_THRESHOLD,
+        scope: str = "",
     ):
         self.path = path
         self.index = index
         self.field = field
         self.view = view
         self.shard = shard
-        self.frag_id = (index, field, view, shard)
+        self.scope = scope
+        # scope leads the id: residency keys and write-routing tags must
+        # never collide across two Holders in one process (in-process
+        # clusters, embedded multi-server) — same-named fragments on
+        # different holders hold DIFFERENT replicas' data
+        self.frag_id = (scope, index, field, view, shard)
         self.bitmap = RoaringBitmap()
         self.op_n = 0
         # monotonic content version: bumped on every mutation (see
@@ -526,7 +532,7 @@ class Fragment:
         cache.invalidate_fragment(self.frag_id + ("__planes__",))
         cache.apply_write(residency.WriteEvent(
             self.index, self.field, self.view, self.shard, row,
-            positions=positions, added=added,
+            positions=positions, added=added, scope=self.scope,
         ))
         self.row_cache.add(row, self.count_row(row))
         from pilosa_tpu.utils.stats import global_stats

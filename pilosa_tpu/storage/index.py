@@ -24,6 +24,13 @@ class Index:
     def __init__(self, path: str, name: str, keys: bool = False, track_existence: bool = True):
         self.path = path
         self.name = name
+        # Residency-cache scope: unique per holder data dir, so two
+        # Holders in ONE process (in-process cluster tests, embedded
+        # multi-server use) can never collide on device-cache keys or
+        # write-routing tags for same-named indexes (a shared-cache hit
+        # on another holder's leaf served stale replica data — found by
+        # the seed-swept membership-churn property test).
+        self.scope = path
         self.keys = keys
         self.track_existence = track_existence
         self.fields: dict[str, Field] = {}
@@ -53,7 +60,8 @@ class Index:
         for entry in sorted(os.listdir(self.path)):
             p = os.path.join(self.path, entry)
             if os.path.isdir(p) and not entry.startswith("."):
-                self.fields[entry] = Field(p, self.name, entry).open()
+                self.fields[entry] = Field(p, self.name, entry,
+                                           scope=self.scope).open()
         if self.track_existence and EXISTENCE_FIELD not in self.fields:
             self.create_field(EXISTENCE_FIELD, FieldOptions(type=TYPE_SET, cache_type="none"))
         from pilosa_tpu.storage.attrs import AttrStore
@@ -79,7 +87,8 @@ class Index:
                 raise ValueError(f"field {name!r} already exists")
             _validate_name(name, allow_internal=name == EXISTENCE_FIELD)
             field = Field(
-                os.path.join(self.path, name), self.name, name, options
+                os.path.join(self.path, name), self.name, name, options,
+                scope=self.scope,
             ).open()
             self.fields[name] = field
             self.plan_epoch += 1

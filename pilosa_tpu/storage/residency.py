@@ -87,10 +87,10 @@ class WriteEvent:
     """
 
     __slots__ = ("index", "field", "view", "shard", "row", "positions",
-                 "added")
+                 "added", "scope")
 
     def __init__(self, index, field, view, shard, row, positions=None,
-                 added=None):
+                 added=None, scope=""):
         self.index = index
         self.field = field
         self.view = view
@@ -98,6 +98,7 @@ class WriteEvent:
         self.row = row
         self.positions = positions
         self.added = added
+        self.scope = scope
 
 
 class _DenseEntry:
@@ -435,7 +436,7 @@ class DeviceRowCache:
         global write-generation purge, which evicted EVERY stacked leaf on
         any write). Runs fully under the lock so concurrent writers can't
         lose each other's read-modify-write of a shared leaf."""
-        tag = (event.index, event.field)
+        tag = (event.scope, event.index, event.field)
         with self._lock:
             self.write_events += 1
             for key in list(self._tag_index.get(tag, ())):

@@ -183,7 +183,7 @@ def test_apply_write_patches_dense_and_spares_unrelated():
             return None
         return lambda arr: arr | jnp.uint32(1)
 
-    cache.register_updater(("stack", "i", "f", 1), ("i", "f"), probe)
+    cache.register_updater(("stack", "i", "f", 1), ("", "i", "f"), probe)
     cache.apply_write(WriteEvent("i", "f", "standard", 0, 1))
     assert probed == [1] and cache.updates == 1
     assert len(cache) == 2 and cache.misses == 2  # nothing evicted
@@ -212,7 +212,7 @@ def test_apply_write_invalidates_compressed_copies():
     def probe_hit(ev):
         return (lambda arr: arr) if ev.row == 1 else None
 
-    cache.register_updater(("stack", "i", "f", 1), ("i", "f"), probe_hit)
+    cache.register_updater(("stack", "i", "f", 1), ("", "i", "f"), probe_hit)
     cache.get_row(("stack", "i", "f", 2), b)  # demotes a to compressed
     assert cache.compressions == 1
     cache.apply_write(WriteEvent("i", "f", "standard", 0, 1))
@@ -226,12 +226,12 @@ def test_updaters_dropped_with_entries():
     rng = np.random.default_rng(15)
     cache = DeviceRowCache(budget_bytes=4 << 20)
     cache.get_row(("k",), CountingDecoder(sparse_row(rng, 2)))
-    cache.register_updater(("k",), ("i", "f"), lambda ev: None)
-    assert ("i", "f") in cache._tag_index
+    cache.register_updater(("k",), ("", "i", "f"), lambda ev: None)
+    assert ("", "i", "f") in cache._tag_index
     cache.invalidate(("k",))
     assert not cache._tag_index and not cache._updaters
     # registering for a non-resident key is a no-op
-    cache.register_updater(("gone",), ("i", "f"), lambda ev: None)
+    cache.register_updater(("gone",), ("", "i", "f"), lambda ev: None)
     assert not cache._updaters
     cache.apply_write(WriteEvent("i", "f", "standard", 0, 1))  # no crash
 

@@ -115,7 +115,7 @@ class TestSetDoesNotEvictUnrelatedLeaves:
         fill(f, rows=[1], shards=1)
         fill(g, rows=[1], shards=1)
         ex.execute("i", "Count(Row(f=1)) Count(Row(g=1))")
-        g_keys = [k for k in cache()._rows if len(k) > 2 and k[2] == "g"]
+        g_keys = [k for k in cache()._rows if len(k) > 3 and k[3] == "g"]
         assert g_keys
         g_arrs = [cache()._rows[k].arr for k in g_keys]
         ex.execute("i", "Set(9, f=1)")
