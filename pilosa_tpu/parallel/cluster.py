@@ -202,8 +202,11 @@ class Cluster:
             for pid, node in list(pending.items()):
                 try:
                     st = self.client.status(node.uri)
-                except ClientError:
-                    continue  # unreachable: retry until the deadline
+                except Exception:  # noqa: BLE001 — a freshly-killed
+                    # peer can surface raw socket errors the client
+                    # doesn't wrap; any failure means "not confirmably
+                    # NORMAL", retried until the deadline
+                    continue
                 if st.get("state") == STATE_NORMAL:
                     del pending[pid]
             if not pending:
@@ -439,12 +442,23 @@ class Cluster:
                 # ack now, fetch in a worker: the coordinator's delivery
                 # must not block on the fetch (a large move would trip
                 # the client timeout, spuriously DEGRADE a healthy-but-
-                # busy node, and un-gate queries mid-move)
-                threading.Thread(
-                    target=self._run_resize_job,
-                    args=(message.get("sources", []), job, reply_to),
-                    daemon=True,
-                ).start()
+                # busy node, and un-gate queries mid-move). Gate BEFORE
+                # spawning: if the worker took the gate itself, a node
+                # whose other fetch paths just drained would be briefly
+                # observable as NORMAL while the instruction fragments
+                # are still missing — wait_until_normal callers then
+                # query short (caught ~1-in-15 under CI load).
+                self._begin_local_fetch()
+                try:
+                    threading.Thread(
+                        target=self._run_resize_job,
+                        args=(message.get("sources", []), job, reply_to,
+                              True),
+                        daemon=True,
+                    ).start()
+                except BaseException:
+                    self._end_local_fetch()
+                    raise
         elif kind == "resize-complete":
             with self._resize_cv:
                 if message.get("job") == self._resize_job:
@@ -483,6 +497,15 @@ class Cluster:
             if not new:
                 return
             seen.update(new)
+            # Self-knowledge too: the shard universe is monotonic
+            # cluster metadata (reference maxShard only grows), NOT a
+            # reflection of local holdings. Without this, a node whose
+            # post-resize cleanup deleted its formerly-local fragments
+            # lost those shards from its own fan-out universe whenever
+            # the peer-poll cache predated the resize — a cluster-wide
+            # Count quietly skipped them (mesh join test, ~1-in-10
+            # under load).
+            self.known_shards.setdefault(index, set()).update(new)
         if len(self.nodes) <= 1:
             return
         message = {"type": "create-shard", "index": index, "shards": new}
@@ -749,7 +772,17 @@ class Cluster:
         fetches running concurrently. Fragment objects are resolved (and
         created) serially first — view.fragment(create=True) must not be
         raced for one (view, shard) — and the per-fragment union runs
-        under each fragment's own lock."""
+        under each fragment's own lock.
+
+        A joiner runs TWO overlapping fetch paths (its own inventory
+        fetch and the coordinator's resize instruction), which can both
+        transfer a fragment when their timing overlaps. That redundancy
+        is DELIBERATE: the union is idempotent, and each path covers the
+        other's failure modes (the instruction job can arrive before
+        schema adoption and fetch nothing; the inventory can race a
+        source's cleanup). An earlier claims registry that deduplicated
+        them converted a failed instruction fetch into a permanent gap —
+        the skipped inventory pass was the safety net."""
         work = []
         for src in sources:
             idx = self.holder.index(src["index"])
@@ -796,13 +829,17 @@ class Cluster:
     RESIZE_PROGRESS_INTERVAL = 10.0
 
     def _run_resize_job(self, sources: list[dict], job: str,
-                        reply_to: str | None) -> None:
+                        reply_to: str | None,
+                        pre_gated: bool = False) -> None:
         """Receiver worker for an async resize instruction: fetch, with a
         timer thread sending progress keepalives for as long as the fetch
         runs — wall-clock-based, not per-fragment, so one huge fragment
         cannot outlast the coordinator's quiet deadline silently — then
         report completion (reference resize-job pattern — nodes fetch
-        asynchronously and report, SURVEY.md §3.5)."""
+        asynchronously and report, SURVEY.md §3.5). ``pre_gated``: the
+        message handler already holds the local-fetch gate (taken before
+        spawning this worker) and hands it over — exactly one begin per
+        the finally's end."""
         done = threading.Event()
 
         def keepalive() -> None:
@@ -815,12 +852,16 @@ class Cluster:
                 except ClientError:
                     pass
 
+        if not pre_gated:
+            self._begin_local_fetch()
         ka = None
-        if reply_to:
-            ka = threading.Thread(target=keepalive, daemon=True)
-            ka.start()
-        self._begin_local_fetch()
         try:
+            # keepalive start is INSIDE the gate's try: a thread-spawn
+            # failure here must still release the handed-over gate, or
+            # the node wedges RESIZING forever
+            if reply_to:
+                ka = threading.Thread(target=keepalive, daemon=True)
+                ka.start()
             fetched = self.fetch_fragments(sources)
         except Exception as e:
             self._log_exception("resize-instruction fetch", e)

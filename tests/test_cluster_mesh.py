@@ -86,6 +86,12 @@ class TestMeshClusterResize:
         servers = make_mesh_cluster(tmp_path, 2)
         try:
             seed(servers[0], n_shards=8)
+            # prime both nodes' shard-universe poll caches BEFORE the
+            # join: the post-cleanup re-check below must prove a node
+            # still covers its formerly-local shards from its own
+            # metadata when the poll cache predates the resize
+            for s in servers:
+                s.api.executor._all_shards("i")
             late = join_node(tmp_path, servers[0], use_mesh=True,
                              name="m9", prefix="mlate")
             servers.append(late)
@@ -101,6 +107,21 @@ class TestMeshClusterResize:
                 out = req("POST", f"{uri(s)}/index/i/query",
                           b"Count(Row(f=1))")
                 assert out == {"results": [32]}, s.api.cluster.local.id
+            # Deterministic post-cleanup coverage (the async cleanup may
+            # or may not have landed by the queries above): prime every
+            # node's shard-universe poll cache, force the cleanup
+            # everywhere, and re-check — a node whose formerly-local
+            # fragments were just deleted must still fan out over the
+            # full universe from its own metadata (regression: it lost
+            # them whenever the poll cache predated the resize).
+            members = sorted(servers[0].api.cluster.nodes)
+            for s in servers:
+                s.api.cluster.cleanup_unowned(members)
+            for s in servers:
+                out = req("POST", f"{uri(s)}/index/i/query",
+                          b"Count(Row(f=1))")
+                assert out == {"results": [32]}, (
+                    "post-cleanup", s.api.cluster.local.id)
         finally:
             for s in servers:
                 s.close()
