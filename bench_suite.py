@@ -434,6 +434,116 @@ def config_serving(n_shards: int = 8, n_clients: int = 16,
             server.close()
 
 
+def config_serving_readwrite(n_shards: int = 32, n_clients: int = 16,
+                             n_ops: int = 256) -> dict:
+    """Mixed READ+WRITE concurrent serving: 75% Counts through the wave
+    pipeline, 25% point Sets through the routed write path (each write
+    durably logged before its ACK and patched into resident leaves).
+    Correctness: every write must ACK true and the final written row
+    must equal the written column set exactly. Produced the BENCH_SUITE
+    'serving.readwrite' record."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory() as tmp:
+        server = Server(ServerConfig(
+            data_dir=tmp, port=0, name="bench", anti_entropy_interval=0,
+            heartbeat_interval=0,
+        )).open()
+        try:
+            idx = server.holder.create_index("b")
+            f = idx.create_field("f")
+            n = int(SHARD_WIDTH * 0.1)
+            for shard in range(n_shards):
+                frag = f.view(VIEW_STANDARD, create=True).fragment(
+                    shard, create=True
+                )
+                for row in range(1, 5):
+                    frag.bulk_import(
+                        np.full(n, row, np.uint64),
+                        rng.choice(SHARD_WIDTH, n, replace=False).astype(
+                            np.uint64
+                        ),
+                    )
+            server.api.cluster.note_local_shards("b", list(range(n_shards)))
+            url = f"http://localhost:{server.port}/index/b/query"
+
+            def post(pql: str) -> dict:
+                r = urllib.request.Request(
+                    url, data=pql.encode(), method="POST"
+                )
+                with urllib.request.urlopen(r, timeout=300) as resp:
+                    return _json.loads(resp.read())
+
+            write_cols = rng.choice(
+                n_shards * SHARD_WIDTH, n_ops // 4, replace=False
+            ).tolist()
+            ops, wi = [], 0
+            for i in range(n_ops):
+                if i % 4 == 3:
+                    ops.append(f"Set({write_cols[wi]}, f=9)")
+                    wi += 1
+                else:
+                    ops.append(
+                        "Count(Intersect(Row(f={}), Row(f={})))".format(
+                            1 + (i % 4), 1 + ((i + 1) % 4)
+                        )
+                    )
+            post(ops[0])
+            post("Count(Row(f=9))")  # warm both program shapes
+            t0 = time.perf_counter()
+            for q in ops[:64]:
+                post(q)
+            serial_qps = 64 / (time.perf_counter() - t0)
+            post("ClearRow(f=9)")
+
+            results: list = [None] * n_ops
+            errors: list = []
+            gate = threading.Event()
+
+            def worker(tid: int):
+                gate.wait(30)
+                for k in range(tid, n_ops, n_clients):
+                    try:
+                        results[k] = post(ops[k])
+                    except Exception as e:  # surfaced below
+                        errors.append(repr(e))
+
+            threads = [threading.Thread(target=worker, args=(t,))
+                       for t in range(n_clients)]
+            for t in threads:
+                t.start()
+            t0 = time.perf_counter()
+            gate.set()
+            for t in threads:
+                t.join(600)
+            wall = time.perf_counter() - t0
+            ok = not errors
+            ok = ok and all(results[k] == {"results": [True]}
+                            for k in range(3, n_ops, 4))
+            ok = ok and post("Count(Row(f=9))") == {
+                "results": [len(write_cols)]
+            }
+            return {
+                "config": "readwrite",
+                "metric": "serving_readwrite_qps",
+                "value": round(n_ops / wall, 1),
+                "unit": "queries/sec",
+                "qps_serial": round(serial_qps, 1),
+                "speedup_vs_serial": round((n_ops / wall) / serial_qps, 2),
+                "clients": n_clients, "ops": n_ops, "write_frac": 0.25,
+                "shards": n_shards, "ok": bool(ok),
+            }
+        finally:
+            server.close()
+
+
 def config_import(n_shards: int = 8, rows_per_shard: int = 4,
                   density: float = 0.05) -> dict:
     """Bulk-import throughput — the reference's write-path hot loop
@@ -751,6 +861,10 @@ def main() -> None:
         "serving": lambda: config_serving(
             n_shards=64 if args.full else 8,
             n_queries=256 if args.full else 64,
+        ),
+        "readwrite": lambda: config_serving_readwrite(
+            n_shards=32 if args.full else 8,
+            n_ops=256 if args.full else 64,
         ),
         "import": lambda: config_import(
             n_shards=32 if args.full else 8,

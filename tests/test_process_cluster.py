@@ -82,6 +82,22 @@ def wait_members(base, want, timeout=20):
     raise AssertionError(f"{base}: members {seen} != {want}")
 
 
+
+
+def terminate_all(procs):
+    """Shared teardown: TERM everyone first, then reap (kill stragglers)."""
+    for p in procs:
+        if p is not None:
+            p.terminate()
+    for p in procs:
+        if p is None:
+            continue
+        try:
+            p.wait(15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
 def test_two_process_cluster_end_to_end(tmp_path):
     p0 = p1 = None
     port0, port1 = free_port(), free_port()
@@ -140,15 +156,7 @@ def test_two_process_cluster_end_to_end(tmp_path):
         out = req("POST", f"{b0}/index/i/query", b"Count(Row(f=1))")
         assert out == {"results": [6]}
     finally:
-        for p in (p0, p1):
-            if p is not None:
-                p.terminate()
-        for p in (p0, p1):
-            if p is not None:
-                try:
-                    p.wait(15)
-                except subprocess.TimeoutExpired:
-                    p.kill()
+        terminate_all([p0, p1])
 
 
 def test_sigkill_durability_acked_writes_survive(tmp_path):
@@ -187,9 +195,73 @@ def test_sigkill_durability_acked_writes_survive(tmp_path):
         out = req("POST", f"{b}/index/i/query", b"Set(999999, f=1)")
         assert out == {"results": [True]}
     finally:
-        if p is not None:
-            p.terminate()
-            try:
-                p.wait(15)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        terminate_all([p])
+
+
+def test_third_process_joins_resize_and_cleanup(tmp_path):
+    """A third OS process joins a live 2-process cluster: the resize
+    moves its owned shards' data across real process boundaries, the
+    post-resize cleanup leaves each shard on exactly its owner, and
+    cluster-wide queries stay exact from every process throughout."""
+    procs = []
+    try:
+        port0, port1, port2 = free_port(), free_port(), free_port()
+        p0, b0 = spawn_server(tmp_path, "q0", port0)
+        procs.append(p0)
+        p1, b1 = spawn_server(tmp_path, "q1", port1, seed_port=port0)
+        procs.append(p1)
+        for b in (b0, b1):
+            wait_members(b, {"q0", "q1"})
+        req("POST", f"{b0}/index/i", {})
+        req("POST", f"{b0}/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + c for s in range(8) for c in (3, 9)]
+        req("POST", f"{b0}/index/i/field/f/import",
+            {"rows": [1] * len(cols), "columns": cols})
+        assert req("POST", f"{b0}/index/i/query",
+                   b"Count(Row(f=1))") == {"results": [16]}
+
+        p2, b2 = spawn_server(tmp_path, "q2", port2, seed_port=port0)
+        procs.append(p2)
+        for b in (b0, b1, b2):
+            wait_members(b, {"q0", "q1", "q2"})
+        # resize completes: the joiner drains to NORMAL and every node
+        # answers the full count (including the joiner's moved shards)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = req("GET", f"{b2}/status")
+            if st["state"] == "NORMAL":
+                break
+            time.sleep(0.25)
+        assert st["state"] == "NORMAL", st
+        for b in (b0, b1, b2):
+            out = req("POST", f"{b}/index/i/query", b"Count(Row(f=1))")
+            assert out == {"results": [16]}, b
+        # writes through the NEW process land and are visible everywhere
+        req("POST", f"{b2}/index/i/query",
+            "Set({}, f=2)".format(3 * SHARD_WIDTH + 77).encode())
+        for b in (b0, b1, b2):
+            assert req("POST", f"{b}/index/i/query",
+                       b"Count(Row(f=2))") == {"results": [1]}, b
+        # post-resize cleanup (async): eventually no shard's fragment
+        # file exists on more than replica_n=1 processes
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            over = []
+            for s in range(8):
+                holders = [
+                    n for n in ("q0", "q1", "q2")
+                    if (tmp_path / n / "i" / "f" / "views" / "standard"
+                        / "fragments" / str(s)).exists()
+                ]
+                if len(holders) > 1:
+                    over.append((s, holders))
+            if not over:
+                break
+            time.sleep(0.5)
+        assert not over, over
+        # and the data still fully reachable after cleanup
+        for b in (b0, b1, b2):
+            assert req("POST", f"{b}/index/i/query",
+                       b"Count(Row(f=1))") == {"results": [16]}, b
+    finally:
+        terminate_all(procs)
