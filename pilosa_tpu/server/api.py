@@ -514,6 +514,15 @@ class API:
     def version(self) -> dict:
         return {"version": __version__}
 
+    def pipeline_metrics(self) -> dict:
+        """Wave-coalescing counters for the exporters (zeros until the
+        first pipelined query — the series must exist from scrape one so
+        rate()/increase() windows are well-behaved)."""
+        pipe = self._pipeline
+        if pipe is None:
+            return {"waves": 0, "coalesced": 0}
+        return {"waves": pipe.waves, "coalesced": pipe.coalesced}
+
     def recalculate_caches(self, remote: bool = False) -> None:
         """Authoritative recount of every fragment's TopN row cache
         (reference ``POST /recalculate-caches`` → api.RecalculateCaches:

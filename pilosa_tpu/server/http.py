@@ -294,8 +294,17 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
         stats = global_stats()
         text = stats.prometheus_text()
-        text += global_row_cache().prometheus_lines(
-            getattr(stats, "prefix", "pilosa_tpu")
+        prefix = getattr(stats, "prefix", "pilosa_tpu")
+        text += global_row_cache().prometheus_lines(prefix)
+        # wave coalescing health: queries/waves ratio is the batch
+        # factor operators size concurrency against (OPERATIONS.md);
+        # exported as 0 from scrape one so rate() windows never see the
+        # series appear mid-flight
+        pm = self.api.pipeline_metrics()
+        text += (
+            f"{prefix}_serving_waves_total {pm['waves']}\n"
+            f"{prefix}_serving_coalesced_requests_total "
+            f"{pm['coalesced']}\n"
         )
         self._text(text, "text/plain; version=0.0.4")
 
@@ -315,6 +324,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
         snap = global_stats().snapshot()
         snap["residency"] = global_row_cache().metrics()
+        snap["serving_pipeline"] = self.api.pipeline_metrics()
         self._json(snap)
 
     def get_pprof(self, query=None):

@@ -165,6 +165,14 @@ def test_status_info_version_metrics(node):
     text = req("GET", f"{node}/metrics", raw=True).decode()
     assert "pilosa_tpu_residency_bytes_used" in text
     assert "pilosa_tpu_residency_hits_total" in text
+    # run one pipelined read, then the wave-coalescing counters must
+    # be exported for operators (and exist as 0 even before it)
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    req("POST", f"{node}/index/i/query", b"Set(1, f=1)")
+    req("POST", f"{node}/index/i/query", b"Count(Row(f=1))")
+    text = req("GET", f"{node}/metrics", raw=True).decode()
+    assert "pilosa_tpu_serving_waves_total" in text
     (budget_line,) = [l for l in text.splitlines()
                       if l.startswith("pilosa_tpu_residency_budget_bytes")]
     dv = req("GET", f"{node}/debug/vars")
