@@ -1,0 +1,17 @@
+# Test targets. Tier-1 (the CI gate) runs the whole suite minus
+# @pytest.mark.slow stress cases; the qos-smoke target runs the serving
+# QoS fault-injection suite in isolation (fast feedback while tuning
+# admission/deadline/hedge knobs — see docs/QOS.md).
+
+PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
+
+.PHONY: test test-slow qos-smoke
+
+test:
+	$(PYTEST) tests/ -m "not slow"
+
+test-slow:
+	$(PYTEST) tests/ -m slow
+
+qos-smoke:
+	$(PYTEST) tests/test_qos.py -m "not slow"

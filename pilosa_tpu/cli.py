@@ -38,6 +38,15 @@ heartbeat-interval = 5.0      # seconds; 0 disables death detection
 # device-budget-bytes = 0     # HBM residency budget; 0 = auto
 long-query-time = 0.0         # log queries slower than this; 0 = off
 max-writes-per-request = 5000 # reject larger write batches; 0 = unlimited
+
+# Serving QoS (docs/QOS.md): admission -> deadline -> hedged reads
+qos-max-inflight = 0          # concurrent-query cap; excess sheds 429 (0 = off)
+qos-tenant-inflight = 0       # per-tenant cap (X-Pilosa-Tenant); 0 = global
+qos-default-deadline = 0.0    # server-default request deadline; 0 = none
+qos-hedge-delay = 0.25        # hedge trigger before the p95 tracker warms up
+qos-hedge-budget = 0.05       # max hedges as a fraction of reads; 0 disables
+qos-breaker-threshold = 5     # consecutive faults before a breaker opens
+qos-breaker-cooldown = 5.0    # open -> half-open probe interval (seconds)
 tracing = false               # span collection on /debug/traces
 # statsd = "127.0.0.1:8125"   # statsd UDP sink (Prometheus /metrics is
                               # always on)
@@ -54,7 +63,10 @@ verbose = false
 def _load_config(path: str | None) -> dict:
     cfg: dict = {}
     if path:
-        import tomllib
+        try:  # stdlib on 3.11+
+            import tomllib
+        except ImportError:  # 3.10 runtimes ship the identical tomli
+            import tomli as tomllib
 
         with open(path, "rb") as f:
             cfg = tomllib.load(f)

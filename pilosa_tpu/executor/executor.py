@@ -298,7 +298,13 @@ class Executor:
 
     # ------------------------------------------------------------ top level
 
-    def execute(self, index_name: str, query, shards=None):
+    def execute(self, index_name: str, query, shards=None, deadline=None):
+        if deadline is not None:
+            # the local map is fast (per-shard work is cheap, the paper's
+            # tail math is all coordination) — enforcing at the dispatch
+            # boundary is what keeps an expired sub-query from occupying
+            # a device dispatch slot at all
+            deadline.check("local execute")
         idx = self.holder.index(index_name)
         if idx is None:
             raise PQLError(f"index {index_name!r} not found")
@@ -311,7 +317,7 @@ class Executor:
             lambda call: self._execute_call(idx, call, shards),
         )
 
-    def submit(self, index_name: str, query, shards=None):
+    def submit(self, index_name: str, query, shards=None, deadline=None):
         """Pipelined execution: parse, compile, and ENQUEUE each call's
         device program without blocking on the result readback; returns
         one ``Deferred`` per call, resolved on ``.result()``.
@@ -332,7 +338,14 @@ class Executor:
         candidates). Remaining call types (writes, host-only reads)
         evaluate eagerly at submit time and return an already-resolved
         Deferred.
+
+        ``deadline`` (qos.Deadline) is enforced at the dispatch boundary:
+        an already-expired request raises before any device program is
+        enqueued, so a backlogged wave sheds its dead requests instead of
+        spending dispatches on answers nobody is waiting for.
         """
+        if deadline is not None:
+            deadline.check("local submit")
         idx = self.holder.index(index_name)
         if idx is None:
             raise PQLError(f"index {index_name!r} not found")

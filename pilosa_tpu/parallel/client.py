@@ -64,15 +64,19 @@ class InternalClient:
 
     def _call(self, method: str, url: str, body: bytes | None = None,
               content_type: str = "application/json", raw: bool = False,
-              accept: str | None = None):
+              accept: str | None = None, headers: dict | None = None,
+              timeout: float | None = None):
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
         if accept is not None:
             req.add_header("Accept", accept)
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         try:
             with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl_context
+                req, timeout=self.timeout if timeout is None else timeout,
+                context=self._ssl_context
             ) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
@@ -93,19 +97,43 @@ class InternalClient:
             ) from e
         except urllib.error.URLError as e:
             raise ClientError(f"{method} {url}: {e.reason}") from e
+        except (TimeoutError, OSError) as e:
+            # a timeout during the response READ surfaces as a bare
+            # socket.timeout (urlopen only wraps connect-stage faults in
+            # URLError) — it is the same transport-level node fault, and
+            # deadline-capped hops hit it routinely on a stalled peer
+            raise ClientError(f"{method} {url}: {str(e) or 'timed out'}") from e
         return data if raw else json.loads(data or b"{}")
 
     # ---------------------------------------------------------------- query
 
     def query_node(self, uri: str, index: str, pql: str, shards: list[int],
-                   remote: bool = True) -> dict:
+                   remote: bool = True, deadline=None) -> dict:
         """One sub-query carrying an explicit shard list (reference
         QueryRequest{Remote: true, Shards: [...]} — SURVEY.md §3.2).
 
         Negotiates a protobuf response (Accept: x-protobuf) so remote row
         results travel as varint-packed column ids instead of JSON int
         lists; decoded to the same dict shapes either way. A peer whose
-        wire lacks protobuf answers 406 once, then gets JSON."""
+        wire lacks protobuf answers 406 once, then gets JSON.
+
+        ``deadline`` (qos.Deadline) rides the hop as a remaining-budget
+        header AND caps the transport timeout, so a stalled peer is
+        abandoned when the root's budget runs out — not after the full
+        client timeout."""
+        def hop_kwargs():
+            """Deadline header + transport cap from the budget remaining
+            NOW — recomputed for the JSON fallback after a 406, so a
+            failed protobuf attempt's latency is not re-granted to the
+            peer as budget."""
+            if deadline is None:
+                return {}, None
+            from pilosa_tpu.qos.deadline import DEADLINE_HEADER
+
+            deadline.check("remote hop")
+            return ({DEADLINE_HEADER: str(deadline.to_millis())},
+                    min(self.timeout, max(deadline.remaining(), 1e-3)))
+
         qs = f"?shards={','.join(map(str, shards))}"
         if remote:
             qs += "&remote=true"
@@ -113,10 +141,12 @@ class InternalClient:
         if self._proto_ok(uri):
             from pilosa_tpu.wire.serializer import decode_results_json
 
+            headers, timeout = hop_kwargs()
             try:
                 raw = self._call(
                     "POST", url, pql.encode(), content_type="text/plain",
                     raw=True, accept="application/x-protobuf",
+                    headers=headers, timeout=timeout,
                 )
             except ClientError as e:
                 if not self._is_406(e):
@@ -132,8 +162,10 @@ class InternalClient:
                     # None) so the caller keeps its replica fallback
                     raise ClientError(f"POST {url}: {out['error']}")
                 return out
+        headers, timeout = hop_kwargs()
         return self._call("POST", url, pql.encode(),
-                          content_type="text/plain")
+                          content_type="text/plain", headers=headers,
+                          timeout=timeout)
 
     # --------------------------------------------------------------- import
 

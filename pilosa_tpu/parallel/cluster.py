@@ -236,11 +236,20 @@ class Cluster:
         seed-swept membership-churn property test). ``members`` is the
         coordinator's post-resize membership; mismatch with the local
         view means this node's ring is stale and deleting by it could
-        destroy a sole copy — skip. Returns #fragments removed."""
+        destroy a sole copy — skip. Returns #fragments removed.
+
+        The node RING is snapshotted under _lock at the same moment the
+        membership is verified, and every per-shard ownership decision
+        below walks that frozen snapshot (ADVICE r5 TOCTOU): a
+        node-join/leave message landing mid-loop would otherwise swing
+        shard_nodes() to the NEW ring before the new ring's resize has
+        copied anything — at replica_n=1 deleting by the new ring
+        destroys the sole copy the coming resize needs as its source."""
         if self.holder is None:
             return 0
         with self._lock:
             local_members = sorted(self.nodes)
+            ring = self._frozen_ring()
         if self.local.id not in local_members:
             return 0  # departed (leave()): never self-wipe on exit
         if members is not None and sorted(members) != local_members:
@@ -261,7 +270,10 @@ class Cluster:
                         if mine is None:
                             mine = any(
                                 n.id == self.local.id
-                                for n in self.shard_nodes(index_name, shard)
+                                for n in self._partition_nodes_on(
+                                    ring,
+                                    self.partition(index_name, shard),
+                                )
                             )
                             owned[shard] = mine
                         if not mine:
@@ -326,7 +338,20 @@ class Cluster:
     def partition_nodes(self, partition: int) -> list[Node]:
         """replica_n nodes for a partition: walk the ring of nodes ordered
         by hash(node id), starting at the partition's point."""
-        ring = sorted(self.nodes.values(), key=lambda n: (_hash64(n.id), n.id))
+        return self._partition_nodes_on(
+            self._frozen_ring(), partition
+        )
+
+    def _frozen_ring(self) -> list[Node]:
+        """Hash-ordered snapshot of the current membership. Callers that
+        make several ownership decisions against ONE membership view
+        (cleanup_unowned) take this once under _lock and walk it, so a
+        join/leave landing mid-walk cannot shift ownership under them."""
+        return sorted(self.nodes.values(),
+                      key=lambda n: (_hash64(n.id), n.id))
+
+    def _partition_nodes_on(self, ring: list[Node],
+                            partition: int) -> list[Node]:
         if not ring:
             return []
         start = partition % len(ring)

@@ -33,6 +33,7 @@ from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.ops.packing import pack_bits
 from pilosa_tpu.parallel.client import ClientError
 from pilosa_tpu.parallel.cluster import Cluster, Node
+from pilosa_tpu.qos.deadline import DeadlineExceeded
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.pql.ast import Query
 from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_of
@@ -51,10 +52,15 @@ class ClusterExecutor:
 
     accepts_remote = True
 
-    def __init__(self, local_executor: Executor, cluster: Cluster):
+    def __init__(self, local_executor: Executor, cluster: Cluster,
+                 qos=None):
         self.local = local_executor
         self.holder = local_executor.holder
         self.cluster = cluster
+        # serving-QoS bundle (qos.ServingQos): hedge policy + per-node
+        # circuit breakers for the remote read fan-out; None disables
+        # both (bare constructions in tests/tools)
+        self.qos = qos
         self._shards_cache: dict[str, tuple[float, list[int]]] = {}
         self._lock = threading.Lock()
         # key translation goes through the coordinator (reference:
@@ -76,12 +82,19 @@ class ClusterExecutor:
 
     # ------------------------------------------------------------ top level
 
-    def execute(self, index_name: str, query, shards=None, remote: bool = False):
+    def execute(self, index_name: str, query, shards=None,
+                remote: bool = False, deadline=None):
         if remote:
             # sub-query from a peer: evaluate strictly locally on the given
             # shards, no re-fan-out (reference Remote=true)
-            return self.local.execute(index_name, query, shards=shards)
-        if not self.cluster.wait_until_normal(_RESIZE_WAIT):
+            return self.local.execute(index_name, query, shards=shards,
+                                      deadline=deadline)
+        if not self.cluster.wait_until_normal(
+            _RESIZE_WAIT if deadline is None
+            else min(_RESIZE_WAIT, max(deadline.remaining(), 0))
+        ):
+            if deadline is not None:
+                deadline.check("resize wait")
             raise PQLError("cluster is resizing; query deferred past timeout")
         if isinstance(query, str):
             query = parse(query)
@@ -90,9 +103,11 @@ class ClusterExecutor:
         idx = self.holder.index(index_name)
         if idx is None:
             raise PQLError(f"index {index_name!r} not found")
-        return [self._execute_call(idx, call, shards) for call in query.calls]
+        return [self._execute_call(idx, call, shards, deadline=deadline)
+                for call in query.calls]
 
-    def submit(self, index_name: str, query, shards=None, remote: bool = False):
+    def submit(self, index_name: str, query, shards=None,
+               remote: bool = False, deadline=None):
         """Pipelined cluster execution: one ``Deferred`` per call.
 
         The cluster analog of ``Executor.submit`` (the reference serves
@@ -111,7 +126,8 @@ class ClusterExecutor:
         """
         if remote:
             # peer sub-query: strictly local, still pipelined
-            return self.local.submit(index_name, query, shards=shards)
+            return self.local.submit(index_name, query, shards=shards,
+                                     deadline=deadline)
         if isinstance(query, str):
             query = parse(query)
         elif isinstance(query, Call):
@@ -126,22 +142,33 @@ class ClusterExecutor:
             # calls submit, never result) stays unblocked.
             def deferred(call):
                 def finalize():
-                    if not self.cluster.wait_until_normal(_RESIZE_WAIT):
+                    wait = _RESIZE_WAIT
+                    if deadline is not None:
+                        wait = min(wait, max(deadline.remaining(), 0))
+                    if not self.cluster.wait_until_normal(wait):
+                        if deadline is not None:
+                            deadline.check("resize wait")
                         raise PQLError(
                             "cluster is resizing; query deferred past timeout"
                         )
-                    return self._execute_call(idx, call, shards)
+                    return self._execute_call(idx, call, shards,
+                                              deadline=deadline)
 
                 return Deferred(finalize)
 
             return [deferred(call) for call in query.calls]
-        return [self._submit_call(idx, call, shards) for call in query.calls]
+        return [self._submit_call(idx, call, shards, deadline=deadline)
+                for call in query.calls]
 
-    def _submit_call(self, idx, call: Call, shards=None) -> Deferred:
+    def _submit_call(self, idx, call: Call, shards=None,
+                     deadline=None) -> Deferred:
+        if deadline is not None:
+            deadline.check("cluster submit")
         name = call.name
         if name == "Options":
             inner = self._submit_call(
-                idx, options_child(call), options_restrict_shards(call, shards)
+                idx, options_child(call),
+                options_restrict_shards(call, shards), deadline=deadline,
             )
             return Deferred(
                 lambda: apply_options_result(idx, call, inner.result())
@@ -151,7 +178,8 @@ class ClusterExecutor:
             # thread NOW so a slow shard owner cannot convoy a serving
             # pipeline's dispatcher; result() joins
             return Deferred(spawn(
-                lambda: self._execute_includes(idx, call, shards)
+                lambda: self._execute_includes(idx, call, shards,
+                                               deadline=deadline)
             ))
         if name in ("Set", "Clear", "Store", "ClearRow") or name in _WRITE_BROADCAST:
             # writes keep eager in-order semantics at submit time
@@ -159,9 +187,11 @@ class ClusterExecutor:
         shard_list = shards if shards is not None else self._all_shards(idx.name)
         local, groups = self._route(idx.name, shard_list)
         if not groups:
-            return self.local.submit(idx.name, call, shards=local)[0]
+            return self.local.submit(idx.name, call, shards=local,
+                                     deadline=deadline)[0]
         if name == "TopN":
-            return self._submit_topn(idx, call, local, groups)
+            return self._submit_topn(idx, call, local, groups,
+                                     deadline=deadline)
         having = None
         if name == "GroupBy":
             having = having_predicate(
@@ -181,8 +211,10 @@ class ClusterExecutor:
         # whose local submit is eager — Rows — would otherwise serialize
         # ahead of it), then the local program enqueues on the device
         # stream; nothing blocks until result()
-        remote_join = spawn(lambda: self._map_remote(idx.name, mapped, groups))
-        local_def = self.local.submit(idx.name, mapped, shards=local)[0]
+        remote_join = spawn(lambda: self._map_remote(idx.name, mapped, groups,
+                                                     deadline=deadline))
+        local_def = self.local.submit(idx.name, mapped, shards=local,
+                                      deadline=deadline)[0]
 
         def finalize():
             local_res = local_def.result()
@@ -257,7 +289,8 @@ class ClusterExecutor:
                     remote.setdefault(n.id, (n, []))[1].append(shard)
         return local, list(remote.values())
 
-    def _map_remote(self, index_name: str, call: Call, groups, _depth=0):
+    def _map_remote(self, index_name: str, call: Call, groups, _depth=0,
+                    deadline=None):
         """One CONCURRENT sub-query per remote node (reference mapReduce:
         one goroutine per remote node — SURVEY.md §2 #12); returns a flat
         list of raw JSON partials (shard coverage exact; group order
@@ -268,17 +301,30 @@ class ClusterExecutor:
         replicas (recursing once per hop, bounded); the query only fails
         when some shard has no live replica left. Reads therefore
         tolerate single-replica faults the way the reference's
-        mapReduce retry loop does."""
+        mapReduce retry loop does.
+
+        With a QoS bundle wired, each sub-query additionally rides the
+        hedged-read path (_query_group): circuit-broken nodes are skipped
+        without paying a transport timeout, and a primary slower than the
+        p95-tracked hedge delay races a budgeted duplicate at the next
+        replica. DeadlineExceeded propagates — an expired budget is a
+        property of the REQUEST, so replica retries must not chase it."""
         pql = call.to_pql()
 
         def one(group):
             node, shard_group = group
             try:
-                out = self.cluster.client.query_node(
-                    node.uri, index_name, pql, shard_group, remote=True
-                )
-                return [out["results"][0]]
+                return self._query_group(index_name, call, pql, node,
+                                         shard_group, _depth, deadline)
             except ClientError as e:
+                if deadline is not None and deadline.expired:
+                    # the budget died with this hop: report the deadline,
+                    # not the transport symptom — no retry can answer an
+                    # expired request, so replica fallback must not run
+                    raise DeadlineExceeded(
+                        f"deadline exceeded during remote read "
+                        f"({node.id}: {e})"
+                    ) from e
                 # Transport/5xx: the NODE is sick — degrade it and retry
                 # siblings. 404: ambiguous — 'index/field not found' can
                 # mean a schema-lagging replica, so retry siblings but do
@@ -286,7 +332,11 @@ class ClusterExecutor:
                 # query errors every replica would repeat — surface as
                 # PQLError (HTTP 400), never 'internal'.
                 if e.is_node_fault:
-                    node.state = "DEGRADED"
+                    # a circuit-open error is synthetic — no contact was
+                    # made, so it reroutes but must not override the
+                    # heartbeat's view of the node
+                    if not getattr(e, "circuit_open", False):
+                        node.state = "DEGRADED"
                 elif e.status != 404:
                     raise PQLError(str(e)) from e
 
@@ -297,20 +347,216 @@ class ClusterExecutor:
 
                 if _depth >= 2:
                     give_up()
-                retry: dict[str, tuple[Node, list[int]]] = {}
-                for shard in shard_group:
-                    alts = [
-                        n for n in self.cluster.shard_nodes(index_name, shard)
-                        if n.id != node.id and n.state == "NORMAL"
-                    ]
-                    if not alts:
-                        give_up()  # no live replica holds this shard
-                    retry.setdefault(alts[0].id, (alts[0], []))[1].append(shard)
+                retry, orphans = self._reroute_groups(
+                    index_name, shard_group, node.id
+                )
+                if orphans:
+                    give_up()  # some shard has no live replica left
                 return self._map_remote(
-                    index_name, call, list(retry.values()), _depth + 1
+                    index_name, call, retry, _depth + 1, deadline=deadline,
                 )
 
         return [p for chunk in concurrent_map(one, groups) for p in chunk]
+
+    # ------------------------------------------------------- hedged reads
+
+    def _reroute_groups(self, index_name: str, shards, exclude_id: str):
+        """Next-live-replica routing shared by the failure fallback and
+        the hedge path: bucket each shard onto its first NORMAL replica
+        that is not ``exclude_id``. Returns ``(groups, orphans)`` —
+        orphans are shards with no live alternate; the caller decides
+        whether that aborts the query (fallback) or merely disables
+        hedging. One implementation so a future replica-selection change
+        cannot make the two paths route differently."""
+        groups: dict[str, tuple[Node, list[int]]] = {}
+        orphans: list[int] = []
+        for shard in shards:
+            alts = [
+                n for n in self.cluster.shard_nodes(index_name, shard)
+                if n.id != exclude_id and n.state == "NORMAL"
+            ]
+            if not alts:
+                orphans.append(shard)
+            else:
+                groups.setdefault(alts[0].id, (alts[0], []))[1].append(shard)
+        return list(groups.values()), orphans
+
+    def _record_breaker_outcome(self, breaker, exc, deadline,
+                                elapsed: float) -> None:
+        """Classify a failed primary read for the circuit breaker.
+
+        A transport/5xx fault with the request's budget still live is
+        plain evidence against the node. At budget expiry it is
+        ambiguous — transport timeouts are capped at the remaining
+        budget (client.py hop_kwargs), so a TIGHT deadline makes a
+        healthy node look faulty (deadline.py's invariant: a request
+        property must not open breakers) while a truly stalled node
+        always faults exactly at expiry and would otherwise never trip
+        its breaker. Discriminate by how long the node was given: a
+        fault after several multiples of the tracked hedge delay (and
+        at least 1 s) counts even at expiry. A 4xx is a deterministic
+        query error every replica would repeat — never node evidence.
+        Inconclusive outcomes release a half-open probe seat without
+        moving state."""
+        if isinstance(exc, ClientError) and exc.is_node_fault:
+            fair_chance = max(1.0, 4 * self.qos.hedge.delay())
+            if (deadline is None or not deadline.expired
+                    or elapsed >= fair_chance):
+                breaker.record_failure()
+                return
+        breaker.record_inconclusive()
+
+    def _alternate_groups(self, index_name: str, primary, shard_group):
+        """Hedge targets for one sub-query. All-or-nothing — a partial
+        hedge would return a partial result that cannot stand in for the
+        primary's, so any shard without a live alternate disables hedging
+        for the whole group."""
+        groups, orphans = self._reroute_groups(index_name, shard_group,
+                                               primary.id)
+        return [] if orphans else groups
+
+    def _query_group(self, index_name: str, call: Call, pql: str, node,
+                     shard_group, _depth, deadline):
+        """One node's sub-query with QoS: circuit breaker, then a hedged
+        race against the next replica when the primary outlives the
+        hedge delay. Returns a flat partial list; raises ClientError on
+        failure so the caller's replica-fallback path stays authoritative
+        for DEGRADED marking and rerouting."""
+        client = self.cluster.client
+        qos = self.qos
+        # kwarg added only when set: bare clients (and test doubles)
+        # predating the deadline wire stay call-compatible
+        dl_kw = {"deadline": deadline} if deadline is not None else {}
+        if qos is None:
+            out = client.query_node(node.uri, index_name, pql, shard_group,
+                                    remote=True, **dl_kw)
+            return [out["results"][0]]
+        breaker = qos.breaker(node.id)
+        if not breaker.allow():
+            # open circuit: don't pay this node's transport timeout —
+            # fail fast into the caller's replica fallback. The error is
+            # SYNTHETIC (no contact was made), so it must reroute like a
+            # node fault without being treated as fresh evidence: the
+            # circuit_open marker stops one() from re-marking a
+            # heartbeat-recovered node DEGRADED off stale breaker state
+            err = ClientError(f"circuit open for node {node.id}")
+            err.circuit_open = True
+            raise err
+        # only EDGE fan-out legs (depth 0) count toward the hedge-budget
+        # denominator and the p95 tracker: hedge legs and fallback
+        # retries re-enter this function at depth >= 1, and counting them
+        # as primaries would inflate the denominator the ≤budget-fraction
+        # invariant divides by (and skew the delay toward retry latency)
+        is_edge_leg = _depth == 0
+        if is_edge_leg:
+            qos.hedge.note_primary()
+        t0 = time.monotonic()
+        if (self.cluster.replica_n <= 1 or _depth >= 2
+                or qos.hedge.budget_fraction <= 0):
+            # no race partner is possible (unreplicated, depth-capped, or
+            # hedging disabled via qos-hedge-budget=0): call inline — the
+            # thread + condvar handshake below would be pure overhead
+            try:
+                out = client.query_node(node.uri, index_name, pql,
+                                        shard_group, remote=True, **dl_kw)
+            except BaseException as e:
+                self._record_breaker_outcome(breaker, e, deadline,
+                                             time.monotonic() - t0)
+                raise
+            if is_edge_leg:
+                qos.hedge.record(time.monotonic() - t0)
+            breaker.record_success()
+            return [out["results"][0]]
+
+        cv = threading.Condition()
+        state: dict = {}
+
+        def finish(key, value):
+            with cv:
+                state.setdefault(key, value)
+                cv.notify_all()
+
+        def run_primary():
+            try:
+                out = client.query_node(node.uri, index_name, pql,
+                                        shard_group, remote=True, **dl_kw)
+            except BaseException as e:
+                self._record_breaker_outcome(breaker, e, deadline,
+                                             time.monotonic() - t0)
+                finish("primary_err", e)
+            else:
+                if is_edge_leg:
+                    qos.hedge.record(time.monotonic() - t0)
+                breaker.record_success()
+                finish("result", ("primary", [out["results"][0]]))
+
+        threading.Thread(target=run_primary, daemon=True,
+                         name=f"qos-primary-{node.id}").start()
+        delay = qos.hedge.delay()
+        if deadline is not None:
+            delay = min(delay, max(deadline.remaining(), 0))
+        with cv:
+            cv.wait_for(lambda: state, timeout=delay)
+            pending = not state
+        hedged = False
+        if pending and not (deadline is not None and deadline.expired):
+            # alternates are computed only now, on the slow path: the
+            # ~95% of reads the primary answers within the delay never
+            # pay the per-shard ring walks
+            alt_groups = self._alternate_groups(index_name, node,
+                                                shard_group)
+            with cv:
+                # the primary may have settled during the ring walk —
+                # don't spend budget on a hedge that cannot win
+                pending = not state
+            if pending and alt_groups and qos.hedge.try_hedge():
+                hedged = True
+
+                def run_hedge():
+                    try:
+                        partials = self._map_remote(
+                            index_name, call, alt_groups, _depth + 1,
+                            deadline=deadline,
+                        )
+                    except BaseException as e:
+                        finish("hedge_err", e)
+                    else:
+                        finish("result", ("hedge", partials))
+
+                threading.Thread(target=run_hedge, daemon=True,
+                                 name=f"qos-hedge-{node.id}").start()
+
+        def settled():
+            return ("result" in state
+                    or ("primary_err" in state
+                        and (not hedged or "hedge_err" in state)))
+
+        with cv:
+            if deadline is None:
+                cv.wait_for(settled)
+            else:
+                # wake at settle OR budget expiry — no fixed-rate polling
+                while not cv.wait_for(settled,
+                                      timeout=max(deadline.remaining(),
+                                                  1e-3)):
+                    if deadline.expired:
+                        break
+        with cv:
+            final = dict(state)
+        if "result" in final:
+            source, partials = final["result"]
+            if source == "hedge":
+                qos.hedge.note_win()
+            return partials
+        if "primary_err" in final:
+            # both legs failed (or no hedge fired): surface the PRIMARY
+            # error so the caller's fallback semantics (DEGRADED marking,
+            # bounded reroute, 4xx propagation) are unchanged
+            raise final["primary_err"]
+        # neither leg settled: the only path here is the expired-budget
+        # break above, so the check always raises DeadlineExceeded
+        deadline.check("hedged read")
+        raise AssertionError("hedged-read settle loop exited unexpectedly")
 
     def _map_remote_tolerant(self, index_name: str, call: Call, groups):
         """Row-wide write fan-out (Store/ClearRow): every replica is
@@ -351,7 +597,7 @@ class ClusterExecutor:
 
     # ----------------------------------------------------------- dispatch
 
-    def _execute_call(self, idx, call: Call, shards=None):
+    def _execute_call(self, idx, call: Call, shards=None, deadline=None):
         name = call.name
         if name in ("Set", "Clear"):
             return self._execute_routed_write(idx, call)
@@ -380,7 +626,7 @@ class ClusterExecutor:
         # _submit_call, resolved immediately. submit's enqueue/spawn
         # overlap gives eager execution the same max(local, slowest peer)
         # wall time run_concurrently did, and the two paths cannot drift.
-        return self._submit_call(idx, call, shards).result()
+        return self._submit_call(idx, call, shards, deadline=deadline).result()
 
     # --------------------------------------------------------------- writes
 
@@ -526,7 +772,8 @@ class ClusterExecutor:
 
     # ----------------------------------------------------------------- TopN
 
-    def _submit_topn(self, idx, call: Call, local, groups) -> Deferred:
+    def _submit_topn(self, idx, call: Call, local, groups,
+                     deadline=None) -> Deferred:
         """Two-phase distributed TopN, pipelined: phase 1 (overfetched
         candidates) enqueues locally and departs remotely at SUBMIT time;
         phase 2 (exact recount of the merged candidate set) must wait for
@@ -541,8 +788,10 @@ class ClusterExecutor:
         if explicit_ids is None:
             overfetch = max(n * TOPN_CANDIDATE_FACTOR, n + 10)
             phase1 = Call("TopN", {**mapped_args, "n": overfetch}, call.children)
-            remote1 = spawn(lambda: self._map_remote(idx.name, phase1, groups))
-            local1 = self.local.submit(idx.name, phase1, shards=local)[0]
+            remote1 = spawn(lambda: self._map_remote(idx.name, phase1, groups,
+                                                     deadline=deadline))
+            local1 = self.local.submit(idx.name, phase1, shards=local,
+                                       deadline=deadline)[0]
 
         def finalize():
             if explicit_ids is None:
@@ -560,7 +809,8 @@ class ClusterExecutor:
             totals: dict[int, int] = {}
             local2, remote2 = run_concurrently(
                 lambda: self.local._execute_call(idx, phase2, local),
-                lambda: self._map_remote(idx.name, phase2, groups),
+                lambda: self._map_remote(idx.name, phase2, groups,
+                                         deadline=deadline),
             )
             for p in local2:
                 totals[p.id] = totals.get(p.id, 0) + p.count
@@ -575,7 +825,7 @@ class ClusterExecutor:
 
         return Deferred(finalize)
 
-    def _execute_includes(self, idx, call: Call, shards=None):
+    def _execute_includes(self, idx, call: Call, shards=None, deadline=None):
         target = self.local.includes_target(idx, call, shards)
         if target is None:
             return False
@@ -588,7 +838,8 @@ class ClusterExecutor:
             return self.local._execute_call(idx, call)
         node = self.cluster.primary_for_shard(idx.name, shard)
         out = self.cluster.client.query_node(
-            node.uri, idx.name, call.to_pql(), [shard], remote=True
+            node.uri, idx.name, call.to_pql(), [shard], remote=True,
+            **({"deadline": deadline} if deadline is not None else {}),
         )
         return out["results"][0]
 

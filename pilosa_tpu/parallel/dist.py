@@ -22,7 +22,19 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map
+
+    SHARD_MAP_NATIVE = True
+except ImportError:  # older runtimes ship it under experimental; on
+    # those, concurrent shard_map programs from SEPARATE executors over
+    # the same forced-CPU device set can deadlock in the cross-module
+    # all-reduce rendezvous — single-mesh use is fine, multi-server
+    # in-process meshes should be avoided (tests gate on this flag)
+    from jax.experimental.shard_map import shard_map
+
+    SHARD_MAP_NATIVE = False
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.executor import expr

@@ -1,0 +1,40 @@
+"""Serving QoS: admission control, deadline propagation, hedged reads.
+
+The request lifecycle (docs/QOS.md): a query is ADMITTED (or shed 429)
+at the HTTP edge, carries a DEADLINE through every layer and every
+inter-node hop, and replicated remote reads are HEDGED to a sibling
+replica when the primary outlives the p95-tracked hedge delay — all
+within a global hedge budget and behind per-node circuit breakers.
+"""
+
+from pilosa_tpu.qos.admission import (
+    AdmissionController,
+    AdmissionError,
+    AdmissionSlot,
+)
+from pilosa_tpu.qos.deadline import (
+    DEADLINE_HEADER,
+    TENANT_HEADER,
+    Deadline,
+    DeadlineExceeded,
+)
+from pilosa_tpu.qos.hedge import (
+    CircuitBreaker,
+    HedgePolicy,
+    LatencyTracker,
+    ServingQos,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionError",
+    "AdmissionSlot",
+    "CircuitBreaker",
+    "DEADLINE_HEADER",
+    "TENANT_HEADER",
+    "Deadline",
+    "DeadlineExceeded",
+    "HedgePolicy",
+    "LatencyTracker",
+    "ServingQos",
+]

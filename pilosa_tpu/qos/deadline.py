@@ -1,0 +1,74 @@
+"""Request deadlines, propagated root → executor → remote shards.
+
+The reference tolerated slow shard owners because goroutines were cheap
+and the client timeout (30 s) bounded the damage per request; on a TPU
+backend a stalled request holds a dispatch slot, so every request carries
+a deadline — header-derived or the server default — threaded through
+server/http.py → server/api.py → server/pipeline.py → executor →
+parallel/cluster_exec.py and serialized on inter-node hops
+(parallel/client.py), so remote shards stop work the moment the root
+gives up.
+
+Wire format: the remaining BUDGET in milliseconds (``X-Pilosa-Deadline-Ms``),
+not an absolute timestamp — budgets survive clock skew between nodes, and
+each hop re-anchors the budget against its own monotonic clock (the same
+scheme gRPC uses for ``grpc-timeout``).
+"""
+
+from __future__ import annotations
+
+import time
+
+# Remaining request budget in integer milliseconds on inter-node hops.
+DEADLINE_HEADER = "X-Pilosa-Deadline-Ms"
+# Admission-control tenant identity (header-derived quotas).
+TENANT_HEADER = "X-Pilosa-Tenant"
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before its work completed.
+
+    Deliberately NOT a ClientError: an expired deadline is a property of
+    the REQUEST, not of any node — replica fallback must not retry it,
+    and no node may be marked DEGRADED for it. Maps to HTTP 504.
+    """
+
+
+class Deadline:
+    """Absolute deadline on the local monotonic clock."""
+
+    __slots__ = ("_at",)
+
+    def __init__(self, at: float):
+        self._at = at
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + seconds)
+
+    @classmethod
+    def from_millis(cls, millis: int) -> "Deadline":
+        """Re-anchor a wire budget (remaining ms) on this node's clock."""
+        return cls(time.monotonic() + millis / 1000.0)
+
+    def remaining(self) -> float:
+        return self._at - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def check(self, what: str = "request") -> None:
+        rem = self.remaining()
+        if rem <= 0:
+            raise DeadlineExceeded(
+                f"deadline exceeded ({what}, {-rem * 1e3:.0f}ms past)"
+            )
+
+    def to_millis(self) -> int:
+        """Remaining budget for the wire; >= 1 so an in-flight hop never
+        serializes to a zero budget (expiry is raised locally instead)."""
+        return max(1, int(self.remaining() * 1000))
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining():.3f}s)"

@@ -57,17 +57,47 @@ class API:
         self.serve_pipelined: bool = True
         self._pipeline = None  # created lazily on first pipelined query
         self._pipeline_lock = threading.Lock()
+        # Serving QoS (pilosa_tpu.qos): admission gate + hedge policy +
+        # breakers. Default bundle has the gate OFF (0 = unlimited) and
+        # stock hedge knobs; Server.open swaps in the configured one.
+        from pilosa_tpu.qos import ServingQos
+
+        self.qos = ServingQos()
+        # server default request deadline in seconds (0 = none); a
+        # client header always wins (server/http.py)
+        self.default_deadline_s: float = 0.0
+        # async TopN cache recount (recalculate_caches): one worker at a
+        # time, a request landing mid-recount queues exactly one re-run
+        self._recalc_lock = threading.Lock()
+        self._recalc_thread: threading.Thread | None = None
+        self._recalc_rerun = False
 
     # ---------------------------------------------------------------- query
 
     def query_raw(self, index: str, pql: str, shards=None,
-                  remote: bool = False, opts: dict | None = None):
-        """Execute and return raw result objects (serializer-agnostic)."""
+                  remote: bool = False, opts: dict | None = None,
+                  tenant: str = "default", deadline=None):
+        """Execute and return raw result objects (serializer-agnostic).
+
+        QoS envelope: edge requests (``remote=False``) pass the admission
+        gate first — shed requests raise ApiError 429 with a Retry-After
+        hint and never reach the pipeline. ``deadline`` (qos.Deadline)
+        threads through the executor and every inter-node hop; expiry
+        maps to ApiError 504."""
         import time
 
         from pilosa_tpu.executor.executor import PQLError
         from pilosa_tpu.pql import ParseError
+        from pilosa_tpu.qos import AdmissionError, DeadlineExceeded
 
+        slot = None
+        if not remote:
+            try:
+                slot = self.qos.admission.admit(tenant)
+            except AdmissionError as e:
+                err = ApiError(str(e), 429)
+                err.retry_after = e.retry_after
+                raise err from e
         t0 = time.perf_counter()
         try:
             query = pql
@@ -85,6 +115,8 @@ class API:
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
                 kwargs["remote"] = remote
+            if deadline is not None:
+                kwargs["deadline"] = deadline
             # Read-only MICRO-BATCHABLE requests ride the coalescing
             # pipeline (waves of concurrent requests share device
             # dispatches — see server/pipeline.py). Requests carrying
@@ -120,9 +152,14 @@ class API:
             if opts:
                 results = self._apply_request_opts(index, results, opts)
             return results
+        except DeadlineExceeded as e:
+            self.qos.note_deadline_expired()
+            raise ApiError(str(e), 504) from e
         except (ParseError, PQLError) as e:
             raise ApiError(str(e)) from e
         finally:
+            if slot is not None:
+                slot.release()
             elapsed = time.perf_counter() - t0
             if self.long_query_time > 0 and elapsed >= self.long_query_time:
                 entry = {
@@ -138,9 +175,10 @@ class API:
                     )
 
     def query(self, index: str, pql: str, shards=None, remote: bool = False,
-              opts: dict | None = None) -> dict:
+              opts: dict | None = None, tenant: str = "default",
+              deadline=None) -> dict:
         results = self.query_raw(index, pql, shards=shards, remote=remote,
-                                 opts=opts)
+                                 opts=opts, tenant=tenant, deadline=deadline)
         return {"results": [result_to_json(r) for r in results]}
 
     def _apply_request_opts(self, index: str, results: list,
@@ -523,18 +561,47 @@ class API:
             return {"waves": 0, "coalesced": 0}
         return {"waves": pipe.waves, "coalesced": pipe.coalesced}
 
-    def recalculate_caches(self, remote: bool = False) -> None:
+    def recalculate_caches(self, remote: bool = False) -> threading.Thread:
         """Authoritative recount of every fragment's TopN row cache
         (reference ``POST /recalculate-caches`` → api.RecalculateCaches:
         broadcast to peers, then recount locally). ``remote=True`` marks
-        a peer-originated message: apply locally only, no re-broadcast."""
+        a peer-originated message: apply locally only, no re-broadcast.
+
+        The local recount runs in a BACKGROUND worker (ADVICE r5): on a
+        large holder the per-fragment row_counts() scans each take the
+        fragment lock, so a synchronous recount in the cluster
+        message-delivery path stalls heartbeats and message handling for
+        seconds. The HTTP handler returns 204 once the work is queued; a
+        recount requested while one is running queues exactly one re-run
+        (it starts after the current pass, so it observes any writes the
+        in-flight pass missed). Returns the worker thread so in-process
+        callers (tests, CLI) can join it."""
         if not remote:
             self._broadcast({"type": "recalculate-caches"})
-        for idx in list(self.holder.indexes.values()):
-            for field in list(idx.fields.values()):
-                for view in list(field.views.values()):
-                    for frag in list(view.fragments.values()):
-                        frag.recalculate_cache()
+
+        def recount():
+            while True:
+                for idx in list(self.holder.indexes.values()):
+                    for field in list(idx.fields.values()):
+                        for view in list(field.views.values()):
+                            for frag in list(view.fragments.values()):
+                                frag.recalculate_cache()
+                with self._recalc_lock:
+                    if not self._recalc_rerun:
+                        self._recalc_thread = None
+                        return
+                    self._recalc_rerun = False
+
+        with self._recalc_lock:
+            t = self._recalc_thread
+            if t is not None and t.is_alive():
+                self._recalc_rerun = True
+                return t
+            t = threading.Thread(target=recount, daemon=True,
+                                 name="recalculate-caches")
+            self._recalc_thread = t
+            t.start()
+            return t
 
     def max_shards(self) -> dict:
         return {

@@ -87,7 +87,14 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 try:
                     getattr(self, handler)(*match.groups(), query=parse_qs(parsed.query))
                 except ApiError as e:
-                    self._json({"error": str(e)}, status=e.status)
+                    headers = None
+                    retry_after = getattr(e, "retry_after", None)
+                    if retry_after is not None:
+                        # shed at admission: tell the client when to come
+                        # back instead of letting it hammer a full queue
+                        headers = {"Retry-After": str(max(1, int(retry_after)))}
+                    self._json({"error": str(e)}, status=e.status,
+                               headers=headers)
                 except Exception as e:  # internal error → 500, not a crash
                     self._json({"error": f"internal: {e}"}, status=500)
                 return
@@ -117,13 +124,41 @@ class HTTPHandler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise ApiError(f"invalid JSON body: {e}") from e
 
-    def _json(self, obj, status: int = 200) -> None:
+    def _json(self, obj, status: int = 200, headers: dict | None = None) -> None:
         data = json.dumps(obj).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
+
+    def _qos_envelope(self, remote: bool = False):
+        """Tenant + deadline from request headers (the QoS request
+        envelope — docs/QOS.md). The deadline header carries remaining
+        budget in ms; absent, the server default applies (0 = none) —
+        but only to EDGE requests: a remote sub-query's budget belongs
+        to its root, and minting a local default for it would let one
+        peer's tighter config 504 (and so DEGRADE) healthy nodes."""
+        from pilosa_tpu.qos import DEADLINE_HEADER, TENANT_HEADER, Deadline
+
+        tenant = (self.headers.get(TENANT_HEADER) or "default").strip()
+        raw = self.headers.get(DEADLINE_HEADER)
+        if raw is not None:
+            try:
+                millis = int(raw)
+                if millis <= 0:
+                    raise ValueError
+            except ValueError:
+                raise ApiError(
+                    f"invalid {DEADLINE_HEADER} header {raw!r}: must be a "
+                    "positive integer of milliseconds"
+                ) from None
+            return tenant, Deadline.from_millis(millis)
+        if not remote and self.api.default_deadline_s > 0:
+            return tenant, Deadline.after(self.api.default_deadline_s)
+        return tenant, None
 
     def _text(self, text: str, content_type: str = "text/plain") -> None:
         data = text.encode()
@@ -176,23 +211,31 @@ class HTTPHandler(BaseHTTPRequestHandler):
             if query and query.get(k, ["false"])[0] == "true"
         })
 
+        tenant, deadline = self._qos_envelope(remote=remote)
         if not proto_out:
             self._json(self.api.query(index, pql, shards=shards,
-                                      remote=remote, opts=opts))
+                                      remote=remote, opts=opts,
+                                      tenant=tenant, deadline=deadline))
             return
         from pilosa_tpu.wire.serializer import encode_error, encode_results
 
+        retry_after = None
         try:
             results = self.api.query_raw(index, pql, shards=shards,
-                                         remote=remote, opts=opts)
+                                         remote=remote, opts=opts,
+                                         tenant=tenant, deadline=deadline)
             payload = encode_results(results)
             status = 200
         except ApiError as e:
             payload = encode_error(str(e))
             status = e.status
+            retry_after = getattr(e, "retry_after", None)
         self.send_response(status)
         self.send_header("Content-Type", "application/x-protobuf")
         self.send_header("Content-Length", str(len(payload)))
+        if retry_after is not None:
+            # admission shed: same backoff hint the JSON route sends
+            self.send_header("Retry-After", str(max(1, int(retry_after))))
         self.end_headers()
         self.wfile.write(payload)
 
@@ -306,6 +349,11 @@ class HTTPHandler(BaseHTTPRequestHandler):
             f"{prefix}_serving_coalesced_requests_total "
             f"{pm['coalesced']}\n"
         )
+        # serving-QoS series (admission/deadline/hedge/breaker): emitted
+        # from scrape one, zeros included, for the same rate()-window
+        # reason as the wave counters above
+        for name, value in sorted(self.api.qos.metrics().items()):
+            text += f"{prefix}_qos_{name} {value}\n"
         self._text(text, "text/plain; version=0.0.4")
 
     def get_traces(self, query=None):
@@ -325,6 +373,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap = global_stats().snapshot()
         snap["residency"] = global_row_cache().metrics()
         snap["serving_pipeline"] = self.api.pipeline_metrics()
+        snap["qos"] = self.api.qos.metrics()
         self._json(snap)
 
     def get_pprof(self, query=None):

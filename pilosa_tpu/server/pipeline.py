@@ -60,6 +60,7 @@ class QueryPipeline:
         self._thread: threading.Thread | None = None
         self._last_arrival = 0.0
         self._recent_gap = float("inf")  # gap between the last 2 arrivals
+        self._last_wave_size = 0  # latch breaker: did the window pay off?
         self.waves = 0          # dispatch waves formed (observability)
         self.coalesced = 0      # requests that shared a wave with others
 
@@ -136,6 +137,18 @@ class QueryPipeline:
             except queue.Empty:
                 break
         if self._recent_gap >= self.PRESSURE_GAP_S:
+            self._last_wave_size = len(wave)
+            return
+        # Latch breaker (ADVICE r5): a single fast closed-loop client
+        # keeps _recent_gap ≈ window + service < PRESSURE_GAP_S, so the
+        # gap signal alone holds the window open forever while every
+        # wave dispatches at size 1 — the window buys nothing and costs
+        # 2 ms per query. Require evidence of actual concurrency: either
+        # this wave already drained >1 requests, or the previous wave
+        # did. A real burst re-opens the window within one wave (the
+        # backlog makes the greedy drain multi-request).
+        if len(wave) == 1 and self._last_wave_size <= 1:
+            self._last_wave_size = len(wave)
             return
         # WAITING past one full micro-batch buys nothing, so the window
         # phase caps at the live executor's batch limit (falls back to
@@ -143,11 +156,14 @@ class QueryPipeline:
         cap = getattr(getattr(self._api, "executor", None),
                       "microbatch_max", None) or self.GATHER_CAP
         deadline = time.monotonic() + self.GATHER_WINDOW_S
-        while len(wave) < cap:
-            left = deadline - time.monotonic()
-            if left <= 0:
-                return
-            try:
-                wave.append(self._q.get(timeout=left))
-            except queue.Empty:
-                return
+        try:
+            while len(wave) < cap:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return
+                try:
+                    wave.append(self._q.get(timeout=left))
+                except queue.Empty:
+                    return
+        finally:
+            self._last_wave_size = len(wave)

@@ -40,6 +40,13 @@ class ServerConfig:
         tls_certificate: str = "",
         tls_key: str = "",
         tls_skip_verify: bool = False,
+        qos_max_inflight: int = 0,
+        qos_tenant_inflight: int = 0,
+        qos_default_deadline: float = 0.0,
+        qos_hedge_delay: float = 0.25,
+        qos_hedge_budget: float = 0.05,
+        qos_breaker_threshold: int = 5,
+        qos_breaker_cooldown: float = 5.0,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -61,6 +68,17 @@ class ServerConfig:
         self.tls_certificate = tls_certificate
         self.tls_key = tls_key
         self.tls_skip_verify = tls_skip_verify
+        # Serving QoS (docs/QOS.md): admission gate (0 = unlimited),
+        # server-default request deadline (0 = none), hedged replica
+        # reads (initial delay before the p95 tracker warms up; budget as
+        # a fraction of primary reads), per-node circuit breakers.
+        self.qos_max_inflight = qos_max_inflight
+        self.qos_tenant_inflight = qos_tenant_inflight
+        self.qos_default_deadline = qos_default_deadline
+        self.qos_hedge_delay = qos_hedge_delay
+        self.qos_hedge_budget = qos_hedge_budget
+        self.qos_breaker_threshold = qos_breaker_threshold
+        self.qos_breaker_cooldown = qos_breaker_cooldown
 
     @property
     def tls_enabled(self) -> bool:
@@ -105,6 +123,17 @@ class ServerConfig:
                 _parse_bool(d["use-mesh"])
                 if d.get("use-mesh") not in (None, "") else None
             ),
+            qos_max_inflight=int(d.get("qos-max-inflight", 0)),
+            qos_tenant_inflight=int(d.get("qos-tenant-inflight", 0)),
+            qos_default_deadline=_parse_duration(
+                d.get("qos-default-deadline", 0.0)
+            ),
+            qos_hedge_delay=_parse_duration(d.get("qos-hedge-delay", 0.25)),
+            qos_hedge_budget=float(d.get("qos-hedge-budget", 0.05)),
+            qos_breaker_threshold=int(d.get("qos-breaker-threshold", 5)),
+            qos_breaker_cooldown=_parse_duration(
+                d.get("qos-breaker-cooldown", 5.0)
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -129,6 +158,13 @@ class ServerConfig:
             "tls-skip-verify": self.tls_skip_verify,
             "device-budget-bytes": self.device_budget_bytes,
             "use-mesh": self.use_mesh,
+            "qos-max-inflight": self.qos_max_inflight,
+            "qos-tenant-inflight": self.qos_tenant_inflight,
+            "qos-default-deadline": self.qos_default_deadline,
+            "qos-hedge-delay": self.qos_hedge_delay,
+            "qos-hedge-budget": self.qos_hedge_budget,
+            "qos-breaker-threshold": self.qos_breaker_threshold,
+            "qos-breaker-cooldown": self.qos_breaker_cooldown,
         }
 
 
@@ -195,6 +231,29 @@ class Server:
         self.api.long_query_time = self.config.long_query_time
         self.api.max_writes_per_request = self.config.max_writes_per_request
         self.api.logger = self.logger
+        if self.config.statsd:
+            # statsd sink must be wired BEFORE anything captures the
+            # global stats client (ServingQos below) — a late swap would
+            # leave qos counting sheds into the discarded default client
+            from pilosa_tpu.utils.stats import StatsdStatsClient, set_global_stats
+
+            host, _, port = self.config.statsd.partition(":")
+            set_global_stats(
+                StatsdStatsClient(host or "127.0.0.1", int(port or 8125))
+            )
+        from pilosa_tpu.qos import ServingQos
+        from pilosa_tpu.utils.stats import global_stats
+
+        self.api.qos = ServingQos(
+            max_inflight=self.config.qos_max_inflight,
+            tenant_max=self.config.qos_tenant_inflight,
+            hedge_delay=self.config.qos_hedge_delay,
+            hedge_budget=self.config.qos_hedge_budget,
+            breaker_threshold=self.config.qos_breaker_threshold,
+            breaker_cooldown=self.config.qos_breaker_cooldown,
+            stats=global_stats(),
+        )
+        self.api.default_deadline_s = self.config.qos_default_deadline
         self._http = make_http_server(self.api, self.config.bind, self.config.port)
         if self.config.tls_enabled:
             import ssl
@@ -230,13 +289,6 @@ class Server:
             from pilosa_tpu.utils.tracing import global_tracer
 
             global_tracer().enabled = True
-        if self.config.statsd:
-            from pilosa_tpu.utils.stats import StatsdStatsClient, set_global_stats
-
-            host, _, port = self.config.statsd.partition(":")
-            set_global_stats(
-                StatsdStatsClient(host or "127.0.0.1", int(port or 8125))
-            )
         from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 
         self._diagnostics = DiagnosticsCollector(
@@ -276,7 +328,7 @@ class Server:
             local = DistExecutor(self.holder)
         else:
             local = Executor(self.holder)
-        self.api.executor = ClusterExecutor(local, cluster)
+        self.api.executor = ClusterExecutor(local, cluster, qos=self.api.qos)
 
         for seed in self.config.seeds:
             try:

@@ -10,14 +10,15 @@ from pilosa_tpu.server import Server, ServerConfig
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
-def req(method, url, body=None):
+def req(method, url, body=None, raw=False):
     data = (body if isinstance(body, (bytes, type(None)))
             else json.dumps(body).encode())
     r = urllib.request.Request(url, data=data, method=method)
     if data is not None:
         r.add_header("Content-Type", "application/json")
     with urllib.request.urlopen(r, timeout=60) as resp:
-        return json.loads(resp.read() or b"{}")
+        payload = resp.read()
+    return payload if raw else json.loads(payload or b"{}")
 
 
 def uri(s: Server) -> str:

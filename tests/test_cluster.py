@@ -116,6 +116,13 @@ class TestMembership:
         )
         with urllib.request.urlopen(r) as resp:
             assert resp.status == 204
+        # 204 = queued: every node recounts in a background worker so
+        # message delivery/heartbeats never stall on the scan (ADVICE
+        # r5); join each node's worker before asserting
+        for s in cluster3:
+            t = s.api._recalc_thread
+            if t is not None:
+                t.join(timeout=30)
         for frag in drifted:
             assert frag.row_cache.get(77) is None, frag.frag_id
             c = frag.row_cache.get(1)

@@ -141,6 +141,12 @@ def test_recalculate_caches_repairs_drift(node_api):
     with urllib.request.urlopen(r) as resp:
         assert resp.status == 204
         assert resp.headers.get("Content-Length") is None  # RFC 7230 204
+    # 204 means QUEUED: the recount runs in a background worker so the
+    # cluster message-delivery path can't stall on it (ADVICE r5) — join
+    # the worker before asserting on the repaired cache
+    t = api._recalc_thread
+    if t is not None:
+        t.join(timeout=30)
     cache = api.holder.indexes["i"].fields["f"].views["standard"] \
         .fragments[0].row_cache
     assert cache.get(1) == 3 and cache.get(2) == 8 and cache.get(3) == 5
