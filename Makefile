@@ -1,11 +1,13 @@
 # Test targets. Tier-1 (the CI gate) runs the whole suite minus
 # @pytest.mark.slow stress cases; the qos-smoke target runs the serving
 # QoS fault-injection suite in isolation (fast feedback while tuning
-# admission/deadline/hedge knobs — see docs/QOS.md).
+# admission/deadline/hedge knobs — see docs/QOS.md); ingest-smoke pushes
+# a small CSV through `cli.py import` against an in-process server and
+# exercises the routed-import suite (docs/INGEST.md).
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: test test-slow qos-smoke
+.PHONY: test test-slow qos-smoke ingest-smoke bench-ingest
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -15,3 +17,9 @@ test-slow:
 
 qos-smoke:
 	$(PYTEST) tests/test_qos.py -m "not slow"
+
+ingest-smoke:
+	$(PYTEST) tests/test_ingest.py -m "not slow"
+
+bench-ingest:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest

@@ -573,6 +573,9 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
         server = Server(ServerConfig(
             data_dir=tmp, port=0, name="imp", anti_entropy_interval=0,
             heartbeat_interval=0,
+            # this bench measures ROUTE cost with deliberately huge
+            # bodies; the edge batch limit is the CLI's problem
+            max_writes_per_request=0,
         )).open()
         try:
             idx = server.holder.create_index("b")
@@ -720,6 +723,142 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
             server.close()
 
 
+def config_ingest(n_remote: int = 3, n_shards: int = 16,
+                  density: float = 0.02, delay_s: float = 0.05) -> dict:
+    """Parallel ingest pipeline (ISSUE 3): routed-import fan-out with an
+    INJECTED per-call slow client. Proves two things on the same data:
+
+    (a) concurrent fan-out wall time tracks the SLOWEST owner node's
+        busy time (max), not the sum of all owners' busy times — the
+        write-path analog of the read path's concurrent_map property;
+    (b) routed bits/sec with the parallel fan-out beats the serialized
+        fan-out (ingest_fanout_workers = 1) on identical batches.
+
+    Also reports the local shard-group apply rate with the bounded
+    worker pool on vs off (ingest-workers knob) — engine-layer, no
+    injected latency."""
+    import threading
+
+    from pilosa_tpu.parallel.cluster import Cluster, Node
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import Holder
+
+    class SlowClient:
+        """Injectable transport: every import call sleeps ``delay``
+        (one RTT) and acks the shipped bit count."""
+
+        def __init__(self, delay: float):
+            self.delay = delay
+            self.per_uri: dict[str, int] = {}
+            self._lock = threading.Lock()
+
+        def _hit(self, uri: str, n: int) -> int:
+            with self._lock:
+                self.per_uri[uri] = self.per_uri.get(uri, 0) + 1
+            time.sleep(self.delay)
+            return n
+
+        def import_roaring(self, uri, index, field, shard, data):
+            from pilosa_tpu.roaring.format import load_any
+
+            bm, _ = load_any(data)
+            return self._hit(uri, int(bm.count()))
+
+        def import_bits(self, uri, index, field, rows, columns,
+                        timestamps=None, clear=False):
+            return self._hit(uri, len(columns))
+
+        def import_values(self, uri, index, field, columns, values,
+                          clear=False):
+            return self._hit(uri, len(columns))
+
+        def send_message(self, uri, message):
+            return {}
+
+    rng = np.random.default_rng(21)
+    n = int(SHARD_WIDTH * density)
+    cols = np.concatenate([
+        s * SHARD_WIDTH
+        + np.sort(rng.choice(SHARD_WIDTH, n, replace=False))
+        for s in range(n_shards)
+    ]).astype(np.int64)
+    rows = np.ones(cols.size, np.int64)
+
+    def routed(fanout_workers: int, delay: float):
+        with tempfile.TemporaryDirectory() as tmp:
+            holder = Holder(tmp).open()
+            api = API(holder)
+            cluster = Cluster(
+                Node("n0", "http://n0"),
+                peers=[Node(f"n{i}", f"http://n{i}")
+                       for i in range(1, n_remote + 1)],
+                replica_n=1, holder=holder,
+            )
+            cluster.api = api
+            api.cluster = cluster
+            fake = SlowClient(delay)
+            cluster.client = fake
+            holder.create_index("b").create_field("f")
+            api.ingest_fanout_workers = fanout_workers
+            t0 = time.perf_counter()
+            changed = api.import_bits("b", "f", rows, cols)
+            wall = time.perf_counter() - t0
+            holder.close()
+            busy = {u: c * delay for u, c in fake.per_uri.items()}
+            return wall, changed, busy
+
+    wall_par, changed_par, busy = routed(16, delay_s)
+    wall_ser, changed_ser, _ = routed(1, delay_s)
+    # zero-delay pass isolates the route's fixed cost (slicing, roaring
+    # serialization, local apply) so the delay-attributable remainder can
+    # be compared against max vs sum of the injected node busy times
+    wall_base, _, _ = routed(16, 0.0)
+    sum_busy = sum(busy.values())
+    max_busy = max(busy.values()) if busy else 0.0
+
+    def engine(workers: int) -> float:
+        with tempfile.TemporaryDirectory() as tmp:
+            holder = Holder(tmp).open()
+            api = API(holder)
+            api.ingest_workers = workers
+            holder.create_index("b").create_field("f")
+            t0 = time.perf_counter()
+            api.import_bits("b", "f", rows, cols)
+            dt = time.perf_counter() - t0
+            holder.close()
+            return dt
+
+    eng_ser = engine(1)
+    eng_par = engine(4)
+
+    delay_wall = max(wall_par - wall_base, 0.0)
+    ok = (changed_par == changed_ser == cols.size
+          # delay-attributable fan-out time tracks the slowest node's
+          # busy time (max), NOT the sum over nodes
+          and delay_wall < (max_busy + sum_busy) / 2
+          # parallel routed path beats the serialized one on same data
+          and wall_par < 0.75 * wall_ser)
+    return {
+        "config": "ingest",
+        "metric": "routed_import_bits_per_sec",
+        "value": round(cols.size / wall_par, 1),
+        "unit": "bits/sec",
+        "serial_routed_bits_per_sec": round(cols.size / wall_ser, 1),
+        "speedup_vs_serial_fanout": round(wall_ser / wall_par, 2),
+        "fanout_wall_ms": round(wall_par * 1e3, 1),
+        "fanout_wall_serial_ms": round(wall_ser * 1e3, 1),
+        "fanout_wall_nodelay_ms": round(wall_base * 1e3, 1),
+        "slowest_node_busy_ms": round(max_busy * 1e3, 1),
+        "sum_node_busy_ms": round(sum_busy * 1e3, 1),
+        "local_apply_bits_per_sec_serial": round(cols.size / eng_ser, 1),
+        "local_apply_bits_per_sec_parallel": round(cols.size / eng_par, 1),
+        "nodes": n_remote + 1, "shards": n_shards,
+        "bits": int(cols.size), "injected_delay_ms": delay_s * 1e3,
+        "ok": bool(ok),
+    }
+
+
 def config_hostpath(n_shards: int = 8) -> dict:
     """Host-side cost of the pipelined submit path, device excluded.
 
@@ -839,7 +978,7 @@ def main() -> None:
     parser.add_argument("--full", action="store_true",
                         help="billion-column scale (real TPU)")
     parser.add_argument(
-        "--configs", default="1,2,3,4,5,mesh8,serving,import,hostpath"
+        "--configs", default="1,2,3,4,5,mesh8,serving,import,ingest,hostpath"
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -869,6 +1008,10 @@ def main() -> None:
         "import": lambda: config_import(
             n_shards=32 if args.full else 8,
             density=0.2 if args.full else 0.05,
+        ),
+        "ingest": lambda: config_ingest(
+            n_shards=64 if args.full else 16,
+            density=0.1 if args.full else 0.02,
         ),
         "hostpath": lambda: config_hostpath(n_shards=8),
     }

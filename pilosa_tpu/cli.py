@@ -10,6 +10,7 @@ which is the TPU-friendly path for bulk imports (no HTTP hop).
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import os
 import sys
@@ -38,6 +39,9 @@ heartbeat-interval = 5.0      # seconds; 0 disables death detection
 # device-budget-bytes = 0     # HBM residency budget; 0 = auto
 long-query-time = 0.0         # log queries slower than this; 0 = off
 max-writes-per-request = 5000 # reject larger write batches; 0 = unlimited
+ingest-workers = 1            # local shard-group apply pool per import
+                              # batch; raise where fragment writes pay real
+                              # disk latency (docs/INGEST.md)
 
 # Serving QoS (docs/QOS.md): admission -> deadline -> hedged reads
 qos-max-inflight = 0          # concurrent-query cap; excess sheds 429 (0 = off)
@@ -86,7 +90,10 @@ def _http(method: str, url: str, data: bytes | None = None,
         return json.loads(resp.read() or b"{}")
 
 
-def _parse_csv_bits(files):
+def _iter_csv_bits(files, batch: float):
+    """Stream ``row,col[,ts]`` CSVs as (rows, cols, timestamps|None)
+    batches of at most ``batch`` lines — whole-file parse lists never
+    materialize, so import memory is O(batch), not O(file)."""
     rows, cols, timestamps = [], [], []
     any_ts = False
     for path in files:
@@ -102,13 +109,20 @@ def _parse_csv_bits(files):
                 ts = parts[2] if len(parts) > 2 else None
                 timestamps.append(ts)
                 any_ts = any_ts or ts is not None
+                if len(rows) >= batch:
+                    yield rows, cols, (timestamps if any_ts else None)
+                    rows, cols, timestamps = [], [], []
+                    any_ts = False
         finally:
             if fh is not sys.stdin:
                 fh.close()
-    return rows, cols, (timestamps if any_ts else None)
+    if rows:
+        yield rows, cols, (timestamps if any_ts else None)
 
 
-def _parse_csv_values(files):
+def _iter_csv_values(files, batch: float):
+    """Stream ``col,value`` CSVs as (cols, vals) batches (see
+    _iter_csv_bits)."""
     cols, vals = [], []
     for path in files:
         fh = sys.stdin if path == "-" else open(path)
@@ -120,10 +134,23 @@ def _parse_csv_values(files):
                 parts = [p.strip() for p in line.split(",")]
                 cols.append(int(parts[0]))
                 vals.append(int(parts[1]))
+                if len(cols) >= batch:
+                    yield cols, vals
+                    cols, vals = [], []
         finally:
             if fh is not sys.stdin:
                 fh.close()
-    return cols, vals
+    if cols:
+        yield cols, vals
+
+
+def _parse_csv_bits(files):
+    """Whole-file form of _iter_csv_bits (small inputs, tests)."""
+    return next(_iter_csv_bits(files, float("inf")), ([], [], None))
+
+
+def _parse_csv_values(files):
+    return next(_iter_csv_values(files, float("inf")), ([], []))
 
 
 def cmd_server(args) -> int:
@@ -160,8 +187,48 @@ def _in_process_api(data_dir: str):
     return API(Holder(data_dir).open())
 
 
+DEFAULT_IMPORT_BATCH = 100_000
+
+
+class _ImportHTTPError(Exception):
+    def __init__(self, code: int, detail: str):
+        super().__init__(f"HTTP {code}: {detail}")
+        self.code = code
+
+
+def _probe_batch_limit(host: str) -> int:
+    """Server write-batch limit from /status (0 = none advertised). A
+    probe failure is fine — the 413 split fallback in _post_import still
+    converges on an acceptable size."""
+    try:
+        st = _http("GET", f"{host}/status")
+        return int(st.get("maxWritesPerRequest") or 0)
+    except (urllib.error.URLError, OSError, ValueError):
+        return 0
+
+
+def _post_import(host: str, path: str, payload: dict) -> int:
+    """POST one import body; on a 413 (server max-writes-per-request
+    tighter than the client's batch — e.g. the /status probe failed or
+    raced a config change) split the batch in half and retry both
+    halves. Returns bits changed."""
+    body = json.dumps(payload).encode()
+    try:
+        return _http("POST", f"{host}{path}", body).get("changed", 0)
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")
+        n = len(payload["columns"])
+        if e.code == 413 and n > 1:
+            lo = {k: (v[: n // 2] if isinstance(v, list) else v)
+                  for k, v in payload.items()}
+            hi = {k: (v[n // 2:] if isinstance(v, list) else v)
+                  for k, v in payload.items()}
+            return (_post_import(host, path, lo)
+                    + _post_import(host, path, hi))
+        raise _ImportHTTPError(e.code, detail) from e
+
+
 def cmd_import(args) -> int:
-    batch = 100_000
     if args.data_dir:
         api = _in_process_api(args.data_dir)
         if args.create:
@@ -170,46 +237,82 @@ def cmd_import(args) -> int:
             if api.holder.index(args.index).field(args.field) is None:
                 opts = {"type": "int", "min": args.min, "max": args.max} if args.values else {}
                 api.create_field(args.index, args.field, opts)
+        # streamed batches: O(batch) memory even for huge CSVs (the
+        # in-process path has no HTTP limit to clamp against)
+        batch = args.batch_size if args.batch_size > 0 else 1_000_000
+        n = 0
         if args.values:
-            cols, vals = _parse_csv_values(args.files)
-            n = api.import_values(args.index, args.field, cols, vals, clear=args.clear)
+            for cols, vals in _iter_csv_values(args.files, batch):
+                n += api.import_values(args.index, args.field, cols, vals,
+                                       clear=args.clear)
         else:
-            rows, cols, ts = _parse_csv_bits(args.files)
-            n = api.import_bits(args.index, args.field, rows, cols,
-                                timestamps=ts, clear=args.clear)
+            for rows, cols, ts in _iter_csv_bits(args.files, batch):
+                n += api.import_bits(args.index, args.field, rows, cols,
+                                     timestamps=ts, clear=args.clear)
         api.holder.close()
         print(f"imported: {n} bits changed")
         return 0
-    # HTTP mode: batch into import endpoints
+    # HTTP mode: stream-parse the CSV and pipeline encode→POST — batch
+    # N+1 parses on this thread while batch N's POST is in flight
+    # (double-buffer); --concurrency > 1 keeps that many POSTs in
+    # flight, which the server routes per shard server-side.
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+
     host = args.host.rstrip("/")
+    # <= 0 means "auto" (bare `or` would let a negative through, turning
+    # every CSV line into its own single-row POST)
+    batch = args.batch_size if args.batch_size > 0 else DEFAULT_IMPORT_BATCH
+    limit = _probe_batch_limit(host)
+    if limit > 0:
+        batch = min(batch, limit)
+    workers = max(1, args.concurrency)
+    if args.values:
+        path = f"/index/{args.index}/field/{args.field}/import-value"
+        payloads = (
+            {"columns": cols, "values": vals, "clear": args.clear}
+            for cols, vals in _iter_csv_values(args.files, batch)
+        )
+    else:
+        path = f"/index/{args.index}/field/{args.field}/import"
+
+        def _bit_payloads():
+            for rows, cols, ts in _iter_csv_bits(args.files, batch):
+                p = {"rows": rows, "columns": cols, "clear": args.clear}
+                if ts:
+                    p["timestamps"] = ts
+                yield p
+
+        payloads = _bit_payloads()
+    total = 0
     try:
         if args.create:
             _http_create(host, args)
-        total = 0
-        if args.values:
-            cols, vals = _parse_csv_values(args.files)
-            for i in range(0, len(cols), batch):
-                body = json.dumps(
-                    {"columns": cols[i : i + batch], "values": vals[i : i + batch],
-                     "clear": args.clear}
-                ).encode()
-                out = _http("POST", f"{host}/index/{args.index}/field/{args.field}/import-value", body)
-                total += out.get("changed", 0)
-        else:
-            rows, cols, ts = _parse_csv_bits(args.files)
-            for i in range(0, len(rows), batch):
-                payload = {"rows": rows[i : i + batch], "columns": cols[i : i + batch],
-                           "clear": args.clear}
-                if ts:
-                    payload["timestamps"] = ts[i : i + batch]
-                out = _http("POST", f"{host}/index/{args.index}/field/{args.field}/import", json.dumps(payload).encode())
-                total += out.get("changed", 0)
+        inflight: collections.deque = collections.deque()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            for payload in payloads:
+                inflight.append(
+                    pool.submit(_post_import, host, path, payload)
+                )
+                while len(inflight) > workers:
+                    total += inflight.popleft().result()
+            while inflight:
+                total += inflight.popleft().result()
+    except _ImportHTTPError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     except urllib.error.HTTPError as e:
         body = e.read().decode(errors="replace")
         print(f"error: HTTP {e.code}: {body}", file=sys.stderr)
         return 1
     except urllib.error.URLError as e:
         print(f"error: cannot reach {host}: {e.reason}", file=sys.stderr)
+        return 1
+    except (OSError, http.client.HTTPException) as e:
+        # a server dying mid-stream surfaces as a read-stage reset
+        # (ConnectionResetError, RemoteDisconnected) that urlopen does
+        # NOT wrap in URLError — same user-facing failure, same exit
+        print(f"error: connection to {host} failed: {e}", file=sys.stderr)
         return 1
     print(f"imported: {total} bits changed")
     return 0
@@ -358,6 +461,13 @@ def main(argv=None) -> int:
     p.add_argument("--create", action="store_true", help="create index/field if missing")
     p.add_argument("--min", type=int, default=0)
     p.add_argument("--max", type=int, default=1 << 32)
+    p.add_argument("--batch-size", type=int, default=0,
+                   help="rows per HTTP batch (default 100000, clamped to "
+                        "the server's max-writes-per-request)")
+    p.add_argument("--concurrency", type=int, default=1,
+                   help="parallel in-flight POSTs (server routes per "
+                        "shard); >1 reorders batches, so duplicate "
+                        "columns across batches lose write order")
     p.add_argument("files", nargs="+", help="CSV files ('-' for stdin)")
     p.set_defaults(fn=cmd_import)
 

@@ -15,6 +15,8 @@ import ssl
 import urllib.error
 import urllib.request
 
+from pilosa_tpu.utils import as_int_list
+
 
 class ClientError(Exception):
     """Peer RPC failure. ``status`` is the HTTP status code, or None for
@@ -189,8 +191,8 @@ class InternalClient:
                 if not self._is_406(e):
                     raise
                 self._json_only_peers.add(uri)
-        payload: dict = {"rows": list(map(int, rows)),
-                         "columns": list(map(int, columns)),
+        payload: dict = {"rows": as_int_list(rows),
+                         "columns": as_int_list(columns),
                          "clear": clear}
         if timestamps is not None:
             payload["timestamps"] = timestamps
@@ -217,8 +219,8 @@ class InternalClient:
                 self._json_only_peers.add(uri)
         out = self._call(
             "POST", url,
-            json.dumps({"columns": list(map(int, columns)),
-                        "values": list(map(int, values)),
+            json.dumps({"columns": as_int_list(columns),
+                        "values": as_int_list(values),
                         "clear": clear}).encode(),
         )
         return out.get("changed", 0)

@@ -33,6 +33,39 @@ class ApiError(Exception):
         self.status = status
 
 
+class ImportRoutingError(ApiError):
+    """A routed import failed on one or more owners AFTER other owners'
+    batches (fanned out concurrently) already landed. Partial application
+    is explicit: ``failed_nodes`` names the owners whose batch did not
+    apply, ``node_errors`` maps each to its error text, and ``applied``
+    counts the bits/values the healthy owners acknowledged — the caller
+    can retry idempotently (imports are set-unions / last-write-wins) or
+    surface exactly what is missing."""
+
+    def __init__(self, node_errors: dict[str, str], applied: int,
+                 status: int = 502):
+        detail = "; ".join(f"{n}: {m}" for n, m in sorted(node_errors.items()))
+        super().__init__(
+            f"import failed on node(s) {', '.join(sorted(node_errors))} "
+            f"({applied} changes applied on healthy owners): {detail}",
+            status,
+        )
+        self.failed_nodes = sorted(node_errors)
+        self.node_errors = dict(node_errors)
+        self.applied = applied
+
+
+# Default width of the bounded worker pool applying independent local
+# shard groups of one import batch (fragments carry their own locks, so
+# groups are lock-disjoint). Overridden by the ``ingest-workers``
+# ServerConfig knob. Default 1 (serial): on CPython the per-group work is
+# GIL-bound (roaring container merges + small numpy ops), and measured
+# thread fan-out LOSES throughput on tmpfs-backed storage; raise the knob
+# where fragment writes pay real disk latency (fsync'd disks, network
+# filesystems) so groups overlap I/O stalls — see docs/INGEST.md.
+INGEST_WORKERS_DEFAULT = 1
+
+
 class API:
     def __init__(self, holder: Holder, cluster=None, stats=None):
         self.holder = holder
@@ -50,6 +83,15 @@ class API:
         # reference max-writes-per-request server knob: reject queries
         # carrying more write calls than this (0 = unlimited)
         self.max_writes_per_request: int = 5000
+        # Parallel ingest (docs/INGEST.md): local shard groups of one
+        # import apply on a bounded pool (ingest-workers knob), and
+        # routed batches fan out to owner nodes concurrently. The
+        # fan-out width is attribute-only (benches pin it to 1 for a
+        # serialized baseline).
+        self.ingest_workers: int = INGEST_WORKERS_DEFAULT
+        from pilosa_tpu.utils.pool import MAX_FANOUT
+
+        self.ingest_fanout_workers: int = MAX_FANOUT
         # Coalescing serving pipeline (server/pipeline.py): read-only
         # requests ride Executor.submit through a wave-forming queue so
         # concurrent HTTP clients share micro-batched dispatches. Set
@@ -286,27 +328,44 @@ class API:
             raise ApiError("bool field rows must be 0 (false) or 1 (true)")
         if not remote and self.cluster is not None and len(self.cluster.nodes) > 1:
             return self._route_import(
-                index, field, rows, columns, timestamps, clear, values=None
+                index, field, rows_i, columns_i, timestamps, clear,
+                values=None,
             )
         rows = rows_i.astype(np.uint64)
         columns = columns_i.astype(np.uint64)
         if rows.size == 0:
             return 0
-        changed = 0
+        import time
+
+        from pilosa_tpu.utils.pool import concurrent_map
+        from pilosa_tpu.utils.stats import global_stats
+
+        t0 = time.perf_counter()
         order, boundaries, shards_sorted = shard_groups(columns)
         rows, columns = rows[order], columns[order]
         ts_sorted = [timestamps[i] for i in order] if timestamps is not None else None
-        for i in range(boundaries.size - 1):
+        # resolve the view ONCE before the fan-out below — Field.view's
+        # create lock makes racing creation safe, but there is no reason
+        # to funnel every worker through it
+        view = None if clear else fld.view(VIEW_STANDARD, create=True)
+
+        def apply_group(i: int) -> int:
             lo, hi = int(boundaries[i]), int(boundaries[i + 1])
             shard = int(shards_sorted[lo])
             pos = columns[lo:hi] & np.uint64(SHARD_WIDTH - 1)
+            changed = 0
             if clear:
                 for r, p in zip(rows[lo:hi].tolist(), pos.tolist()):
                     changed += fld.clear_bit(
                         int(r), (shard << SHARD_WIDTH_EXP) + int(p)
                     )
-                continue
-            frag = fld.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
+                return changed
+            # existence rides the same group worker: the batch is
+            # already shard-sorted, so the per-batch argsort inside
+            # mark_columns_exist (a serial tail ~half as costly as the
+            # data write itself) is skipped entirely
+            idx.mark_columns_exist_shard(shard, pos)
+            frag = view.fragment(shard, create=True)
             if fld.options.type in (TYPE_MUTEX, TYPE_BOOL):
                 # single-value fields: the mutex-aware path clears each
                 # column's previous row in the same pass — plain
@@ -338,45 +397,87 @@ class API:
                     vfrag.bulk_import(
                         rows[sel], columns[sel] & np.uint64(SHARD_WIDTH - 1)
                     )
-        if not clear:
-            idx.mark_columns_exist(columns)
-            if self.cluster is not None:
-                self.cluster.note_local_shards(
-                    index, np.unique(shards_sorted).tolist()
-                )
+            return changed
+
+        n_groups = boundaries.size - 1
+        if n_groups > 1 and self.ingest_workers > 1:
+            # shard groups touch disjoint fragments (each with its own
+            # lock): apply them on a bounded pool — numpy slicing and the
+            # op-log fsync both release the GIL, so groups overlap
+            changed = sum(concurrent_map(
+                apply_group, range(n_groups),
+                max_workers=self.ingest_workers,
+            ))
+        else:
+            changed = sum(apply_group(i) for i in range(n_groups))
+        elapsed = time.perf_counter() - t0
+        stats = global_stats()
+        tags = {"kind": "bits"}
+        stats.count("ingest_rows", rows.size, tags=tags)
+        stats.observe("ingest_batch_size", rows.size, tags=tags)
+        stats.timing("ingest_apply", elapsed, tags=tags)
+        if elapsed > 0:
+            stats.gauge("ingest_rows_per_sec", rows.size / elapsed, tags=tags)
+        if not clear and self.cluster is not None:
+            self.cluster.note_local_shards(
+                index, np.unique(shards_sorted).tolist()
+            )
         return int(changed)
 
     def _route_import(self, index, field, rows, columns, timestamps, clear,
                       values=None) -> int:
-        """Split an import batch by shard owner and fan out (reference
-        api.Import routing — SURVEY.md §3.3). Local portions apply with
-        remote=True to stop recursion."""
+        """Split an import batch by shard owner and fan out CONCURRENTLY
+        (reference api.Import routing — SURVEY.md §3.3; fan-out mirrors
+        the read path's concurrent_map, so routed wall time is the MAX of
+        per-owner latencies, not the sum). Local portions apply with
+        remote=True to stop recursion.
+
+        Destination building is one ``shard_groups`` pass + numpy slices
+        of the sort permutation — no per-shard ``np.nonzero`` rescans, no
+        Python-list element copies. Per-node errors are captured (one
+        dead replica cannot abort or hide the others' batches); imports
+        are idempotent (set/clear unions, last-write-wins values), so a
+        NODE fault earns one retry before surfacing. Any remaining
+        failures raise ImportRoutingError naming the failed nodes and the
+        count already applied elsewhere."""
+        import time
+
         import numpy as np
 
-        columns_arr = np.asarray(columns, dtype=np.int64)
-        shards = columns_arr >> SHARD_WIDTH_EXP
-        changed = 0
-        local_mask = np.zeros(columns_arr.size, bool)
-        remote_batches: dict[str, tuple[object, list[int]]] = {}
-        for shard in np.unique(shards).tolist():
-            owners = self.cluster.shard_nodes(index, int(shard))
-            sel = np.nonzero(shards == shard)[0]
-            for node in owners:
+        from pilosa_tpu.parallel.client import ClientError
+        from pilosa_tpu.utils.pool import concurrent_map
+        from pilosa_tpu.utils.stats import global_stats
+
+        try:
+            columns_arr = np.asarray(columns, dtype=np.int64)
+            rows_arr = (np.asarray(rows, dtype=np.int64)
+                        if values is None else None)
+            values_arr = (np.asarray(values, dtype=np.int64)
+                          if values is not None else None)
+        except (OverflowError, ValueError) as e:
+            raise ApiError(f"row/column/value out of range: {e}") from e
+        if values_arr is not None and columns_arr.shape != values_arr.shape:
+            raise ApiError("columns and values must be the same length")
+        if columns_arr.size == 0:
+            return 0
+        ts_arr = (np.asarray(list(timestamps), dtype=object)
+                  if timestamps is not None else None)
+
+        order, bounds, shards_sorted = shard_groups(columns_arr)
+        local_parts: list[np.ndarray] = []
+        remote_parts: dict[str, tuple[object, list[np.ndarray]]] = {}
+        for i in range(bounds.size - 1):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            sel = order[lo:hi]
+            for node in self.cluster.shard_nodes(
+                index, int(shards_sorted[lo])
+            ):
                 if node.id == self.cluster.local.id:
-                    local_mask[sel] = True
+                    local_parts.append(sel)
                 else:
-                    remote_batches.setdefault(node.id, (node, []))[1].extend(
-                        sel.tolist()
-                    )
-        pick = lambda seq, idxs: [seq[i] for i in idxs]
+                    remote_parts.setdefault(node.id, (node, []))[1].append(sel)
+
         if values is None:
-            if local_mask.any():
-                li = np.nonzero(local_mask)[0].tolist()
-                changed += self.import_bits(
-                    index, field, pick(list(rows), li), pick(list(columns), li),
-                    timestamps=pick(list(timestamps), li) if timestamps else None,
-                    clear=clear, remote=True,
-                )
             # mutex/bool batches must NOT ride the roaring route: its
             # receiver unions blindly, so a remote replica would keep a
             # column's previous row set (single-value invariant broken,
@@ -386,48 +487,119 @@ class API:
             fld_type = self._field(self._index(index), field).options.type
             bulk_roaring = (timestamps is None and not clear
                             and fld_type not in (TYPE_MUTEX, TYPE_BOOL))
-            for node, idxs in remote_batches.values():
-                if bulk_roaring:
-                    # plain set-bit batches ship as per-shard roaring
-                    # bodies — O(bitmap bytes) on the wire (the import-
-                    # roaring endpoint already unions + tracks existence)
-                    changed += self._send_roaring_batch(
-                        node, index, field, rows, columns_arr, idxs
-                    )
-                    continue
-                changed += self.cluster.client.import_bits(
-                    node.uri, index, field,
-                    pick(list(rows), idxs), pick(list(columns), idxs),
-                    timestamps=pick(list(timestamps), idxs) if timestamps else None,
-                    clear=clear,
+
+        stats = global_stats()
+
+        def send_once(node, sel: np.ndarray) -> int:
+            if values_arr is not None:
+                return self.cluster.client.import_values(
+                    node.uri, index, field, columns_arr[sel],
+                    values_arr[sel], clear=clear,
                 )
-        else:
-            if local_mask.any():
-                li = np.nonzero(local_mask)[0].tolist()
-                changed += self.import_values(
-                    index, field, pick(list(columns), li), pick(list(values), li),
+            if bulk_roaring:
+                # plain set-bit batches ship as per-shard roaring bodies
+                # — O(bitmap bytes) on the wire (the import-roaring
+                # endpoint already unions + tracks existence)
+                return self._send_roaring_batch(
+                    node, index, field, rows_arr[sel], columns_arr[sel]
+                )
+            return self.cluster.client.import_bits(
+                node.uri, index, field, rows_arr[sel], columns_arr[sel],
+                timestamps=(ts_arr[sel].tolist()
+                            if ts_arr is not None else None),
+                clear=clear,
+            )
+
+        def run_local(sel: np.ndarray) -> int:
+            if values_arr is not None:
+                return self.import_values(
+                    index, field, columns_arr[sel], values_arr[sel],
                     clear=clear, remote=True,
                 )
-            for node, idxs in remote_batches.values():
-                changed += self.cluster.client.import_values(
-                    node.uri, index, field,
-                    pick(list(columns), idxs), pick(list(values), idxs),
-                    clear=clear,
-                )
+            return self.import_bits(
+                index, field, rows_arr[sel], columns_arr[sel],
+                timestamps=(ts_arr[sel].tolist()
+                            if ts_arr is not None else None),
+                clear=clear, remote=True,
+            )
+
+        def run_remote(node, parts: list[np.ndarray]) -> int:
+            sel = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            t0 = time.perf_counter()
+            try:
+                try:
+                    return send_once(node, sel)
+                except ClientError as e:
+                    # imports are idempotent, so a transport/5xx NODE
+                    # fault earns one immediate retry (rides out a
+                    # heartbeat blip without failing the whole batch);
+                    # deterministic 4xx never retries — every replay
+                    # would answer the same
+                    if not e.is_node_fault:
+                        raise
+                    stats.count("ingest_retries", 1,
+                                tags={"node": node.id})
+                    return send_once(node, sel)
+            finally:
+                stats.timing("ingest_fanout", time.perf_counter() - t0,
+                             tags={"node": node.id})
+
+        tasks = []
+        labels: list[str | None] = []
+        if local_parts:
+            sel = (local_parts[0] if len(local_parts) == 1
+                   else np.concatenate(local_parts))
+            tasks.append(lambda sel=sel: run_local(sel))
+            labels.append(None)
+        for node, parts in remote_parts.values():
+            tasks.append(lambda node=node, parts=parts:
+                         run_remote(node, parts))
+            labels.append(node.id)
+
+        t0 = time.perf_counter()
+        outcomes = concurrent_map(
+            lambda fn: fn(), tasks,
+            max_workers=max(1, self.ingest_fanout_workers),
+            return_exceptions=True,
+        )
+        stats.timing("ingest_route_wall", time.perf_counter() - t0)
+        stats.observe("ingest_fanout_width", len(tasks))
+
+        changed = 0
+        node_errors: dict[str, str] = {}
+        status = None
+        for label, out in zip(labels, outcomes):
+            if isinstance(out, Exception):
+                name = label or self.cluster.local.id
+                node_errors[name] = str(out)
+                stats.count("ingest_node_errors", 1, tags={"node": name})
+                # deterministic request errors (local validation, remote
+                # 4xx) dominate the surfaced status — they mean the
+                # REQUEST is bad, not the node
+                if isinstance(out, ApiError):
+                    status = out.status
+                elif (isinstance(out, ClientError)
+                      and not out.is_node_fault and status is None):
+                    status = out.status
+            else:
+                changed += out
+        if node_errors:
+            raise ImportRoutingError(node_errors, changed,
+                                     status=status or 502)
         return changed
 
-    def _send_roaring_batch(self, node, index, field, rows, columns_arr,
-                            idxs) -> int:
+    def _send_roaring_batch(self, node, index, field, rows_arr,
+                            cols_arr) -> int:
         """Ship one node's slice of a routed set-bit import as per-shard
-        roaring bodies (fragment id space: row * SHARD_WIDTH + position)."""
+        roaring bodies (fragment id space: row * SHARD_WIDTH + position).
+        ``rows_arr``/``cols_arr`` are the node's already-sliced arrays."""
         import numpy as np
 
         from pilosa_tpu.roaring import RoaringBitmap
         from pilosa_tpu.roaring.format import serialize
 
-        idxs = np.asarray(idxs, np.int64)
-        rows_arr = np.asarray(list(rows), np.uint64)[idxs]
-        cols = columns_arr[idxs].astype(np.uint64)
+        rows_arr = np.asarray(rows_arr).astype(np.uint64)
+        cols = np.asarray(cols_arr).astype(np.uint64)
         order, bounds, shards_sorted = shard_groups(cols)
         rows_arr, cols = rows_arr[order], cols[order]
         changed = 0
@@ -459,6 +631,11 @@ class API:
             raise ApiError(f"column id out of range: {e}") from e
         if cols_i.size and cols_i.min() < 0:
             raise ApiError(f"column {int(cols_i.min())} is negative")
+        import time
+
+        from pilosa_tpu.utils.stats import global_stats
+
+        t0 = time.perf_counter()
         if clear:
             changed = 0
             for col in cols_i.tolist():
@@ -473,11 +650,21 @@ class API:
                 )
             except (ValueError, OverflowError) as e:
                 raise ApiError(str(e)) from e
+        elapsed = time.perf_counter() - t0
+        stats = global_stats()
+        tags = {"kind": "values"}
+        stats.count("ingest_rows", cols_i.size, tags=tags)
+        stats.observe("ingest_batch_size", cols_i.size, tags=tags)
+        stats.timing("ingest_apply", elapsed, tags=tags)
+        if elapsed > 0:
+            stats.gauge("ingest_rows_per_sec", cols_i.size / elapsed,
+                        tags=tags)
         if not clear:
-            idx.mark_columns_exist([int(c) for c in columns])
+            idx.mark_columns_exist(cols_i)
             if self.cluster is not None:
                 self.cluster.note_local_shards(
-                    index, {int(c) >> SHARD_WIDTH_EXP for c in columns}
+                    index,
+                    np.unique(cols_i >> SHARD_WIDTH_EXP).tolist(),
                 )
         return int(changed)
 
@@ -494,6 +681,12 @@ class API:
             changed = frag.add_ids(ids)
         except ValueError as e:
             raise ApiError(str(e)) from e
+        from pilosa_tpu.utils.stats import global_stats
+
+        stats = global_stats()
+        stats.count("ingest_rows", int(ids.size), tags={"kind": "roaring"})
+        stats.observe("ingest_batch_size", int(ids.size),
+                      tags={"kind": "roaring"})
         positions = np.unique(ids & np.uint64(SHARD_WIDTH - 1))
         idx.mark_columns_exist(
             ((shard << SHARD_WIDTH_EXP) + positions.astype(np.int64)).tolist()
@@ -522,17 +715,22 @@ class API:
     # ---------------------------------------------------------------- info
 
     def status(self) -> dict:
+        # maxWritesPerRequest rides /status so bulk clients (the CLI
+        # importer) can clamp their batch size to this server's limit
+        # instead of discovering it via 413s
         if self.cluster is not None:
             return {
                 "state": self.cluster.state,
                 "nodes": self.cluster.nodes_json(),
                 "localID": self.cluster.local.id,
+                "maxWritesPerRequest": self.max_writes_per_request,
             }
         return {
             "state": "NORMAL",
             "nodes": [{"id": "local", "uri": "localhost", "isCoordinator": True,
                        "state": "NORMAL"}],
             "localID": "local",
+            "maxWritesPerRequest": self.max_writes_per_request,
         }
 
     def info(self) -> dict:

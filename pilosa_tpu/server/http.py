@@ -281,6 +281,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
             rows, columns = body.get("rows", []), body.get("columns", [])
             timestamps = body.get("timestamps")
             clear = bool(body.get("clear", False))
+        self._check_import_size(len(columns), remote)
         changed = self.api.import_bits(
             index, field, rows, columns, timestamps=timestamps, clear=clear,
             remote=remote,
@@ -301,10 +302,27 @@ class HTTPHandler(BaseHTTPRequestHandler):
             body = self._json_body()
             columns, values = body.get("columns", []), body.get("values", [])
             clear = bool(body.get("clear", False))
+        self._check_import_size(len(columns), remote)
         changed = self.api.import_values(
             index, field, columns, values, clear=clear, remote=remote,
         )
         self._json({"changed": changed})
+
+    def _check_import_size(self, n: int, remote: bool) -> None:
+        """Apply max-writes-per-request to EDGE import bodies (the same
+        knob the query path enforces — a 100k-row import is no lighter
+        than 100k Set() calls). Remote hops are exempt: they carry
+        slices of an already-admitted edge batch, and a routed slice
+        must never bounce off a peer with a tighter config. 413 so bulk
+        clients (CLI --batch-size) can split-and-retry distinguishably
+        from validation 400s."""
+        limit = self.api.max_writes_per_request
+        if not remote and 0 < limit < n:
+            raise ApiError(
+                f"import batch of {n} rows exceeds max-writes-per-request "
+                f"{limit}; split the batch (the CLI clamps --batch-size "
+                "to this server's limit automatically)", 413,
+            )
 
     def post_import_roaring(self, index, field, shard, query=None):
         changed = self.api.import_roaring(index, field, int(shard), self._body())

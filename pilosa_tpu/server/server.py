@@ -37,6 +37,7 @@ class ServerConfig:
         statsd: str = "",
         long_query_time: float = 0.0,
         max_writes_per_request: int = 5000,
+        ingest_workers: int = 1,
         tls_certificate: str = "",
         tls_key: str = "",
         tls_skip_verify: bool = False,
@@ -65,6 +66,9 @@ class ServerConfig:
         self.statsd = statsd
         self.long_query_time = long_query_time
         self.max_writes_per_request = max_writes_per_request
+        # bounded pool width for applying one import's independent local
+        # shard groups (docs/INGEST.md); 1 = serial apply
+        self.ingest_workers = ingest_workers
         self.tls_certificate = tls_certificate
         self.tls_key = tls_key
         self.tls_skip_verify = tls_skip_verify
@@ -110,6 +114,9 @@ class ServerConfig:
                 d.get("max-writes-per-request",
                       d.get("max_writes_per_request", 5000))
             ),
+            ingest_workers=int(
+                d.get("ingest-workers", d.get("ingest_workers", 1))
+            ),
             tls_certificate=d.get("tls-certificate", tls.get("certificate", "")),
             tls_key=d.get("tls-key", tls.get("key", "")),
             tls_skip_verify=_parse_bool(
@@ -153,6 +160,7 @@ class ServerConfig:
             "statsd": self.statsd,
             "long-query-time": self.long_query_time,
             "max-writes-per-request": self.max_writes_per_request,
+            "ingest-workers": self.ingest_workers,
             "tls-certificate": self.tls_certificate,
             "tls-key": self.tls_key,
             "tls-skip-verify": self.tls_skip_verify,
@@ -230,6 +238,7 @@ class Server:
         self.holder.open()
         self.api.long_query_time = self.config.long_query_time
         self.api.max_writes_per_request = self.config.max_writes_per_request
+        self.api.ingest_workers = max(1, self.config.ingest_workers)
         self.api.logger = self.logger
         if self.config.statsd:
             # statsd sink must be wired BEFORE anything captures the

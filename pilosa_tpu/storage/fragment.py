@@ -519,10 +519,16 @@ class Fragment:
         for i, row in enumerate(uniq.tolist()):
             self._after_row_write(
                 int(row), positions=sorted_pos[bounds[i]:bounds[i + 1]],
-                added=True,
+                added=True, count_stat=False,
             )
+        # one counter bump for the whole batch: parallel ingest workers
+        # would otherwise serialize on the global stats lock per row
+        from pilosa_tpu.utils.stats import global_stats
 
-    def _after_row_write(self, row: int, positions=None, added=None) -> None:
+        global_stats().count("fragment_row_writes", int(uniq.size))
+
+    def _after_row_write(self, row: int, positions=None, added=None,
+                         count_stat: bool = True) -> None:
         """Invalidate this fragment's own device entries and route the
         write to dependent stacked leaves for in-place patching (instead
         of the old global generation purge — one Set() must not evict
@@ -535,9 +541,10 @@ class Fragment:
             positions=positions, added=added, scope=self.scope,
         ))
         self.row_cache.add(row, self.count_row(row))
-        from pilosa_tpu.utils.stats import global_stats
+        if count_stat:
+            from pilosa_tpu.utils.stats import global_stats
 
-        global_stats().count("fragment_row_writes", 1)
+            global_stats().count("fragment_row_writes", 1)
 
     def _check_pos(self, pos: int) -> None:
         if not 0 <= pos < SHARD_WIDTH:

@@ -137,6 +137,27 @@ class Index:
                 zeros[lo:hi], cols[lo:hi] & np.uint64(SHARD_WIDTH - 1)
             )
 
+    def mark_columns_exist_shard(self, shard: int, positions) -> None:
+        """One shard group's existence write, ``positions`` already
+        in-shard. The bulk import path calls this from its per-group
+        workers: the caller's shard_groups pass already sorted the
+        batch, so re-deriving groups here (a second argsort over the
+        whole batch — half of mark_columns_exist's cost) is skipped, and
+        the existence write parallelizes with the data write instead of
+        running as a serial tail."""
+        if not self.track_existence:
+            return
+        import numpy as np
+
+        positions = np.asarray(positions, np.uint64)
+        if positions.size == 0:
+            return
+        ex = self.fields[EXISTENCE_FIELD]
+        frag = ex.view(VIEW_STANDARD, create=True).fragment(
+            int(shard), create=True
+        )
+        frag.bulk_import(np.zeros(positions.size, np.uint64), positions)
+
     def existence_fragment(self, shard: int):
         if not self.track_existence:
             return None

@@ -1,1 +1,14 @@
 """Infra utilities: stats, tracing, logging (reference L1 — SURVEY.md §1)."""
+
+
+def as_int_list(seq) -> list:
+    """Python ints from any id sequence. Routed imports hand numpy
+    slices straight to the wire encoders; ``.tolist()`` converts the
+    whole buffer in C, where a per-element ``int()`` loop costs more
+    than the HTTP frame on large batches. Shared by the JSON
+    (parallel/client.py) and protobuf (wire/serializer.py) encode paths
+    so the fast path cannot drift between them."""
+    tolist = getattr(seq, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    return [int(v) for v in seq]

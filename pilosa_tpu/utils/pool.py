@@ -19,18 +19,30 @@ from concurrent.futures import ThreadPoolExecutor
 MAX_FANOUT = 16
 
 
-def concurrent_map(fn, items, max_workers: int = MAX_FANOUT) -> list:
+def concurrent_map(fn, items, max_workers: int = MAX_FANOUT,
+                   return_exceptions: bool = False) -> list:
     """Apply ``fn`` to every item concurrently; results in input order.
 
     The first exception propagates to the caller (after in-flight calls
     finish — pool shutdown joins its threads); callers wanting per-item
-    error tolerance catch inside ``fn``.
+    error tolerance pass ``return_exceptions=True``, which returns each
+    item's Exception in place of its result so one failed item cannot
+    abort (or hide the results of) the rest — the routed-import fan-out
+    relies on this to report exactly which nodes failed while every
+    healthy node's batch still lands.
     """
     items = list(items)
+    call = fn
+    if return_exceptions:
+        def call(x):
+            try:
+                return fn(x)
+            except Exception as e:  # per-item capture, surfaced in-order
+                return e
     if len(items) <= 1:
-        return [fn(x) for x in items]
+        return [call(x) for x in items]
     with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
-        return list(pool.map(fn, items))
+        return list(pool.map(call, items))
 
 
 def spawn(thunk):

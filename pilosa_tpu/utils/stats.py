@@ -11,7 +11,13 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
+
+# Bounded per-series sample window backing the exported p50/p95 lines —
+# a sliding window, not a decaying histogram: ingest fan-out and batch
+# sizes change regime abruptly (bulk load starts/stops), and a window
+# forgets the old regime after SAMPLE_WINDOW observations.
+SAMPLE_WINDOW = 256
 
 
 def _fmt_tags(tags: dict | None) -> str:
@@ -19,6 +25,18 @@ def _fmt_tags(tags: dict | None) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
     return "{" + inner + "}"
+
+
+def _with_tag(tags_str: str, extra: str) -> str:
+    """Splice one more label into an already-rendered tag block."""
+    if not tags_str:
+        return "{" + extra + "}"
+    return tags_str[:-1] + "," + extra + "}"
+
+
+def _quantile(samples, q: float) -> float:
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
 
 
 class StatsClient:
@@ -29,7 +47,15 @@ class StatsClient:
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
-        self._timings: dict[tuple, list] = defaultdict(lambda: [0, 0.0])
+        # [count, sum, sample window] — the window feeds quantile export
+        self._timings: dict[tuple, list] = defaultdict(
+            lambda: [0, 0.0, deque(maxlen=SAMPLE_WINDOW)]
+        )
+        # unit-free distributions (batch sizes, fan-out widths): same
+        # shape as _timings but rendered without the _seconds unit suffix
+        self._observations: dict[tuple, list] = defaultdict(
+            lambda: [0, 0.0, deque(maxlen=SAMPLE_WINDOW)]
+        )
 
     def count(self, name: str, value: float = 1, tags: dict | None = None) -> None:
         with self._lock:
@@ -44,12 +70,32 @@ class StatsClient:
             entry = self._timings[(name, _fmt_tags(tags))]
             entry[0] += 1
             entry[1] += seconds
+            entry[2].append(seconds)
 
     def timer(self, name: str, tags: dict | None = None):
         return _Timer(self, name, tags)
 
     def histogram(self, name: str, value: float, tags: dict | None = None) -> None:
         self.timing(name, value, tags)
+
+    def observe(self, name: str, value: float, tags: dict | None = None) -> None:
+        """Record one sample of a unit-free distribution (batch size,
+        fan-out width). Exported as count/sum/quantile lines without the
+        _seconds suffix that timing() series carry."""
+        with self._lock:
+            entry = self._observations[(name, _fmt_tags(tags))]
+            entry[0] += 1
+            entry[1] += value
+            entry[2].append(value)
+
+    def quantile(self, name: str, q: float, tags: dict | None = None) -> float | None:
+        """Windowed quantile of a timing or observation series (None if
+        the series has no samples yet)."""
+        key = (name, _fmt_tags(tags))
+        with self._lock:
+            entry = self._timings.get(key) or self._observations.get(key)
+            samples = list(entry[2]) if entry else []
+        return _quantile(samples, q) if samples else None
 
     def prometheus_text(self) -> str:
         lines = []
@@ -58,16 +104,44 @@ class StatsClient:
                 lines.append(f"{self.prefix}_{name}_total{tags} {v:g}")
             for (name, tags), v in sorted(self._gauges.items()):
                 lines.append(f"{self.prefix}_{name}{tags} {v:g}")
-            for (name, tags), (n, total) in sorted(self._timings.items()):
+            for (name, tags), (n, total, samples) in sorted(self._timings.items()):
                 lines.append(f"{self.prefix}_{name}_seconds_count{tags} {n:g}")
                 lines.append(f"{self.prefix}_{name}_seconds_sum{tags} {total:g}")
+                for q in (0.5, 0.95):
+                    if samples:
+                        qt = _with_tag(tags, f'quantile="{q}"')
+                        lines.append(
+                            f"{self.prefix}_{name}_seconds{qt} "
+                            f"{_quantile(samples, q):g}"
+                        )
+            for (name, tags), (n, total, samples) in sorted(
+                self._observations.items()
+            ):
+                lines.append(f"{self.prefix}_{name}_count{tags} {n:g}")
+                lines.append(f"{self.prefix}_{name}_sum{tags} {total:g}")
+                for q in (0.5, 0.95):
+                    if samples:
+                        qt = _with_tag(tags, f'quantile="{q}"')
+                        lines.append(
+                            f"{self.prefix}_{name}{qt} "
+                            f"{_quantile(samples, q):g}"
+                        )
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> dict:
         with self._lock:
+            dists = {}
+            for source in (self._timings, self._observations):
+                for (n, t), (count, total, samples) in source.items():
+                    dists[f"{n}{t}"] = {
+                        "count": count, "sum": total,
+                        "p50": _quantile(samples, 0.5) if samples else None,
+                        "p95": _quantile(samples, 0.95) if samples else None,
+                    }
             return {
                 "counters": {f"{n}{t}": v for (n, t), v in self._counters.items()},
                 "gauges": {f"{n}{t}": v for (n, t), v in self._gauges.items()},
+                "distributions": dists,
             }
 
 
@@ -122,6 +196,10 @@ class StatsdStatsClient(StatsClient):
         super().timing(name, seconds, tags)
         self._emit(name, round(seconds * 1e3, 3), "ms", tags)
 
+    def observe(self, name, value, tags=None):
+        super().observe(name, value, tags)
+        self._emit(name, value, "h", tags)
+
 
 class NopStatsClient(StatsClient):
     """Discards everything (reference stats.NopStatsClient)."""
@@ -133,6 +211,9 @@ class NopStatsClient(StatsClient):
         pass
 
     def timing(self, *a, **k):
+        pass
+
+    def observe(self, *a, **k):
         pass
 
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
+from pilosa_tpu.utils import as_int_list
 from pilosa_tpu.wire import pb2
 
 RESULT_NIL = 0
@@ -182,8 +183,8 @@ def encode_import_request(index: str, field: str, rows, columns,
     p = pb2()
     req = p.ImportRequest()
     req.index, req.field, req.clear = index, field, clear
-    req.row_ids.extend(int(r) for r in rows)
-    req.column_ids.extend(int(c) for c in columns)
+    req.row_ids.extend(as_int_list(rows))
+    req.column_ids.extend(as_int_list(columns))
     if timestamps is not None:
         req.timestamps.extend("" if t is None else str(t) for t in timestamps)
     return req.SerializeToString()
@@ -194,8 +195,8 @@ def encode_import_value_request(index: str, field: str, columns, values,
     p = pb2()
     req = p.ImportValueRequest()
     req.index, req.field, req.clear = index, field, clear
-    req.column_ids.extend(int(c) for c in columns)
-    req.values.extend(int(v) for v in values)
+    req.column_ids.extend(as_int_list(columns))
+    req.values.extend(as_int_list(values))
     return req.SerializeToString()
 
 

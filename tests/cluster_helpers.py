@@ -25,7 +25,8 @@ def uri(s: Server) -> str:
     return f"http://localhost:{s.port}"
 
 
-def make_cluster(tmp_path, n, replica_n=1, use_mesh=False, prefix="node"):
+def make_cluster(tmp_path, n, replica_n=1, use_mesh=False, prefix="node",
+                 **config_kw):
     servers = []
     for i in range(n):
         seeds = [uri(servers[0])] if servers else []
@@ -33,7 +34,7 @@ def make_cluster(tmp_path, n, replica_n=1, use_mesh=False, prefix="node"):
             data_dir=str(tmp_path / f"{prefix}{i}"), port=0,
             name=f"{prefix[0]}{i}", replica_n=replica_n, seeds=seeds,
             anti_entropy_interval=0, heartbeat_interval=0,
-            use_mesh=use_mesh,
+            use_mesh=use_mesh, **config_kw,
         )).open())
     return servers
 

@@ -17,22 +17,10 @@ from pilosa_tpu.server import Server, ServerConfig
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
-def make_cluster(tmp_path, n: int, replica_n: int = 1) -> list[Server]:
-    servers = []
-    for i in range(n):
-        seeds = [f"http://localhost:{servers[0].port}"] if servers else []
-        cfg = ServerConfig(
-            data_dir=str(tmp_path / f"node{i}"),
-            port=0,
-            name=f"n{i}",
-            replica_n=replica_n,
-            seeds=seeds,
-            anti_entropy_interval=0,   # ticker off; tests drive sync directly
-            heartbeat_interval=0,
-            use_mesh=False,
-        )
-        servers.append(Server(cfg).open())
-    return servers
+# one make_cluster for every cluster suite (node names/dirs/ticker-off
+# semantics identical; keeping a private copy here meant every new
+# ServerConfig knob needed a synchronized two-file edit)
+from cluster_helpers import make_cluster  # noqa: E402
 
 
 def req(method, url, body=None, content_type="application/json"):
@@ -1072,7 +1060,9 @@ class TestBinaryInternalWire:
         """A routed set-bit import ships per-shard roaring bodies: the
         bytes on the wire are O(bitmap bytes), not JSON int lists
         (reference: every internal hop is protobuf — SURVEY.md §2 #16-17)."""
-        servers = make_cluster(tmp_path, 2)
+        # the edge batch below is deliberately huge (2^18 rows); lift the
+        # max-writes-per-request gate that edge imports now enforce
+        servers = make_cluster(tmp_path, 2, max_writes_per_request=0)
         try:
             req("POST", f"{uri(servers[0])}/index/i", {})
             req("POST", f"{uri(servers[0])}/index/i/field/f", {})
