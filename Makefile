@@ -3,11 +3,15 @@
 # QoS fault-injection suite in isolation (fast feedback while tuning
 # admission/deadline/hedge knobs — see docs/QOS.md); ingest-smoke pushes
 # a small CSV through `cli.py import` against an in-process server and
-# exercises the routed-import suite (docs/INGEST.md).
+# exercises the routed-import suite (docs/INGEST.md); serving-smoke
+# gates the host-path fast lane — keep-alive reuse via the
+# connection-count oracle, and /internal/query-batch returning
+# byte-identical results vs per-query dispatch (docs/OPERATIONS.md).
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: test test-slow qos-smoke ingest-smoke bench-ingest
+.PHONY: test test-slow qos-smoke ingest-smoke serving-smoke bench-ingest \
+	bench-serving
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -21,5 +25,11 @@ qos-smoke:
 ingest-smoke:
 	$(PYTEST) tests/test_ingest.py -m "not slow"
 
+serving-smoke:
+	$(PYTEST) tests/test_fastlane.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
+
+bench-serving:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs serving

@@ -61,6 +61,76 @@ def _mk_env(tmp):
     return holder, Executor(holder)
 
 
+def profiled_device_ms(fn, iters: int = 5):
+    """PROFILER-MEASURED device execution time per iteration (VERDICT r5
+    Next #2): run ``fn`` ``iters`` times inside a ``jax.profiler`` trace
+    (utils/tracing.start_jax_trace) and sum the device-lane op durations
+    from the captured perfetto trace — replacing the old wall-minus-floor
+    arithmetic, which inferred device time from a noisy tunnel-RTT
+    sample. Returns mean ms/iteration, or None when the trace could not
+    be captured/parsed (the bench must not fail on profiler quirks)."""
+    import glob
+    import gzip
+    import os
+    import tempfile as _tf
+
+    from pilosa_tpu.utils.tracing import start_jax_trace
+
+    with _tf.TemporaryDirectory() as td:
+        try:
+            with start_jax_trace(td):
+                for _ in range(iters):
+                    fn()
+        except Exception:
+            return None
+        total_us = 0.0
+        found = False
+        for path in glob.glob(os.path.join(td, "**", "*.trace.json.gz"),
+                              recursive=True):
+            try:
+                with gzip.open(path, "rt") as f:
+                    trace = json.load(f)
+            except Exception:
+                continue
+            events = trace.get("traceEvents", [])
+            # TPU/GPU: device lanes are separate trace processes named
+            # "/device:TPU:0 ..." whose per-op lane is the thread named
+            # "XLA Ops" — summing ALL device-pid lanes would double
+            # count ("XLA Modules"/"Steps" spans COVER their op spans).
+            # CPU backend: XLA executes on the "/host:CPU" process's
+            # tf_XLA* threads (Eigen pool + TfrtCpuClient); those lanes
+            # run genuinely in parallel, so their sum is device
+            # THREAD-time (can exceed wall — labeled as such).
+            device_pids = set()
+            op_threads = set()
+            cpu_threads = set()
+            for e in events:
+                if e.get("ph") != "M":
+                    continue
+                name = str((e.get("args") or {}).get("name", ""))
+                if (e.get("name") == "process_name"
+                        and "device" in name.lower()):
+                    device_pids.add(e.get("pid"))
+                elif e.get("name") == "thread_name":
+                    if name.startswith("XLA Ops"):
+                        op_threads.add((e.get("pid"), e.get("tid")))
+                    elif name.startswith("tf_XLA"):
+                        cpu_threads.add((e.get("pid"), e.get("tid")))
+            # prefer the per-op lanes of device processes; fall back to
+            # the CPU execution threads when no device process exists
+            keep = {t for t in op_threads if t[0] in device_pids}
+            if not keep:
+                keep = cpu_threads
+            for e in events:
+                if (e.get("ph") == "X"
+                        and (e.get("pid"), e.get("tid")) in keep):
+                    total_us += float(e.get("dur", 0) or 0)
+                    found = True
+        if not found:
+            return None
+        return round(total_us / 1e3 / iters, 3)
+
+
 def config1_star_trace(n_shards: int) -> dict:
     """Star-Trace: Row(stargazer) ∩ Row(language) → Count."""
     from pilosa_tpu.shardwidth import SHARD_WIDTH
@@ -82,6 +152,7 @@ def config1_star_trace(n_shards: int) -> dict:
         # oracle on one query
         pql = "Count(Intersect(Row(stargazer=1), Row(language=5)))"
         dt, got = _timed(lambda: ex.execute("repos", pql)[0])
+        dev_ms = profiled_device_ms(lambda: ex.execute("repos", pql)[0])
         # numpy oracle
         want = 0
         for shard in range(n_shards):
@@ -92,6 +163,7 @@ def config1_star_trace(n_shards: int) -> dict:
         return {
             "config": 1, "metric": "star_trace_intersect_count_p50_ms",
             "value": round(dt * 1e3, 3), "unit": "ms",
+            "device_p50_ms": dev_ms, "device_p50_source": "jax-profiler (sum of device-lane op durations)",
             "cols": n_shards << 20, "ok": got == want,
         }
 
@@ -117,11 +189,15 @@ def config2_taxi_topn_groupby(n_shards: int) -> dict:
         dt_gb, groups = _timed(
             lambda: ex.execute("taxi", "GroupBy(Rows(passenger_count))")[0], iters=3
         )
+        dev_ms = profiled_device_ms(
+            lambda: ex.execute("taxi", "TopN(cab_type, n=3)")[0]
+        )
         total = sum(g.count for g in groups)
         holder.close()
         return {
             "config": 2, "metric": "taxi_topn_p50_ms",
             "value": round(dt_topn * 1e3, 3), "unit": "ms",
+            "device_p50_ms": dev_ms, "device_p50_source": "jax-profiler (sum of device-lane op durations)",
             "groupby_ms": round(dt_gb * 1e3, 3),
             "ok": pairs[0].id == 0 and total == n_shards << 20,
         }
@@ -156,10 +232,14 @@ def config3_bsi_range_sum(n_shards: int) -> dict:
             frag.bulk_import(np.concatenate(rows), np.concatenate(pos))
         dt_range, got_gt = _timed(lambda: ex.execute("taxi", "Count(Range(fare > 1000))")[0])
         dt_sum, got_sum = _timed(lambda: ex.execute("taxi", 'Sum(field="fare")')[0])
+        dev_ms = profiled_device_ms(
+            lambda: ex.execute("taxi", "Count(Range(fare > 1000))")[0]
+        )
         holder.close()
         return {
             "config": 3, "metric": "bsi_range_count_p50_ms",
             "value": round(dt_range * 1e3, 3), "unit": "ms",
+            "device_p50_ms": dev_ms, "device_p50_source": "jax-profiler (sum of device-lane op durations)",
             "sum_ms": round(dt_sum * 1e3, 3),
             "ok": got_gt == oracle_gt and got_sum.value == oracle_sum,
         }
@@ -196,10 +276,13 @@ def config4_time_quantum(n_shards: int) -> dict:
                     oracle.update((shard << 20) + int(c) for c in cols)
         pql = "Count(Row(t=1, from='2019-01-01T00:00', to='2020-01-01T00:00'))"
         dt_q, got = _timed(lambda: ex.execute("events", pql)[0])
+        dev_ms = profiled_device_ms(lambda: ex.execute("events", pql)[0])
         holder.close()
         return {
             "config": 4, "metric": "time_union_count_p50_ms",
-            "value": round(dt_q * 1e3, 3), "unit": "ms", "ok": got == len(oracle),
+            "value": round(dt_q * 1e3, 3), "unit": "ms",
+            "device_p50_ms": dev_ms, "device_p50_source": "jax-profiler (sum of device-lane op durations)",
+            "ok": got == len(oracle),
         }
 
 
@@ -229,6 +312,7 @@ def config5_ssb_4way(n_shards: int) -> dict:
         pql = ("Count(Intersect(Row(year=1), Row(region=1), "
                "Row(category=1), Row(brand=1)))")
         dt_q, got = _timed(lambda: ex.execute("ssb", pql)[0])
+        dev_ms = profiled_device_ms(lambda: ex.execute("ssb", pql)[0])
         want = 0
         for shard in range(n_shards):
             acc = None
@@ -240,6 +324,7 @@ def config5_ssb_4way(n_shards: int) -> dict:
         return {
             "config": 5, "metric": "ssb_4way_intersect_count_p50_ms",
             "value": round(dt_q * 1e3, 3), "unit": "ms",
+            "device_p50_ms": dev_ms, "device_p50_source": "jax-profiler (sum of device-lane op durations)",
             "mesh_devices": make_mesh().size, "ok": got == want,
         }
 
@@ -325,18 +410,33 @@ def config5_mesh_cpu8(n_shards: int = 16, n_queries: int = 64) -> dict:
         }
 
 
-def config_serving(n_shards: int = 8, n_clients: int = 16,
-                   n_queries: int = 64) -> dict:
-    """Serving-path throughput: concurrent HTTP clients against ONE
-    in-process server (real loopback HTTP, the full handler → API →
-    ClusterExecutor.submit stack). The wave-coalescing query pipeline
-    (server/pipeline.py) must push aggregate QPS far above the serial
-    per-request rate — on a tunneled TPU backend the serial rate is
-    pinned near 1/dispatch-floor, so this is the VERDICT r4 #1 'done'
-    criterion measured: same-shape Counts across concurrent requests
-    share micro-batched dispatches. Correctness: concurrent responses
-    must equal the serial responses for the same queries."""
-    import json as _json
+def config_serving(n_shards: int = 8, n_queries: int = 512,
+                   client_counts=(16, 64, 128)) -> dict:
+    """Serving-path throughput with the HOST-PATH FAST LANE (ISSUE 4 /
+    VERDICT r5 Next #3): concurrent HTTP clients against ONE in-process
+    server (real loopback HTTP, full handler → API →
+    ClusterExecutor.submit stack), in two transport modes on the same
+    hardware, same data, same queries:
+
+    - ``fastlane``: each client holds a persistent HTTP/1.1 keep-alive
+      connection (what the pooled InternalClient and any sane production
+      client do) — requests amortize TCP connect + server handler-thread
+      spawn, responses ride pre-serialized bytes, identical wavemates
+      dedupe in the pipeline;
+    - ``legacy``: the r5 serving path end to end — urllib clients (one
+      fresh connection per request, exactly the r5 bench's client) AND
+      ``api.serve_fastlane = False`` (dict building + json.dumps per
+      request, no identical-query dedupe); that curve plateaued
+      ~650 QPS/node on TPU hardware.
+
+    The headline is plateau-vs-plateau: max QPS over the client sweep in
+    each mode. ok requires byte-identical responses to the serial pass,
+    the connection-count oracle (fastlane connections ≈ clients while
+    legacy ≈ requests), and ≥2× legacy plateau. A second phase proves
+    the cluster fast lane: a 2-node cluster answers a query set with the
+    wave batcher ON and OFF and the response bytes must be identical,
+    with batches actually formed."""
+    import http.client as _hc
     import threading
     import urllib.request
 
@@ -353,8 +453,7 @@ def config_serving(n_shards: int = 8, n_clients: int = 16,
         try:
             idx = server.holder.create_index("b")
             f = idx.create_field("f")
-            density = 0.1
-            n = int(SHARD_WIDTH * density)
+            n = int(SHARD_WIDTH * 0.1)
             for shard in range(n_shards):
                 frag = f.view(VIEW_STANDARD, create=True).fragment(
                     shard, create=True
@@ -367,38 +466,54 @@ def config_serving(n_shards: int = 8, n_clients: int = 16,
                         ),
                     )
             server.api.cluster.note_local_shards("b", list(range(n_shards)))
-            url = f"http://localhost:{server.port}/index/b/query"
-
-            def post(pql: str) -> dict:
-                r = urllib.request.Request(
-                    url, data=pql.encode(), method="POST"
-                )
-                with urllib.request.urlopen(r, timeout=120) as resp:
-                    return _json.loads(resp.read())
-
+            port = server.port
             queries = [
                 ("Count(Intersect(Row(f={}), Row(f={})))".format(
                     1 + (i % 4), 1 + ((i + 1) % 4)))
                 for i in range(n_queries)
             ]
-            post(queries[0])  # warm the per-query compile caches
 
+            def post_keepalive(conn, pql: str) -> bytes:
+                conn.request("POST", "/index/b/query", body=pql.encode())
+                return conn.getresponse().read()
+
+            def post_legacy(pql: str) -> bytes:
+                # urllib, new connection per request: byte-for-byte the
+                # client the r5 serving bench used for its curve
+                r = urllib.request.Request(
+                    f"http://localhost:{port}/index/b/query",
+                    data=pql.encode(), method="POST",
+                )
+                with urllib.request.urlopen(r, timeout=120) as resp:
+                    return resp.read()
+
+            post_legacy(queries[0])  # warm the per-query compile caches
+            serial_conn = _hc.HTTPConnection("localhost", port, timeout=120)
             t0 = time.perf_counter()
-            serial = [post(q) for q in queries]
+            serial = [post_keepalive(serial_conn, q) for q in queries]
             serial_wall = time.perf_counter() - t0
+            serial_conn.close()
+            serial_parsed = [json.loads(s) for s in serial]
 
-            def run_concurrent():
+            def run_concurrent(n_clients: int, keepalive: bool):
                 results = [None] * n_queries
                 errors: list = []
                 gate = threading.Event()
 
                 def worker(tid: int):
+                    conn = (_hc.HTTPConnection("localhost", port,
+                                               timeout=120)
+                            if keepalive else None)
                     gate.wait(30)
                     for k in range(tid, n_queries, n_clients):
                         try:
-                            results[k] = post(queries[k])
+                            results[k] = (post_keepalive(conn, queries[k])
+                                          if keepalive
+                                          else post_legacy(queries[k]))
                         except Exception as e:  # surfaced via errors
                             errors.append(repr(e))
+                    if conn is not None:
+                        conn.close()
 
                 threads = [
                     threading.Thread(target=worker, args=(t,))
@@ -414,24 +529,160 @@ def config_serving(n_shards: int = 8, n_clients: int = 16,
 
             # warm burst: compiles the pow-of-two batched program shapes
             # the waves will use (the serial pass only compiled batch=1)
-            run_concurrent()
-            conc_wall, results, errors = run_concurrent()
+            run_concurrent(max(client_counts), True)
 
-            ok = not errors and results == serial
-            waves = getattr(server.api._pipeline, "waves", 0)
-            return {
-                "config": "serving",
-                "metric": "serving_concurrent_qps",
-                "value": round(n_queries / conc_wall, 1),
-                "unit": "queries/sec",
-                "qps_serial": round(n_queries / serial_wall, 1),
-                "speedup_vs_serial": round(serial_wall / conc_wall, 2),
-                "clients": n_clients, "queries": n_queries,
-                "shards": n_shards, "pipeline_waves": waves,
-                "ok": bool(ok),
-            }
+            ok = True
+            scaling = []
+            oracle = {}
+            for mode, keepalive in (("fastlane", True), ("legacy", False)):
+                # legacy mode is the FULL r5 serving path: per-request
+                # connections AND the pre-fastlane response pipeline
+                server.api.serve_fastlane = keepalive
+                for n_clients in client_counts:
+                    best = 0.0
+                    for _ in range(3):  # best-of-3: loopback jitter
+                        http_srv = server._http
+                        with http_srv.metrics_lock:
+                            conns0 = http_srv.connections_opened
+                        wall, results, errors = run_concurrent(
+                            n_clients, keepalive
+                        )
+                        with http_srv.metrics_lock:
+                            conns = http_srv.connections_opened - conns0
+                        same = (results == serial if keepalive else
+                                [json.loads(r) for r in results
+                                 if r is not None] == serial_parsed)
+                        ok = ok and not errors and same
+                        best = max(best, n_queries / wall)
+                    scaling.append({"mode": mode, "clients": n_clients,
+                                    "qps": round(best, 1),
+                                    "connections_last_run": conns})
+                    # connection-count oracle from the LAST run of the
+                    # sweep point: keep-alive ≈ one per client, legacy
+                    # ≈ one per request
+                    if mode == "fastlane":
+                        ok = ok and conns <= 2 * n_clients
+                    else:
+                        ok = ok and conns >= n_queries
+                    oracle[mode] = conns
+            server.api.serve_fastlane = True
+            fast_plateau = max(s["qps"] for s in scaling
+                               if s["mode"] == "fastlane")
+            legacy_plateau = max(s["qps"] for s in scaling
+                                 if s["mode"] == "legacy")
+            pm = server.api.pipeline_metrics()
         finally:
             server.close()
+
+    batch_check = _serving_cluster_batch_check(n_shards=8)
+    speedup = round(fast_plateau / max(legacy_plateau, 1e-9), 2)
+    return {
+        "config": "serving",
+        "metric": "serving_fastlane_plateau_qps",
+        "value": round(fast_plateau, 1),
+        "unit": "queries/sec",
+        "legacy_plateau_qps": round(legacy_plateau, 1),
+        "plateau_speedup": speedup,
+        "qps_serial": round(n_queries / serial_wall, 1),
+        "scaling": scaling,
+        "connections_oracle": oracle,
+        "queries": n_queries, "shards": n_shards,
+        "pipeline": pm,
+        "remote_batch": batch_check,
+        "ok": bool(ok and speedup >= 2.0 and batch_check["ok"]),
+    }
+
+
+def _serving_cluster_batch_check(n_shards: int = 8,
+                                 n_queries: int = 32) -> dict:
+    """Cluster fast-lane proof: a 2-node cluster answers the same
+    concurrent query set with the remote wave batcher ON then OFF;
+    responses must be byte-identical and the ON pass must actually form
+    multi-query batches."""
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        s1 = Server(ServerConfig(
+            data_dir=t1, port=0, name="a", anti_entropy_interval=0,
+            heartbeat_interval=0,
+        )).open()
+        s2 = Server(ServerConfig(
+            data_dir=t2, port=0, name="b", anti_entropy_interval=0,
+            heartbeat_interval=0, seeds=[f"http://localhost:{s1.port}"],
+        )).open()
+        try:
+            url = f"http://localhost:{s1.port}"
+
+            def post(path, data):
+                r = urllib.request.Request(url + path, data=data,
+                                           method="POST")
+                with urllib.request.urlopen(r, timeout=120) as resp:
+                    return resp.read()
+
+            post("/index/i", b"{}")
+            post("/index/i/field/f", b"{}")
+            rows, cols = [], []
+            for shard in range(n_shards):
+                for c in range(64):
+                    rows.append(1 + c % 3)
+                    cols.append(shard * SHARD_WIDTH + c * 11)
+            post("/index/i/field/f/import",
+                 json.dumps({"rows": rows, "columns": cols}).encode())
+
+            queries = [f"Count(Row(f={1 + i % 3}))" for i in range(n_queries)]
+
+            def run():
+                results = [None] * n_queries
+                errors: list = []
+                gate = threading.Event()
+
+                def worker(tid):
+                    gate.wait(10)
+                    for k in range(tid, n_queries, 8):
+                        try:
+                            results[k] = post("/index/i/query",
+                                              queries[k].encode())
+                        except Exception as e:  # keep the stripe going
+                            errors.append(f"{queries[k]}: {e!r}")
+
+                threads = [threading.Thread(target=worker, args=(t,))
+                           for t in range(8)]
+                for t in threads:
+                    t.start()
+                gate.set()
+                for t in threads:
+                    t.join(120)
+                return results, errors
+
+            batched, err_on = run()
+            m_on = s1.api.executor.wave_batcher.metrics()
+            s1.api.executor.remote_batch = False
+            unbatched, err_off = run()
+            m_off = s1.api.executor.wave_batcher.metrics()
+            errors = err_on + err_off
+            ok = (not errors
+                  and batched == unbatched
+                  and None not in batched
+                  and m_on["remote_batched_queries_total"] > 0
+                  and m_off["remote_batched_queries_total"]
+                  == m_on["remote_batched_queries_total"])
+            out = {
+                "byte_identical": batched == unbatched,
+                "batched_queries": m_on["remote_batched_queries_total"],
+                "batches": m_on["remote_batches_total"],
+                "ok": bool(ok),
+            }
+            if errors:
+                out["errors"] = errors[:5]
+            return out
+        finally:
+            s2.close()
+            s1.close()
 
 
 def config_serving_readwrite(n_shards: int = 32, n_clients: int = 16,
@@ -999,7 +1250,8 @@ def main() -> None:
         "5": lambda: config5_ssb_4way(n_shards),
         "serving": lambda: config_serving(
             n_shards=64 if args.full else 8,
-            n_queries=256 if args.full else 64,
+            n_queries=1024 if args.full else 512,
+            client_counts=(16, 64, 128) if args.full else (16, 64),
         ),
         "readwrite": lambda: config_serving_readwrite(
             n_shards=32 if args.full else 8,

@@ -14,7 +14,6 @@ import http.client
 import json
 import os
 import sys
-import urllib.error
 import urllib.request
 
 from pilosa_tpu import __version__
@@ -42,6 +41,11 @@ max-writes-per-request = 5000 # reject larger write batches; 0 = unlimited
 ingest-workers = 1            # local shard-group apply pool per import
                               # batch; raise where fragment writes pay real
                               # disk latency (docs/INGEST.md)
+
+# Serving fast lane (docs/OPERATIONS.md): keep-alive pooling + batching
+client-pool-size = 8          # keep-alive connections retained per peer
+remote-batch = true           # coalesce same-node remote sub-queries onto
+                              # /internal/query-batch (false = per-query)
 
 # Serving QoS (docs/QOS.md): admission -> deadline -> hedged reads
 qos-max-inflight = 0          # concurrent-query cap; excess sheds 429 (0 = off)
@@ -81,13 +85,48 @@ def _load_config(path: str | None) -> dict:
     return cfg
 
 
+_pool = None
+
+
+def _client_pool():
+    """Process-wide keep-alive pool for CLI HTTP calls: every import
+    batch (and the --concurrency workers' parallel POSTs) reuses
+    persistent connections instead of paying TCP connect per batch —
+    the same fast lane the internal node-to-node client rides."""
+    global _pool
+    if _pool is None:
+        from pilosa_tpu.parallel.connpool import ConnectionPool
+
+        _pool = ConnectionPool(max_per_host=16, timeout=300.0)
+    return _pool
+
+
+class _HTTPStatusError(Exception):
+    """Non-2xx response through the pooled client (code + body text)."""
+
+    def __init__(self, code: int, detail: str):
+        super().__init__(f"HTTP {code}: {detail}")
+        self.code = code
+        self.detail = detail
+
+
 def _http(method: str, url: str, data: bytes | None = None,
           content_type: str = "application/json"):
-    req = urllib.request.Request(url, data=data, method=method)
-    if data is not None:
-        req.add_header("Content-Type", content_type)
-    with urllib.request.urlopen(req) as resp:
-        return json.loads(resp.read() or b"{}")
+    headers = {"Content-Type": content_type} if data is not None else {}
+    resp = _client_pool().request(method, url, body=data, headers=headers)
+    if 300 <= resp.status < 400:
+        # the pool does not follow redirects (urllib did): surface a
+        # clear error instead of feeding an HTML body to json.loads
+        location = resp.headers.get("Location", "")
+        raise _HTTPStatusError(
+            resp.status,
+            "redirect" + (f" to {location}" if location else "")
+            + " — point --host at the final URL",
+        )
+    if resp.status >= 400:
+        raise _HTTPStatusError(resp.status,
+                               resp.data.decode(errors="replace"))
+    return json.loads(resp.data or b"{}")
 
 
 def _iter_csv_bits(files, batch: float):
@@ -190,12 +229,6 @@ def _in_process_api(data_dir: str):
 DEFAULT_IMPORT_BATCH = 100_000
 
 
-class _ImportHTTPError(Exception):
-    def __init__(self, code: int, detail: str):
-        super().__init__(f"HTTP {code}: {detail}")
-        self.code = code
-
-
 def _probe_batch_limit(host: str) -> int:
     """Server write-batch limit from /status (0 = none advertised). A
     probe failure is fine — the 413 split fallback in _post_import still
@@ -203,7 +236,8 @@ def _probe_batch_limit(host: str) -> int:
     try:
         st = _http("GET", f"{host}/status")
         return int(st.get("maxWritesPerRequest") or 0)
-    except (urllib.error.URLError, OSError, ValueError):
+    except (_HTTPStatusError, OSError, http.client.HTTPException,
+            ValueError):
         return 0
 
 
@@ -215,8 +249,7 @@ def _post_import(host: str, path: str, payload: dict) -> int:
     body = json.dumps(payload).encode()
     try:
         return _http("POST", f"{host}{path}", body).get("changed", 0)
-    except urllib.error.HTTPError as e:
-        detail = e.read().decode(errors="replace")
+    except _HTTPStatusError as e:
         n = len(payload["columns"])
         if e.code == 413 and n > 1:
             lo = {k: (v[: n // 2] if isinstance(v, list) else v)
@@ -225,7 +258,7 @@ def _post_import(host: str, path: str, payload: dict) -> int:
                   for k, v in payload.items()}
             return (_post_import(host, path, lo)
                     + _post_import(host, path, hi))
-        raise _ImportHTTPError(e.code, detail) from e
+        raise
 
 
 def cmd_import(args) -> int:
@@ -298,20 +331,14 @@ def cmd_import(args) -> int:
                     total += inflight.popleft().result()
             while inflight:
                 total += inflight.popleft().result()
-    except _ImportHTTPError as e:
+    except _HTTPStatusError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
-    except urllib.error.HTTPError as e:
-        body = e.read().decode(errors="replace")
-        print(f"error: HTTP {e.code}: {body}", file=sys.stderr)
-        return 1
-    except urllib.error.URLError as e:
-        print(f"error: cannot reach {host}: {e.reason}", file=sys.stderr)
-        return 1
     except (OSError, http.client.HTTPException) as e:
-        # a server dying mid-stream surfaces as a read-stage reset
-        # (ConnectionResetError, RemoteDisconnected) that urlopen does
-        # NOT wrap in URLError — same user-facing failure, same exit
+        # transport-stage failure through the pooled client: connect
+        # refused/unreachable, or a server dying mid-stream (reset,
+        # RemoteDisconnected on a fresh connection) — same user-facing
+        # failure, same exit
         print(f"error: connection to {host} failed: {e}", file=sys.stderr)
         return 1
     print(f"imported: {total} bits changed")
@@ -331,7 +358,7 @@ def _http_create(host: str, args) -> None:
     ):
         try:
             _http("POST", url, json.dumps(body).encode())
-        except urllib.error.HTTPError as e:
+        except _HTTPStatusError as e:
             if e.code != 409:
                 raise
 

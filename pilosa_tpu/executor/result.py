@@ -8,6 +8,8 @@ GroupBy).
 
 from __future__ import annotations
 
+import json
+
 import numpy as np
 
 from pilosa_tpu.ops.packing import popcount_words, unpack_bits
@@ -152,3 +154,40 @@ def result_to_json(res):
     if isinstance(res, np.integer):
         return int(res)
     return res
+
+
+# ------------------------------------------------- pre-serialized responses
+#
+# The serving fast lane encodes hot result shapes (Count, Row, TopN pairs,
+# ValCount) straight to compact-JSON bytes once, instead of dict-building
+# then json.dumps per request. RowResult encodings memoize ON the result
+# object — the encoded-bytes cache keyed by result identity — so a wave of
+# identical coalesced queries (server/pipeline.py dedupe) pays the
+# segment-unpack + encode exactly once however many clients asked.
+
+
+def _dumps(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def result_json_bytes(res) -> bytes:
+    """Compact-JSON bytes of ``result_to_json(res)`` (exact same JSON
+    value; whitespace-free encoding)."""
+    if isinstance(res, bool):  # before int — bool subclasses int
+        return b"true" if res else b"false"
+    if isinstance(res, (int, np.integer)):
+        return b"%d" % int(res)
+    if isinstance(res, RowResult):
+        cached = getattr(res, "_json_bytes", None)
+        if cached is None:
+            cached = res._json_bytes = _dumps(res.to_json())
+        return cached
+    if isinstance(res, ValCount):
+        return b'{"value":%d,"count":%d}' % (res.value, res.count)
+    return _dumps(result_to_json(res))
+
+
+def results_json_bytes(results) -> bytes:
+    """The whole ``{"results": [...]}`` response envelope as bytes."""
+    return (b'{"results":['
+            + b",".join(result_json_bytes(r) for r in results) + b"]}")

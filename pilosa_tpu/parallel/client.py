@@ -12,9 +12,8 @@ from __future__ import annotations
 
 import json
 import ssl
-import urllib.error
-import urllib.request
 
+from pilosa_tpu.parallel.connpool import ConnectionPool
 from pilosa_tpu.utils import as_int_list
 
 
@@ -36,22 +35,35 @@ class ClientError(Exception):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0, insecure_tls: bool = False):
+    def __init__(self, timeout: float = 30.0, insecure_tls: bool = False,
+                 pool_size: int = 8):
         """insecure_tls accepts self-signed node certificates (reference
         tls.skip-verify), scoped to THIS client only — plumbed from the
         owning server's config so one skip-verify server can't disable
-        certificate verification for other servers in the same process."""
+        certificate verification for other servers in the same process.
+
+        ``pool_size`` bounds the keep-alive connections retained per peer
+        (parallel/connpool.py): every hop through this client reuses a
+        pooled persistent connection instead of paying TCP connect (and a
+        server-side handler-thread spawn) per request. Checkout is
+        exclusive, so concurrent requests — a hedge leg racing its
+        primary included — always ride distinct connections."""
         self.timeout = timeout
         # peers that answered 406 to a protobuf hop: a mixed-capability
         # cluster (one node without the protobuf runtime) falls back to
         # JSON per peer instead of failing every internal request
         self._json_only_peers: set[str] = set()
+        # peers whose wire predates /internal/query-batch (404/405 once):
+        # the wave batcher falls back to per-query dispatch for them
+        self._no_batch_peers: set[str] = set()
         self._ssl_context: ssl.SSLContext | None = None
         if insecure_tls:
             ctx = ssl.create_default_context()
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
             self._ssl_context = ctx
+        self.pool = ConnectionPool(max_per_host=pool_size, timeout=timeout,
+                                   ssl_context=self._ssl_context)
 
     # -------------------------------------------------------------- helpers
 
@@ -68,44 +80,53 @@ class InternalClient:
               content_type: str = "application/json", raw: bool = False,
               accept: str | None = None, headers: dict | None = None,
               timeout: float | None = None):
-        req = urllib.request.Request(url, data=body, method=method)
+        hdrs = dict(headers or {})
         if body is not None:
-            req.add_header("Content-Type", content_type)
+            hdrs.setdefault("Content-Type", content_type)
         if accept is not None:
-            req.add_header("Accept", accept)
-        for k, v in (headers or {}).items():
-            req.add_header(k, v)
+            hdrs.setdefault("Accept", accept)
+        import http.client as _hc
+
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout if timeout is None else timeout,
-                context=self._ssl_context
-            ) as resp:
-                data = resp.read()
-        except urllib.error.HTTPError as e:
-            body = e.read()
-            if "x-protobuf" in (e.headers.get("Content-Type") or ""):
+            resp = self.pool.request(method, url, body=body, headers=hdrs,
+                                     timeout=timeout)
+        except (OSError, _hc.HTTPException) as e:
+            # transport-stage faults only (connect refused, DNS, reset,
+            # TLS failure, read-stage timeout on a stalled peer) map to
+            # the node-level ClientError (status None) callers classify;
+            # programming errors (bad URI, bad header types) propagate —
+            # wrapping them would mark a healthy node DEGRADED and bury
+            # the bug in replica-fallback noise
+            raise ClientError(f"{method} {url}: {str(e) or type(e).__name__}"
+                              ) from e
+        if 300 <= resp.status < 400:
+            # the pool does not follow redirects (urllib did): a proxy's
+            # 3xx must surface as a readable error, not as JSONDecodeError
+            # on an HTML body
+            location = resp.headers.get("Location", "")
+            raise ClientError(
+                f"{method} {url}: HTTP {resp.status} redirect"
+                + (f" to {location}" if location else "")
+                + " (redirects are not followed)",
+                status=resp.status,
+            )
+        if resp.status >= 400:
+            if "x-protobuf" in (resp.headers.get("Content-Type") or ""):
                 # protobuf-negotiated error body: surface the readable
                 # QueryResponse.err, not raw tag/length bytes
                 try:
                     from pilosa_tpu.wire.serializer import decode_results_json
 
-                    detail = decode_results_json(body).get("error", "")
+                    detail = decode_results_json(resp.data).get("error", "")
                 except Exception:
-                    detail = body.decode(errors="replace")
+                    detail = resp.data.decode(errors="replace")
             else:
-                detail = body.decode(errors="replace")
+                detail = resp.data.decode(errors="replace")
             raise ClientError(
-                f"{method} {url}: HTTP {e.code}: {detail}", status=e.code
-            ) from e
-        except urllib.error.URLError as e:
-            raise ClientError(f"{method} {url}: {e.reason}") from e
-        except (TimeoutError, OSError) as e:
-            # a timeout during the response READ surfaces as a bare
-            # socket.timeout (urlopen only wraps connect-stage faults in
-            # URLError) — it is the same transport-level node fault, and
-            # deadline-capped hops hit it routinely on a stalled peer
-            raise ClientError(f"{method} {url}: {str(e) or 'timed out'}") from e
-        return data if raw else json.loads(data or b"{}")
+                f"{method} {url}: HTTP {resp.status}: {detail}",
+                status=resp.status,
+            )
+        return resp.data if raw else json.loads(resp.data or b"{}")
 
     # ---------------------------------------------------------------- query
 
@@ -169,6 +190,58 @@ class InternalClient:
                           content_type="text/plain", headers=headers,
                           timeout=timeout)
 
+    def supports_batch(self, uri: str) -> bool:
+        """Whether the peer is believed to speak /internal/query-batch
+        (flips False after one 404/405 — older wire)."""
+        return uri not in self._no_batch_peers
+
+    def query_batch(self, uri: str, items: list[tuple[str, str, list[int]]]
+                    ) -> list[dict]:
+        """Ship several same-node remote sub-queries as ONE internal
+        request (the cluster-wide analog of the local wave coalescer —
+        server/pipeline.py): ``items`` is ``[(index, pql, shards), ...]``;
+        returns one response dict per item, each either
+        ``{"results": [...]}`` or ``{"error": ..., "status": ...}``.
+
+        Negotiates a protobuf body/response like query_node (per-peer 406
+        fallback to JSON). A peer without the route answers 404/405 —
+        recorded in ``_no_batch_peers`` and re-raised so the wave batcher
+        falls back to per-query dispatch for that peer."""
+        url = f"{uri}/internal/query-batch"
+        if self._proto_ok(uri):
+            from pilosa_tpu.wire.serializer import (
+                decode_batch_responses,
+                encode_batch_request,
+            )
+
+            try:
+                raw = self._call(
+                    "POST", url, encode_batch_request(items),
+                    content_type="application/x-protobuf", raw=True,
+                    accept="application/x-protobuf",
+                )
+            except ClientError as e:
+                if e.status in (404, 405):
+                    self._no_batch_peers.add(uri)
+                    raise
+                if not self._is_406(e):
+                    raise
+                self._json_only_peers.add(uri)
+            else:
+                return decode_batch_responses(raw)
+        body = json.dumps({"queries": [
+            {"index": index, "query": pql,
+             "shards": [int(s) for s in shards]}
+            for index, pql, shards in items
+        ]}).encode()
+        try:
+            out = self._call("POST", url, body)
+        except ClientError as e:
+            if e.status in (404, 405):
+                self._no_batch_peers.add(uri)
+            raise
+        return out.get("responses", [])
+
     # --------------------------------------------------------------- import
 
     def import_bits(self, uri: str, index: str, field: str, rows, columns,
@@ -228,10 +301,13 @@ class InternalClient:
     def import_roaring(self, uri: str, index: str, field: str, shard: int,
                        data: bytes) -> int:
         """Whole-shard roaring body (O(bitmap bytes) on the wire): the
-        bulk path for routed set-bit imports."""
+        bulk path for routed set-bit imports. remote=true: the slice of
+        an already-admitted edge batch must not bounce off the peer's
+        max-writes-per-request."""
         out = self._call(
             "POST",
-            f"{uri}/index/{index}/field/{field}/import-roaring/{shard}",
+            f"{uri}/index/{index}/field/{field}/import-roaring/{shard}"
+            "?remote=true",
             data, content_type="application/octet-stream",
         )
         return out.get("changed", 0)

@@ -73,7 +73,7 @@ class Cluster:
 
     def __init__(self, local: Node, peers: list[Node] | None = None,
                  replica_n: int = 1, holder=None, api=None,
-                 insecure_tls: bool = False):
+                 insecure_tls: bool = False, pool_size: int = 8):
         self.local = local
         self.nodes: dict[str, Node] = {local.id: local}
         for p in peers or []:
@@ -81,7 +81,8 @@ class Cluster:
         self.replica_n = replica_n
         self.holder = holder
         self.api = api  # set by Server after API construction
-        self.client = InternalClient(insecure_tls=insecure_tls)
+        self.client = InternalClient(insecure_tls=insecure_tls,
+                                     pool_size=pool_size)
         self._state = STATE_NORMAL
         self._state_normal = threading.Event()
         self._state_normal.set()
@@ -819,10 +820,34 @@ class Cluster:
             work.append((src, frag))
 
         from pilosa_tpu.roaring.format import load_any
+        from pilosa_tpu.utils.stats import global_stats
+
+        probe_blocks = getattr(self.client, "fragment_blocks", None)
 
         def one(item):
             src, frag = item
             for source_uri in [src["from"], *src.get("fallbacks", [])]:
+                # Block-checksum probe first (ADVICE r4 #4): a
+                # legitimately-empty fragment — advertised by the peer
+                # catalog but holding no bits — would otherwise be
+                # re-fetched as a full payload from EVERY replica on
+                # every self-join/resize pass (the empty-payload check
+                # below only fires after the download). The blocks list
+                # is O(checksum rows), so an empty source costs one tiny
+                # control response instead of a data-plane transfer.
+                if probe_blocks is not None:
+                    try:
+                        if not probe_blocks(
+                            source_uri, src["index"], src["field"],
+                            src["view"], int(src["shard"]),
+                        ):
+                            global_stats().count(
+                                "sync_empty_fetches_skipped", 1
+                            )
+                            continue  # source holds no data: next replica
+                    except ClientError:
+                        continue  # unreachable for the probe: data fetch
+                                  # would fail the same way
                 try:
                     data = self.client.fragment_data(
                         source_uri, src["index"], src["field"], src["view"],

@@ -53,7 +53,7 @@ class ClusterExecutor:
     accepts_remote = True
 
     def __init__(self, local_executor: Executor, cluster: Cluster,
-                 qos=None):
+                 qos=None, remote_batch: bool = True):
         self.local = local_executor
         self.holder = local_executor.holder
         self.cluster = cluster
@@ -61,6 +61,12 @@ class ClusterExecutor:
         # circuit breakers for the remote read fan-out; None disables
         # both (bare constructions in tests/tools)
         self.qos = qos
+        # cluster-wide wave batching (parallel/wavebatch.py): deadline-
+        # free primary reads bound for the same node group-commit onto
+        # one /internal/query-batch request. ``remote-batch = false``
+        # (ServerConfig) restores per-query dispatch.
+        self.remote_batch = remote_batch
+        self._wave_batcher = None
         self._shards_cache: dict[str, tuple[float, list[int]]] = {}
         self._lock = threading.Lock()
         # key translation goes through the coordinator (reference:
@@ -415,6 +421,37 @@ class ClusterExecutor:
                                                primary.id)
         return [] if orphans else groups
 
+    @property
+    def wave_batcher(self):
+        """Lazy per-executor batcher (observability handle for /metrics)."""
+        batcher = self._wave_batcher
+        if batcher is None:
+            with self._lock:
+                if self._wave_batcher is None:
+                    from pilosa_tpu.parallel.wavebatch import (
+                        RemoteWaveBatcher,
+                    )
+
+                    self._wave_batcher = RemoteWaveBatcher(
+                        self.cluster.client)
+                batcher = self._wave_batcher
+        return batcher
+
+    def _remote_query(self, node, index_name: str, pql: str, shard_group,
+                      deadline, _depth) -> dict:
+        """One remote sub-query, through the wave batcher when eligible.
+        Eligibility: batching enabled, deadline-free, and a depth-0
+        primary leg — deadline-capped hops keep their per-hop transport
+        cap, and hedge/fallback legs (depth ≥ 1) must not queue behind
+        the very primary they are racing."""
+        if self.remote_batch and deadline is None and _depth == 0:
+            return self.wave_batcher.query(node, index_name, pql,
+                                           shard_group)
+        dl_kw = {"deadline": deadline} if deadline is not None else {}
+        return self.cluster.client.query_node(node.uri, index_name, pql,
+                                              shard_group, remote=True,
+                                              **dl_kw)
+
     def _query_group(self, index_name: str, call: Call, pql: str, node,
                      shard_group, _depth, deadline):
         """One node's sub-query with QoS: circuit breaker, then a hedged
@@ -422,14 +459,10 @@ class ClusterExecutor:
         hedge delay. Returns a flat partial list; raises ClientError on
         failure so the caller's replica-fallback path stays authoritative
         for DEGRADED marking and rerouting."""
-        client = self.cluster.client
         qos = self.qos
-        # kwarg added only when set: bare clients (and test doubles)
-        # predating the deadline wire stay call-compatible
-        dl_kw = {"deadline": deadline} if deadline is not None else {}
         if qos is None:
-            out = client.query_node(node.uri, index_name, pql, shard_group,
-                                    remote=True, **dl_kw)
+            out = self._remote_query(node, index_name, pql, shard_group,
+                                     deadline, _depth)
             return [out["results"][0]]
         breaker = qos.breaker(node.id)
         if not breaker.allow():
@@ -457,8 +490,8 @@ class ClusterExecutor:
             # hedging disabled via qos-hedge-budget=0): call inline — the
             # thread + condvar handshake below would be pure overhead
             try:
-                out = client.query_node(node.uri, index_name, pql,
-                                        shard_group, remote=True, **dl_kw)
+                out = self._remote_query(node, index_name, pql, shard_group,
+                                         deadline, _depth)
             except BaseException as e:
                 self._record_breaker_outcome(breaker, e, deadline,
                                              time.monotonic() - t0)
@@ -478,8 +511,8 @@ class ClusterExecutor:
 
         def run_primary():
             try:
-                out = client.query_node(node.uri, index_name, pql,
-                                        shard_group, remote=True, **dl_kw)
+                out = self._remote_query(node, index_name, pql, shard_group,
+                                         deadline, _depth)
             except BaseException as e:
                 self._record_breaker_outcome(breaker, e, deadline,
                                              time.monotonic() - t0)

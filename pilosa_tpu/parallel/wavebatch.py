@@ -1,0 +1,252 @@
+"""Cluster-wide wave batching: per-node group-commit of remote sub-queries.
+
+The local serving pipeline (server/pipeline.py) converts concurrent
+requests into shared device dispatches; this module does the same for the
+HTTP hop between nodes. When a wave's remote sub-queries target the same
+node, they ship as ONE ``/internal/query-batch`` request, so the remote
+hop amortizes the per-request host cost (request line, headers, handler
+dispatch, response envelope) exactly as the local micro-batcher amortizes
+device dispatches.
+
+Mechanism — group commit, not a timer window: one flusher thread per peer
+node drains a queue. While a batch's round trip is in flight, newly
+arriving sub-queries for that node accumulate; the next flush ships them
+all. Idle traffic therefore pays ZERO added latency (a lone sub-query
+flushes immediately), and batching grows automatically with exactly the
+concurrency that exists.
+
+Scope guards (the caller — ClusterExecutor — enforces most of these):
+
+- only deadline-free, depth-0 primary reads batch; deadline-capped hops,
+  hedge legs, and replica-fallback retries keep their direct per-request
+  path (a hedge racing its primary must not queue behind it, and checkout
+  exclusivity in the connection pool already guarantees they never share
+  a socket);
+- a peer answering 404/405 (older wire, no batch route) is remembered and
+  served per-query thereafter;
+- a batch-level transport fault fails every member with the SAME
+  ClientError shape a direct query would have raised, so the caller's
+  replica-fallback and breaker logic are unchanged;
+- per-item errors inside a 200 batch envelope surface as per-item
+  ClientErrors carrying the item's status.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.parallel.client import ClientError
+from pilosa_tpu.utils.pool import concurrent_map
+
+
+class _NodeQueue:
+    __slots__ = ("lock", "pending", "flushing")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.pending: list = []
+        self.flushing = False
+
+
+class _Slot:
+    """One sub-query's seat in a batch: an event + outcome box."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+
+    def resolve(self, value=None, error=None):
+        if self.event.is_set():  # idempotent: sweep-up after a partial
+            return               # distribution must not clobber a result
+        self.value = value
+        self.error = error
+        self.event.set()
+
+    def wait(self):
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class RemoteWaveBatcher:
+    """Group-commit batcher over ``InternalClient.query_batch``."""
+
+    def __init__(self, client):
+        self.client = client
+        self._nodes: dict[str, _NodeQueue] = {}
+        self._lock = threading.Lock()
+        # observability (exported as serving_* on /metrics)
+        self.batches = 0          # multi-query batch requests sent
+        self.batched_queries = 0  # sub-queries that rode those batches
+        self.solo = 0             # flushes that carried a single query
+        self.fallbacks = 0        # per-query fallbacks (no-batch peer)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "remote_batches_total": self.batches,
+                "remote_batched_queries_total": self.batched_queries,
+                "remote_batch_solo_total": self.solo,
+                "remote_batch_fallbacks_total": self.fallbacks,
+            }
+
+    def _count(self, **deltas) -> None:
+        with self._lock:
+            for name, d in deltas.items():
+                setattr(self, name, getattr(self, name) + d)
+
+    # -------------------------------------------------------------- public
+
+    def query(self, node, index: str, pql: str, shards) -> dict:
+        """One remote sub-query through the per-node group-commit lane.
+        Returns the same ``{"results": [...]}`` dict ``query_node``
+        would; raises ClientError on failure."""
+        client = self.client
+        if (not getattr(client, "supports_batch", lambda uri: False)(node.uri)
+                or not hasattr(client, "query_batch")):
+            # older peer wire, or a test double without the batch verb
+            self._count(fallbacks=1)
+            return client.query_node(node.uri, index, pql, shards,
+                                     remote=True)
+        nq = self._node_queue(node.id)
+        slot = _Slot()
+        with nq.lock:
+            nq.pending.append((index, pql, shards, slot))
+            leader = not nq.flushing
+            if leader:
+                nq.flushing = True
+        if leader:
+            self._flush_loop(node, nq)
+        return slot.wait()
+
+    # ------------------------------------------------------------ internals
+
+    def _node_queue(self, node_id: str) -> _NodeQueue:
+        with self._lock:
+            nq = self._nodes.get(node_id)
+            if nq is None:
+                nq = self._nodes[node_id] = _NodeQueue()
+            return nq
+
+    def _flush_loop(self, node, nq: _NodeQueue, leader: bool = True) -> None:
+        """Drain-and-send until the queue is empty; sub-queries arriving
+        during a round trip are picked up by the next flush (group
+        commit). The LEADER (the request thread that found no flush in
+        flight) sends exactly one batch — its own slot resolves in it —
+        then hands any accumulated tail to a worker thread, so the
+        leader's caller gets its response without paying later batches'
+        round trips."""
+        while True:
+            with nq.lock:
+                batch = nq.pending
+                nq.pending = []
+                if not batch:
+                    nq.flushing = False
+                    return
+            try:
+                self._send(node, batch)
+            except BaseException as e:
+                # _send guards its own distribution, so this is a bug's
+                # last line of defense: every unresolved slot — this
+                # batch's AND any stragglers queued behind it — gets the
+                # error as a ClientError (callers run replica fallback;
+                # nobody hangs), and the flushing flag is released so
+                # the node's lane cannot wedge permanently. Not
+                # re-raised: the error IS the slots' outcome, and the
+                # leader must fall through to its own slot.wait().
+                with nq.lock:
+                    stranded = nq.pending
+                    nq.pending = []
+                    nq.flushing = False
+                for *_, slot in [*batch, *stranded]:
+                    slot.resolve(error=_clone_error(e))
+                return
+            if leader:
+                with nq.lock:
+                    if not nq.pending:
+                        nq.flushing = False
+                        return
+                threading.Thread(
+                    target=self._flush_loop, args=(node, nq, False),
+                    daemon=True, name=f"wavebatch-{node.id}",
+                ).start()
+                return
+
+    def _send(self, node, batch: list) -> None:
+        client = self.client
+        if len(batch) == 1:
+            index, pql, shards, slot = batch[0]
+            self._count(solo=1)
+            try:
+                slot.resolve(client.query_node(node.uri, index, pql, shards,
+                                               remote=True))
+            except BaseException as e:
+                slot.resolve(error=e)
+            return
+        items = [(index, pql, shards) for index, pql, shards, _ in batch]
+        try:
+            responses = client.query_batch(node.uri, items)
+            if len(responses) != len(batch):
+                raise ClientError(
+                    f"query-batch to {node.id}: {len(responses)} responses "
+                    f"for {len(batch)} queries"
+                )
+        except BaseException as e:
+            if isinstance(e, ClientError) and e.status in (404, 405):
+                # peer predates the route: replay this batch per-query
+                # (the client already recorded the peer as no-batch, so
+                # future waves skip straight to query_node)
+                self._count(fallbacks=len(batch))
+                self._replay_individually(node, batch)
+                return
+            for *_, slot in batch:
+                slot.resolve(error=_clone_error(e))
+            return
+        self._count(batches=1, batched_queries=len(batch))
+        try:
+            for (index, pql, shards, slot), resp in zip(batch, responses):
+                if not isinstance(resp, dict):
+                    # malformed peer item (e.g. null): this slot fails,
+                    # well-formed batchmates still resolve normally
+                    slot.resolve(error=ClientError(
+                        f"POST {node.uri}/internal/query-batch "
+                        f"[{index}: {pql}]: malformed batch item "
+                        f"{type(resp).__name__}"))
+                elif "error" in resp:
+                    slot.resolve(error=ClientError(
+                        f"POST {node.uri}/internal/query-batch "
+                        f"[{index}: {pql}]: {resp['error']}",
+                        status=resp.get("status"),
+                    ))
+                else:
+                    slot.resolve(resp)
+        except BaseException as e:
+            # distribution must never strand a slot: whatever broke,
+            # every unresolved waiter gets a node-fault error
+            for *_, slot in batch:
+                slot.resolve(error=_clone_error(e))
+            raise
+
+    def _replay_individually(self, node, batch: list) -> None:
+        def one(entry):
+            index, pql, shards, slot = entry
+            try:
+                slot.resolve(self.client.query_node(node.uri, index, pql,
+                                                    shards, remote=True))
+            except BaseException as e:
+                slot.resolve(error=e)
+
+        concurrent_map(one, batch)
+
+
+def _clone_error(exc: BaseException) -> BaseException:
+    """Per-slot copies of a batch-level failure: every waiter raises its
+    own exception object, so one caller's traceback/handling can never
+    mutate a sibling's."""
+    if isinstance(exc, ClientError):
+        return ClientError(str(exc), status=exc.status)
+    return ClientError(str(exc) or type(exc).__name__)

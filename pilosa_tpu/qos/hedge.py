@@ -14,6 +14,14 @@ answer wins. Two safety rails keep hedging from amplifying an overload:
 - per-node CIRCUIT BREAKING on repeated transport faults — a dead node's
   connect timeouts stop being paid per-query once its breaker opens, and
   a half-open probe discovers recovery without a thundering herd.
+
+Transport invariant (serving fast lane): a hedge leg always rides its
+OWN pooled connection — the connection pool's checkout is exclusive
+(parallel/connpool.py), so the duplicate read can never queue behind, or
+share a socket with, the very primary it is racing. Hedge and fallback
+legs also bypass the remote wave batcher (cluster_exec._remote_query:
+depth ≥ 1 goes direct) for the same reason. Pinned by
+tests/test_fastlane.py::test_concurrent_requests_use_distinct_connections.
 """
 
 from __future__ import annotations

@@ -97,6 +97,12 @@ class API:
         # concurrent HTTP clients share micro-batched dispatches. Set
         # False to serve every request through blocking execute().
         self.serve_pipelined: bool = True
+        # Host-path fast lane (docs/OPERATIONS.md): pre-serialized
+        # response bytes + identical-query wave dedupe. False restores
+        # the round-5 serving path (dict building + json.dumps per
+        # request, no dedupe) — the bisection/baseline switch the
+        # serving bench uses for its r5-shaped legacy mode.
+        self.serve_fastlane: bool = True
         self._pipeline = None  # created lazily on first pipelined query
         self._pipeline_lock = threading.Lock()
         # Serving QoS (pilosa_tpu.qos): admission gate + hedge policy +
@@ -178,7 +184,17 @@ class API:
                             )
 
                             self._pipeline = QueryPipeline(self)
-                deferreds = self._pipeline.run(index, query, kwargs)
+                # plain edge reads (PQL string, no explicit shards, no
+                # deadline, no result options) are dedupe-eligible:
+                # identical queries landing in one wave submit once and
+                # share results + pre-serialized response bytes
+                key = None
+                if (self.serve_fastlane and isinstance(pql, str)
+                        and shards is None and deadline is None
+                        and not remote and not opts):
+                    key = (index, pql)
+                deferreds = self._pipeline.run(index, query, kwargs,
+                                               key=key)
                 # Same stats/trace envelope as Executor.execute (shared
                 # helper) — the timer here observes resolve latency,
                 # i.e. what this request actually waited for.
@@ -222,6 +238,84 @@ class API:
         results = self.query_raw(index, pql, shards=shards, remote=remote,
                                  opts=opts, tenant=tenant, deadline=deadline)
         return {"results": [result_to_json(r) for r in results]}
+
+    def query_json_bytes(self, index: str, pql: str, shards=None,
+                         remote: bool = False, opts: dict | None = None,
+                         tenant: str = "default", deadline=None) -> bytes:
+        """The whole JSON response envelope, pre-serialized (serving fast
+        lane): hot result shapes encode straight to bytes — memoized on
+        the result objects, so a deduped wave of identical queries
+        serializes once — instead of dict-building + json.dumps per
+        request (see executor/result.py)."""
+        from pilosa_tpu.executor.result import results_json_bytes
+
+        results = self.query_raw(index, pql, shards=shards, remote=remote,
+                                 opts=opts, tenant=tenant, deadline=deadline)
+        return results_json_bytes(results)
+
+    def query_batch(self, items: list) -> list:
+        """Execute a wave-batched internal request (/internal/query-batch):
+        ``items`` is ``[(index, pql, shards), ...]`` — remote sub-queries
+        a peer coalesced toward this node. Every item is SUBMITTED before
+        any is resolved, so the batch shares micro-batched device
+        dispatches exactly like a local wave (server/pipeline.py).
+
+        Returns one outcome per item: ``("ok", [raw results])`` or
+        ``("err", message, status)`` — per-item isolation, one bad
+        sub-query cannot poison its batchmates. Write calls are rejected
+        per item: the batch route exists for coalesced reads, and remote
+        write fan-out keeps its eager per-request semantics."""
+        from pilosa_tpu.executor.executor import PQLError, instrument_calls
+        from pilosa_tpu.pql import ParseError, parse
+
+        submitted: list = []
+        for index, pql, shards in items:
+            try:
+                query = parse(pql)
+                if query.write_calls():
+                    raise ApiError(
+                        "writes are not allowed on /internal/query-batch")
+                if self.holder.index(index) is None:
+                    raise ApiError(f"index {index!r} not found", 404)
+                kwargs = {"shards": shards}
+                if getattr(self.executor, "accepts_remote", False):
+                    kwargs["remote"] = True
+                if hasattr(self.executor, "submit"):
+                    handles = self.executor.submit(index, query, **kwargs)
+                    submitted.append(("defs", index, query, handles))
+                else:
+                    submitted.append(
+                        ("eager", index, query,
+                         self.executor.execute(index, query, **kwargs)))
+            except (ParseError, PQLError) as e:
+                submitted.append(("err", str(e), 400))
+            except ApiError as e:
+                submitted.append(("err", str(e), e.status))
+            except Exception as e:  # item-level internal error
+                submitted.append(("err", f"internal: {e}", 500))
+        out: list = []
+        for entry in submitted:
+            if entry[0] == "err":
+                out.append(entry)
+                continue
+            kind, index, query, payload = entry
+            try:
+                if kind == "defs":
+                    handles = iter(payload)
+                    results = instrument_calls(
+                        index, query.calls,
+                        lambda call: next(handles).result(),
+                    )
+                else:
+                    results = payload
+                out.append(("ok", results))
+            except (ParseError, PQLError) as e:
+                out.append(("err", str(e), 400))
+            except ApiError as e:
+                out.append(("err", str(e), e.status))
+            except Exception as e:
+                out.append(("err", f"internal: {e}", 500))
+        return out
 
     def _apply_request_opts(self, index: str, results: list,
                             opts: dict) -> list:
@@ -669,7 +763,7 @@ class API:
         return int(changed)
 
     def import_roaring(self, index: str, field: str, shard: int, data: bytes,
-                       view: str = VIEW_STANDARD) -> int:
+                       view: str = VIEW_STANDARD, remote: bool = False) -> int:
         idx = self._index(index)
         fld = self._field(idx, field)
         frag = fld.view(view, create=True).fragment(shard, create=True)
@@ -678,6 +772,19 @@ class API:
         try:
             bitmap, _ = load_any(data)
             ids = bitmap.to_ids()
+        except ValueError as e:
+            raise ApiError(str(e)) from e
+        # max-writes-per-request applies to EDGE roaring bodies like the
+        # JSON/protobuf import routes (a 100k-bit bitmap is no lighter
+        # than 100k Set() calls); routed internal slices are exempt —
+        # they carry pieces of an already-admitted edge batch
+        limit = self.max_writes_per_request
+        if not remote and 0 < limit < int(ids.size):
+            raise ApiError(
+                f"import-roaring body of {int(ids.size)} bits exceeds "
+                f"max-writes-per-request {limit}; split the bitmap", 413,
+            )
+        try:
             changed = frag.add_ids(ids)
         except ValueError as e:
             raise ApiError(str(e)) from e
@@ -756,8 +863,33 @@ class API:
         rate()/increase() windows are well-behaved)."""
         pipe = self._pipeline
         if pipe is None:
-            return {"waves": 0, "coalesced": 0}
-        return {"waves": pipe.waves, "coalesced": pipe.coalesced}
+            return {"waves": 0, "coalesced": 0, "deduped": 0}
+        return {"waves": pipe.waves, "coalesced": pipe.coalesced,
+                "deduped": pipe.deduped}
+
+    def fastlane_metrics(self) -> dict:
+        """Serving fast-lane counters (connection pool + remote wave
+        batching) for /metrics and /debug/vars — every key present from
+        scrape one, zeros included, so rate() windows never see a series
+        appear mid-flight."""
+        out = {
+            "pool_connections_created_total": 0,
+            "pool_connections_reused_total": 0,
+            "pool_connections_discarded_total": 0,
+            "pool_requests_total": 0,
+            "pool_idle_connections": 0,
+            "remote_batches_total": 0,
+            "remote_batched_queries_total": 0,
+            "remote_batch_solo_total": 0,
+            "remote_batch_fallbacks_total": 0,
+        }
+        pool = getattr(getattr(self.cluster, "client", None), "pool", None)
+        if pool is not None:
+            out.update(pool.metrics())
+        batcher = getattr(self.executor, "_wave_batcher", None)
+        if batcher is not None:
+            out.update(batcher.metrics())
+        return out
 
     def recalculate_caches(self, remote: bool = False) -> threading.Thread:
         """Authoritative recount of every fragment's TopN row cache

@@ -49,6 +49,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/export$"), "get_export"),
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("POST", re.compile(r"^/recalculate-caches$"), "post_recalculate_caches"),
+    ("POST", re.compile(r"^/internal/query-batch$"), "post_query_batch"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
     ("GET", re.compile(r"^/internal/shards/list$"), "get_shards_list"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
@@ -72,12 +73,62 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
 class HTTPHandler(BaseHTTPRequestHandler):
     api: API = None  # set by make_http_server
     protocol_version = "HTTP/1.1"
+    # idle keep-alive reaper: a persistent connection that sends nothing
+    # for this long is closed (handle_one_request catches the socket
+    # timeout), so pooled-but-abandoned client connections cannot pin
+    # handler threads forever
+    timeout = 120
+    # buffered response writes: status line + headers + body leave as
+    # ONE syscall/packet per response (handle_one_request flushes after
+    # each request) instead of a header write then a body write —
+    # responses here are always full Content-Length'd bodies, never
+    # streamed, so buffering costs nothing
+    wbufsize = -1
 
     # quiet logging; the server wires its own logger
     def log_message(self, fmt, *args):
         pass
 
+    def setup(self):
+        super().setup()
+        # connection-count oracle for keep-alive reuse: requests ≫
+        # connections proves clients are riding persistent connections.
+        # The socket is also registered so server_close can hard-close
+        # established keep-alive connections — without that, a "closed"
+        # node would keep serving old peers' pooled connections forever
+        # (its handler threads outlive the listener), which is graceful
+        # drain, not death.
+        lock = getattr(self.server, "metrics_lock", None)
+        if lock is not None:
+            with lock:
+                self.server.connections_opened += 1
+                self.server.open_connections.add(self.connection)
+
+    def finish(self):
+        lock = getattr(self.server, "metrics_lock", None)
+        if lock is not None:
+            with lock:
+                self.server.open_connections.discard(self.connection)
+        super().finish()
+
     def _dispatch(self, method: str):
+        self._body_read = False
+        lock = getattr(self.server, "metrics_lock", None)
+        if lock is not None:
+            with lock:
+                self.server.requests_served += 1
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            # _body/_drain_body only understand Content-Length; chunk
+            # framing left in rfile would be parsed as the next request
+            # line and poison every later exchange on this connection —
+            # reject with 411 and close (RFC 7230 §3.3.3 option)
+            self._body_read = True
+            # Connection: close both tells the client AND (via
+            # send_header's side effect) sets close_connection here
+            self._json({"error": "chunked request bodies are not "
+                                 "supported; send Content-Length"},
+                       status=411, headers={"Connection": "close"})
+            return
         parsed = urlparse(self.path)
         for m, pattern, handler in _ROUTES:
             if m != method:
@@ -93,11 +144,20 @@ class HTTPHandler(BaseHTTPRequestHandler):
                         # shed at admission: tell the client when to come
                         # back instead of letting it hammer a full queue
                         headers = {"Retry-After": str(max(1, int(retry_after)))}
+                    self._drain_body()
                     self._json({"error": str(e)}, status=e.status,
                                headers=headers)
                 except Exception as e:  # internal error → 500, not a crash
+                    self._drain_body()
                     self._json({"error": f"internal: {e}"}, status=500)
+                else:
+                    # a handler that never read its body (GET with a
+                    # stray body, early-return route) must not leave the
+                    # bytes to corrupt the NEXT request on this
+                    # keep-alive connection
+                    self._drain_body()
                 return
+        self._drain_body()
         self._json({"error": "not found"}, status=404)
 
     def do_GET(self):
@@ -112,8 +172,28 @@ class HTTPHandler(BaseHTTPRequestHandler):
     # -------------------------------------------------------------- helpers
 
     def _body(self) -> bytes:
+        self._body_read = True
         length = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(length) if length else b""
+
+    def _drain_body(self) -> None:
+        """Consume an unread request body so the error (or body-less)
+        response leaves the connection aligned on the next request —
+        leftover body bytes would be parsed as a request line and poison
+        every later exchange on a keep-alive connection."""
+        if getattr(self, "_body_read", True):
+            return
+        self._body_read = True
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            self.close_connection = True
+            return
+        while length > 0:
+            chunk = self.rfile.read(min(length, 1 << 16))
+            if not chunk:
+                break
+            length -= len(chunk)
 
     def _json_body(self) -> dict:
         raw = self._body()
@@ -175,6 +255,16 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _raw(self, data: bytes, content_type: str = "application/json",
+             status: int = 200) -> None:
+        """Pre-serialized response body (serving fast lane): no dict
+        building, no json.dumps — the bytes were encoded once upstream."""
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     # --------------------------------------------------------------- routes
 
     def post_query(self, index, query=None):
@@ -213,9 +303,17 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
         tenant, deadline = self._qos_envelope(remote=remote)
         if not proto_out:
-            self._json(self.api.query(index, pql, shards=shards,
-                                      remote=remote, opts=opts,
-                                      tenant=tenant, deadline=deadline))
+            if self.api.serve_fastlane:
+                # fast lane: the response envelope arrives pre-serialized
+                # (hot shapes encode straight to bytes; identical deduped
+                # wavemates share one encoding — executor/result.py)
+                self._raw(self.api.query_json_bytes(
+                    index, pql, shards=shards, remote=remote, opts=opts,
+                    tenant=tenant, deadline=deadline))
+            else:  # r5-shaped legacy path (serve_fastlane = False)
+                self._json(self.api.query(index, pql, shards=shards,
+                                          remote=remote, opts=opts,
+                                          tenant=tenant, deadline=deadline))
             return
         from pilosa_tpu.wire.serializer import encode_error, encode_results
 
@@ -238,6 +336,55 @@ class HTTPHandler(BaseHTTPRequestHandler):
             self.send_header("Retry-After", str(max(1, int(retry_after))))
         self.end_headers()
         self.wfile.write(payload)
+
+    def post_query_batch(self, query=None):
+        """Cluster-wide wave batching receiver: several remote
+        sub-queries from one peer, executed with every item submitted
+        before any resolves (shared micro-batched dispatches), answered
+        positionally. Per-item errors ride inside the 200 envelope —
+        item isolation, not request failure."""
+        raw = self._body()
+        content_type = self.headers.get("Content-Type", "")
+        accept = self.headers.get("Accept", "")
+        if ("application/x-protobuf" in content_type
+                or "application/x-protobuf" in accept):
+            from pilosa_tpu import wire
+
+            if not wire.available():
+                raise ApiError("protobuf wire format unavailable", 406)
+            from pilosa_tpu.wire.serializer import (
+                decode_batch_request,
+                encode_batch_responses,
+            )
+
+            outcomes = self.api.query_batch(decode_batch_request(raw))
+            self._raw(encode_batch_responses(outcomes),
+                      "application/x-protobuf")
+            return
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError as e:
+            raise ApiError(f"invalid JSON body: {e}") from e
+        items = [
+            (q.get("index", ""), q.get("query", ""),
+             [int(s) for s in (q.get("shards") or [])])
+            for q in body.get("queries", [])
+        ]
+        from pilosa_tpu.executor.result import results_json_bytes
+
+        parts = []
+        for outcome in self.api.query_batch(items):
+            if outcome[0] == "ok":
+                # identical bytes to a per-query /index/{i}/query
+                # response — the batch route must be a pure transport
+                # optimization (gated by `make serving-smoke`)
+                parts.append(results_json_bytes(outcome[1]))
+            else:
+                parts.append(json.dumps(
+                    {"error": outcome[1], "status": outcome[2]},
+                    separators=(",", ":"),
+                ).encode())
+        self._raw(b'{"responses":[' + b",".join(parts) + b"]}")
 
     def post_index(self, index, query=None):
         body = self._json_body()
@@ -325,7 +472,9 @@ class HTTPHandler(BaseHTTPRequestHandler):
             )
 
     def post_import_roaring(self, index, field, shard, query=None):
-        changed = self.api.import_roaring(index, field, int(shard), self._body())
+        remote = bool(query and query.get("remote", ["false"])[0] == "true")
+        changed = self.api.import_roaring(index, field, int(shard),
+                                          self._body(), remote=remote)
         self._json({"changed": changed})
 
     def get_schema(self, query=None):
@@ -366,7 +515,23 @@ class HTTPHandler(BaseHTTPRequestHandler):
             f"{prefix}_serving_waves_total {pm['waves']}\n"
             f"{prefix}_serving_coalesced_requests_total "
             f"{pm['coalesced']}\n"
+            f"{prefix}_serving_deduped_requests_total "
+            f"{pm['deduped']}\n"
         )
+        # serving fast lane (connection pool, remote wave batching, HTTP
+        # keep-alive oracle): all series present from scrape one, zeros
+        # included, like the qos block below
+        for name, value in sorted(self.api.fastlane_metrics().items()):
+            text += f"{prefix}_serving_{name} {value}\n"
+        lock = getattr(self.server, "metrics_lock", None)
+        if lock is not None:
+            with lock:
+                conns = self.server.connections_opened
+                reqs = self.server.requests_served
+            text += (
+                f"{prefix}_serving_http_connections_total {conns}\n"
+                f"{prefix}_serving_http_requests_total {reqs}\n"
+            )
         # serving-QoS series (admission/deadline/hedge/breaker): emitted
         # from scrape one, zeros included, for the same rate()-window
         # reason as the wave counters above
@@ -392,6 +557,14 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap["residency"] = global_row_cache().metrics()
         snap["serving_pipeline"] = self.api.pipeline_metrics()
         snap["qos"] = self.api.qos.metrics()
+        fastlane = self.api.fastlane_metrics()
+        lock = getattr(self.server, "metrics_lock", None)
+        if lock is not None:
+            with lock:
+                fastlane["http_connections_total"] = \
+                    self.server.connections_opened
+                fastlane["http_requests_total"] = self.server.requests_served
+        snap["serving_fastlane"] = fastlane
         self._json(snap)
 
     def get_pprof(self, query=None):
@@ -535,17 +708,53 @@ def _int_param(value: str, name: str) -> int:
         raise ApiError(f"invalid {name} parameter {value!r}") from e
 
 
-def make_http_server(api: API, bind: str = "localhost", port: int = 10101):
-    handler = type("BoundHandler", (HTTPHandler,), {"api": api})
+class PilosaHTTPServer(ThreadingHTTPServer):
     # socketserver's default listen backlog (5) resets connections under
     # a concurrent client wave — exactly the traffic shape the coalescing
-    # query pipeline exists to serve (server/pipeline.py)
-    server_cls = type(
-        "PilosaHTTPServer", (ThreadingHTTPServer,),
-        {"request_queue_size": 128},
-    )
-    server = server_cls((bind, port), handler)
-    return server
+    # query pipeline exists to serve (server/pipeline.py).
+    request_queue_size = 128
+    # disable_nagle_algorithm: responses go out as a header write + a
+    # body write; without TCP_NODELAY the second small packet can sit
+    # behind Nagle/delayed-ACK interplay on real networks
+    disable_nagle_algorithm = True
+
+    def __init__(self, *args, **kwargs):
+        # counters/registry exist BEFORE bind: TCPServer.__init__ calls
+        # server_close on a bind failure (port in use), which walks the
+        # registry — post-construction assignment would turn that into
+        # an AttributeError masking the real bind error
+        self.metrics_lock = threading.Lock()
+        self.connections_opened = 0
+        self.requests_served = 0
+        self.open_connections = set()
+        super().__init__(*args, **kwargs)
+
+    def server_close(self):
+        super().server_close()
+        # Hard-close ESTABLISHED keep-alive connections too: closing only
+        # the listener leaves handler threads serving old peers' pooled
+        # connections indefinitely — a closed node must look DEAD to the
+        # cluster (peers' pools see EOF, reconnect, get refused, degrade),
+        # exactly like a crashed process whose sockets the kernel reset.
+        import socket as _socket
+
+        with self.metrics_lock:
+            conns = list(self.open_connections)
+            self.open_connections.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def make_http_server(api: API, bind: str = "localhost", port: int = 10101):
+    handler = type("BoundHandler", (HTTPHandler,), {"api": api})
+    return PilosaHTTPServer((bind, port), handler)
 
 
 def serve_in_thread(api: API, bind: str = "localhost", port: int = 0):

@@ -29,6 +29,44 @@ import time
 from concurrent.futures import Future
 
 
+class _SharedDeferred:
+    """Deferred handle shared by deduped wavemates: the first resolver
+    computes (executor Deferreds are not safe to resolve concurrently),
+    everyone else gets the memoized value — or the memoized exception,
+    re-raised per request so error semantics match a solo submit."""
+
+    __slots__ = ("_deferred", "_lock", "_done", "_value", "_error")
+
+    def __init__(self, deferred):
+        self._deferred = deferred
+        self._lock = threading.Lock()
+        self._done = False
+        self._value = None
+        self._error = None
+
+    def result(self):
+        with self._lock:
+            if not self._done:
+                try:
+                    self._value = self._deferred.result()
+                except BaseException as e:
+                    self._error = e
+                self._done = True
+                self._deferred = None
+        if self._error is not None:
+            # per-caller copies: concurrent raises of ONE instance would
+            # mutate its __traceback__/__context__ across threads (the
+            # wave batcher clones for the same reason — _clone_error)
+            import copy
+
+            try:
+                err = copy.copy(self._error)
+            except Exception:
+                err = self._error  # uncopyable custom exception: degrade
+            raise err
+        return self._value
+
+
 class QueryPipeline:
     """Wave-coalescing front end over ``executor.submit``.
 
@@ -63,20 +101,28 @@ class QueryPipeline:
         self._last_wave_size = 0  # latch breaker: did the window pay off?
         self.waves = 0          # dispatch waves formed (observability)
         self.coalesced = 0      # requests that shared a wave with others
+        self.deduped = 0        # requests served off an identical wavemate
 
     # ------------------------------------------------------------- frontend
 
-    def run(self, index: str, query, kwargs: dict) -> list:
+    def run(self, index: str, query, kwargs: dict, key=None) -> list:
         """Queue one request; returns its per-call Deferreds once the
         whole wave containing it has been submitted. The caller resolves
-        them (concurrently across request threads)."""
+        them (concurrently across request threads).
+
+        ``key`` (optional) marks the request dedupe-eligible: wavemates
+        carrying the SAME key are submitted once and share the resulting
+        Deferreds (behind a memoizing wrapper, so concurrent resolves are
+        race-free). The API façade only passes a key for plain edge reads
+        — no explicit shards, no deadline, no result options — where
+        identical PQL strings are guaranteed identical requests."""
         self._ensure_thread()
         now = time.monotonic()
         # benign races: both fields are plain floats read heuristically
         self._recent_gap = now - self._last_arrival
         self._last_arrival = now
         fut: Future = Future()
-        self._q.put((index, query, kwargs, fut))
+        self._q.put((index, query, kwargs, fut, key))
         return fut.result()
 
     # ----------------------------------------------------------- dispatcher
@@ -106,11 +152,29 @@ class QueryPipeline:
             # first result(), so a request thread resuming early would
             # split the wave's shared dispatch.
             done = []
-            for index, q, kwargs, fut in wave:
+            # identical dedupe-eligible wavemates submit ONCE and share
+            # the leader's Deferreds; the shared handles memoize their
+            # resolution so the N-1 followers pay neither the dispatch
+            # nor the readback (and the followers' responses reuse the
+            # leader's pre-serialized result bytes — executor/result.py)
+            leaders: dict = {}
+            for index, q, kwargs, fut, key in wave:
+                shared = leaders.get(key) if key is not None else None
+                if shared is not None:
+                    self.deduped += 1
+                    done.append((fut, shared))
+                    continue
                 try:
-                    done.append((fut, executor.submit(index, q, **kwargs)))
+                    defs = executor.submit(index, q, **kwargs)
                 except BaseException as e:
                     fut.set_exception(e)
+                    continue
+                if key is not None:
+                    # wrapped only when shareable: followers' resolves
+                    # must be race-free against the leader's
+                    defs = [_SharedDeferred(d) for d in defs]
+                    leaders[key] = defs
+                done.append((fut, defs))
             for fut, defs in done:
                 fut.set_result(defs)
 

@@ -48,6 +48,8 @@ class ServerConfig:
         qos_hedge_budget: float = 0.05,
         qos_breaker_threshold: int = 5,
         qos_breaker_cooldown: float = 5.0,
+        client_pool_size: int = 8,
+        remote_batch: bool = True,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -83,6 +85,12 @@ class ServerConfig:
         self.qos_hedge_budget = qos_hedge_budget
         self.qos_breaker_threshold = qos_breaker_threshold
         self.qos_breaker_cooldown = qos_breaker_cooldown
+        # Serving fast lane (docs/OPERATIONS.md): keep-alive connections
+        # retained per peer by the internal client's pool, and whether
+        # same-node remote sub-queries group-commit onto
+        # /internal/query-batch.
+        self.client_pool_size = client_pool_size
+        self.remote_batch = remote_batch
 
     @property
     def tls_enabled(self) -> bool:
@@ -141,6 +149,10 @@ class ServerConfig:
             qos_breaker_cooldown=_parse_duration(
                 d.get("qos-breaker-cooldown", 5.0)
             ),
+            client_pool_size=int(
+                d.get("client-pool-size", d.get("client_pool_size", 8))
+            ),
+            remote_batch=_parse_bool(d.get("remote-batch", True)),
         )
 
     def to_dict(self) -> dict:
@@ -173,6 +185,8 @@ class ServerConfig:
             "qos-hedge-budget": self.qos_hedge_budget,
             "qos-breaker-threshold": self.qos_breaker_threshold,
             "qos-breaker-cooldown": self.qos_breaker_cooldown,
+            "client-pool-size": self.client_pool_size,
+            "remote-batch": self.remote_batch,
         }
 
 
@@ -321,6 +335,7 @@ class Server:
         cluster = Cluster(
             Node(name, uri), replica_n=self.config.replica_n, holder=self.holder,
             insecure_tls=self.config.tls_skip_verify,
+            pool_size=self.config.client_pool_size,
         )
         cluster.api = self.api
         cluster.logger = self.logger
@@ -337,7 +352,10 @@ class Server:
             local = DistExecutor(self.holder)
         else:
             local = Executor(self.holder)
-        self.api.executor = ClusterExecutor(local, cluster, qos=self.api.qos)
+        self.api.executor = ClusterExecutor(
+            local, cluster, qos=self.api.qos,
+            remote_batch=self.config.remote_batch,
+        )
 
         for seed in self.config.seeds:
             try:
@@ -357,6 +375,11 @@ class Server:
         if self._http:
             self._http.shutdown()
             self._http.server_close()
+        cluster = getattr(self.api, "cluster", None)
+        if cluster is not None:
+            pool = getattr(cluster.client, "pool", None)
+            if pool is not None:
+                pool.close()  # drop idle keep-alive connections to peers
         self.holder.close()
 
     def _schedule_anti_entropy(self) -> None:

@@ -200,6 +200,59 @@ def encode_import_value_request(index: str, field: str, columns, values,
     return req.SerializeToString()
 
 
+def encode_batch_request(items) -> bytes:
+    """``items``: [(index, pql, shards), ...] → BatchQueryRequest bytes
+    (the wave-batched internal hop — one request per node per wave)."""
+    p = pb2()
+    req = p.BatchQueryRequest()
+    for index, pql, shards in items:
+        unit = req.queries.add()
+        unit.index = index
+        unit.query = pql
+        unit.shards.extend(int(s) for s in shards)
+    return req.SerializeToString()
+
+
+def decode_batch_request(data: bytes) -> list[tuple[str, str, list[int]]]:
+    p = pb2()
+    req = p.BatchQueryRequest()
+    req.ParseFromString(data)
+    return [(u.index, u.query, list(u.shards)) for u in req.queries]
+
+
+def encode_batch_responses(outcomes) -> bytes:
+    """``outcomes``: one entry per batched sub-query, either
+    ``("ok", [raw results])`` or ``("err", message, status)`` →
+    BatchQueryResponse bytes (positional with the request)."""
+    p = pb2()
+    batch = p.BatchQueryResponse()
+    for outcome in outcomes:
+        resp = batch.responses.add()
+        if outcome[0] == "ok":
+            for res in outcome[1]:
+                _encode_result(resp.results.add(), res)
+        else:
+            resp.err = outcome[1]
+            resp.status = int(outcome[2])
+    return batch.SerializeToString()
+
+
+def decode_batch_responses(data: bytes) -> list[dict]:
+    """BatchQueryResponse bytes → one dict per sub-query, the same
+    shapes query_node returns: ``{"results": [...]}`` on success,
+    ``{"error": ..., "status": ...}`` on a per-item error."""
+    p = pb2()
+    batch = p.BatchQueryResponse()
+    batch.ParseFromString(data)
+    out = []
+    for resp in batch.responses:
+        if resp.err:
+            out.append({"error": resp.err, "status": int(resp.status) or None})
+        else:
+            out.append(_response_results_json(resp))
+    return out
+
+
 def decode_results_json(data: bytes) -> dict:
     """Parse a QueryResponse into the SAME dict shapes the JSON surface
     emits (executor/result.py to_json), so callers reduce remote partials
@@ -209,6 +262,11 @@ def decode_results_json(data: bytes) -> dict:
     resp.ParseFromString(data)
     if resp.err:
         return {"error": resp.err}
+    return _response_results_json(resp)
+
+
+def _response_results_json(resp) -> dict:
+    """The result-decoding body shared by single and batched responses."""
     out = []
     for qr in resp.results:
         t = qr.type

@@ -370,6 +370,44 @@ def test_max_writes_per_request(node_api):
     assert req("POST", f"{node}/index/i/query", b"Count(Row(f=1))")["results"] == [3]
 
 
+def test_import_roaring_edge_respects_max_writes(node_api):
+    """max-writes-per-request covers the roaring route's EDGE bodies too
+    (413, like /import) — the cheapest encoding must not bypass the
+    admission limit; routed internal slices (?remote=true) are exempt."""
+    from pilosa_tpu.roaring import RoaringBitmap, serialize
+
+    node, api = node_api
+    req("POST", f"{node}/index/i", {})
+    req("POST", f"{node}/index/i/field/f", {})
+    api.max_writes_per_request = 3
+    body = serialize(RoaringBitmap.from_ids([1, 2, 3, 4, 5]))
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req("POST", f"{node}/index/i/field/f/import-roaring/0", body,
+            content_type="application/octet-stream")
+    assert e.value.code == 413
+    out = req("POST",
+              f"{node}/index/i/field/f/import-roaring/0?remote=true",
+              body, content_type="application/octet-stream")
+    assert out["changed"] == 5
+
+
+def test_bind_failure_raises_oserror_not_attributeerror():
+    """TCPServer.__init__ calls server_close on a bind failure; the
+    connection registry must already exist so the REAL error (port in
+    use) surfaces."""
+    import socket
+
+    from pilosa_tpu.server.http import make_http_server
+
+    srv = socket.create_server(("localhost", 0))
+    busy_port = srv.getsockname()[1]
+    try:
+        with pytest.raises(OSError):
+            make_http_server(None, "localhost", busy_port)
+    finally:
+        srv.close()
+
+
 def test_import_roaring_malformed_upstream_blob_is_400(node):
     req("POST", f"{node}/index/i", {})
     req("POST", f"{node}/index/i/field/f", {})

@@ -153,12 +153,16 @@ class TestHTTPServing:
                     self.alock = threading.Lock()
                     self.gate = threading.Event()
 
-                def run(self, index, query, kwargs):
+                def run(self, index, query, kwargs, key=None):
                     with self.alock:
                         self.arrived += 1
                         if self.arrived >= self.expected:
                             self.gate.set()
                     self.gate.wait(30)
+                    # key deliberately NOT forwarded: this test counts
+                    # device dispatches across DISTINCT submits, so the
+                    # identical-query dedupe (covered by its own tests)
+                    # must stay out of the way
                     return super().run(index, query, kwargs)
 
             dist = api.executor.local
