@@ -6,12 +6,16 @@
 # exercises the routed-import suite (docs/INGEST.md); serving-smoke
 # gates the host-path fast lane — keep-alive reuse via the
 # connection-count oracle, and /internal/query-batch returning
-# byte-identical results vs per-query dispatch (docs/OPERATIONS.md).
+# byte-identical results vs per-query dispatch (docs/OPERATIONS.md);
+# sync-smoke gates the anti-entropy/resize fast path — batched-manifest
+# repair byte-identical to the per-fragment path, the ≤2-RTT diff
+# oracle, compression negotiation, and pacer bounds. bench-sync runs the
+# seeded-divergence repair benchmark (control RTTs, wall, wire bytes).
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: test test-slow qos-smoke ingest-smoke serving-smoke bench-ingest \
-	bench-serving
+.PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
+	bench-ingest bench-serving bench-sync
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -28,8 +32,14 @@ ingest-smoke:
 serving-smoke:
 	$(PYTEST) tests/test_fastlane.py -m "not slow"
 
+sync-smoke:
+	$(PYTEST) tests/test_sync_fastpath.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
 bench-serving:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs serving
+
+bench-sync:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs sync

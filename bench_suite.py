@@ -1110,6 +1110,307 @@ def config_ingest(n_remote: int = 3, n_shards: int = 16,
     }
 
 
+def config_sync(n_fragments: int = 192, n_divergent: int = 32,
+                rows_per_block: int = 12, bits_per_row: int = 400,
+                rounds: int = 2, injected_rtt_s: float = 0.005) -> dict:
+    """Anti-entropy fast path (ISSUE 5): the SAME seeded divergence
+    repaired against identical source clusters over two transports —
+
+    - ``legacy``: the r5 per-fragment path end to end (catalog walk + one
+      ``fragment_blocks`` GET per fragment + one block-data GET per
+      differing block, serial pass), forced via the old-wire fallback
+      (``_no_manifest_peers`` + ``sync_workers = 1``);
+    - ``fastpath``: one batched manifest per peer, multi-block delta
+      POSTs, ``sync-workers``-wide pipeline, compressed payloads.
+
+    The SOURCE node runs as a real OS subprocess (``python -m pilosa_tpu
+    server``, like tests/test_process_cluster.py) so the measured RTTs
+    cross a process boundary the way production DCN hops do — two
+    in-process nodes share one GIL, which flattens exactly the
+    concurrency the pipeline exploits. The repairer stays in-process for
+    instrumentation (RTT/byte counting on its connection pool).
+
+    ``injected_rtt_s`` adds a fixed per-request transport delay to BOTH
+    modes (the config_ingest precedent: loopback under-prices a network
+    round trip by ~50×, and the fast path's whole claim is paying fewer
+    of them; 5 ms is a conservative inter-host DCN hop). The shared local
+    work — checksum walks, block merges — is identical either way and
+    paid for real.
+
+    Measures control-plane round trips, bytes on the wire, and repair
+    wall time; ok requires byte-identical post-repair fragments across
+    the two modes, ≥5× fewer RTTs, and ≥2× lower wall. A final phase
+    re-runs a paced repair (`repair-max-bytes-per-sec`) under a
+    concurrent serving client and reports the query p95 — resize storms
+    must not starve serving."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.roaring import RoaringBitmap
+    from pilosa_tpu.roaring.format import serialize
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    def post(port, path, data, binary=False):
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method="POST"
+        )
+        if binary:
+            r.add_header("Content-Type", "application/octet-stream")
+        with urllib.request.urlopen(r, timeout=120) as resp:
+            return resp.read()
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    # deterministic payloads, built once: base data for every fragment
+    # (replicated) + WIDE, shallow divergence (a missed write here and
+    # there across many fragments — the anti-entropy steady state, where
+    # control RTTs dominate the repair and the fast path pays off)
+    rng = np.random.default_rng(17)
+    base_payloads = []
+    for _ in range(n_fragments):
+        rows = np.repeat(np.arange(rows_per_block, dtype=np.uint64), 64)
+        poss = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64)
+        bm = RoaringBitmap()
+        bm.add_ids((rows << np.uint64(20)) + poss)
+        base_payloads.append(serialize(bm))
+    div_payloads = []
+    for _ in range(n_divergent):
+        rows = np.repeat(np.arange(3, dtype=np.uint64), bits_per_row)
+        poss = np.concatenate([
+            rng.choice(SHARD_WIDTH, bits_per_row,
+                       replace=False).astype(np.uint64)
+            for _ in range(3)
+        ])
+        bm = RoaringBitmap()
+        bm.add_ids((rows << np.uint64(20)) + poss)
+        div_payloads.append(serialize(bm))
+
+    def spawn_source(tmp) -> tuple:
+        """Boot the divergence source as a separate OS process and seed
+        it over HTTP (?remote=true applies locally, no fan-out)."""
+        port = free_port()
+        args = [
+            sys.executable, "-m", "pilosa_tpu", "server",
+            "--data-dir", f"{tmp}/src", "--bind", "127.0.0.1",
+            "--port", str(port),
+        ]
+        env = {
+            **os.environ, "JAX_PLATFORMS": "cpu",
+            "PILOSA_TPU_NAME": "src",
+            "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "0",
+            "PILOSA_TPU_HEARTBEAT_INTERVAL": "0",
+            "PILOSA_TPU_USE_MESH": "false",
+        }
+        proc = subprocess.Popen(args, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+        for _ in range(240):
+            if proc.poll() is not None:
+                raise AssertionError(f"source exited rc={proc.returncode}")
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=5
+                ).read()
+                break
+            except Exception:
+                time.sleep(0.25)
+        else:
+            proc.terminate()
+            raise AssertionError("source never served /status")
+        # trackExistence off: the HTTP imports below would otherwise
+        # populate the _exists field on the source only, drowning the
+        # seeded divergence in existence-bit repair traffic
+        post(port, "/index/i",
+             b'{"options": {"trackExistence": false}}')
+        post(port, "/index/i/field/f", b"{}")
+        for shard, payload in enumerate(base_payloads):
+            post(port,
+                 f"/index/i/field/f/import-roaring/{shard}?remote=true",
+                 payload, binary=True)
+        for shard, payload in enumerate(div_payloads):
+            post(port,
+                 f"/index/i/field/f/import-roaring/{shard}?remote=true",
+                 payload, binary=True)
+        return proc, port
+
+    def make_repairer(tmp, src_port, legacy: bool) -> "Server":
+        """In-process repairer holding only the BASE data. Membership is
+        wired directly (no seed join — the join path's gated self-join
+        fetch would repair the divergence before the measured pass)."""
+        from pilosa_tpu.parallel.cluster import Node
+
+        s1 = Server(ServerConfig(
+            data_dir=f"{tmp}/rep", port=0, name="rep", replica_n=2,
+            anti_entropy_interval=0, heartbeat_interval=0,
+            use_mesh=False,
+        )).open()
+        s1.holder.create_index("i", track_existence=False).create_field("f")
+        f1 = s1.holder.index("i").field("f")
+        view = f1.view(VIEW_STANDARD, create=True)
+        for shard, payload in enumerate(base_payloads):
+            view.fragment(shard, create=True).import_roaring(payload)
+        s1.api.cluster.nodes["src"] = Node(
+            "src", f"http://127.0.0.1:{src_port}"
+        )
+        if legacy:
+            s1.api.cluster.sync_workers = 1
+            s1.api.cluster.client._no_manifest_peers.add(
+                f"http://127.0.0.1:{src_port}"
+            )
+        return s1
+
+    def run_mode(legacy: bool):
+        best_wall = float("inf")
+        rtts = bytes_wire = repaired = snap = converged = None
+        for _ in range(rounds):
+            with tempfile.TemporaryDirectory() as tmp:
+                proc, src_port = spawn_source(tmp)
+                s1 = make_repairer(tmp, src_port, legacy)
+                try:
+                    pool = s1.api.cluster.client.pool
+                    counts = {"rtts": 0, "bytes": 0}
+                    real = pool.request
+
+                    def counting(method, url, body=None, headers=None,
+                                 timeout=None, real=real, counts=counts):
+                        if injected_rtt_s > 0:
+                            time.sleep(injected_rtt_s)
+                        resp = real(method, url, body=body,
+                                    headers=headers, timeout=timeout)
+                        counts["rtts"] += 1
+                        counts["bytes"] += (
+                            len(body or b"") + len(resp.data)
+                        )
+                        return resp
+
+                    pool.request = counting
+                    t0 = time.perf_counter()
+                    rep = s1.api.cluster.sync_holder()
+                    dt = time.perf_counter() - t0
+                    pool.request = real
+                    f1 = s1.holder.index("i").field("f")
+                    snap = b"".join(
+                        f1.view(VIEW_STANDARD).fragment(s)
+                        .serialize_snapshot()
+                        for s in range(n_fragments)
+                    )
+                    # convergence oracle: the repairer's checksums match
+                    # the source's, fetched by an independent client
+                    from pilosa_tpu.parallel.client import InternalClient
+
+                    oracle = InternalClient()
+                    src_manifest = dict(
+                        ((f, v, s), dict(blocks)) for f, v, s, blocks in
+                        oracle.sync_manifest(
+                            f"http://127.0.0.1:{src_port}", "i")
+                    )
+                    oracle.pool.close()
+                    converged = all(
+                        dict(f1.view(VIEW_STANDARD).fragment(s).blocks())
+                        == src_manifest.get(("f", VIEW_STANDARD, s), {})
+                        for s in range(n_fragments)
+                    )
+                    rtts, bytes_wire = counts["rtts"], counts["bytes"]
+                    repaired = rep
+                    best_wall = min(best_wall, dt)
+                finally:
+                    s1.close()
+                    proc.terminate()
+                    proc.wait(timeout=30)
+        return {
+            "rtts": rtts, "bytes": bytes_wire,
+            "wall_ms": round(best_wall * 1e3, 1),
+            "bits_repaired": repaired["bits"], "converged": converged,
+            "snapshot": snap,
+        }
+
+    legacy = run_mode(True)
+    fast = run_mode(False)
+    byte_identical = legacy.pop("snapshot") == fast.pop("snapshot")
+    rtt_factor = round(legacy["rtts"] / max(fast["rtts"], 1), 2)
+    wall_factor = round(legacy["wall_ms"] / max(fast["wall_ms"], 1e-9), 2)
+
+    # paced repair under concurrent serving: the pacer must shape the
+    # transfer without starving queries on the repairing node
+    with tempfile.TemporaryDirectory() as tmp:
+        proc, src_port = spawn_source(tmp)
+        s1 = make_repairer(tmp, src_port, legacy=False)
+        try:
+            from pilosa_tpu.parallel.pacer import RepairPacer
+
+            # rate sized so the divergent payload takes a visible ~1-2 s
+            s1.api.cluster.client.pacer = RepairPacer(
+                max_bytes_per_sec=64_000, max_inflight=2,
+            )
+            latencies: list = []
+            stop = threading.Event()
+
+            def serve():
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    post(s1.port,
+                         "/index/i/query?shards=0,1,2,3",
+                         b"Count(Row(f=1))")
+                    latencies.append(time.perf_counter() - t0)
+
+            t = threading.Thread(target=serve, daemon=True)
+            post(s1.port, "/index/i/query?shards=0,1,2,3",
+                 b"Count(Row(f=1))")  # warm the compile
+            t.start()
+            t0 = time.perf_counter()
+            s1.api.cluster.sync_holder()
+            paced_wall = time.perf_counter() - t0
+            stop.set()
+            t.join(30)
+            paced_sleep = s1.api.cluster.client.pacer.paced_sleep_s
+            p95 = (float(np.quantile(latencies, 0.95))
+                   if latencies else None)
+        finally:
+            s1.close()
+            proc.terminate()
+            proc.wait(timeout=30)
+
+    ok = (byte_identical
+          and legacy["converged"] and fast["converged"]
+          and legacy["bits_repaired"] == fast["bits_repaired"] > 0
+          and rtt_factor >= 5.0
+          and wall_factor >= 2.0
+          and paced_sleep > 0          # the pacer actually shaped traffic
+          and p95 is not None and p95 < 1.0)
+    return {
+        "config": "sync",
+        "metric": "repair_control_rtt_reduction_factor",
+        "value": rtt_factor,
+        "unit": "x fewer round trips",
+        "wall_speedup": wall_factor,
+        "legacy": {k: legacy[k] for k in
+                   ("rtts", "bytes", "wall_ms", "bits_repaired")},
+        "fastpath": {k: fast[k] for k in
+                     ("rtts", "bytes", "wall_ms", "bits_repaired")},
+        "byte_identical_post_repair": byte_identical,
+        "paced_repair": {
+            "wall_ms": round(paced_wall * 1e3, 1),
+            "paced_sleep_ms": round(paced_sleep * 1e3, 1),
+            "serving_p95_ms_during_repair": (
+                round(p95 * 1e3, 1) if p95 is not None else None
+            ),
+            "serving_samples": len(latencies),
+        },
+        "fragments": n_fragments, "divergent": n_divergent,
+        "injected_rtt_ms": injected_rtt_s * 1e3,
+        "ok": bool(ok),
+    }
+
+
 def config_hostpath(n_shards: int = 8) -> dict:
     """Host-side cost of the pipelined submit path, device excluded.
 
@@ -1229,7 +1530,8 @@ def main() -> None:
     parser.add_argument("--full", action="store_true",
                         help="billion-column scale (real TPU)")
     parser.add_argument(
-        "--configs", default="1,2,3,4,5,mesh8,serving,import,ingest,hostpath"
+        "--configs",
+        default="1,2,3,4,5,mesh8,serving,import,ingest,sync,hostpath",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -1264,6 +1566,10 @@ def main() -> None:
         "ingest": lambda: config_ingest(
             n_shards=64 if args.full else 16,
             density=0.1 if args.full else 0.02,
+        ),
+        "sync": lambda: config_sync(
+            n_fragments=384 if args.full else 192,
+            n_divergent=64 if args.full else 32,
         ),
         "hostpath": lambda: config_hostpath(n_shards=8),
     }

@@ -47,6 +47,15 @@ client-pool-size = 8          # keep-alive connections retained per peer
 remote-batch = true           # coalesce same-node remote sub-queries onto
                               # /internal/query-batch (false = per-query)
 
+# Anti-entropy / resize data plane (docs/OPERATIONS.md)
+sync-workers = 8              # fragment diff/fetch/apply pipeline width
+                              # per repair pass
+repair-max-bytes-per-sec = 0  # token-bucket pacing of repair/resize
+                              # transfers; 0 = unpaced
+repair-max-inflight = 0       # concurrent repair transfers; 0 = unbounded
+repair-compression = true     # zlib Content-Encoding on fragment and
+                              # delta payloads (negotiated per peer)
+
 # Serving QoS (docs/QOS.md): admission -> deadline -> hedged reads
 qos-max-inflight = 0          # concurrent-query cap; excess sheds 429 (0 = off)
 qos-tenant-inflight = 0       # per-tenant cap (X-Pilosa-Tenant); 0 = global

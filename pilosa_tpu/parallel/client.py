@@ -56,6 +56,18 @@ class InternalClient:
         # peers whose wire predates /internal/query-batch (404/405 once):
         # the wave batcher falls back to per-query dispatch for them
         self._no_batch_peers: set[str] = set()
+        # peers whose wire predates the batched sync routes
+        # (/internal/sync/manifest + /internal/sync/blocks, 404/405
+        # once): anti-entropy falls back to the per-fragment
+        # blocks/block-data path for them (mixed-version clusters)
+        self._no_manifest_peers: set[str] = set()
+        # Repair/resize data-plane shaping, wired by the owning server:
+        # ``pacer`` (parallel/pacer.py) bounds transfer rate + inflight;
+        # ``compress_repair`` advertises Accept-Encoding: deflate on
+        # fragment and delta payload fetches (the peer compresses only
+        # when it actually shrinks the body).
+        self.pacer = None
+        self.compress_repair = True
         self._ssl_context: ssl.SSLContext | None = None
         if insecure_tls:
             ctx = ssl.create_default_context()
@@ -76,10 +88,37 @@ class InternalClient:
     def _is_406(err: "ClientError") -> bool:
         return err.status == 406
 
+    def _pace(self, nbytes: int) -> None:
+        """Debit a data-plane transfer from the repair pacer (no-op when
+        the server wired none — bare clients in tests/tools)."""
+        if self.pacer is not None:
+            self.pacer.consume(nbytes)
+
+    def _repair_slot(self):
+        """Inflight-bound context for one repair transfer."""
+        if self.pacer is not None:
+            return self.pacer.slot()
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def _repair_headers(self) -> dict | None:
+        return ({"Accept-Encoding": "deflate"}
+                if self.compress_repair else None)
+
+    @staticmethod
+    def _decode_body(resp) -> bytes:
+        """Response body with any negotiated Content-Encoding undone."""
+        if (resp.headers.get("Content-Encoding") or "").lower() == "deflate":
+            import zlib
+
+            return zlib.decompress(resp.data)
+        return resp.data
+
     def _call(self, method: str, url: str, body: bytes | None = None,
               content_type: str = "application/json", raw: bool = False,
               accept: str | None = None, headers: dict | None = None,
-              timeout: float | None = None):
+              timeout: float | None = None, want_response: bool = False):
         hdrs = dict(headers or {})
         if body is not None:
             hdrs.setdefault("Content-Type", content_type)
@@ -126,6 +165,8 @@ class InternalClient:
                 f"{method} {url}: HTTP {resp.status}: {detail}",
                 status=resp.status,
             )
+        if want_response:
+            return resp
         return resp.data if raw else json.loads(resp.data or b"{}")
 
     # ---------------------------------------------------------------- query
@@ -326,26 +367,149 @@ class InternalClient:
     def fragment_block_bitmap(self, uri: str, index: str, field: str,
                               view: str, shard: int, block: int):
         """One checksum block's bits as a parsed RoaringBitmap (binary
-        data plane: ~O(bitmap bytes) on the wire, not JSON int lists)."""
+        data plane: ~O(bitmap bytes) on the wire, not JSON int lists).
+        The per-block fallback for peers without /internal/sync/blocks;
+        still paced — a mixed-version repair storm must obey the same
+        budget as the fast path."""
         from pilosa_tpu.roaring.format import load
 
-        raw = self._call(
-            "GET",
-            f"{uri}/internal/fragment/block/data?index={index}&field={field}"
-            f"&view={view}&shard={shard}&block={block}",
-            raw=True,
-        )
+        with self._repair_slot():
+            raw = self._call(
+                "GET",
+                f"{uri}/internal/fragment/block/data?index={index}"
+                f"&field={field}&view={view}&shard={shard}&block={block}",
+                raw=True,
+            )
+        self._pace(len(raw))
         bitmap, _ = load(raw)
         return bitmap
 
     def fragment_data(self, uri: str, index: str, field: str, view: str,
                       shard: int) -> bytes:
-        return self._call(
-            "GET",
-            f"{uri}/internal/fragment/data?index={index}&field={field}"
-            f"&view={view}&shard={shard}",
-            raw=True,
-        )
+        """Whole-fragment payload (resize moves). Compressed on the wire
+        when ``repair-compression`` is on and the peer honors deflate;
+        paced by wire bytes (what the network actually carried), not the
+        inflated size."""
+        with self._repair_slot():
+            resp = self._call(
+                "GET",
+                f"{uri}/internal/fragment/data?index={index}&field={field}"
+                f"&view={view}&shard={shard}",
+                headers=self._repair_headers(), want_response=True,
+            )
+        self._pace(len(resp.data))
+        return self._decode_body(resp)
+
+    # ------------------------------------------------- anti-entropy fast path
+
+    def supports_sync_manifest(self, uri: str) -> bool:
+        """Whether the peer is believed to speak the batched sync routes
+        (flips False after one 404/405 — older wire)."""
+        return uri not in self._no_manifest_peers
+
+    def sync_manifest(self, uri: str, index: str
+                      ) -> list[tuple[str, str, int, list]]:
+        """One RTT for a whole index's sync state: every (field, view,
+        shard) → [(block, checksum)] the peer holds. Protobuf with the
+        per-peer 406 JSON fallback; a peer without the route answers
+        404/405, recorded in ``_no_manifest_peers`` and re-raised so the
+        caller falls back to the per-fragment blocks path."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        url = f"{uri}/internal/sync/manifest?index={index}"
+        global_stats().count("sync_manifest_fetches", 1)
+        if self._proto_ok(uri):
+            from pilosa_tpu.wire.serializer import decode_sync_manifest
+
+            try:
+                raw = self._call("GET", url, raw=True,
+                                 accept="application/x-protobuf")
+            except ClientError as e:
+                if e.status in (404, 405):
+                    self._no_manifest_peers.add(uri)
+                    raise
+                if not self._is_406(e):
+                    raise
+                self._json_only_peers.add(uri)
+            else:
+                return decode_sync_manifest(raw)
+        try:
+            out = self._call("GET", url)
+        except ClientError as e:
+            if e.status in (404, 405):
+                self._no_manifest_peers.add(uri)
+            raise
+        return [
+            (e.get("field", ""), e.get("view", "standard"),
+             int(e.get("shard", 0)),
+             [(int(b["block"]), b["checksum"])
+              for b in e.get("blocks", [])])
+            for e in out.get("fragments", [])
+        ]
+
+    def sync_blocks(self, uri: str, index: str, fragments) -> list:
+        """Multi-block delta fetch: ``fragments`` is
+        ``[(field, view, shard, [block, ...]), ...]``; returns one parsed
+        RoaringBitmap per requested block, in flattened request order.
+        One POST replaces one block-data GET per differing block; the
+        response is a length-prefixed roaring stream (optionally
+        deflated), paced by wire bytes. 404/405 records the peer as
+        old-wire and re-raises (caller drops to per-block GETs)."""
+        from pilosa_tpu.roaring.format import load
+        from pilosa_tpu.utils.stats import global_stats
+        from pilosa_tpu.wire.serializer import decode_block_frames
+
+        url = f"{uri}/internal/sync/blocks"
+        n_blocks = sum(len(f[3]) for f in fragments)
+        resp = None
+        if self._proto_ok(uri):
+            from pilosa_tpu.wire.serializer import (
+                encode_sync_blocks_request,
+            )
+
+            try:
+                with self._repair_slot():
+                    resp = self._call(
+                        "POST", url,
+                        encode_sync_blocks_request(index, fragments),
+                        content_type="application/x-protobuf",
+                        headers=self._repair_headers(),
+                        want_response=True,
+                    )
+            except ClientError as e:
+                if e.status in (404, 405):
+                    self._no_manifest_peers.add(uri)
+                    raise
+                if not self._is_406(e):
+                    raise
+                self._json_only_peers.add(uri)
+        if resp is None:
+            body = json.dumps({"index": index, "fragments": [
+                {"field": f, "view": v, "shard": int(s),
+                 "blocks": [int(b) for b in blocks]}
+                for f, v, s, blocks in fragments
+            ]}).encode()
+            try:
+                with self._repair_slot():
+                    resp = self._call("POST", url, body,
+                                      headers=self._repair_headers(),
+                                      want_response=True)
+            except ClientError as e:
+                if e.status in (404, 405):
+                    self._no_manifest_peers.add(uri)
+                raise
+        self._pace(len(resp.data))
+        stats = global_stats()
+        stats.count("sync_delta_blocks_requests", 1)
+        stats.count("sync_delta_blocks_fetched", n_blocks)
+        stats.count("sync_delta_blocks_bytes", len(resp.data))
+        frames = decode_block_frames(self._decode_body(resp))
+        if len(frames) != n_blocks:
+            raise ClientError(
+                f"POST {url}: {len(frames)} block frames for {n_blocks} "
+                "requested blocks"
+            )
+        return [load(frame)[0] for frame in frames]
 
     def fragment_catalog(self, uri: str, index: str) -> list[dict]:
         out = self._call("GET", f"{uri}/internal/fragments?index={index}")

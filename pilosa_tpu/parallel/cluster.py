@@ -116,6 +116,11 @@ class Cluster:
         self._local_fetch_jobs = 0
         self._commanded_state = STATE_NORMAL
         self.logger = None  # set by Server; failures fall back to stderr
+        # Anti-entropy pipeline width (ServerConfig sync-workers): owned
+        # fragments diff/fetch/apply concurrently, so a pass tracks the
+        # slowest peer's RTTs, not the sum over fragments — which also
+        # shrinks the gated self-join window that rides sync_holder.
+        self.sync_workers = 8
 
     @property
     def state(self) -> str:
@@ -689,14 +694,18 @@ class Cluster:
             raise
         return t
 
-    def _peer_fragment_entries(self, index_name: str):
+    def _peer_fragment_entries(self, index_name: str, peers=None):
         """(field, view, shard, source node) for every fragment any peer
         holds of one index — shared by resize fetches and the anti-entropy
         inventory walk. Peers are polled CONCURRENTLY (reference: one
         goroutine per node in cross-node walks — SURVEY.md §2 #12), so
         the walk costs the slowest peer's RTT, not the sum; an
-        unreachable peer contributes nothing."""
-        peers = [n for n in self.sorted_nodes() if n.id != self.local.id]
+        unreachable peer contributes nothing. ``peers`` restricts the
+        walk (the fast-path sync only catalogs old-wire peers this way —
+        manifests carry the catalog for everyone else)."""
+        if peers is None:
+            peers = [n for n in self.sorted_nodes()
+                     if n.id != self.local.id]
 
         def one(node):
             try:
@@ -1097,82 +1106,222 @@ class Cluster:
         (reference HolderSyncer.SyncHolder — SURVEY.md §3.5). Returns
         repair counts for observability. ``peer_entries`` reuses an
         already-gathered catalog walk; ``skip`` excludes fragments just
-        fetched in full (the gated self-join path uses both)."""
+        fetched in full (the gated self-join path uses both).
+
+        Fast path (docs/OPERATIONS.md): per index, ONE batched manifest
+        per peer replaces the per-fragment blocks GET storm (and the
+        catalog walk — the manifest carries the peer's inventory), and
+        the owned fragments then diff/fetch/apply as a bounded pipeline
+        (``sync_workers`` wide), so the pass costs the slowest peer, not
+        the sum over fragments. Differing blocks move as one multi-block
+        delta POST per (fragment, peer). Peers whose wire predates the
+        sync routes (404 once) fall back per-peer to the r5 per-fragment
+        path; post-repair state is byte-identical either way, and the
+        mutex/bool/BSI conflict-aware merge rules are unchanged."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        t0 = time.perf_counter()
         repaired = {"fragments": 0, "bits": 0, "attr_blocks": 0}
         repaired["translate_ops"] = self.sync_translate()
         repaired["attr_blocks"] = self._sync_attrs()
         for index_name, idx in list(self.holder.indexes.items()):
-            # Inventory = local fragments ∪ peers' catalogs: a replica that
-            # never materialized an owned fragment must still repair it
-            # (the reference syncer walks the schema × max-shard space, not
-            # just local files — SURVEY.md §3.5).
+            peers = [n for n in self.sorted_nodes()
+                     if n.id != self.local.id]
+            manifests = (self._peer_sync_manifests(index_name, peers)
+                         if peers else {})
+            # Inventory = local fragments ∪ peers' holdings: a replica
+            # that never materialized an owned fragment must still
+            # repair it (the reference syncer walks the schema ×
+            # max-shard space, not just local files — SURVEY.md §3.5).
+            # Manifests double as the peer catalog; only old-wire peers
+            # still cost a catalog GET.
             inventory = set()
             for field_name, field in list(idx.fields.items()):
                 for view_name, view in list(field.views.items()):
                     for shard in list(view.fragments):
                         inventory.add((field_name, view_name, shard))
-            entries = (peer_entries.get(index_name, [])
-                       if peer_entries is not None
-                       else self._peer_fragment_entries(index_name))
-            inventory.update((f, v, s) for f, v, s, _ in entries)
-            for field_name, view_name, shard in sorted(inventory):
-                if skip and (index_name, field_name, view_name, shard) in skip:
+            for m in manifests.values():
+                if isinstance(m, dict):
+                    inventory.update(m.keys())
+            legacy_peers = [n for n in peers
+                            if manifests.get(n.id) == "legacy"]
+            if peer_entries is not None:
+                inventory.update(
+                    (f, v, s)
+                    for f, v, s, _ in peer_entries.get(index_name, [])
+                )
+            elif legacy_peers:
+                inventory.update(
+                    (f, v, s) for f, v, s, _ in
+                    self._peer_fragment_entries(index_name, legacy_peers)
+                )
+            work = []
+            for key in sorted(inventory):
+                field_name, view_name, shard = key
+                if skip and (index_name, *key) in skip:
                     continue
                 if not self.owns_shard(index_name, shard):
                     continue
-                field = idx.field(field_name)
-                if field is None:
+                if idx.field(field_name) is None:
                     continue
-                replicas = [
-                    n for n in self.shard_nodes(index_name, shard)
-                    if n.id != self.local.id
-                ]
-                view = field.view(view_name, create=True)
-                # fragment created lazily at first import so a sync pass
-                # that repairs nothing leaves no empty fragment files
-                frag = view.fragment(shard)
-                local_blocks = dict(frag.blocks()) if frag is not None else {}
-                for node in replicas:
-                    try:
-                        peer_blocks = dict(
-                            self.client.fragment_blocks(
-                                node.uri, index_name, field_name,
-                                view_name, shard,
-                            )
-                        )
-                    except ClientError:
-                        continue
-                    for block, checksum in peer_blocks.items():
-                        if local_blocks.get(block) == checksum:
-                            continue
-                        try:
-                            bm = self.client.fragment_block_bitmap(
-                                node.uri, index_name, field_name,
-                                view_name, shard, block,
-                            )
-                        except ClientError:
-                            continue
-                        if bm.count():
-                            if frag is None:
-                                frag = view.fragment(shard, create=True)
-                            if field.options.type in ("mutex", "bool"):
-                                # single-value fields: union repair would
-                                # resurrect rows a newer import cleared;
-                                # conflicting columns keep the local row
-                                added = frag.add_ids_mutex(bm.to_ids())
-                            elif view_name == field.bsi_view_name():
-                                # BSI planes: per-column all-or-nothing —
-                                # unioning stale planes into a newer
-                                # value would fabricate values
-                                added = frag.add_ids_value(bm.to_ids())
-                            else:
-                                added = frag.import_roaring_bitmap(bm)
-                            if added:
-                                repaired["bits"] += added
-                                repaired["fragments"] += 1
-                    if frag is not None:
-                        local_blocks = dict(frag.blocks())
+                work.append(key)
+            results = concurrent_map(
+                lambda key: self._sync_fragment(index_name, idx, key,
+                                                manifests),
+                work, max_workers=max(1, self.sync_workers),
+                return_exceptions=True,
+            )
+            for key, result in zip(work, results):
+                if isinstance(result, Exception):
+                    self._log_exception(
+                        f"anti-entropy sync of {index_name}/{key}", result
+                    )
+                    continue
+                repaired["fragments"] += result[0]
+                repaired["bits"] += result[1]
+        global_stats().timing("sync_pass", time.perf_counter() - t0)
         return repaired
+
+    def _peer_sync_manifests(self, index_name: str, peers) -> dict:
+        """Concurrently fetch one batched sync manifest per peer. Values:
+        a ``{(field, view, shard): {block: checksum}}`` dict for peers
+        that answered, the string ``"legacy"`` for peers without the
+        route (repair falls back to per-fragment GETs against them), or
+        None for peers unreachable this pass (skipped — their fragment
+        GETs would fail identically, so nothing is lost but the RTTs)."""
+        def one(node):
+            if not self.client.supports_sync_manifest(node.uri):
+                return node.id, "legacy"
+            try:
+                entries = self.client.sync_manifest(node.uri, index_name)
+            except ClientError:
+                if not self.client.supports_sync_manifest(node.uri):
+                    return node.id, "legacy"  # 404/405: old wire
+                return node.id, None  # transport fault: skip this pass
+            except Exception as e:  # noqa: BLE001 — a malformed 200
+                # (truncated body, undecodable protobuf) from ONE peer
+                # must not abort the whole pass against every peer; the
+                # per-fragment blast radius the old loop had is the bar
+                self._log_exception(
+                    f"sync manifest from {node.id}", e
+                )
+                return node.id, None
+            return node.id, {
+                (f, v, s): dict(blocks) for f, v, s, blocks in entries
+            }
+
+        return dict(concurrent_map(one, peers))
+
+    def _sync_fragment(self, index_name: str, idx, key, manifests
+                       ) -> tuple[int, int]:
+        """Diff/fetch/apply one owned fragment against its replicas (one
+        pipeline work item). Returns (blocks-with-adds, bits-added) —
+        the same counting the serial pass reported."""
+        field_name, view_name, shard = key
+        field = idx.field(field_name)
+        if field is None:
+            return 0, 0
+        replicas = [
+            n for n in self.shard_nodes(index_name, shard)
+            if n.id != self.local.id
+        ]
+        view = field.view(view_name, create=True)
+        # fragment created lazily at first merge so a sync pass that
+        # repairs nothing leaves no empty fragment files
+        frag = view.fragment(shard)
+        local_blocks = dict(frag.blocks()) if frag is not None else {}
+        blocks_repaired = 0
+        bits = 0
+        for node in replicas:
+            manifest = manifests.get(node.id)
+            if manifest is None:
+                continue  # unreachable this pass
+            if isinstance(manifest, dict):
+                peer_blocks = manifest.get(key)
+                if not peer_blocks:
+                    continue  # peer holds no data for this fragment
+            else:  # "legacy": old-wire peer, per-fragment blocks GET
+                try:
+                    peer_blocks = dict(self.client.fragment_blocks(
+                        node.uri, index_name, field_name, view_name,
+                        shard,
+                    ))
+                except ClientError:
+                    continue
+            wanted = sorted(
+                b for b, checksum in peer_blocks.items()
+                if local_blocks.get(b) != checksum
+            )
+            if not wanted:
+                continue
+            merged_any = False
+            for block, bm in self._fetch_delta_blocks(
+                    node, index_name, key, wanted):
+                if bm is None or not bm.count():
+                    continue
+                if frag is None:
+                    frag = view.fragment(shard, create=True)
+                if field.options.type in ("mutex", "bool"):
+                    # single-value fields: union repair would resurrect
+                    # rows a newer import cleared; conflicting columns
+                    # keep the local row
+                    added = frag.add_ids_mutex(bm.to_ids())
+                elif view_name == field.bsi_view_name():
+                    # BSI planes: per-column all-or-nothing — unioning
+                    # stale planes into a newer value would fabricate
+                    # values
+                    added = frag.add_ids_value(bm.to_ids())
+                else:
+                    added = frag.import_roaring_bitmap(bm)
+                if added:
+                    bits += added
+                    blocks_repaired += 1
+                    merged_any = True
+            # Recompute the local checksum set ONLY when this peer
+            # actually merged something: the serial pass re-hashed the
+            # whole fragment after EVERY peer, so an N-replica cluster
+            # with zero divergence still paid N full to_ids+hash walks
+            # per fragment per pass.
+            if merged_any:
+                local_blocks = dict(frag.blocks())
+        return blocks_repaired, bits
+
+    def _fetch_delta_blocks(self, node, index_name: str, key, wanted):
+        """[(block, RoaringBitmap)] for the wanted blocks of one fragment
+        from one peer: ONE multi-block POST when the peer speaks
+        /internal/sync/blocks, per-block GETs otherwise (old wire). A
+        transport fault skips the peer for this fragment — the next pass
+        retries."""
+        field_name, view_name, shard = key
+        if self.client.supports_sync_manifest(node.uri):
+            try:
+                bitmaps = self.client.sync_blocks(
+                    node.uri, index_name,
+                    [(field_name, view_name, shard, wanted)],
+                )
+                return list(zip(wanted, bitmaps))
+            except ClientError:
+                if self.client.supports_sync_manifest(node.uri):
+                    return []  # transport fault: skip peer this pass
+                # 404/405 was just recorded: old wire — fall through to
+                # the per-block path below
+            except Exception as e:  # noqa: BLE001 — torn frames or an
+                # undecodable payload from this peer: skip it this pass
+                # (the next pass retries) instead of failing the fragment
+                self._log_exception(
+                    f"sync delta blocks from {node.id}", e
+                )
+                return []
+        out = []
+        for block in wanted:
+            try:
+                out.append((block, self.client.fragment_block_bitmap(
+                    node.uri, index_name, field_name, view_name, shard,
+                    block,
+                )))
+            except ClientError:
+                continue
+        return out
 
     def _sync_attrs(self) -> int:
         """Diff + union attr-store blocks against every peer (reference

@@ -1,0 +1,89 @@
+"""Token-bucket pacing for the repair/rebalance data plane.
+
+A resize or anti-entropy storm moves whole fragments between nodes; left
+unpaced, those bulk transfers compete with serving traffic for the same
+NIC and the same Python accept loop. The pacer bounds the damage two
+ways, both off by default:
+
+- ``max_bytes_per_sec``: a token bucket debited per transferred payload.
+  The bucket holds one second of budget (floored at 64 KiB so a tiny
+  rate still admits one block), and a transfer that overdraws sleeps the
+  deficit off before the next one starts — aggregate repair throughput
+  converges on the configured rate while individual transfers stay
+  unfragmented (the HTTP bodies are read whole by the pool).
+- ``max_inflight``: a semaphore capping concurrent data-plane transfers,
+  so a wide ``sync-workers`` pipeline cannot hold every connection-pool
+  slot (and every peer handler thread) at once.
+
+Sleep time is exported as the ``repair_paced_sleep_ms`` counter: a
+growing value under resize means the pacer is actually shaping traffic,
+not just configured.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+
+# Minimum bucket depth: one typical roaring block payload, so a very low
+# byte rate paces between transfers instead of deadlocking before the
+# first one.
+MIN_BURST_BYTES = 1 << 16
+
+
+class RepairPacer:
+    """Shared by every repair/resize transfer of one node's client."""
+
+    def __init__(self, max_bytes_per_sec: float = 0,
+                 max_inflight: int = 0, stats=None):
+        self.rate = float(max_bytes_per_sec or 0)
+        self.max_inflight = int(max_inflight or 0)
+        self.burst = max(self.rate, MIN_BURST_BYTES)
+        self._tokens = self.burst
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+        self._sem = (threading.BoundedSemaphore(self.max_inflight)
+                     if self.max_inflight > 0 else None)
+        self.stats = stats
+        # totals for /debug/vars-style introspection and tests
+        self.paced_sleep_s = 0.0
+        self.bytes_consumed = 0
+
+    def slot(self):
+        """Context manager bounding concurrent transfers (no-op when
+        ``max_inflight`` is 0)."""
+        if self._sem is None:
+            return nullcontext()
+        return self._slot()
+
+    @contextmanager
+    def _slot(self):
+        self._sem.acquire()
+        try:
+            yield
+        finally:
+            self._sem.release()
+
+    def consume(self, nbytes: int) -> float:
+        """Debit ``nbytes`` from the bucket; sleep off any deficit.
+        Returns the seconds slept (0.0 when unpaced or within budget)."""
+        if nbytes <= 0:
+            return 0.0
+        with self._lock:
+            self.bytes_consumed += int(nbytes)
+            if self.rate <= 0:
+                return 0.0
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t_last) * self.rate
+            )
+            self._t_last = now
+            self._tokens -= nbytes
+            wait = (-self._tokens / self.rate) if self._tokens < 0 else 0.0
+            self.paced_sleep_s += wait
+        if wait > 0:
+            if self.stats is not None:
+                self.stats.count("repair_paced_sleep_ms", wait * 1e3)
+            time.sleep(wait)
+        return wait

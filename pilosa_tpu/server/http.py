@@ -52,6 +52,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/internal/query-batch$"), "post_query_batch"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
     ("GET", re.compile(r"^/internal/shards/list$"), "get_shards_list"),
+    ("GET", re.compile(r"^/internal/sync/manifest$"), "get_sync_manifest"),
+    ("POST", re.compile(r"^/internal/sync/blocks$"), "post_sync_blocks"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
     ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
     ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
@@ -254,6 +256,35 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    # Payloads below this size skip the compression attempt: zlib headers
+    # plus the CPU round trip cost more than the bytes saved.
+    COMPRESS_MIN_BYTES = 256
+
+    def _bytes_negotiated(self, data: bytes) -> None:
+        """Octet-stream body with optional zlib Content-Encoding,
+        negotiated per request: compressed ONLY when the client
+        advertised ``Accept-Encoding: deflate`` (the repair client's
+        ``repair-compression`` knob controls whether it does) AND
+        compression actually shrinks the payload — so plain clients,
+        old-wire peers, and incompressible bodies all get identity
+        bytes. Roaring fragment payloads compress dramatically (Chambi
+        et al. 1402.6407), which is where resize transfer time lives."""
+        accept = (self.headers.get("Accept-Encoding") or "").lower()
+        if "deflate" in accept and len(data) >= self.COMPRESS_MIN_BYTES:
+            import zlib
+
+            compressed = zlib.compress(data, 6)
+            if len(compressed) < len(data):
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Encoding", "deflate")
+                self.send_header("Content-Length", str(len(compressed)))
+                self.end_headers()
+                self.wfile.write(compressed)
+                return
+        self._bytes(data)
 
     def _raw(self, data: bytes, content_type: str = "application/json",
              status: int = 200) -> None:
@@ -621,7 +652,101 @@ class HTTPHandler(BaseHTTPRequestHandler):
         v = fld.view(view)
         frag = v.fragment(shard) if v else None
         data = frag.serialize_snapshot() if frag else b""
-        self._bytes(data)
+        # whole-fragment resize payloads honor Accept-Encoding: deflate
+        # (the repair client's repair-compression knob)
+        self._bytes_negotiated(data)
+
+    def get_sync_manifest(self, query=None):
+        """Batched anti-entropy manifest: every (field, view, shard) →
+        checksum-block list of one index in ONE response, so a repair
+        pass diffs the whole index against this node in one RTT instead
+        of one /internal/fragment/blocks GET per fragment. Protobuf by
+        Accept negotiation, JSON fallback (the 406 dance the query path
+        uses)."""
+        from pilosa_tpu.storage.fragment import build_index_manifest
+        from pilosa_tpu.utils.stats import global_stats
+
+        index = (query.get("index") or [""])[0]
+        # An unknown index answers an EMPTY manifest, not 404: sync-wise
+        # this node simply holds nothing for it (a schema broadcast may
+        # not have landed yet), and a 404 here would be misread by peers
+        # as "route missing" — permanently demoting this node to the
+        # per-fragment legacy path. The legacy catalog walk treated the
+        # same condition as "no fragments" too (ClientError → []).
+        idx = self.api.holder.index(index)
+        entries = build_index_manifest(idx) if idx is not None else []
+        global_stats().count("sync_manifest_served", 1)
+        if "application/x-protobuf" in (self.headers.get("Accept") or ""):
+            from pilosa_tpu import wire
+
+            if not wire.available():
+                raise ApiError("protobuf wire format unavailable", 406)
+            from pilosa_tpu.wire.serializer import encode_sync_manifest
+
+            self._raw(encode_sync_manifest(entries),
+                      "application/x-protobuf")
+            return
+        self._json({"fragments": [
+            {"field": f, "view": v, "shard": s,
+             "blocks": [{"block": b, "checksum": c} for b, c in blocks]}
+            for f, v, s, blocks in entries
+        ]})
+
+    def post_sync_blocks(self, query=None):
+        """Multi-block delta fetch: the body lists every wanted checksum
+        block per fragment (protobuf SyncBlocksRequest or JSON); the
+        response streams the blocks back as length-prefixed roaring
+        payloads in request order — one POST replaces one
+        /internal/fragment/block/data GET per differing block. The data
+        plane stays raw roaring bytes whichever control encoding was
+        negotiated; Accept-Encoding: deflate compresses the framed
+        stream."""
+        from pilosa_tpu.roaring import RoaringBitmap
+        from pilosa_tpu.roaring.format import serialize
+        from pilosa_tpu.utils.stats import global_stats
+        from pilosa_tpu.wire.serializer import encode_block_frames
+
+        raw = self._body()
+        if "application/x-protobuf" in (
+                self.headers.get("Content-Type") or ""):
+            from pilosa_tpu import wire
+
+            if not wire.available():
+                raise ApiError("protobuf wire format unavailable", 406)
+            from pilosa_tpu.wire.serializer import (
+                decode_sync_blocks_request,
+            )
+
+            index, fragments = decode_sync_blocks_request(raw)
+        else:
+            try:
+                body = json.loads(raw or b"{}")
+            except json.JSONDecodeError as e:
+                raise ApiError(f"invalid JSON body: {e}") from e
+            index = body.get("index", "")
+            fragments = [
+                (e.get("field", ""), e.get("view", "standard"),
+                 _int_param(str(e.get("shard", 0)), "shard"),
+                 [_int_param(str(b), "block")
+                  for b in e.get("blocks", [])])
+                for e in body.get("fragments", [])
+            ]
+        # unknown index/field answer empty bitmaps, not 404, for the
+        # same reason as the manifest route: a domain 404 would be
+        # misread as "route missing" and demote the peer to the legacy
+        # path for the process lifetime — and an empty payload is the
+        # correct sync answer for data this node doesn't hold
+        idx = self.api.holder.index(index)
+        payloads = []
+        for fname, vname, shard, blocks in fragments:
+            fld = idx.field(fname) if idx is not None else None
+            v = fld.view(vname) if fld is not None else None
+            frag = v.fragment(shard) if v else None
+            for block in blocks:
+                ids = frag.block_ids(block) if frag is not None else []
+                payloads.append(serialize(RoaringBitmap.from_ids(ids)))
+        global_stats().count("sync_delta_blocks_served", len(payloads))
+        self._bytes_negotiated(encode_block_frames(payloads))
 
     def get_shards_list(self, query=None):
         index = (query.get("index") or [""])[0]

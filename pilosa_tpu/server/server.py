@@ -50,6 +50,10 @@ class ServerConfig:
         qos_breaker_cooldown: float = 5.0,
         client_pool_size: int = 8,
         remote_batch: bool = True,
+        sync_workers: int = 8,
+        repair_max_bytes_per_sec: int = 0,
+        repair_max_inflight: int = 0,
+        repair_compression: bool = True,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -91,6 +95,15 @@ class ServerConfig:
         # /internal/query-batch.
         self.client_pool_size = client_pool_size
         self.remote_batch = remote_batch
+        # Anti-entropy / resize data plane (docs/OPERATIONS.md): pipeline
+        # width for the fragment diff/fetch/apply pass, token-bucket
+        # pacing of repair transfers (bytes/sec; 0 = unpaced), inflight
+        # transfer cap (0 = unbounded), and zlib Content-Encoding on
+        # fragment/delta payloads.
+        self.sync_workers = sync_workers
+        self.repair_max_bytes_per_sec = repair_max_bytes_per_sec
+        self.repair_max_inflight = repair_max_inflight
+        self.repair_compression = repair_compression
 
     @property
     def tls_enabled(self) -> bool:
@@ -153,6 +166,21 @@ class ServerConfig:
                 d.get("client-pool-size", d.get("client_pool_size", 8))
             ),
             remote_batch=_parse_bool(d.get("remote-batch", True)),
+            sync_workers=int(
+                d.get("sync-workers", d.get("sync_workers", 8))
+            ),
+            repair_max_bytes_per_sec=int(
+                d.get("repair-max-bytes-per-sec",
+                      d.get("repair_max_bytes_per_sec", 0))
+            ),
+            repair_max_inflight=int(
+                d.get("repair-max-inflight",
+                      d.get("repair_max_inflight", 0))
+            ),
+            repair_compression=_parse_bool(
+                d.get("repair-compression",
+                      d.get("repair_compression", True))
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -187,6 +215,10 @@ class ServerConfig:
             "qos-breaker-cooldown": self.qos_breaker_cooldown,
             "client-pool-size": self.client_pool_size,
             "remote-batch": self.remote_batch,
+            "sync-workers": self.sync_workers,
+            "repair-max-bytes-per-sec": self.repair_max_bytes_per_sec,
+            "repair-max-inflight": self.repair_max_inflight,
+            "repair-compression": self.repair_compression,
         }
 
 
@@ -339,6 +371,19 @@ class Server:
         )
         cluster.api = self.api
         cluster.logger = self.logger
+        cluster.sync_workers = max(1, self.config.sync_workers)
+        # repair/resize data-plane shaping: one pacer per node's internal
+        # client, shared by every transfer path (manifest deltas,
+        # per-block fallbacks, whole-fragment resize fetches)
+        from pilosa_tpu.parallel.pacer import RepairPacer
+        from pilosa_tpu.utils.stats import global_stats as _stats
+
+        cluster.client.pacer = RepairPacer(
+            max_bytes_per_sec=self.config.repair_max_bytes_per_sec,
+            max_inflight=self.config.repair_max_inflight,
+            stats=_stats(),
+        )
+        cluster.client.compress_repair = self.config.repair_compression
         self.api.cluster = cluster
 
         use_mesh = self.config.use_mesh

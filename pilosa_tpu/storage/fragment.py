@@ -77,6 +77,7 @@ class Fragment:
         # _log_op); validates the row_counts memo
         self.mutations = 0
         self._row_counts_memo: tuple | None = None
+        self._blocks_memo: tuple | None = None
         self.snapshot_threshold = snapshot_threshold
         self.row_cache = new_row_cache(cache_type, cache_size)
         self._file = None
@@ -560,22 +561,33 @@ class Fragment:
 
     def blocks(self) -> list[tuple[int, str]]:
         """Checksums of BLOCK_ROWS-row blocks for replica diffing
-        (reference fragment.Blocks — SURVEY.md §3.5)."""
+        (reference fragment.Blocks — SURVEY.md §3.5).
+
+        Memoized against the mutation counter: the batched manifest route
+        serves EVERY fragment's checksums per anti-entropy pass, and each
+        recompute is a full to_ids materialization + hash walk. The
+        version is snapshotted before the pass, so a racing write can
+        only force an extra recompute, never a stale hit. Callers must
+        not mutate the returned list."""
+        memo = self._blocks_memo
+        if memo is not None and memo[0] == self.mutations:
+            return memo[1]
+        version = self.mutations
         out = []
         with self.lock:
             ids = self.bitmap.to_ids()
-        if ids.size == 0:
-            return out
-        block_of = (ids >> np.uint64(20)) // BLOCK_ROWS
-        boundaries = np.concatenate(
-            ([0], np.nonzero(np.diff(block_of))[0] + 1, [ids.size])
-        )
-        for i in range(boundaries.size - 1):
-            lo, hi = int(boundaries[i]), int(boundaries[i + 1])
-            digest = hashlib.blake2b(
-                ids[lo:hi].astype("<u8").tobytes(), digest_size=16
-            ).hexdigest()
-            out.append((int(block_of[lo]), digest))
+        if ids.size:
+            block_of = (ids >> np.uint64(20)) // BLOCK_ROWS
+            boundaries = np.concatenate(
+                ([0], np.nonzero(np.diff(block_of))[0] + 1, [ids.size])
+            )
+            for i in range(boundaries.size - 1):
+                lo, hi = int(boundaries[i]), int(boundaries[i + 1])
+                digest = hashlib.blake2b(
+                    ids[lo:hi].astype("<u8").tobytes(), digest_size=16
+                ).hexdigest()
+                out.append((int(block_of[lo]), digest))
+        self._blocks_memo = (version, out)
         return out
 
     def block_ids(self, block: int) -> np.ndarray:
@@ -622,3 +634,22 @@ class Fragment:
         pairs = [(r, c) for r, c in pairs if c > 0]
         pairs.sort(key=lambda rc: (-rc[1], rc[0]))
         return pairs[:n] if n else pairs
+
+
+def build_index_manifest(idx) -> list[tuple[str, str, int, list]]:
+    """Every (field, view, shard) → checksum-block list of one index, in
+    deterministic order — the body of ``GET /internal/sync/manifest``.
+    One response replaces the per-fragment ``fragment_blocks`` GET storm
+    of the r5 anti-entropy pass (O(fragments) control RTTs → 1); the
+    per-fragment blocks() memo keeps serving it cheap for unmutated
+    fragments. Fragments with no data still appear (empty block list):
+    the manifest doubles as the peer catalog for inventory walks."""
+    out = []
+    for fname, fld in sorted(idx.fields.items()):
+        for vname, view in sorted(fld.views.items()):
+            for shard in sorted(view.fragments):
+                frag = view.fragment(shard)
+                if frag is None:
+                    continue
+                out.append((fname, vname, shard, frag.blocks()))
+    return out

@@ -253,6 +253,98 @@ def decode_batch_responses(data: bytes) -> list[dict]:
     return out
 
 
+# ------------------------------------------------- anti-entropy fast path
+#
+# Batched sync manifests + multi-block deltas (docs/OPERATIONS.md). The
+# control halves (manifest, block list) negotiate protobuf like every
+# other internal hop; the delta payloads themselves ride a raw
+# octet-stream of length-prefixed roaring bitmaps — the framing helpers
+# below are protobuf-independent so a JSON-only peer still moves binary
+# block data.
+
+
+def encode_sync_manifest(entries) -> bytes:
+    """``entries``: [(field, view, shard, [(block, checksum), ...]), ...]
+    → SyncManifest bytes (one response for a whole index)."""
+    p = pb2()
+    manifest = p.SyncManifest()
+    for field, view, shard, blocks in entries:
+        fm = manifest.fragments.add()
+        fm.field, fm.view, fm.shard = field, view, int(shard)
+        for block, checksum in blocks:
+            bc = fm.blocks.add()
+            bc.block, bc.checksum = int(block), checksum
+    return manifest.SerializeToString()
+
+
+def decode_sync_manifest(data: bytes):
+    p = pb2()
+    manifest = p.SyncManifest()
+    manifest.ParseFromString(data)
+    return [
+        (fm.field, fm.view, int(fm.shard),
+         [(int(bc.block), bc.checksum) for bc in fm.blocks])
+        for fm in manifest.fragments
+    ]
+
+
+def encode_sync_blocks_request(index: str, fragments) -> bytes:
+    """``fragments``: [(field, view, shard, [block, ...]), ...] →
+    SyncBlocksRequest bytes (one POST fetches every wanted block)."""
+    p = pb2()
+    req = p.SyncBlocksRequest()
+    req.index = index
+    for field, view, shard, blocks in fragments:
+        fl = req.fragments.add()
+        fl.field, fl.view, fl.shard = field, view, int(shard)
+        fl.blocks.extend(int(b) for b in blocks)
+    return req.SerializeToString()
+
+
+def decode_sync_blocks_request(data: bytes):
+    p = pb2()
+    req = p.SyncBlocksRequest()
+    req.ParseFromString(data)
+    return req.index, [
+        (fl.field, fl.view, int(fl.shard), [int(b) for b in fl.blocks])
+        for fl in req.fragments
+    ]
+
+
+def encode_block_frames(payloads) -> bytes:
+    """Length-prefixed concatenation of roaring payloads (the delta
+    response body): ``!I`` byte length then the payload, in request
+    order. Pure struct framing — works without the protobuf runtime."""
+    import struct
+
+    parts = []
+    for payload in payloads:
+        parts.append(struct.pack("!I", len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def decode_block_frames(data: bytes) -> list[bytes]:
+    """Inverse of encode_block_frames; raises ValueError on a truncated
+    or over-long stream (a torn response must not silently drop the tail
+    blocks of a repair)."""
+    import struct
+
+    out = []
+    offset = 0
+    n = len(data)
+    while offset < n:
+        if offset + 4 > n:
+            raise ValueError("truncated block frame header")
+        (length,) = struct.unpack_from("!I", data, offset)
+        offset += 4
+        if offset + length > n:
+            raise ValueError("truncated block frame payload")
+        out.append(data[offset:offset + length])
+        offset += length
+    return out
+
+
 def decode_results_json(data: bytes) -> dict:
     """Parse a QueryResponse into the SAME dict shapes the JSON surface
     emits (executor/result.py to_json), so callers reduce remote partials

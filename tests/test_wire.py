@@ -232,6 +232,7 @@ def test_decode_results_json_matches_json_shapes():
         assert g == w, (g, w)
 
 
+@requires_proto
 def test_column_attrs_survive_protobuf():
     """columnAttrs option output rides the wire (QueryResult.column_attrs)
     and decodes back to the JSON surface's columnAttrs shape."""
