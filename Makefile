@@ -11,11 +11,17 @@
 # repair byte-identical to the per-fragment path, the ≤2-RTT diff
 # oracle, compression negotiation, and pacer bounds. bench-sync runs the
 # seeded-divergence repair benchmark (control RTTs, wall, wire bytes).
+# durability-smoke gates the write-path durability subsystem — group
+# commit batching, torn-tail fuzz, the SIGKILL crash-recovery oracle
+# (group + per-op modes), and the backup/restore round trip;
+# bench-durability measures group vs per-op write QPS at 25% write
+# fraction plus the crash and restore oracles (docs/OPERATIONS.md).
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
-	bench-ingest bench-serving bench-sync
+	durability-smoke bench-ingest bench-serving bench-sync \
+	bench-durability
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -35,6 +41,9 @@ serving-smoke:
 sync-smoke:
 	$(PYTEST) tests/test_sync_fastpath.py -m "not slow"
 
+durability-smoke:
+	$(PYTEST) tests/test_durability.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -43,3 +52,6 @@ bench-serving:
 
 bench-sync:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs sync
+
+bench-durability:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs durability

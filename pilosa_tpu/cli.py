@@ -47,6 +47,16 @@ client-pool-size = 8          # keep-alive connections retained per peer
 remote-batch = true           # coalesce same-node remote sub-queries onto
                               # /internal/query-batch (false = per-query)
 
+# Write-path durability (docs/OPERATIONS.md): what an HTTP 200 on a
+# write means
+durability-mode = "group"     # group = one fsync per commit group of
+                              # concurrent writers (acked = durable);
+                              # per-op = fsync every write; flush-only =
+                              # legacy r5 behavior (OS buffer only)
+group-commit-max-ms = 2.0     # max time a record waits for its group's
+                              # fsync to start (bounds write ACK latency)
+group-commit-max-ops = 256    # max op records fsynced per group
+
 # Anti-entropy / resize data plane (docs/OPERATIONS.md)
 sync-workers = 8              # fragment diff/fetch/apply pipeline width
                               # per repair pass
@@ -424,31 +434,96 @@ def cmd_inspect(args) -> int:
 
 
 def cmd_backup(args) -> int:
-    """Archive a data dir to a tar.gz (reference ctl backup — v0.x era;
-    the holder is file-based so a snapshot of the tree is a full backup)."""
-    import tarfile
+    """Back up to an incremental manifest directory (the default — only
+    blocks changed since any previous generation are written; see
+    docs/OPERATIONS.md runbook), or to a legacy whole-tree tar.gz when
+    the output path ends in .tar.gz/.tgz. ``--host`` backs up a LIVE
+    cluster over the anti-entropy wire (compressed, pacer-shaped);
+    ``-d`` walks a data dir in-process and must only run against a
+    STOPPED node."""
+    if args.output.endswith((".tar.gz", ".tgz")):
+        import tarfile
 
-    data_dir = os.path.expanduser(args.data_dir)
-    if not os.path.isdir(data_dir):
-        print(f"error: no data dir {data_dir}", file=sys.stderr)
-        return 1
-    with tarfile.open(args.output, "w:gz") as tar:
-        tar.add(data_dir, arcname=".")
-    print(f"backed up {data_dir} -> {args.output}")
+        if not args.data_dir:
+            print("error: tar.gz backup requires -d/--data-dir",
+                  file=sys.stderr)
+            return 1
+        data_dir = os.path.expanduser(args.data_dir)
+        if not os.path.isdir(data_dir):
+            print(f"error: no data dir {data_dir}", file=sys.stderr)
+            return 1
+        with tarfile.open(args.output, "w:gz") as tar:
+            tar.add(data_dir, arcname=".")
+        print(f"backed up {data_dir} -> {args.output}")
+        return 0
+    from pilosa_tpu.storage.backup import backup_from_host, backup_holder
+
+    if args.data_dir:
+        from pilosa_tpu.storage import Holder
+
+        if not os.path.isdir(os.path.expanduser(args.data_dir)):
+            # same validation the tar path always had: a typo'd path
+            # must not produce a confidently empty "backup"
+            print(f"error: no data dir {args.data_dir}", file=sys.stderr)
+            return 1
+        holder = Holder(args.data_dir).open()
+        try:
+            manifest = backup_holder(holder, args.output)
+        finally:
+            holder.close()
+    else:
+        from pilosa_tpu.parallel.client import InternalClient
+
+        client = InternalClient(timeout=300.0)
+        if args.max_bytes_per_sec > 0:
+            # ride the PR-4 repair pacer so a backup storm can't starve
+            # the serving traffic of the node it reads from
+            from pilosa_tpu.parallel.pacer import RepairPacer
+
+            client.pacer = RepairPacer(
+                max_bytes_per_sec=args.max_bytes_per_sec
+            )
+        try:
+            manifest = backup_from_host(args.host, args.output,
+                                        client=client)
+        except Exception as e:
+            print(f"error: backup from {args.host} failed: {e}",
+                  file=sys.stderr)
+            return 1
+    print(
+        f"backup generation {manifest['generation']} -> {args.output}: "
+        f"{len(manifest['fragments'])} fragments, "
+        f"{manifest['newBlobs']} new blobs, "
+        f"{manifest['reusedBlobs']} reused"
+    )
     return 0
 
 
 def cmd_restore(args) -> int:
-    import tarfile
-
     data_dir = os.path.expanduser(args.data_dir)
     if os.path.isdir(data_dir) and os.listdir(data_dir):
         print(f"error: {data_dir} exists and is not empty", file=sys.stderr)
         return 1
-    os.makedirs(data_dir, exist_ok=True)
-    with tarfile.open(args.input, "r:gz") as tar:
-        tar.extractall(data_dir, filter="data")
-    print(f"restored {args.input} -> {data_dir}")
+    if os.path.isfile(args.input):  # legacy whole-tree archive
+        import tarfile
+
+        os.makedirs(data_dir, exist_ok=True)
+        with tarfile.open(args.input, "r:gz") as tar:
+            tar.extractall(data_dir, filter="data")
+        print(f"restored {args.input} -> {data_dir}")
+        return 0
+    from pilosa_tpu.storage.backup import restore_holder
+
+    try:
+        manifest = restore_holder(args.input, data_dir,
+                                  generation=args.generation)
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"restored generation {manifest['generation']} -> {data_dir}: "
+        f"{manifest['restoredFragments']} fragments (digest-verified)"
+    )
     return 0
 
 
@@ -529,14 +604,32 @@ def main(argv=None) -> int:
     p.add_argument("-d", "--data-dir", required=True)
     p.set_defaults(fn=cmd_check)
 
-    p = sub.add_parser("backup", help="archive a data dir to tar.gz")
-    p.add_argument("-d", "--data-dir", required=True)
-    p.add_argument("-o", "--output", required=True)
+    p = sub.add_parser(
+        "backup",
+        help="incremental manifest backup of a data dir or live cluster "
+             "(legacy tar.gz when -o ends in .tar.gz)",
+    )
+    p.add_argument("-d", "--data-dir",
+                   help="back up a data dir in-process (node must be "
+                        "stopped)")
+    p.add_argument("--host", default=DEFAULT_HOST,
+                   help="back up a LIVE cluster over the sync wire "
+                        "(fragment data; keyed/attr stores need -d)")
+    p.add_argument("-o", "--output", required=True,
+                   help="backup directory (or .tar.gz path for legacy)")
+    p.add_argument("--max-bytes-per-sec", type=int, default=0,
+                   help="pace live-backup transfers (0 = unpaced)")
     p.set_defaults(fn=cmd_backup)
 
-    p = sub.add_parser("restore", help="restore a tar.gz backup into a data dir")
+    p = sub.add_parser(
+        "restore",
+        help="restore a backup directory (or legacy tar.gz) into an "
+             "empty data dir",
+    )
     p.add_argument("-d", "--data-dir", required=True)
     p.add_argument("-i", "--input", required=True)
+    p.add_argument("--generation", type=int, default=None,
+                   help="generation to restore (default: latest)")
     p.set_defaults(fn=cmd_restore)
 
     p = sub.add_parser("version", help="print version")

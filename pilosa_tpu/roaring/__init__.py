@@ -19,7 +19,6 @@ from pilosa_tpu.roaring.bitmap import (
 from pilosa_tpu.roaring.format import (
     serialize,
     deserialize,
-    OpLogWriter,
     replay_ops,
     OP_ADD,
     OP_REMOVE,

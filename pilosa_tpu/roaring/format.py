@@ -24,7 +24,6 @@ Layout (little-endian):
 
 from __future__ import annotations
 
-import io
 import struct
 import zlib
 
@@ -292,17 +291,6 @@ def load_any(buf: bytes | memoryview, *, strict_ops: bool = True
             return bitmap, replay_pilosa_ops(bitmap, buf, ops_at,
                                              strict=strict_ops)
     return load(buf)
-
-
-class OpLogWriter:
-    """Appends op records to an open binary file and fsyncs."""
-
-    def __init__(self, fileobj: io.BufferedWriter):
-        self.f = fileobj
-
-    def append(self, op: int, ids) -> None:
-        self.f.write(encode_op(op, ids))
-        self.f.flush()
 
 
 def load(buf: bytes | memoryview) -> tuple[RoaringBitmap, int]:

@@ -18,6 +18,7 @@ from pilosa_tpu.executor import Executor
 from pilosa_tpu.executor.result import result_to_json
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, shard_groups
 from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.storage.wal import MODE_FLUSH_ONLY
 from pilosa_tpu.storage.field import (
     TYPE_BOOL,
     TYPE_INT,
@@ -209,6 +210,14 @@ class API:
                 results = self.executor.execute(index, query, **kwargs)
             if opts:
                 results = self._apply_request_opts(index, results, opts)
+            if writes:
+                # ACK gate: a 200 means DURABLE. In group mode this
+                # parks the request until the commit thread has fsynced
+                # the group containing its op records (one fsync covers
+                # the whole wave of concurrent writers — storage/wal.py);
+                # per-op already fsynced inline, flush-only promises
+                # nothing, and both make this a no-op.
+                self._ack_durable()
             return results
         except DeadlineExceeded as e:
             self.qos.note_deadline_expired()
@@ -316,6 +325,21 @@ class API:
             except Exception as e:
                 out.append(("err", f"internal: {e}", 500))
         return out
+
+    def _ack_durable(self) -> None:
+        """Group-commit durability barrier for the current request's
+        writes (applied on THIS node — a routed write's remote portions
+        are barriered by each replica before its own 200). In the
+        fsyncing modes the key-translation log syncs too: a keyed
+        write's bit without its key→ID mapping would recover attributed
+        to a different key."""
+        wal = getattr(self.holder, "wal", None)
+        if wal is None or wal.mode == MODE_FLUSH_ONLY:
+            return
+        translate = getattr(self.holder, "translate", None)
+        if translate is not None:
+            translate.sync()
+        wal.barrier()
 
     def _apply_request_opts(self, index: str, results: list,
                             opts: dict) -> list:
@@ -516,6 +540,7 @@ class API:
             self.cluster.note_local_shards(
                 index, np.unique(shards_sorted).tolist()
             )
+        self._ack_durable()  # the import 200 means durable, same as query
         return int(changed)
 
     def _route_import(self, index, field, rows, columns, timestamps, clear,
@@ -760,6 +785,7 @@ class API:
                     index,
                     np.unique(cols_i >> SHARD_WIDTH_EXP).tolist(),
                 )
+        self._ack_durable()
         return int(changed)
 
     def import_roaring(self, index: str, field: str, shard: int, data: bytes,
@@ -800,6 +826,7 @@ class API:
         )
         if self.cluster is not None:
             self.cluster.note_local_shards(index, [shard])
+        self._ack_durable()
         return changed
 
     # --------------------------------------------------------------- export
@@ -890,6 +917,15 @@ class API:
         if batcher is not None:
             out.update(batcher.metrics())
         return out
+
+    def durability_metrics(self) -> dict:
+        """Write-path durability counters (group-commit WAL) for
+        /metrics and /debug/vars — every key present from scrape one,
+        zeros included, like the fast-lane block."""
+        wal = getattr(self.holder, "wal", None)
+        if wal is None:
+            return {}
+        return wal.metrics()
 
     def recalculate_caches(self, remote: bool = False) -> threading.Thread:
         """Authoritative recount of every fragment's TopN row cache

@@ -554,6 +554,10 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # included, like the qos block below
         for name, value in sorted(self.api.fastlane_metrics().items()):
             text += f"{prefix}_serving_{name} {value}\n"
+        # write-path durability (group-commit WAL): zeros from scrape
+        # one, same rate()-window reasoning as the blocks around it
+        for name, value in sorted(self.api.durability_metrics().items()):
+            text += f"{prefix}_wal_{name} {value}\n"
         lock = getattr(self.server, "metrics_lock", None)
         if lock is not None:
             with lock:
@@ -596,6 +600,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
                     self.server.connections_opened
                 fastlane["http_requests_total"] = self.server.requests_served
         snap["serving_fastlane"] = fastlane
+        snap["durability"] = self.api.durability_metrics()
         self._json(snap)
 
     def get_pprof(self, query=None):

@@ -54,6 +54,9 @@ class ServerConfig:
         repair_max_bytes_per_sec: int = 0,
         repair_max_inflight: int = 0,
         repair_compression: bool = True,
+        durability_mode: str = "group",
+        group_commit_max_ms: float = 2.0,
+        group_commit_max_ops: int = 256,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -104,6 +107,22 @@ class ServerConfig:
         self.repair_max_bytes_per_sec = repair_max_bytes_per_sec
         self.repair_max_inflight = repair_max_inflight
         self.repair_compression = repair_compression
+        # Write-path durability (docs/OPERATIONS.md): how an acked
+        # write reaches disk — `group` (one fsync per commit group, the
+        # default), `per-op` (fsync per write), or `flush-only` (the
+        # round-5 behavior: OS buffer only). The group knobs bound how
+        # long a record may wait for its group's fsync and how large a
+        # group may grow.
+        from pilosa_tpu.storage.wal import DURABILITY_MODES
+
+        if durability_mode not in DURABILITY_MODES:
+            raise ValueError(
+                f"invalid durability-mode {durability_mode!r} "
+                f"(want one of {', '.join(DURABILITY_MODES)})"
+            )
+        self.durability_mode = durability_mode
+        self.group_commit_max_ms = float(group_commit_max_ms)
+        self.group_commit_max_ops = int(group_commit_max_ops)
 
     @property
     def tls_enabled(self) -> bool:
@@ -181,6 +200,17 @@ class ServerConfig:
                 d.get("repair-compression",
                       d.get("repair_compression", True))
             ),
+            durability_mode=str(
+                d.get("durability-mode", d.get("durability_mode", "group"))
+            ),
+            group_commit_max_ms=float(
+                d.get("group-commit-max-ms",
+                      d.get("group_commit_max_ms", 2.0))
+            ),
+            group_commit_max_ops=int(
+                d.get("group-commit-max-ops",
+                      d.get("group_commit_max_ops", 256))
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -219,6 +249,9 @@ class ServerConfig:
             "repair-max-bytes-per-sec": self.repair_max_bytes_per_sec,
             "repair-max-inflight": self.repair_max_inflight,
             "repair-compression": self.repair_compression,
+            "durability-mode": self.durability_mode,
+            "group-commit-max-ms": self.group_commit_max_ms,
+            "group-commit-max-ops": self.group_commit_max_ops,
         }
 
 
@@ -262,7 +295,12 @@ class Server:
     def __init__(self, config: ServerConfig | None = None):
         self.config = config or ServerConfig()
         self.logger = new_standard_logger(verbose=self.config.verbose)
-        self.holder = Holder(self.config.data_dir)
+        self.holder = Holder(
+            self.config.data_dir,
+            durability_mode=self.config.durability_mode,
+            group_commit_max_ms=self.config.group_commit_max_ms,
+            group_commit_max_ops=self.config.group_commit_max_ops,
+        )
         self.api = API(self.holder)
         self._http = None
         self._http_thread = None

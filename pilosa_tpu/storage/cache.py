@@ -72,7 +72,17 @@ class RankCache:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"kind": self.kind, "counts": list(self._counts.items())}, f)
+            # fsync BEFORE the rename: os.replace is atomic in the
+            # namespace but not on the platter — a power cut mid-save
+            # could otherwise publish a torn .cache under the final name
+            # (silently "repaired" by recalculate_cache, masking the
+            # corruption)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        from pilosa_tpu.storage.wal import fsync_dir
+
+        fsync_dir(os.path.dirname(path) or ".")
 
     def load(self, path: str) -> None:
         try:

@@ -107,9 +107,11 @@ class FieldOptions:
 
 class Field:
     def __init__(self, path: str, index: str, name: str,
-                 options: FieldOptions | None = None, scope: str = ""):
+                 options: FieldOptions | None = None, scope: str = "",
+                 wal=None):
         self.path = path
         self.scope = scope
+        self.wal = wal  # holder WAL, threaded down to views/fragments
         self.index = index
         self.name = name
         self.options = options or FieldOptions()
@@ -140,6 +142,7 @@ class Field:
                     cache_type=self.options.cache_type,
                     cache_size=self.options.cache_size,
                     scope=self.scope,
+                    wal=self.wal,
                 ).open()
         from pilosa_tpu.storage.attrs import AttrStore
 
@@ -161,8 +164,17 @@ class Field:
         )
 
     def _save_meta(self) -> None:
+        # fsynced for the same reason as Index._save_meta: WAL recovery
+        # must be able to resolve this field after a power cut, or the
+        # acked ops it holds for the field are silently unreplayable
+        from pilosa_tpu.storage.wal import fsync_dir
+
         with open(os.path.join(self.path, ".meta"), "w") as f:
             json.dump(self.options.to_dict(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        fsync_dir(self.path)
+        fsync_dir(os.path.dirname(self.path) or ".")
 
     # ----------------------------------------------------------------- views
 
@@ -180,6 +192,7 @@ class Field:
                         cache_type=self.options.cache_type,
                         cache_size=self.options.cache_size,
                         scope=self.scope,
+                        wal=self.wal,
                     ).open()
                     self.views[name] = v
         return v

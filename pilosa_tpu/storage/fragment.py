@@ -2,10 +2,14 @@
 
 Reference: fragment.go (SURVEY.md §2 #3, §3.2–3.3) — the hot storage unit.
 Row ``r`` of the matrix occupies bit positions [r·2^20, (r+1)·2^20) of the
-fragment bitmap. Durability model preserved from the reference: a roaring
-snapshot file plus an append-only op log, compacted once the op count
-crosses a threshold; crash recovery = snapshot + replay (torn tails
-dropped).
+fragment bitmap. Durability model: a roaring snapshot file plus an
+append-only op log, compacted once the op count crosses a threshold;
+crash recovery = snapshot + replay (torn tails dropped). WHERE the op
+log lives depends on the holder's durability mode (storage/wal.py):
+``group`` routes records through the per-holder group-commit WAL (one
+fsync per wave of writers, fragment files hold snapshots only);
+``per-op``/``flush-only`` append to this fragment's own file as the
+reference does.
 
 TPU divergence (SURVEY.md §7.1): reads are served from dense bit-packed
 rows decoded on demand and cached in device HBM (residency.DeviceRowCache),
@@ -36,6 +40,7 @@ from pilosa_tpu.shardwidth import (
 )
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
+from pilosa_tpu.storage.wal import MODE_PER_OP, fsync_dir, wal_fsync
 
 # Snapshot (compact) once this many op records have accumulated
 # (reference fragment.go opN threshold; exact upstream value unverifiable —
@@ -59,6 +64,7 @@ class Fragment:
         cache_size: int = DEFAULT_CACHE_SIZE,
         snapshot_threshold: int = DEFAULT_SNAPSHOT_OP_THRESHOLD,
         scope: str = "",
+        wal=None,
     ):
         self.path = path
         self.index = index
@@ -66,6 +72,12 @@ class Fragment:
         self.view = view
         self.shard = shard
         self.scope = scope
+        # Holder-level write-ahead log (storage/wal.py). None (direct
+        # construction, unit tests) behaves exactly like the round-5
+        # flush-only path; a holder-provided WAL switches _log_op to the
+        # configured durability mode.
+        self.wal = wal
+        self.wal_key = f"{index}/{field}/{view}/{shard}"
         # scope leads the id: residency keys and write-routing tags must
         # never collide across two Holders in one process (in-process
         # clusters, embedded multi-server) — same-named fragments on
@@ -112,8 +124,23 @@ class Fragment:
         with self.lock:
             if not self._open:
                 return
+            if (self.wal is not None and self.wal.grouped
+                    and self.op_n > 0):
+                # group mode keeps ops only in the WAL: a clean close
+                # must snapshot so the fragment file is self-contained
+                # (and the holder can truncate the WAL afterwards)
+                self._snapshot_locked()
             self.row_cache.save(self._cache_path())
             if self._file:
+                if self.op_n > 0:
+                    # clean-close durability for the appended op tail
+                    # (flush-only/per-op modes): one fsync per fragment,
+                    # not one per op
+                    try:
+                        self._file.flush()
+                        os.fsync(self._file.fileno())
+                    except OSError:
+                        pass
                 self._file.close()
                 self._file = None
             residency.global_row_cache().invalidate_fragment(self.frag_id)
@@ -483,11 +510,37 @@ class Fragment:
         self.mutations += 1
         if self._file is None:
             return
-        self._file.write(encode_op(op, ids))
-        self._file.flush()
+        wal = self.wal
+        record = encode_op(op, ids)
+        if wal is not None and wal.grouped:
+            # group commit (storage/wal.py): the record rides the
+            # holder WAL; ONE fsync per group of concurrent writers.
+            # The ACK point (server/api.py) barriers on the WAL, so the
+            # mutator itself never blocks on the disk — and never waits
+            # while holding this fragment's lock.
+            wal.append_op(self.wal_key, record, self)
+        else:
+            self._file.write(record)
+            self._file.flush()
+            if wal is not None and wal.mode == MODE_PER_OP:
+                # true per-write durability (round 5 only flush()ed —
+                # OS-buffer-deep; see docs/OPERATIONS.md)
+                wal_fsync(self._file.fileno())
         self.op_n += 1
         if self.op_n > self.snapshot_threshold:
             self.snapshot()
+
+    def apply_recovered(self, op: int, ids) -> None:
+        """Apply one replayed WAL op (holder open, single-threaded): the
+        bitmap mutation without logging — the caller snapshots and
+        recounts caches once per touched fragment afterwards."""
+        with self.lock:
+            if op == OP_ADD:
+                self.bitmap.add_ids(ids)
+            else:
+                self.bitmap.remove_ids(ids)
+            self.mutations += 1
+        residency.global_row_cache().invalidate_fragment(self.frag_id)
 
     def snapshot(self) -> None:
         """Compact: rewrite the file as a clean snapshot, dropping the log
@@ -504,6 +557,15 @@ class Fragment:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        # a crash between the rename and the directory entry reaching
+        # disk can lose the whole snapshot: rename durability needs the
+        # parent fsynced too
+        fsync_dir(os.path.dirname(self.path))
+        if self.wal is not None:
+            # every op of this fragment appended so far (the lock is
+            # held, so the seq covers them all) is in the snapshot —
+            # release them from WAL segment retention
+            self.wal.note_snapshot(self.wal_key, self.wal.current_seq())
         self.op_n = 0
         if self._open:
             self._file = open(self.path, "ab")

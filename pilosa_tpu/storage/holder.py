@@ -3,6 +3,12 @@
 Reference: holder.go (SURVEY.md §2 #8): opens/walks ``<data-dir>/`` on
 startup (restart == checkpoint resume: every fragment reloads snapshot +
 op log — SURVEY.md §5.4), caches open fragments, exposes the schema.
+
+Durability (storage/wal.py): the holder owns the write-ahead log every
+fragment logs through. ``durability_mode`` selects group commit (one
+fsync per wave of concurrent writers; the default), per-op fsync, or the
+legacy flush-only path; ``open()`` replays any WAL segments a crash left
+behind before serving, so restart always resumes from every acked write.
 """
 
 from __future__ import annotations
@@ -13,15 +19,29 @@ import threading
 
 from pilosa_tpu.storage.index import Index, _validate_name
 from pilosa_tpu.storage.translate import TranslateStore
+from pilosa_tpu.storage.wal import (
+    DEFAULT_GROUP_MAX_MS,
+    DEFAULT_GROUP_MAX_OPS,
+    MODE_GROUP,
+    WriteAheadLog,
+)
 
 
 class Holder:
-    def __init__(self, data_dir: str):
+    def __init__(self, data_dir: str, durability_mode: str = MODE_GROUP,
+                 group_commit_max_ms: float = DEFAULT_GROUP_MAX_MS,
+                 group_commit_max_ops: int = DEFAULT_GROUP_MAX_OPS):
         self.data_dir = os.path.expanduser(data_dir)
         self.indexes: dict[str, Index] = {}
         self._create_lock = threading.Lock()
         self.translate: TranslateStore | None = None
         self._open = False
+        self.wal = WriteAheadLog(
+            os.path.join(self.data_dir, ".wal"),
+            mode=durability_mode,
+            group_max_ms=group_commit_max_ms,
+            group_max_ops=group_commit_max_ops,
+        )
 
     def open(self) -> "Holder":
         os.makedirs(self.data_dir, exist_ok=True)
@@ -31,15 +51,24 @@ class Holder:
         for entry in sorted(os.listdir(self.data_dir)):
             p = os.path.join(self.data_dir, entry)
             if os.path.isdir(p) and not entry.startswith("."):
-                self.indexes[entry] = Index(p, entry).open()
+                self.indexes[entry] = Index(p, entry, wal=self.wal).open()
+        # crash recovery: replay acked-but-unsnapshotted ops a previous
+        # group-mode run left in the WAL, snapshot the touched fragments,
+        # and start this run's log fresh (any-mode safe — see wal.py)
+        self.wal.recover(self)
+        self.wal.start()
         self._open = True
         return self
 
     def close(self) -> None:
         for idx in list(self.indexes.values()):
-            idx.close()
+            idx.close()  # group mode: dirty fragments snapshot on close
         if self.translate:
             self.translate.close()
+        # after every fragment snapshotted, the WAL truncates to nothing
+        # (clean close); a failed snapshot leaves its segment for the
+        # next open's recover()
+        self.wal.close()
         self._open = False
 
     def create_index(self, name: str, keys: bool = False, track_existence: bool = True) -> Index:
@@ -49,7 +78,7 @@ class Holder:
             _validate_name(name)
             idx = Index(
                 os.path.join(self.data_dir, name), name, keys=keys,
-                track_existence=track_existence,
+                track_existence=track_existence, wal=self.wal,
             ).open()
             self.indexes[name] = idx
             return idx
@@ -61,8 +90,18 @@ class Holder:
         idx = self.indexes.pop(name, None)
         if idx is None:
             raise KeyError(f"index {name!r} not found")
+        self.wal.tombstone(f"{name}/")
         idx.close()
         shutil.rmtree(idx.path, ignore_errors=True)
 
     def schema(self) -> list[dict]:
         return [idx.schema() for _, idx in sorted(self.indexes.items())]
+
+    # --------------------------------------------------------------- backup
+
+    def backup(self, dest: str) -> dict:
+        """Incremental manifest backup of this (open) holder into an
+        object-store-style directory — see storage/backup.py."""
+        from pilosa_tpu.storage.backup import backup_holder
+
+        return backup_holder(self, dest)

@@ -21,9 +21,11 @@ EXISTENCE_FIELD = "_exists"
 
 
 class Index:
-    def __init__(self, path: str, name: str, keys: bool = False, track_existence: bool = True):
+    def __init__(self, path: str, name: str, keys: bool = False,
+                 track_existence: bool = True, wal=None):
         self.path = path
         self.name = name
+        self.wal = wal  # holder WAL, threaded down the storage tree
         # Residency-cache scope: unique per holder data dir, so two
         # Holders in ONE process (in-process cluster tests, embedded
         # multi-server use) can never collide on device-cache keys or
@@ -61,7 +63,8 @@ class Index:
             p = os.path.join(self.path, entry)
             if os.path.isdir(p) and not entry.startswith("."):
                 self.fields[entry] = Field(p, self.name, entry,
-                                           scope=self.scope).open()
+                                           scope=self.scope,
+                                           wal=self.wal).open()
         if self.track_existence and EXISTENCE_FIELD not in self.fields:
             self.create_field(EXISTENCE_FIELD, FieldOptions(type=TYPE_SET, cache_type="none"))
         from pilosa_tpu.storage.attrs import AttrStore
@@ -76,8 +79,17 @@ class Index:
             self.column_attrs.close()
 
     def _save_meta(self) -> None:
+        # fsynced: WAL recovery resolves replayed ops through this file
+        # (and this directory entry) — a power cut that loses them would
+        # make recover() silently drop the field's acked, fsynced ops
+        from pilosa_tpu.storage.wal import fsync_dir
+
         with open(os.path.join(self.path, ".meta"), "w") as f:
             json.dump({"keys": self.keys, "trackExistence": self.track_existence}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        fsync_dir(self.path)
+        fsync_dir(os.path.dirname(self.path) or ".")
 
     # ---------------------------------------------------------------- fields
 
@@ -88,7 +100,7 @@ class Index:
             _validate_name(name, allow_internal=name == EXISTENCE_FIELD)
             field = Field(
                 os.path.join(self.path, name), self.name, name, options,
-                scope=self.scope,
+                scope=self.scope, wal=self.wal,
             ).open()
             self.fields[name] = field
             self.plan_epoch += 1
@@ -101,6 +113,8 @@ class Index:
         field = self.fields.pop(name, None)
         if field is None:
             raise KeyError(f"field {name!r} not found")
+        if self.wal is not None:
+            self.wal.tombstone(f"{self.name}/{name}/")
         field.close()
         shutil.rmtree(field.path, ignore_errors=True)
         self.plan_epoch += 1

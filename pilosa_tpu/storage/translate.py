@@ -33,6 +33,7 @@ class TranslateStore:
         self._key_to_id: dict[str, dict[str, int]] = {}
         self._id_to_key: dict[str, list[str]] = {}
         self._file = None
+        self._dirty = False  # appended-but-not-fsynced records pending
 
     # ------------------------------------------------------------- lifecycle
 
@@ -139,6 +140,23 @@ class TranslateStore:
         ns_b, key_b = namespace.encode(), key.encode()
         self._file.write(_REC.pack(len(ns_b), len(key_b)) + ns_b + key_b)
         self._file.flush()
+        self._dirty = True
+
+    def sync(self) -> None:
+        """Fsync appended key records. The write ACK gate calls this in
+        the fsyncing durability modes: an acked keyed write whose bit
+        survives a crash but whose key→ID mapping does not would come
+        back re-attributed to a DIFFERENT later key (IDs are implicit
+        in append order). No-op when nothing was appended, so unkeyed
+        writes pay nothing."""
+        with self._lock:
+            if not self._dirty or self._file is None:
+                return
+            from pilosa_tpu.storage.wal import wal_fsync
+
+            self._file.flush()
+            wal_fsync(self._file.fileno())
+            self._dirty = False
 
 
 def column_namespace(index: str) -> str:

@@ -1,0 +1,902 @@
+"""Durability subsystem: group-commit WAL, crash recovery, backup/restore.
+
+Three layers (docs/TESTING.md):
+
+1. WAL unit tests — group batching (one fsync per group of concurrent
+   writers), barrier semantics, mode switches, segment rotation +
+   checkpoint GC, tombstones, commit-failure propagation.
+2. Torn-tail fuzz — a crash mid-append may leave a partial final
+   record; recovery must drop EXACTLY that record and nothing else,
+   proven at every byte offset of the final record for both the
+   fragment op log and the WAL segment format.
+3. The crash-recovery oracle — a real subprocess node SIGKILLed mid
+   write-burst must come back with every ACKed write (group AND per-op
+   modes), bit-for-bit against the client's ACK ledger; plus the
+   backup → restore round trip, byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import RoaringBitmap
+from pilosa_tpu.roaring.format import encode_op, load, serialize
+from pilosa_tpu.storage import Holder
+from pilosa_tpu.storage.view import VIEW_STANDARD
+from pilosa_tpu.storage.wal import (
+    MODE_FLUSH_ONLY,
+    MODE_GROUP,
+    MODE_PER_OP,
+    REC_OP,
+    WriteAheadLog,
+    encode_wal_record,
+    iter_wal_records,
+)
+
+
+def _mk_holder(tmp_path, name="h", **kw):
+    return Holder(str(tmp_path / name), **kw).open()
+
+
+def _frag(holder, index="i", field="f", shard=0):
+    idx = holder.index(index) or holder.create_index(index)
+    fld = idx.field(field) or idx.create_field(field)
+    return fld.view(VIEW_STANDARD, create=True).fragment(shard, create=True)
+
+
+def _crash_copy(holder, tmp_path, name="crashed"):
+    """Simulate a crash: copy the data dir while the holder is live (no
+    close, no snapshot, no cache save) and reopen the copy."""
+    holder.wal.barrier()
+    dst = str(tmp_path / name)
+    shutil.copytree(holder.data_dir, dst)
+    return Holder(dst)
+
+
+# --------------------------------------------------------------- WAL units
+
+
+class TestGroupCommit:
+    def test_one_fsync_covers_a_group_of_concurrent_writers(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        fsyncs = []
+        h.wal._fsync = lambda fd: fsyncs.append(fd) or os.fsync(fd)
+        frags = [_frag(h, shard=s) for s in range(4)]
+        gate = threading.Event()
+
+        def writer(tid):
+            gate.wait(10)
+            for k in range(25):
+                frags[tid % 4].set_bit(1, tid * 100 + k)
+            h.wal.barrier()
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join(30)
+        m = h.wal.metrics()
+        assert m["appended_ops_total"] == 200
+        # the whole point: far fewer fsyncs than ops, and groups that
+        # actually batched concurrent writers
+        assert m["fsyncs_total"] == len(fsyncs) < 100
+        assert m["group_max_ops"] > 1
+        h.close()
+
+    def test_barrier_releases_only_after_fsync(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        fsynced = threading.Event()
+        orig = h.wal._fsync
+
+        def slow_fsync(fd):
+            time.sleep(0.05)
+            orig(fd)
+            fsynced.set()
+
+        h.wal._fsync = slow_fsync
+        frag = _frag(h)
+        frag.set_bit(1, 1)
+        assert not fsynced.is_set()  # append alone must not be "durable"
+        h.wal.barrier()
+        assert fsynced.is_set()
+        h.close()
+
+    def test_group_mode_writes_skip_fragment_file(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        frag.set_bit(1, 5)
+        h.wal.barrier()
+        with open(frag.path, "rb") as f:
+            bitmap, n_ops = load(f.read())
+        assert n_ops == 0 and bitmap.count() == 0  # ops live in the WAL
+        h.close()
+        # clean close snapshots: the file is now self-contained
+        with open(frag.path, "rb") as f:
+            bitmap, n_ops = load(f.read())
+        assert n_ops == 0 and bitmap.count() == 1
+
+    def test_per_op_mode_fsyncs_every_record(self, tmp_path, monkeypatch):
+        calls = []
+        from pilosa_tpu.storage import fragment as frag_mod
+
+        monkeypatch.setattr(frag_mod, "wal_fsync",
+                            lambda fd: calls.append(fd) or os.fsync(fd))
+        h = _mk_holder(tmp_path, durability_mode=MODE_PER_OP)
+        frag = _frag(h)
+        before = len(calls)
+        for i in range(5):
+            frag.set_bit(1, i)
+        assert len(calls) - before == 5
+        h.close()
+
+    def test_flush_only_mode_never_fsyncs_writes(self, tmp_path, monkeypatch):
+        calls = []
+        from pilosa_tpu.storage import fragment as frag_mod
+
+        monkeypatch.setattr(frag_mod, "wal_fsync",
+                            lambda fd: calls.append(fd))
+        h = _mk_holder(tmp_path, durability_mode=MODE_FLUSH_ONLY)
+        frag = _frag(h)
+        for i in range(5):
+            frag.set_bit(1, i)
+        assert not calls
+        assert h.wal.metrics()["fsyncs_total"] == 0
+        h.wal.barrier()  # must be a free no-op outside group mode
+        h.close()
+
+    def test_invalid_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="durability"):
+            Holder(str(tmp_path / "x"), durability_mode="maybe")
+        from pilosa_tpu.server import ServerConfig
+
+        with pytest.raises(ValueError, match="durability"):
+            ServerConfig(durability_mode="yolo")
+
+    def test_commit_failure_fails_the_barrier(self, tmp_path):
+        h = _mk_holder(tmp_path)
+
+        def broken(fd):
+            raise OSError("disk gone")
+
+        h.wal._fsync = broken
+        frag = _frag(h)
+        frag.set_bit(1, 1)
+        with pytest.raises(OSError, match="wal commit failed"):
+            h.wal.barrier()
+        # the write path surfaces it too, instead of acking silently
+        # volatile writes
+        h.wal._error = None  # reset so close() can finish
+        h.wal._fsync = os.fsync
+        h.close()
+
+    def test_config_knobs_roundtrip(self):
+        from pilosa_tpu.server import ServerConfig
+
+        cfg = ServerConfig.from_dict({
+            "durability-mode": "per-op",
+            "group-commit-max-ms": "7.5",
+            "group-commit-max-ops": "64",
+        })
+        assert cfg.durability_mode == "per-op"
+        assert cfg.group_commit_max_ms == 7.5
+        assert cfg.group_commit_max_ops == 64
+        d = cfg.to_dict()
+        assert d["durability-mode"] == "per-op"
+        assert d["group-commit-max-ms"] == 7.5
+        assert d["group-commit-max-ops"] == 64
+        # snake_case fallback like the sibling knobs
+        assert ServerConfig.from_dict(
+            {"durability_mode": "flush-only"}
+        ).durability_mode == "flush-only"
+
+    def test_segment_rotation_checkpoints_and_gcs(self, tmp_path,
+                                                  monkeypatch):
+        from pilosa_tpu.storage import wal as wal_mod
+
+        monkeypatch.setattr(wal_mod, "SEGMENT_MAX_BYTES", 4096)
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        for i in range(300):
+            frag.set_bit(1, i)
+            if i % 50 == 49:
+                h.wal.barrier()
+        h.wal.barrier()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if (h.wal.metrics()["checkpoints_total"] > 0
+                    and h.wal.metrics()["segments"] <= 2):
+                break
+            time.sleep(0.05)
+        m = h.wal.metrics()
+        assert m["checkpoints_total"] > 0, m
+        assert m["segments"] <= 2, m  # rotated segments were GCed
+        # the checkpoint snapshot persisted every op the GCed segments
+        # held (the active segment still covers the newest tail)
+        with open(frag.path, "rb") as f:
+            bitmap, _ = load(f.read())
+        assert bitmap.count() > 0
+        h.close()
+        h2 = Holder(str(tmp_path / "h")).open()
+        assert (h2.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+                .count_row(1)) == 300
+        h2.close()
+
+    def test_keyed_write_ack_syncs_translate_log(self, tmp_path):
+        """An acked keyed write's key→ID mapping must be as durable as
+        its bit: IDs are implicit in translate-log append order, so a
+        lost mapping would re-attribute the recovered bit to a LATER
+        key."""
+        from tests.cluster_helpers import make_cluster, req, uri
+
+        (s,) = make_cluster(tmp_path, 1)
+        try:
+            req("POST", f"{uri(s)}/index/k", {"options": {"keys": True}})
+            req("POST", f"{uri(s)}/index/k/field/f",
+                {"options": {"keys": True}})
+            req("POST", f"{uri(s)}/index/k/query",
+                b'Set("alice", f="pizza")')
+            assert s.holder.translate._dirty is False  # synced at ACK
+        finally:
+            s.close()
+
+    def test_commit_thread_death_fails_writes_not_hangs(self, tmp_path):
+        """A commit-thread failure anywhere (not just the guarded fsync)
+        must surface as a write error — a silent death would wedge every
+        write handler on a barrier that can never advance."""
+        import urllib.error
+
+        from tests.cluster_helpers import make_cluster, req, uri
+
+        (s,) = make_cluster(tmp_path, 1)
+        try:
+            req("POST", f"{uri(s)}/index/i", {})
+            req("POST", f"{uri(s)}/index/i/field/f", {})
+
+            def broken(fd):
+                raise OSError("disk gone")
+
+            s.holder.wal._fsync = broken
+            with pytest.raises(urllib.error.HTTPError) as err:
+                req("POST", f"{uri(s)}/index/i/query", b"Set(1, f=1)")
+            assert err.value.code == 500
+        finally:
+            s.holder.wal._error = None
+            s.holder.wal._fsync = os.fsync
+            s.close()
+
+    def test_wal_metrics_exported_via_api(self, tmp_path):
+        from tests.cluster_helpers import make_cluster, req, uri
+
+        (s,) = make_cluster(tmp_path, 1)
+        try:
+            req("POST", f"{uri(s)}/index/i", {})
+            req("POST", f"{uri(s)}/index/i/field/f", {})
+            req("POST", f"{uri(s)}/index/i/query", b"Set(3, f=1)")
+            text = req("GET", f"{uri(s)}/metrics", raw=True).decode()
+            assert "wal_groups_total" in text
+            assert "wal_fsyncs_total" in text
+            dv = req("GET", f"{uri(s)}/debug/vars")
+            assert dv["durability"]["appended_ops_total"] >= 1
+            assert dv["durability"]["fsyncs_total"] >= 1
+        finally:
+            s.close()
+
+
+class TestRecovery:
+    def test_crash_recovery_replays_acked_ops(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        frag.bulk_import(np.repeat([1, 2], 50),
+                         np.arange(100, dtype=np.uint64))
+        frag.set_bit(9, 99)
+        frag.clear_bit(1, 0)
+        val = _frag(h, field="v", shard=1)
+        val.set_bit(3, 7)
+        h2 = _crash_copy(h, tmp_path).open()
+        f2 = h2.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+        assert not f2.contains(1, 0)
+        assert f2.contains(1, 1) and f2.contains(2, 50)
+        assert f2.contains(9, 99)
+        v2 = h2.index("i").field("v").view(VIEW_STANDARD).fragment(1)
+        assert v2.contains(3, 7)
+        # byte-level: recovered state identical to the live writer's
+        assert f2.serialize_snapshot() == frag.serialize_snapshot()
+        h2.close()
+        h.close()
+
+    def test_recovered_row_cache_is_recounted(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        for i in range(20):
+            frag.set_bit(4, i)
+        h2 = _crash_copy(h, tmp_path).open()
+        f2 = h2.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+        assert f2.top(1) == [(4, 20)]
+        h2.close()
+        h.close()
+
+    def test_tombstone_blocks_resurrection_across_recovery(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        frag.set_bit(1, 5)
+        h.delete_index("i")
+        frag2 = _frag(h)  # recreate same names, write different data
+        frag2.set_bit(2, 6)
+        h2 = _crash_copy(h, tmp_path).open()
+        f2 = h2.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+        assert not f2.contains(1, 5)  # deleted era must not come back
+        assert f2.contains(2, 6)
+        h2.close()
+        h.close()
+
+    def test_recovery_skips_ops_for_deleted_fields(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        frag.set_bit(1, 5)
+        h.index("i").delete_field("f")
+        h2 = _crash_copy(h, tmp_path).open()
+        assert h2.index("i").field("f") is None
+        h2.close()
+        h.close()
+
+    def test_mode_switch_after_crash_still_recovers(self, tmp_path):
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        frag.set_bit(1, 5)
+        h2 = _crash_copy(h, tmp_path)
+        # the operator reconfigured durability before the restart: the
+        # group-mode WAL left by the crash must still replay
+        h2.wal.configure(mode=MODE_FLUSH_ONLY)
+        h2.open()
+        assert (h2.index("i").field("f").view(VIEW_STANDARD)
+                .fragment(0).contains(1, 5))
+        h2.close()
+        h.close()
+
+
+# ------------------------------------------------------------- torn tails
+
+
+def _fragment_file_with_ops(n_ops=3):
+    """A fragment file image: snapshot of {} + n_ops add records."""
+    base = RoaringBitmap()
+    buf = bytearray(serialize(base))
+    offsets = [len(buf)]
+    rng = np.random.default_rng(5)
+    ops = []
+    for k in range(n_ops):
+        ids = np.sort(rng.choice(1 << 18, 5 + k, replace=False)
+                      .astype(np.uint64))
+        ops.append(ids)
+        buf.extend(encode_op(1, ids))
+        offsets.append(len(buf))
+    return bytes(buf), ops, offsets
+
+
+class TestTornTails:
+    def test_fragment_log_truncation_at_every_byte_offset(self):
+        """Fuzz replay_ops with the final record truncated at EVERY byte
+        offset: recovery must drop exactly the torn record — all earlier
+        records intact, nothing of the torn one applied."""
+        buf, ops, offsets = _fragment_file_with_ops()
+        want_partial = set()
+        for ids in ops[:-1]:
+            want_partial.update(ids.tolist())
+        full_start, full_end = offsets[-2], offsets[-1]
+        for cut in range(full_start, full_end):  # every offset, incl. 0 bytes
+            bitmap, n_ops = load(buf[:cut])
+            assert n_ops == len(ops) - 1, f"cut at {cut}"
+            assert set(bitmap.to_ids().tolist()) == want_partial, \
+                f"cut at {cut}"
+        # the intact file replays everything
+        bitmap, n_ops = load(buf)
+        assert n_ops == len(ops)
+
+    def test_fragment_log_corrupt_final_crc_drops_only_that_record(self):
+        buf, ops, offsets = _fragment_file_with_ops()
+        bad = bytearray(buf)
+        bad[-1] ^= 0xFF  # flip a payload byte: crc mismatch
+        bitmap, n_ops = load(bytes(bad))
+        assert n_ops == len(ops) - 1
+        want = set()
+        for ids in ops[:-1]:
+            want.update(ids.tolist())
+        assert set(bitmap.to_ids().tolist()) == want
+
+    def test_fragment_log_garbage_tail_dropped(self):
+        buf, ops, _ = _fragment_file_with_ops()
+        bitmap, n_ops = load(buf + b"\x00garbage\xff" * 3)
+        assert n_ops == len(ops)
+
+    def test_wal_segment_truncation_at_every_byte_offset(self):
+        recs = [
+            encode_wal_record(REC_OP, "i/f/standard/0",
+                              encode_op(1, np.arange(4, dtype=np.uint64))),
+            encode_wal_record(REC_OP, "i/f/standard/1",
+                              encode_op(2, np.arange(3, dtype=np.uint64))),
+        ]
+        buf = b"".join(recs)
+        for cut in range(len(recs[0]), len(buf)):
+            got = list(iter_wal_records(buf[:cut]))
+            assert len(got) == 1, f"cut at {cut}"
+            assert got[0][1] == "i/f/standard/0"
+        assert len(list(iter_wal_records(buf))) == 2
+        # corrupt crc in the tail record: dropped, first intact
+        bad = bytearray(buf)
+        bad[-1] ^= 0x55
+        assert len(list(iter_wal_records(bytes(bad)))) == 1
+
+
+# ----------------------------------------------------- subprocess oracle
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _req(method, url, body=None, timeout=30):
+    data = (body if isinstance(body, (bytes, type(None)))
+            else json.dumps(body).encode())
+    r = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _spawn(tmp_path, name, port, mode, extra_env=None, seed_port=None):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PILOSA_TPU_NAME": name,
+        "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "0",
+        "PILOSA_TPU_HEARTBEAT_INTERVAL": "0",
+        "PILOSA_TPU_USE_MESH": "false",
+        "PILOSA_TPU_DURABILITY_MODE": mode,
+        **(extra_env or {}),
+    }
+    if seed_port is not None:
+        env["PILOSA_TPU_SEEDS"] = f"http://127.0.0.1:{seed_port}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu", "server",
+         "--data-dir", str(tmp_path / name), "--bind", "127.0.0.1",
+         "--port", str(port)],
+        env=env, cwd=repo_root,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    base = f"http://127.0.0.1:{port}"
+    for _ in range(240):
+        if proc.poll() is not None:
+            raise AssertionError(f"{name} exited rc={proc.returncode}")
+        try:
+            _req("GET", f"{base}/status", timeout=5)
+            return proc, base
+        except Exception:
+            time.sleep(0.25)
+    proc.terminate()
+    raise AssertionError(f"{name} never served /status")
+
+
+def _kill_burst_oracle(tmp_path, mode, n_writers=6, warmup_writes=30):
+    """SIGKILL a subprocess node mid write-burst and verify the restart
+    against the clients' ACK ledger: every acked column present, and
+    nothing beyond acked ∪ in-flight (bit-exact, checked offline too)."""
+    proc = None
+    port = _free_port()
+    try:
+        proc, base = _spawn(tmp_path, f"oracle-{mode}", port, mode)
+        _req("POST", f"{base}/index/i", {})
+        _req("POST", f"{base}/index/i/field/f", {})
+        acked: set[int] = set()
+        inflight: dict[int, int] = {}  # tid -> col awaiting its ACK
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def writer(tid):
+            k = 0
+            while not stop.is_set():
+                col = tid + k * n_writers
+                k += 1
+                with lock:
+                    inflight[tid] = col
+                try:
+                    out = _req("POST", f"{base}/index/i/query",
+                               f"Set({col}, f=1)".encode(), timeout=10)
+                except Exception:
+                    return  # the kill landed mid-request
+                if out == {"results": [True]}:
+                    with lock:
+                        acked.add(col)
+                        inflight.pop(tid, None)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_writers)]
+        for t in threads:
+            t.start()
+        # let the burst run, then kill mid-flight: no close(), no
+        # snapshot, no cache save, pending groups torn arbitrarily
+        deadline = time.time() + 60
+        while len(acked) < warmup_writes:
+            assert time.time() < deadline, (
+                f"burst stalled at {len(acked)} acked writes")
+            time.sleep(0.02)
+        time.sleep(0.3)
+        proc.kill()
+        proc.wait(15)
+        stop.set()
+        for t in threads:
+            t.join(15)
+        with lock:
+            acked_now = set(acked)
+            maybe = set(inflight.values())
+        assert len(acked_now) >= warmup_writes
+
+        proc, base = _spawn(tmp_path, f"oracle-{mode}", port, mode)
+        out = _req("POST", f"{base}/index/i/query", b"Row(f=1)",
+                   timeout=60)
+        got = set(out["results"][0]["columns"])
+        missing = acked_now - got
+        stray = got - acked_now - maybe
+        assert not missing, f"{mode}: lost {len(missing)} ACKed writes"
+        assert not stray, f"{mode}: {len(stray)} unexplained bits"
+        # the reopened node keeps serving writes
+        assert _req("POST", f"{base}/index/i/query",
+                    b"Set(999999, f=2)") == {"results": [True]}
+        proc.terminate()
+        proc.wait(15)
+        proc = None
+        # offline bit-exactness: the fragment equals the acked set (plus
+        # any in-flight write that happened to land) exactly
+        h = Holder(str(tmp_path / f"oracle-{mode}"),
+                   durability_mode=mode).open()
+        try:
+            frag = (h.index("i").field("f").view(VIEW_STANDARD)
+                    .fragment(0))
+            recovered = set((frag.row_columns(1)).tolist())
+            expect = acked_now | (maybe & recovered)
+            assert recovered == expect
+            want_ids = np.sort(np.fromiter(
+                ((1 << 20) + c for c in expect), np.uint64))
+            assert serialize(RoaringBitmap.from_ids(want_ids)) == \
+                serialize(RoaringBitmap.from_ids(
+                    np.sort((frag.row_columns(1)
+                             + np.uint64(1 << 20)))))
+        finally:
+            h.close()
+        return acked_now
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait(15)
+
+
+def test_sigkill_group_commit_every_acked_write_survives(tmp_path):
+    _kill_burst_oracle(tmp_path, "group")
+
+
+def test_sigkill_per_op_every_acked_write_survives(tmp_path):
+    _kill_burst_oracle(tmp_path, "per-op")
+
+
+def test_crash_then_backup_restore_round_trip(tmp_path):
+    """Crash → recover → backup → restore: the restored fragments must
+    be byte-identical to the recovered node's."""
+    acked = _kill_burst_oracle(tmp_path, "group", warmup_writes=20)
+    src_dir = str(tmp_path / "oracle-group")
+    from pilosa_tpu.storage.backup import backup_holder, restore_holder
+
+    h = Holder(src_dir).open()
+    try:
+        manifest = backup_holder(h, str(tmp_path / "bak"))
+        assert manifest["generation"] == 1
+        restore_holder(str(tmp_path / "bak"), str(tmp_path / "restored"))
+        h2 = Holder(str(tmp_path / "restored")).open()
+        try:
+            a = (h.index("i").field("f").view(VIEW_STANDARD)
+                 .fragment(0).serialize_snapshot())
+            b = (h2.index("i").field("f").view(VIEW_STANDARD)
+                 .fragment(0).serialize_snapshot())
+            assert a == b
+            got = set(h2.index("i").field("f").view(VIEW_STANDARD)
+                      .fragment(0).row_columns(1).tolist())
+            assert acked <= got
+        finally:
+            h2.close()
+    finally:
+        h.close()
+
+
+# ------------------------------------------------------- backup/restore
+
+
+class TestBackupRestore:
+    def _seed(self, tmp_path):
+        h = _mk_holder(tmp_path, "src")
+        frag = _frag(h)
+        rng = np.random.default_rng(3)
+        frag.bulk_import(
+            np.repeat([1, 2, 130], 300),
+            rng.choice(1 << 20, 900, replace=False).astype(np.uint64),
+        )
+        _frag(h, field="g", shard=2).set_bit(7, 7)
+        return h
+
+    def test_round_trip_byte_identical(self, tmp_path):
+        h = self._seed(tmp_path)
+        h.backup(str(tmp_path / "bak"))
+        from pilosa_tpu.storage.backup import restore_holder
+
+        restore_holder(str(tmp_path / "bak"), str(tmp_path / "dst"))
+        h2 = Holder(str(tmp_path / "dst")).open()
+        for iname, idx in h.indexes.items():
+            for fname, fld in idx.fields.items():
+                for vname, view in fld.views.items():
+                    for shard, frag in view.fragments.items():
+                        other = (h2.index(iname).field(fname)
+                                 .view(vname).fragment(shard))
+                        assert other is not None, (iname, fname, shard)
+                        assert (other.serialize_snapshot()
+                                == frag.serialize_snapshot())
+        h2.close()
+        h.close()
+
+    def test_incremental_generation_writes_only_changed_blocks(
+            self, tmp_path):
+        h = self._seed(tmp_path)
+        m1 = h.backup(str(tmp_path / "bak"))
+        frag = _frag(h)
+        frag.set_bit(1, 12345)  # touches ONE checksum block
+        m2 = h.backup(str(tmp_path / "bak"))
+        assert m2["generation"] == 2
+        assert m2["newBlobs"] == 1, m2  # only the changed block shipped
+        from pilosa_tpu.storage.backup import restore_holder
+
+        restore_holder(str(tmp_path / "bak"), str(tmp_path / "dst1"),
+                       generation=1)
+        restore_holder(str(tmp_path / "bak"), str(tmp_path / "dst2"),
+                       generation=2)
+        h1 = Holder(str(tmp_path / "dst1")).open()
+        h2 = Holder(str(tmp_path / "dst2")).open()
+        f1 = h1.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+        f2 = h2.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+        assert not f1.contains(1, 12345)
+        assert f2.contains(1, 12345)
+        h1.close()
+        h2.close()
+        assert m1["newBlobs"] > 1
+        h.close()
+
+    def test_corrupt_blob_fails_restore_loudly(self, tmp_path):
+        h = self._seed(tmp_path)
+        m = h.backup(str(tmp_path / "bak"))
+        h.close()
+        digest = m["fragments"]["i/f/standard/0"][0][1]
+        blob = tmp_path / "bak" / "blobs" / digest
+        import zlib
+
+        payload = bytearray(zlib.decompress(blob.read_bytes()))
+        payload[-1] ^= 0xFF
+        blob.write_bytes(zlib.compress(bytes(payload)))
+        from pilosa_tpu.storage.backup import restore_holder
+
+        with pytest.raises(ValueError, match="verification"):
+            restore_holder(str(tmp_path / "bak"), str(tmp_path / "dst"))
+
+    def test_corrupt_blob_compression_fails_restore_cleanly(self,
+                                                            tmp_path):
+        """Bit rot in the compressed stream itself (not just the
+        payload) must surface as the verification ValueError the CLI
+        reports — never a raw zlib traceback."""
+        h = self._seed(tmp_path)
+        m = h.backup(str(tmp_path / "bak"))
+        h.close()
+        digest = m["fragments"]["i/f/standard/0"][0][1]
+        blob = tmp_path / "bak" / "blobs" / digest
+        blob.write_bytes(blob.read_bytes()[: 10])  # truncated stream
+        from pilosa_tpu.storage.backup import restore_holder
+
+        with pytest.raises(ValueError, match="verification"):
+            restore_holder(str(tmp_path / "bak"), str(tmp_path / "dst"))
+
+    def test_cli_backup_rejects_missing_data_dir(self, tmp_path, capsys):
+        from pilosa_tpu.cli import main
+
+        assert main(["backup", "-d", str(tmp_path / "typo"),
+                     "-o", str(tmp_path / "bak")]) == 1
+        assert "no data dir" in capsys.readouterr().err
+        assert not (tmp_path / "bak").exists()
+
+    def test_restore_refuses_nonempty_target(self, tmp_path):
+        h = self._seed(tmp_path)
+        h.backup(str(tmp_path / "bak"))
+        h.close()
+        tgt = tmp_path / "dst"
+        tgt.mkdir()
+        (tgt / "junk").write_text("x")
+        from pilosa_tpu.storage.backup import restore_holder
+
+        with pytest.raises(ValueError, match="not empty"):
+            restore_holder(str(tmp_path / "bak"), str(tgt))
+
+    def test_cli_backup_restore_verbs(self, tmp_path, capsys):
+        from pilosa_tpu.cli import main
+
+        h = self._seed(tmp_path)
+        h.close()
+        src = str(tmp_path / "src")
+        bak = str(tmp_path / "bak")
+        assert main(["backup", "-d", src, "-o", bak]) == 0
+        assert "generation 1" in capsys.readouterr().out
+        assert main(["backup", "-d", src, "-o", bak]) == 0
+        assert "generation 2" in capsys.readouterr().out
+        assert main(["restore", "-d", str(tmp_path / "dst"), "-i", bak,
+                     "--generation", "1"]) == 0
+        assert "digest-verified" in capsys.readouterr().out
+        h1 = Holder(str(tmp_path / "dst")).open()
+        h2 = Holder(src).open()
+        a = h1.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+        b = h2.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+        assert a.serialize_snapshot() == b.serialize_snapshot()
+        h1.close()
+        h2.close()
+        # legacy tar path still works
+        assert main(["backup", "-d", src, "-o",
+                     str(tmp_path / "legacy.tar.gz")]) == 0
+        assert main(["restore", "-d", str(tmp_path / "dst-tar"), "-i",
+                     str(tmp_path / "legacy.tar.gz")]) == 0
+
+    def test_live_http_backup_rides_sync_wire(self, tmp_path):
+        from tests.cluster_helpers import make_cluster, req, uri
+
+        (s,) = make_cluster(tmp_path, 1)
+        try:
+            req("POST", f"{uri(s)}/index/i", {})
+            req("POST", f"{uri(s)}/index/i/field/f", {})
+            cols = [k * 97 for k in range(50)]
+            req("POST", f"{uri(s)}/index/i/field/f/import",
+                {"rows": [1] * len(cols), "columns": cols})
+            from pilosa_tpu.storage.backup import (
+                backup_from_host,
+                restore_holder,
+            )
+
+            m = backup_from_host(uri(s), str(tmp_path / "bak"))
+            assert m["scope"] == "fragments"
+            assert m["fragments"]
+            restore_holder(str(tmp_path / "bak"), str(tmp_path / "dst"))
+            h = Holder(str(tmp_path / "dst")).open()
+            frag = h.index("i").field("f").view(VIEW_STANDARD).fragment(0)
+            live = (s.holder.index("i").field("f").view(VIEW_STANDARD)
+                    .fragment(0))
+            assert frag.serialize_snapshot() == live.serialize_snapshot()
+            h.close()
+        finally:
+            s.close()
+
+
+# --------------------------------------------------- rolling upgrade drill
+
+
+@pytest.mark.slow
+def test_rolling_upgrade_drill_zero_lost_acked_writes(tmp_path):
+    """Stretch drill: a 3-node replica-2 cluster under a write workload
+    has one node 'upgraded' (stopped and relaunched — the PR-4
+    mixed-version machinery already proves the wire survives version
+    skew) while writers keep acking through the other nodes. Zero acked
+    writes may be lost."""
+    procs = {}
+    ports = {n: _free_port() for n in ("u0", "u1", "u2")}
+    bases = {}
+    drill_env = {"PILOSA_TPU_REPLICA_N": "2",
+                 "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "2"}
+    try:
+        seed = None
+        for name in ("u0", "u1", "u2"):
+            p, b = _spawn(tmp_path, name, ports[name], "group",
+                          extra_env=drill_env, seed_port=seed)
+            procs[name], bases[name] = p, b
+            seed = ports["u0"]
+        for b in bases.values():
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                nodes = {n["id"] for n in
+                         _req("GET", f"{b}/status")["nodes"]}
+                if nodes == {"u0", "u1", "u2"}:
+                    break
+                time.sleep(0.2)
+            assert nodes == {"u0", "u1", "u2"}
+        _req("POST", f"{bases['u0']}/index/i", {})
+        _req("POST", f"{bases['u0']}/index/i/field/f", {})
+        acked: set[int] = set()
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def writer(tid):
+            # writes round-robin the SURVIVING nodes (u0/u2) so the
+            # upgrade window can't refuse the workload; a write that
+            # errors is simply not in the ledger (the oracle is about
+            # ACKED writes only)
+            targets = [bases["u0"], bases["u2"]]
+            k = 0
+            while not stop.is_set():
+                col = tid + k * 4
+                k += 1
+                try:
+                    out = _req("POST",
+                               f"{targets[k % 2]}/index/i/query",
+                               f"Set({col}, f=1)".encode(), timeout=15)
+                    if out == {"results": [True]}:
+                        with lock:
+                            acked.add(col)
+                except Exception:
+                    pass
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 90
+        while len(acked) < 40:
+            assert time.time() < deadline, (
+                f"drill stalled at {len(acked)} acked writes")
+            time.sleep(0.05)
+        # "upgrade" u1: stop, relaunch, wait for rejoin — mid-workload
+        procs["u1"].terminate()
+        procs["u1"].wait(20)
+        p, b = _spawn(tmp_path, "u1", ports["u1"], "group",
+                      extra_env=drill_env, seed_port=ports["u0"])
+        procs["u1"], bases["u1"] = p, b
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if _req("GET", f"{b}/status")["state"] == "NORMAL":
+                break
+            time.sleep(0.25)
+        deadline = time.time() + 120
+        while len(acked) < 120:
+            assert time.time() < deadline, (
+                f"drill stalled at {len(acked)} acked writes post-upgrade")
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        with lock:
+            ledger = set(acked)
+        # the 2 s anti-entropy ticker heals any replica the upgrade
+        # window skipped; every node must converge on the full ledger
+        for name, b in bases.items():
+            deadline = time.time() + 60
+            missing = ledger
+            while time.time() < deadline:
+                out = _req("POST", f"{b}/index/i/query", b"Row(f=1)",
+                           timeout=60)
+                missing = ledger - set(out["results"][0]["columns"])
+                if not missing:
+                    break
+                time.sleep(1.0)
+            assert not missing, (
+                f"{name}: lost {len(missing)} acked writes after "
+                "rolling upgrade"
+            )
+    finally:
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(15)
+            except subprocess.TimeoutExpired:
+                p.kill()
