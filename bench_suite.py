@@ -795,6 +795,59 @@ def config_serving_readwrite(n_shards: int = 32, n_clients: int = 16,
             server.close()
 
 
+def crash_burst_ledger(post_set, kill, n_threads: int, min_acked: int,
+                       deadline_s: float = 60.0):
+    """ACK-ledger write burst + mid-burst kill for the crash-recovery
+    oracle — ONE implementation shared by config_durability and the
+    dryrun_multichip certification. ``n_threads`` writers Set() disjoint
+    columns through ``post_set`` (returns True on a 200 ack; an
+    exception means the kill landed mid-request); once ``min_acked``
+    acks accumulate, ``kill()`` fires mid-burst (SIGKILL: no close, no
+    snapshot, torn groups). Returns (acked, inflight-at-kill): the
+    recovered row must contain every acked col and nothing outside
+    acked | inflight."""
+    import threading
+
+    acked: set = set()
+    inflight: dict = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def writer(tid: int):
+        k = 0
+        while not stop.is_set():
+            col = tid + k * n_threads
+            k += 1
+            with lock:
+                inflight[tid] = col
+            try:
+                ok = post_set(col)
+            except Exception:
+                return  # the kill landed mid-request
+            if ok:
+                with lock:
+                    acked.add(col)
+                    inflight.pop(tid, None)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + deadline_s
+    while len(acked) < min_acked:
+        if time.time() > deadline:
+            raise AssertionError(
+                f"crash-oracle burst stalled at {len(acked)} acked "
+                "writes — node stopped acking")
+        time.sleep(0.02)
+    kill()
+    stop.set()
+    for t in threads:
+        t.join(15)
+    with lock:
+        return set(acked), set(inflight.values())
+
+
 def config_durability(n_shards: int = 8, n_clients: int = 16,
                       n_ops: int = 800, fsync_delay_ms: float = 8.0,
                       group_max_ms: float = 5.0) -> dict:
@@ -982,46 +1035,17 @@ def config_durability(n_shards: int = 8, n_clients: int = 16,
         group, proc, base, data_dir = run_mode("group", tmp)
 
         # ---- crash oracle: SIGKILL mid write-burst on the group node
-        acked: set = set()
-        inflight: dict = {}
-        lock = threading.Lock()
-        stop = threading.Event()
+        def burst_set(col: int) -> bool:
+            return req("POST", base, "/index/i/query",
+                       f"Set({col}, f=8)".encode(),
+                       timeout=10) == {"results": [True]}
 
-        def burst_writer(tid: int):
-            k = 0
-            while not stop.is_set():
-                col = tid + k * 8
-                k += 1
-                with lock:
-                    inflight[tid] = col
-                try:
-                    out = req("POST", base, "/index/i/query",
-                              f"Set({col}, f=8)".encode(), timeout=10)
-                except Exception:
-                    return  # the kill landed mid-request
-                if out == {"results": [True]}:
-                    with lock:
-                        acked.add(col)
-                        inflight.pop(tid, None)
+        def burst_kill():
+            proc.kill()
+            proc.wait(15)
 
-        burst = [threading.Thread(target=burst_writer, args=(t,))
-                 for t in range(8)]
-        for t in burst:
-            t.start()
-        deadline = time.time() + 60
-        while len(acked) < 60:
-            if time.time() > deadline:
-                raise AssertionError(
-                    f"crash-oracle burst stalled at {len(acked)} acked "
-                    "writes — node stopped acking")
-            time.sleep(0.02)
-        proc.kill()  # SIGKILL: no close, no snapshot, torn groups
-        proc.wait(15)
-        stop.set()
-        for t in burst:
-            t.join(15)
-        with lock:
-            ledger, maybe = set(acked), set(inflight.values())
+        ledger, maybe = crash_burst_ledger(burst_set, burst_kill,
+                                           n_threads=8, min_acked=60)
         proc, base = spawn(data_dir, "group")
         got = set(req("POST", base, "/index/i/query", b"Row(f=8)",
                       timeout=120)["results"][0]["columns"])

@@ -270,7 +270,7 @@ class Cluster:
             owned: dict[int, bool] = {}
             for field in list(idx.fields.values()):
                 for view in list(field.views.values()):
-                    view_removed = 0
+                    unowned = []
                     for shard in list(view.fragments):
                         mine = owned.get(shard)
                         if mine is None:
@@ -283,10 +283,12 @@ class Cluster:
                             )
                             owned[shard] = mine
                         if not mine:
-                            view.remove_fragment(
-                                shard, invalidate_derived=False
-                            )
-                            view_removed += 1
+                            unowned.append(shard)
+                    # bulk removal: one durable-tombstone barrier per
+                    # view, not one group-commit fsync per shard
+                    view_removed = view.remove_fragments(
+                        unowned, invalidate_derived=False
+                    )
                     if view_removed:
                         # one derived-entry purge per field, not per shard
                         view.invalidate_derived_entries()

@@ -76,11 +76,11 @@ def _write_blob(blob_dir: str, digest: str, payload: bytes) -> bool:
     return True
 
 
-def _read_blob(src: str, digest: str) -> bytes:
+def _read_raw(src: str, digest: str) -> bytes:
     path = os.path.join(src, "blobs", digest)
     with open(path, "rb") as f:
         try:
-            data = zlib.decompress(f.read())
+            return zlib.decompress(f.read())
         except zlib.error as e:
             # bit rot must surface as the designed verification error,
             # not a raw zlib traceback through the CLI
@@ -88,9 +88,34 @@ def _read_blob(src: str, digest: str) -> bytes:
                 f"backup blob {digest} fails content verification "
                 f"(corrupt compression stream: {e})"
             ) from e
+
+
+def _read_blob(src: str, digest: str) -> bytes:
+    data = _read_raw(src, digest)
     if _digest_matches(digest, data):
         return data
     raise ValueError(f"backup blob {digest} fails content verification")
+
+
+def _read_block_ids(src: str, digest: str):
+    """Read one fragment-block blob and return its verified IDs —
+    decode + digest exactly ONCE. (Fragment blobs are addressed by IDs
+    digest, so the generic _read_blob would verify via a full roaring
+    decode the caller then has to repeat.)"""
+    import struct
+
+    from pilosa_tpu.roaring.format import load
+
+    data = _read_raw(src, digest)
+    try:
+        block, _ = load(data)
+        ids = block.to_ids()
+    except (ValueError, struct.error) as e:
+        raise ValueError(
+            f"backup blob {digest} fails content verification") from e
+    if _ids_digest(ids) != digest:
+        raise ValueError(f"backup blob {digest} fails content verification")
+    return ids
 
 
 def _digest_matches(digest: str, data: bytes) -> bool:
@@ -350,6 +375,24 @@ def restore_holder(src: str, data_dir: str,
     if os.path.isdir(data_dir) and os.listdir(data_dir):
         raise ValueError(f"restore target {data_dir} is not empty")
     manifest = load_manifest(src, generation)
+    if manifest.get("scope") == "fragments":
+        # live --host backups carry fragment data only (no translate
+        # log — backup_from_host docstring): restoring a keyed index
+        # from one would silently lose every key->ID mapping and
+        # re-attribute all restored bits to whatever keys arrive next
+        keyed = sorted(
+            iname for iname, ientry in manifest.get("indexes", {}).items()
+            if ientry.get("options", {}).get("keys")
+            or any(fopts.get("keys")
+                   for fopts in ientry.get("fields", {}).values())
+        )
+        if keyed:
+            raise ValueError(
+                f"refusing to restore keyed index(es) {', '.join(keyed)} "
+                "from a fragments-scope (live --host) backup: it has no "
+                "key-translation log, so every key->ID mapping would be "
+                "lost — take an offline backup with -d instead"
+            )
     os.makedirs(data_dir, exist_ok=True)
 
     for rel, digest in sorted(manifest.get("files", {}).items()):
@@ -378,7 +421,7 @@ def restore_holder(src: str, data_dir: str,
                 _atomic_write(fmeta, json.dumps(fopts).encode())
 
     from pilosa_tpu.roaring import RoaringBitmap
-    from pilosa_tpu.roaring.format import load, serialize
+    from pilosa_tpu.roaring.format import serialize
 
     restored = 0
     for key, blocks in sorted(manifest.get("fragments", {}).items()):
@@ -396,14 +439,13 @@ def restore_holder(src: str, data_dir: str,
         os.makedirs(frag_dir, exist_ok=True)
         bitmap = RoaringBitmap()
         for block, digest in blocks:
-            payload = _read_blob(src, digest)
-            blk, _ = load(payload)
-            ids = blk.to_ids()
-            if _ids_digest(ids) != digest:
+            try:
+                ids = _read_block_ids(src, digest)
+            except ValueError as e:
                 raise ValueError(
                     f"backup block {digest} of {key} fails digest "
                     "verification; refusing to restore corrupt data"
-                )
+                ) from e
             bitmap.add_ids(ids)
         _atomic_write(os.path.join(frag_dir, shard), serialize(bitmap))
         restored += 1

@@ -149,9 +149,9 @@ class Field:
         self.row_attrs = AttrStore(os.path.join(self.path, ".rowattrs.db")).open()
         return self
 
-    def close(self) -> None:
+    def close(self, discard: bool = False) -> None:
         for v in list(self.views.values()):
-            v.close()
+            v.close(discard=discard)
         if self.row_attrs is not None:
             self.row_attrs.close()
         # drop derived device entries (stacked query leaves) tied to this

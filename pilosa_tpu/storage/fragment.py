@@ -120,19 +120,32 @@ class Fragment:
             self.snapshot()
         return self
 
-    def close(self) -> None:
+    def close(self, discard: bool = False) -> None:
+        """``discard=True`` is the delete-path close: the caller is
+        about to unlink the files, so skip the snapshot / cache-save /
+        op-tail-fsync work that would durably rewrite data the
+        tombstone already covers (a resize cleanup over many shards
+        would otherwise pay one full fsynced bitmap rewrite per
+        fragment purely to delete it)."""
         with self.lock:
             if not self._open:
                 return
-            if (self.wal is not None and self.wal.grouped
-                    and self.op_n > 0):
-                # group mode keeps ops only in the WAL: a clean close
-                # must snapshot so the fragment file is self-contained
-                # (and the holder can truncate the WAL afterwards)
-                self._snapshot_locked()
-            self.row_cache.save(self._cache_path())
+            if not discard:
+                if (self.wal is not None and self.wal.grouped
+                        and self.op_n > 0):
+                    # group mode keeps ops only in the WAL: a clean
+                    # close must snapshot so the fragment file is
+                    # self-contained (and the holder can truncate the
+                    # WAL afterwards)
+                    self._snapshot_locked()
+                self.row_cache.save(self._cache_path())
+            elif self.wal is not None and self.wal.grouped:
+                # delete path: a write in flight during the delete may
+                # have appended AFTER the tombstone's seq — release the
+                # key's segment pins or that op holds the WAL hostage
+                self.wal.discard_key(self.wal_key)
             if self._file:
-                if self.op_n > 0:
+                if self.op_n > 0 and not discard:
                     # clean-close durability for the appended op tail
                     # (flush-only/per-op modes): one fsync per fragment,
                     # not one per op

@@ -24,6 +24,7 @@ from pilosa_tpu.storage.wal import (
     DEFAULT_GROUP_MAX_OPS,
     MODE_GROUP,
     WriteAheadLog,
+    fsync_dir,
 )
 
 
@@ -50,6 +51,10 @@ class Holder:
         ).open()
         for entry in sorted(os.listdir(self.data_dir)):
             p = os.path.join(self.data_dir, entry)
+            if entry.startswith(".trash-"):
+                # a delete_index crashed between rename and rmtree
+                shutil.rmtree(p, ignore_errors=True)
+                continue
             if os.path.isdir(p) and not entry.startswith("."):
                 self.indexes[entry] = Index(p, entry, wal=self.wal).open()
         # crash recovery: replay acked-but-unsnapshotted ops a previous
@@ -90,9 +95,29 @@ class Holder:
         idx = self.indexes.pop(name, None)
         if idx is None:
             raise KeyError(f"index {name!r} not found")
+        # rename-then-tombstone makes the delete crash-atomic: the
+        # rename removes the index from the tree in one step (a restart
+        # finding no directory skips its WAL ops — never the half-state
+        # of a live index missing acked writes), the DURABLE tombstone
+        # then keeps replay from resurrecting its ops into a later
+        # same-name re-creation, and only then do the files go away.
+        # open() sweeps any .trash-* a crash leaves behind.
+        trash = os.path.join(self.data_dir, f".trash-{name}")
+        shutil.rmtree(trash, ignore_errors=True)
+        try:
+            os.rename(idx.path, trash)
+        except OSError:
+            trash = None  # already gone; nothing on disk to resurrect
+        else:
+            # the rename must reach the platter before the delete is
+            # acked — a power cut would otherwise undo it and resurrect
+            # every snapshot file (recover() only suppresses op replay)
+            fsync_dir(self.data_dir)
         self.wal.tombstone(f"{name}/")
-        idx.close()
-        shutil.rmtree(idx.path, ignore_errors=True)
+        self.wal.barrier()
+        idx.close(discard=True)
+        if trash is not None:
+            shutil.rmtree(trash, ignore_errors=True)
 
     def schema(self) -> list[dict]:
         return [idx.schema() for _, idx in sorted(self.indexes.items())]

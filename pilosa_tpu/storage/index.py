@@ -61,6 +61,10 @@ class Index:
             self._save_meta()
         for entry in sorted(os.listdir(self.path)):
             p = os.path.join(self.path, entry)
+            if entry.startswith(".trash-"):
+                # a delete_field crashed between rename and rmtree
+                shutil.rmtree(p, ignore_errors=True)
+                continue
             if os.path.isdir(p) and not entry.startswith("."):
                 self.fields[entry] = Field(p, self.name, entry,
                                            scope=self.scope,
@@ -72,9 +76,9 @@ class Index:
         self.column_attrs = AttrStore(os.path.join(self.path, ".colattrs.db")).open()
         return self
 
-    def close(self) -> None:
+    def close(self, discard: bool = False) -> None:
         for f in list(self.fields.values()):
-            f.close()
+            f.close(discard=discard)
         if self.column_attrs is not None:
             self.column_attrs.close()
 
@@ -113,10 +117,31 @@ class Index:
         field = self.fields.pop(name, None)
         if field is None:
             raise KeyError(f"field {name!r} not found")
+        # rename-then-tombstone (the delete_index pattern): the rename
+        # removes the field from the tree in one step, so a crash at
+        # any point leaves either the whole field or no field — never a
+        # live field missing acked writes; the DURABLE tombstone then
+        # keeps replay from resurrecting its ops into a same-name
+        # re-creation. open() sweeps any .trash-* a crash leaves.
+        from pilosa_tpu.storage.wal import fsync_dir
+
+        trash = os.path.join(self.path, f".trash-{name}")
+        shutil.rmtree(trash, ignore_errors=True)
+        try:
+            os.rename(field.path, trash)
+        except OSError:
+            trash = None  # already gone; nothing on disk to resurrect
+        else:
+            # the rename must reach the platter before the delete is
+            # acked — a power cut would otherwise undo it and resurrect
+            # every snapshot file (recover() only suppresses op replay)
+            fsync_dir(self.path)
         if self.wal is not None:
             self.wal.tombstone(f"{self.name}/{name}/")
-        field.close()
-        shutil.rmtree(field.path, ignore_errors=True)
+            self.wal.barrier()
+        field.close(discard=True)
+        if trash is not None:
+            shutil.rmtree(trash, ignore_errors=True)
         self.plan_epoch += 1
         self._shards_memo = None  # deletes can shrink the shard set
 

@@ -30,29 +30,37 @@ Recovery: ``recover()`` replays surviving segments on holder open. Op
 replay is a suffix re-application — each fragment's snapshot state is
 some prefix of its op sequence, and re-applying ordered add/remove
 records on top of a later state is idempotent (every bit ends at its
-LAST op's value) — so replay needs no per-fragment positions, only the
-invariant that a segment is deleted when every fragment with ops in it
-has snapshotted at or past them. Replayed fragments are snapshotted
+LAST op's value) — so replay needs no per-fragment positions, only two
+invariants: a segment is deleted when every fragment with ops in it
+has snapshotted at or past them, and segments are reclaimed
+OLDEST-FIRST so the survivors are always a contiguous tail of the log
+(out-of-order reclamation would leave a non-suffix op subset whose
+replay resurrects stale bits). Replayed fragments are snapshotted
 immediately and the segments dropped, so a restart in any mode starts
 from self-contained fragment files.
 
 WAL segment record layout (little-endian):
   magic uint16 = 0x574C ('WL'), rtype uint16 (1=op 2=tombstone),
   keylen uint16, bodylen uint32, crc32 uint32 (over key+body),
-  key bytes (utf-8 "index/field/view/shard"; tombstones may be a
-  prefix), body bytes (for ops: one roaring/format.py encode_op record)
+  key bytes (utf-8 "index/field/view/shard"; tombstone keys are either
+  a "/"-terminated prefix for index/field deletes or an exact fragment
+  key for shard deletes — see tombstone_matches),
+  body bytes (for ops: one roaring/format.py encode_op record)
 A torn tail (crash mid-append) is dropped, exactly like the fragment
 op log's crash model.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
 import time
 import weakref
 import zlib
+
+_LOG = logging.getLogger("pilosa_tpu.storage.wal")
 
 MODE_GROUP = "group"
 MODE_PER_OP = "per-op"
@@ -156,13 +164,24 @@ def decode_op_body(body: bytes):
     return op, np.frombuffer(raw, dtype="<u8")
 
 
+def tombstone_matches(key: str, tomb: str) -> bool:
+    """True when tombstone ``tomb`` deletes fragment ``key``.
+    Index/field deletes write "/"-terminated prefixes ("idx/",
+    "idx/fld/") and match everything under them; shard deletes write
+    the exact fragment key and must match ONLY it — a bare startswith
+    would make shard 1's tombstone swallow shards 10-19, 100-199, ..."""
+    if tomb.endswith("/"):
+        return key.startswith(tomb)
+    return key == tomb
+
+
 class _Segment:
     __slots__ = ("path", "start_seq", "last_seq", "nbytes")
 
     def __init__(self, path: str, start_seq: int):
         self.path = path
         self.start_seq = start_seq
-        self.last_seq: dict[str, int] = {}  # key -> last op seq written
+        self.last_seq: dict[str, int] = {}  # op key -> last seq written
         self.nbytes = 0
 
 
@@ -284,6 +303,28 @@ class WriteAheadLog:
             self._cond.notify_all()
         if t is not None:
             t.join(30)
+            if t.is_alive():
+                # the commit thread is still draining (or wedged in a
+                # slow fsync): closing the segment file under it would
+                # truncate the shutdown flush SILENTLY — its next write
+                # hits a closed file. Leave the file to the thread,
+                # keep every segment on disk for the next open's
+                # recover(), and make the condition loud: future
+                # barriers fail instead of acking volatile writes.
+                with self._cond:
+                    if self._error is None:
+                        self._error = OSError(
+                            "wal close timed out with commit backlog"
+                        )
+                    self._cond.notify_all()
+                _LOG.error(
+                    "wal: commit thread did not drain within 30s on "
+                    "close; leaving segments in %s for recovery",
+                    self.dir,
+                )
+                self._thread = None
+                self._started = False
+                return
         self._thread = None
         self._started = False
         with self._seg_lock:
@@ -307,15 +348,18 @@ class WriteAheadLog:
             if not self._buffer:
                 self._group_open_t = time.monotonic()
             self._buffer.append(
-                (key, encode_wal_record(REC_OP, key, record), seq, frag)
+                (key, encode_wal_record(REC_OP, key, record), seq, frag,
+                 REC_OP)
             )
             self._cond.notify_all()
         return seq
 
     def tombstone(self, prefix: str) -> None:
-        """Record that every fragment under ``prefix`` was deleted:
-        replay must not resurrect its ops into a later re-creation, and
-        its pending ops stop pinning segments."""
+        """Record a delete: every fragment matched by ``prefix`` (a
+        "/"-terminated index/field prefix, or one exact fragment key —
+        tombstone_matches) is gone. Replay must not resurrect its ops
+        into a later re-creation, and its pending ops stop pinning
+        segments."""
         if not self.grouped:
             return
         with self._cond:
@@ -324,14 +368,13 @@ class WriteAheadLog:
             if not self._buffer:
                 self._group_open_t = time.monotonic()
             self._buffer.append(
-                (prefix, encode_wal_record(REC_TOMBSTONE, prefix), seq, None)
+                (prefix, encode_wal_record(REC_TOMBSTONE, prefix), seq, None,
+                 REC_TOMBSTONE)
             )
             self._cond.notify_all()
-        with self._seg_lock:
-            self._tombstones.append((prefix, seq))
-            for key in list(self._dirty):
-                if key.startswith(prefix):
-                    del self._dirty[key]
+        # _tombstones (consulted by _covered for segment GC) is updated
+        # by the commit loop only once the record is DURABLE; callers
+        # that need the delete on disk follow up with barrier()
 
     def note_snapshot(self, key: str, seq: int) -> None:
         """A fragment's snapshot (fsynced file + dir) now covers all its
@@ -339,6 +382,20 @@ class WriteAheadLog:
         with self._seg_lock:
             if seq > self._snap_seq.get(key, -1):
                 self._snap_seq[key] = seq
+
+    def discard_key(self, key: str) -> None:
+        """A deleted fragment's ops need no preserving: release their
+        segment pins (coverage only — the durable tombstone still rules
+        replay). Closes the delete race where an in-flight writer
+        appends between the tombstone record and the fragment's close;
+        that late op would otherwise pin its segment — and, with
+        oldest-first reclamation, every newer one — until restart."""
+        with self._cond:
+            seq = self._seq
+        with self._seg_lock:
+            if seq > self._snap_seq.get(key, -1):
+                self._snap_seq[key] = seq
+            self._dirty.pop(key, None)
 
     def current_seq(self) -> int:
         with self._cond:
@@ -414,7 +471,7 @@ class WriteAheadLog:
                 if self._buffer:
                     self._group_open_t = time.monotonic()
             end_seq = batch[-1][2]
-            data = b"".join(rec for _, rec, _, _ in batch)
+            data = b"".join(rec for _, rec, _, _, _ in batch)
             try:
                 with self._seg_lock:
                     f, seg = self._file, self._active
@@ -432,7 +489,18 @@ class WriteAheadLog:
                 return
             with self._seg_lock:
                 seg.nbytes += len(data)
-                for key, _, seq, frag in batch:
+                for key, _, seq, frag, rtype in batch:
+                    if rtype == REC_TOMBSTONE:
+                        # register only NOW, post-fsync: _covered must
+                        # never GC op segments on the strength of a
+                        # tombstone a crash could still erase. And keep
+                        # it out of last_seq — a tombstone is not an op
+                        # and must not cover or pin anything as one.
+                        self._tombstones.append((key, seq))
+                        for k in list(self._dirty):
+                            if tombstone_matches(k, key):
+                                del self._dirty[k]
+                        continue
                     seg.last_seq[key] = seq
                     if frag is not None:
                         self._dirty[key] = weakref.ref(frag)
@@ -454,30 +522,47 @@ class WriteAheadLog:
         if self._snap_seq.get(key, -1) >= last_seq:
             return True
         return any(
-            ts_seq >= last_seq and key.startswith(prefix)
+            ts_seq >= last_seq and tombstone_matches(key, prefix)
             for prefix, ts_seq in self._tombstones
         )
 
     def _gc_segments(self, include_active: bool = False) -> None:
+        """Reclaim covered segments OLDEST-FIRST, stopping at the first
+        segment that must stay. In-order reclamation is load-bearing
+        twice over: recover() replays every surviving record as a
+        suffix re-application, so the survivors must be a contiguous
+        tail of the log — deleting a newer covered segment while an
+        older one lives would replay stale ops (an add whose later
+        remove was reclaimed) on top of a snapshot that already folded
+        them in — and it guarantees a tombstone's file outlives every
+        older segment still holding ops it must kill on replay."""
         with self._seg_lock:
-            keep = []
-            for seg in self._segments:
-                closed = include_active or seg is not self._active
-                if closed and all(
+            keep = list(self._segments)
+            while keep:
+                seg = keep[0]
+                if not include_active and seg is self._active:
+                    break
+                if not all(
                     self._covered(k, s) for k, s in seg.last_seq.items()
                 ):
-                    try:
-                        os.unlink(seg.path)
-                    except OSError:
-                        keep.append(seg)
-                else:
-                    keep.append(seg)
+                    break
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    break
+                keep.pop(0)
             if len(keep) != len(self._segments):
                 self._segments = keep
                 fsync_dir(self.dir)
-            if not keep:
-                # every tombstone predates any future record
-                self._tombstones.clear()
+            # prune tombstones that predate every surviving segment:
+            # they can never cover another surviving or future op, and
+            # _covered scans this list for every key at every
+            # checkpoint — unbounded growth under shard churn otherwise
+            min_start = keep[0].start_seq if keep else self._seq + 1
+            if self._tombstones:
+                self._tombstones = [
+                    (p, s) for p, s in self._tombstones if s >= min_start
+                ]
 
     def _spawn_checkpoint(self) -> None:
         """Snapshot the fragments pinning closed segments, then GC —
@@ -537,17 +622,50 @@ class WriteAheadLog:
         for p in paths:
             with open(p, "rb") as f:
                 records.extend(iter_wal_records(f.read()))
-        # tombstone pass: an op is dead if a LATER tombstone prefixes it
+        # tombstone pass: an op is dead if a LATER tombstone matches it
         tombs = [
             (i, key) for i, (rtype, key, _) in enumerate(records)
             if rtype == REC_TOMBSTONE
         ]
+        # redo shard deletes: an exact-key tombstone whose fragment
+        # files survived means the crash landed between the durable
+        # tombstone and remove_fragment's unlinks — finish the delete
+        # before replay. Safe for a same-key re-creation: oldest-first
+        # segment GC means every post-tombstone op is still in the log
+        # while its tombstone is, so replay rebuilds the new era in
+        # full. (Index/field deletes need no redo: their directory is
+        # renamed away atomically before the tombstone is written.)
+        for _, tk in tombs:
+            if tk.endswith("/"):
+                continue
+            parts = tk.split("/")
+            if len(parts) != 4 or not parts[3].isdigit():
+                continue
+            idx = holder.index(parts[0])
+            fld = idx.field(parts[1]) if idx is not None else None
+            view = fld.views.get(parts[2]) if fld is not None else None
+            if view is None:
+                continue
+            stale = view.fragments.pop(int(parts[3]), None)
+            if stale is not None:
+                stale.close(discard=True)
+            frag_path = os.path.join(view.path, "fragments", parts[3])
+            for p in (frag_path, frag_path + ".cache"):
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
+            # the unlink must hit the platter BEFORE the segments (and
+            # with them the tombstone) are durably erased below — a
+            # power cut could otherwise revert the volatile unlink with
+            # no tombstone left anywhere to redo it
+            fsync_dir(os.path.dirname(frag_path))
         applied = 0
         touched: dict[str, object] = {}
         for i, (rtype, key, body) in enumerate(records):
             if rtype != REC_OP:
                 continue
-            if any(ti > i and key.startswith(tk) for ti, tk in tombs):
+            if any(ti > i and tombstone_matches(key, tk) for ti, tk in tombs):
                 continue
             frag = self._resolve_fragment(holder, key)
             if frag is None:

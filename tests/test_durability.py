@@ -342,6 +342,114 @@ class TestRecovery:
         h2.close()
         h.close()
 
+    def test_shard_tombstone_does_not_swallow_decimal_siblings(
+            self, tmp_path):
+        # shard 1's tombstone is the exact key "i/f/standard/1"; a
+        # prefix match would also cover "i/f/standard/10" and drop
+        # shard 10's acked-but-unsnapshotted ops on replay
+        h = _mk_holder(tmp_path)
+        f1 = _frag(h, shard=1)
+        f10 = _frag(h, shard=10)
+        f1.set_bit(1, 1)
+        f10.set_bit(2, 2)
+        h.index("i").field("f").view(VIEW_STANDARD).remove_fragment(1)
+        h2 = _crash_copy(h, tmp_path).open()
+        v2 = h2.index("i").field("f").view(VIEW_STANDARD)
+        assert v2.fragment(10).contains(2, 2)  # acked write survived
+        f1b = v2.fragment(1)
+        assert f1b is None or not f1b.contains(1, 1)  # deleted stays dead
+        h2.close()
+        h.close()
+
+    def test_tombstone_segment_outlives_pinned_older_ops(self, tmp_path):
+        # the segment holding ONLY a tombstone must not GC while an
+        # older segment (pinned by another fragment's uncovered ops)
+        # still holds the tombstoned fragment's op records — a crash in
+        # that window would replay them with no tombstone on disk and
+        # resurrect the deleted shard with stale data (guaranteed by
+        # oldest-first segment reclamation)
+        h = _mk_holder(tmp_path)
+        fa = _frag(h, shard=0)
+        fb = _frag(h, shard=1)
+        fa.set_bit(1, 1)
+        fb.set_bit(2, 2)
+        h.wal.barrier()
+        h.wal._open_segment()  # close the segment holding both ops
+        h.index("i").field("f").view(VIEW_STANDARD).remove_fragment(0)
+        h.wal._open_segment()  # close the segment holding the tombstone
+        h.wal._gc_segments()
+        # shard 1's op pins segment one; the tombstone segment must
+        # survive with it even though all ITS records are "covered"
+        with h.wal._seg_lock:
+            assert all(os.path.exists(s.path) for s in h.wal._segments)
+            assert len(h.wal._segments) == 3
+        h2 = _crash_copy(h, tmp_path).open()
+        v2 = h2.index("i").field("f").view(VIEW_STANDARD)
+        f0 = v2.fragment(0)
+        assert f0 is None or not f0.contains(1, 1)  # no resurrection
+        assert v2.fragment(1).contains(2, 2)
+        h2.close()
+        h.close()
+
+    def test_segment_gc_is_oldest_first_suffix_preserving(self, tmp_path):
+        # out-of-order reclamation breaks the suffix-replay invariant:
+        # if the newer segment holding f's clear op were GC'd (f fully
+        # snapshot-covered) while the older segment survives (pinned by
+        # g), a crash would replay f's ADD on top of a snapshot that
+        # already folded in the clear — resurrecting the cleared bit
+        h = _mk_holder(tmp_path)
+        f = _frag(h, shard=0)
+        g = _frag(h, shard=1)
+        f.set_bit(1, 5)
+        g.set_bit(2, 6)  # pins segment one: never snapshotted
+        h.wal.barrier()
+        h.wal._open_segment()
+        f.clear_bit(1, 5)
+        h.wal.barrier()
+        h.wal._open_segment()
+        f.snapshot()  # covers BOTH of f's segments
+        h.wal._gc_segments()
+        with h.wal._seg_lock:
+            assert len(h.wal._segments) == 3  # nothing reclaimed mid-log
+        h2 = _crash_copy(h, tmp_path).open()
+        v2 = h2.index("i").field("f").view(VIEW_STANDARD)
+        assert not v2.fragment(0).contains(1, 5)  # the clear wins
+        assert v2.fragment(1).contains(2, 6)
+        h2.close()
+        h.close()
+
+    def test_recover_finishes_crashed_shard_delete(self, tmp_path):
+        # remove_fragment crashing AFTER the durable tombstone but
+        # BEFORE the unlinks must not leave the shard resurrected from
+        # its snapshot file: recover() redoes the delete
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        frag.set_bit(1, 5)
+        frag.snapshot()  # bit durable in the fragment FILE itself
+        h.wal.tombstone(frag.wal_key)
+        h.wal.barrier()  # ...and remove_fragment crashes right here
+        h2 = _crash_copy(h, tmp_path).open()
+        v2 = h2.index("i").field("f").view(VIEW_STANDARD)
+        f0 = v2.fragment(0)
+        assert f0 is None or not f0.contains(1, 5)
+        assert not os.path.exists(os.path.join(v2.path, "fragments", "0"))
+        h2.close()
+        h.close()
+
+    def test_open_sweeps_crashed_delete_trash_dirs(self, tmp_path):
+        # delete_index/delete_field rename to .trash-* before removing;
+        # a crash in between must not resurrect it on the next open
+        h = _mk_holder(tmp_path)
+        frag = _frag(h)
+        frag.set_bit(1, 5)
+        h.close()
+        os.rename(str(tmp_path / "h" / "i"),
+                  str(tmp_path / "h" / ".trash-i"))
+        h2 = Holder(str(tmp_path / "h")).open()
+        assert h2.index("i") is None
+        assert not os.path.exists(str(tmp_path / "h" / ".trash-i"))
+        h2.close()
+
     def test_recovery_skips_ops_for_deleted_fields(self, tmp_path):
         h = _mk_holder(tmp_path)
         frag = _frag(h)
@@ -679,6 +787,25 @@ class TestBackupRestore:
         h2.close()
         assert m1["newBlobs"] > 1
         h.close()
+
+    def test_restore_refuses_keyed_index_from_fragments_scope(
+            self, tmp_path):
+        # a live --host backup has no translate log: restoring a keyed
+        # index from one would silently re-attribute every bit
+        from pilosa_tpu.storage.backup import (
+            _finish_generation,
+            restore_holder,
+        )
+
+        _finish_generation(str(tmp_path / "bak"), {
+            "generation": 1,
+            "scope": "fragments",
+            "indexes": {"k": {"options": {"keys": True}, "fields": {}}},
+            "fragments": {},
+            "files": {},
+        })
+        with pytest.raises(ValueError, match="key-translation"):
+            restore_holder(str(tmp_path / "bak"), str(tmp_path / "dst"))
 
     def test_corrupt_blob_fails_restore_loudly(self, tmp_path):
         h = self._seed(tmp_path)
