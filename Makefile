@@ -20,8 +20,8 @@
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
-	durability-smoke bench-ingest bench-serving bench-sync \
-	bench-durability
+	durability-smoke obs-smoke bench-ingest bench-serving bench-sync \
+	bench-durability bench-tracing
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -44,6 +44,12 @@ sync-smoke:
 durability-smoke:
 	$(PYTEST) tests/test_durability.py -m "not slow"
 
+# obs-smoke: start a node, run a traced query, assert /debug/traces
+# renders the span tree, /debug/queries shows-then-clears, and /metrics
+# is stock-Prometheus parseable (docs/OBSERVABILITY.md)
+obs-smoke:
+	$(PYTEST) tests/test_tracing.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -55,3 +61,6 @@ bench-sync:
 
 bench-durability:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs durability
+
+bench-tracing:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs tracing

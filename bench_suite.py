@@ -1803,6 +1803,161 @@ def config_hostpath(n_shards: int = 8) -> dict:
     }
 
 
+def config_tracing(n_shards: int = 8, n_queries: int = 256,
+                   n_clients: int = 32, repeats: int = 4) -> dict:
+    """Tracing overhead gate (ISSUE 7): the observability plane must be
+    effectively free when off and cheap when sampling.
+
+    One in-process server, keep-alive clients (the fast-lane transport),
+    four plateau passes on the SAME data/queries, best-of-``repeats``:
+
+    - ``bare``: trace sampling 0 AND the in-flight inspector disabled —
+      the fast-lane serving plateau with every observability hook on its
+      cheapest path. This is the baseline.
+    - ``off``: shipping defaults — sampling 0, inspector ON (the
+      /debug/queries view is always-on in production). Gate: >= 99% of
+      bare (disabled tracing costs <= 1%).
+    - ``sampled``: trace-sample-rate 0.01. Gate: >= 95% of bare
+      (1%-sampled tracing costs <= 5%).
+    - ``full``: rate 1.0 — informational: what always-on tracing costs.
+
+    Sanity oracle: the full pass must actually produce span trees whose
+    roots are http.query with executor + wave children, and the
+    in-flight tracker must be empty once the run drains."""
+    import http.client as _hc
+    import threading
+
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+    from pilosa_tpu.utils.tracing import (
+        global_query_tracker,
+        global_tracer,
+    )
+
+    rng = np.random.default_rng(11)
+    tracer = global_tracer()
+    tracker = global_query_tracker()
+    with tempfile.TemporaryDirectory() as tmp:
+        server = Server(ServerConfig(
+            data_dir=tmp, port=0, name="bench-tracing",
+            anti_entropy_interval=0, heartbeat_interval=0,
+        )).open()
+        try:
+            idx = server.holder.create_index("t")
+            f = idx.create_field("f")
+            n = int(SHARD_WIDTH * 0.05)
+            for shard in range(n_shards):
+                frag = f.view(VIEW_STANDARD, create=True).fragment(
+                    shard, create=True
+                )
+                for row in range(1, 5):
+                    frag.bulk_import(
+                        np.full(n, row, np.uint64),
+                        rng.choice(SHARD_WIDTH, n, replace=False).astype(
+                            np.uint64
+                        ),
+                    )
+            server.api.cluster.note_local_shards("t", list(range(n_shards)))
+            port = server.port
+            queries = [
+                "Count(Intersect(Row(f={}), Row(f={})))".format(
+                    1 + (i % 4), 1 + ((i + 1) % 4))
+                for i in range(n_queries)
+            ]
+
+            def run_once() -> float:
+                results = [None] * n_queries
+                errors: list = []
+                gate = threading.Event()
+
+                def worker(tid):
+                    conn = _hc.HTTPConnection("localhost", port,
+                                              timeout=120)
+                    gate.wait(30)
+                    for k in range(tid, n_queries, n_clients):
+                        try:
+                            conn.request("POST", "/index/t/query",
+                                         body=queries[k].encode())
+                            results[k] = conn.getresponse().read()
+                        except Exception as e:  # surfaced below
+                            errors.append(repr(e))
+                    conn.close()
+
+                threads = [threading.Thread(target=worker, args=(t,))
+                           for t in range(n_clients)]
+                for t in threads:
+                    t.start()
+                t0 = time.perf_counter()
+                gate.set()
+                for t in threads:
+                    t.join(300)
+                if errors or None in results:
+                    raise RuntimeError(f"bench errors: {errors[:3]}")
+                return n_queries / (time.perf_counter() - t0)
+
+            run_once()  # warm: compiles the batched program shapes
+
+            def plateau(sample_rate: float, inspector: bool) -> float:
+                tracer.sample_rate = sample_rate
+                tracker.enabled = inspector
+                try:
+                    return max(run_once() for _ in range(repeats))
+                finally:
+                    tracer.sample_rate = 0.0
+                    tracker.enabled = True
+
+            bare = plateau(0.0, inspector=False)
+            off = plateau(0.0, inspector=True)
+            sampled = plateau(0.01, inspector=True)
+            full = plateau(1.0, inspector=True)
+
+            # sanity oracle on the full pass's trees
+            trees = tracer.recent()
+            roots = {t["name"] for t in trees}
+            span_names: set = set()
+
+            def walk(node):
+                span_names.add(node["name"])
+                for c in node.get("children", []):
+                    walk(c)
+
+            for t in trees:
+                walk(t)
+            traces_ok = (
+                "http.query" in roots
+                and "executor.Execute" in span_names
+                and "pipeline.wave" in span_names
+            )
+            drained = not tracker.snapshot()
+        finally:
+            tracer.sample_rate = 0.0
+            tracker.enabled = True
+            server.close()
+
+    off_ratio = off / max(bare, 1e-9)
+    sampled_ratio = sampled / max(bare, 1e-9)
+    ok = (off_ratio >= 0.99 and sampled_ratio >= 0.95
+          and traces_ok and drained)
+    return {
+        "config": "tracing",
+        "metric": "tracing_off_plateau_ratio",
+        "value": round(off_ratio, 4),
+        "unit": "fraction of bare fast-lane plateau",
+        "bare_qps": round(bare, 1),
+        "off_qps": round(off, 1),
+        "sampled_1pct_qps": round(sampled, 1),
+        "full_sampled_qps": round(full, 1),
+        "sampled_ratio": round(sampled_ratio, 4),
+        "full_ratio": round(full / max(bare, 1e-9), 4),
+        "traces_ok": bool(traces_ok),
+        "inflight_drained": bool(drained),
+        "queries": n_queries, "clients": n_clients, "shards": n_shards,
+        "gates": {"off_vs_bare": ">=0.99", "sampled_vs_bare": ">=0.95"},
+        "ok": bool(ok),
+    }
+
+
 def _spawn_cpu_mesh_entry() -> None:
     """Run config5_mesh_cpu8 in a subprocess pinned to an 8-device
     virtual CPU platform (the axon TPU plugin would otherwise own the
@@ -1839,7 +1994,7 @@ def main() -> None:
     parser.add_argument(
         "--configs",
         default="1,2,3,4,5,mesh8,serving,import,ingest,sync,hostpath,"
-                "durability",
+                "durability,tracing",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -1880,6 +2035,10 @@ def main() -> None:
             n_divergent=64 if args.full else 32,
         ),
         "hostpath": lambda: config_hostpath(n_shards=8),
+        "tracing": lambda: config_tracing(
+            n_queries=512 if args.full else 256,
+            repeats=5 if args.full else 4,
+        ),
         "durability": lambda: config_durability(
             n_ops=1600 if args.full else 800,
             n_clients=32 if args.full else 16,

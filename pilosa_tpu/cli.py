@@ -74,7 +74,14 @@ qos-hedge-delay = 0.25        # hedge trigger before the p95 tracker warms up
 qos-hedge-budget = 0.05       # max hedges as a fraction of reads; 0 disables
 qos-breaker-threshold = 5     # consecutive faults before a breaker opens
 qos-breaker-cooldown = 5.0    # open -> half-open probe interval (seconds)
-tracing = false               # span collection on /debug/traces
+tracing = false               # legacy always-on switch (= sample rate 1.0)
+trace-sample-rate = 0.0       # probabilistic trace sampling: 0 = off
+                              # (zero overhead), 0.01 = 1% of requests
+                              # root a cross-node span tree on
+                              # /debug/traces (docs/OBSERVABILITY.md)
+# trace-log-dir = ""          # where POST /debug/trace-device writes JAX
+                              # profiler captures (default:
+                              # <data-dir>/jax-traces)
 # statsd = "127.0.0.1:8125"   # statsd UDP sink (Prometheus /metrics is
                               # always on)
 # diagnostics-endpoint = ""   # phone-home URL; empty = off

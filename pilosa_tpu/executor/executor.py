@@ -234,7 +234,9 @@ def instrument_calls(index_name: str, calls, run_one) -> list:
 
     stats = global_stats()
     out = []
-    with global_tracer().span("executor.Execute", index=index_name):
+    # root_span: joins the request's trace under the HTTP root, or roots
+    # its own tree for direct in-process callers (tests, CLI)
+    with global_tracer().root_span("executor.Execute", index=index_name):
         for call in calls:
             with global_tracer().span(f"execute{call.name}"), stats.timer(
                 "query", {"call": call.name}
@@ -603,10 +605,13 @@ class Executor:
     def _dispatch(self, node, reduce_kind: str, leaves, scalars):
         import jax.numpy as jnp
 
+        from pilosa_tpu.utils.tracing import global_tracer
+
         fn = self._program(
             node, reduce_kind, tuple(l.ndim - 1 for l in leaves), len(scalars)
         )
-        return fn(*leaves, *(jnp.asarray(s, jnp.int32) for s in scalars))
+        with global_tracer().span("device.dispatch", reduce=reduce_kind):
+            return fn(*leaves, *(jnp.asarray(s, jnp.int32) for s in scalars))
 
     def _batched_eval(self, idx: Index, compiled: _Compiled, block,
                       reduce_kind: str, extra_leaves=()):
@@ -704,7 +709,14 @@ class Executor:
         args = [leaf for leaves, _ in padded for leaf in leaves]
         if n_scalars:
             args.append(np.asarray([s for _, s in padded], np.int32))
-        group["out"] = fn(*args)
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        # the span lands in the trace of whichever request flushed the
+        # group — truthful attribution: that request paid the dispatch,
+        # its batchmates ride for free (tagged with the shared size)
+        with global_tracer().span("device.dispatch", reduce=reduce_kind,
+                                  batch=len(rows)):
+            group["out"] = fn(*args)
         if self._pending.get(key) is group:
             del self._pending[key]
 

@@ -102,9 +102,15 @@ class InternalClient:
 
         return contextlib.nullcontext()
 
-    def _repair_headers(self) -> dict | None:
-        return ({"Accept-Encoding": "deflate"}
-                if self.compress_repair else None)
+    def _repair_headers(self, trace: str | None = None) -> dict | None:
+        headers = {}
+        if self.compress_repair:
+            headers["Accept-Encoding"] = "deflate"
+        if trace is not None:
+            from pilosa_tpu.utils.tracing import TRACE_HEADER
+
+            headers[TRACE_HEADER] = trace
+        return headers or None
 
     @staticmethod
     def _decode_body(resp) -> bytes:
@@ -172,7 +178,8 @@ class InternalClient:
     # ---------------------------------------------------------------- query
 
     def query_node(self, uri: str, index: str, pql: str, shards: list[int],
-                   remote: bool = True, deadline=None) -> dict:
+                   remote: bool = True, deadline=None,
+                   trace: str | None = None) -> dict:
         """One sub-query carrying an explicit shard list (reference
         QueryRequest{Remote: true, Shards: [...]} — SURVEY.md §3.2).
 
@@ -184,18 +191,28 @@ class InternalClient:
         ``deadline`` (qos.Deadline) rides the hop as a remaining-budget
         header AND caps the transport timeout, so a stalled peer is
         abandoned when the root's budget runs out — not after the full
-        client timeout."""
+        client timeout.
+
+        ``trace`` (an ``X-Pilosa-Trace`` value) marks the hop as part of
+        a sampled trace: the peer roots a span under it and returns its
+        finished subtree as a ``"trace"`` key in the response dict."""
         def hop_kwargs():
             """Deadline header + transport cap from the budget remaining
             NOW — recomputed for the JSON fallback after a 406, so a
             failed protobuf attempt's latency is not re-granted to the
             peer as budget."""
+            headers = {}
+            if trace is not None:
+                from pilosa_tpu.utils.tracing import TRACE_HEADER
+
+                headers[TRACE_HEADER] = trace
             if deadline is None:
-                return {}, None
+                return headers, None
             from pilosa_tpu.qos.deadline import DEADLINE_HEADER
 
             deadline.check("remote hop")
-            return ({DEADLINE_HEADER: str(deadline.to_millis())},
+            headers[DEADLINE_HEADER] = str(deadline.to_millis())
+            return (headers,
                     min(self.timeout, max(deadline.remaining(), 1e-3)))
 
         qs = f"?shards={','.join(map(str, shards))}"
@@ -236,12 +253,14 @@ class InternalClient:
         (flips False after one 404/405 — older wire)."""
         return uri not in self._no_batch_peers
 
-    def query_batch(self, uri: str, items: list[tuple[str, str, list[int]]]
-                    ) -> list[dict]:
+    def query_batch(self, uri: str, items: list) -> list[dict]:
         """Ship several same-node remote sub-queries as ONE internal
         request (the cluster-wide analog of the local wave coalescer —
-        server/pipeline.py): ``items`` is ``[(index, pql, shards), ...]``;
-        returns one response dict per item, each either
+        server/pipeline.py): ``items`` is ``[(index, pql, shards), ...]``
+        (optionally a 4th element: the item's ``X-Pilosa-Trace`` value —
+        sampled wavemates keep their trace context through the shared
+        POST, and the peer's per-item span subtree rides back as a
+        ``"trace"`` key); returns one response dict per item, each either
         ``{"results": [...]}`` or ``{"error": ..., "status": ...}``.
 
         Negotiates a protobuf body/response like query_node (per-peer 406
@@ -271,9 +290,10 @@ class InternalClient:
             else:
                 return decode_batch_responses(raw)
         body = json.dumps({"queries": [
-            {"index": index, "query": pql,
-             "shards": [int(s) for s in shards]}
-            for index, pql, shards in items
+            {"index": item[0], "query": item[1],
+             "shards": [int(s) for s in item[2]],
+             **({"trace": item[3]} if len(item) > 3 and item[3] else {})}
+            for item in items
         ]}).encode()
         try:
             out = self._call("POST", url, body)
@@ -407,23 +427,31 @@ class InternalClient:
         (flips False after one 404/405 — older wire)."""
         return uri not in self._no_manifest_peers
 
-    def sync_manifest(self, uri: str, index: str
+    def sync_manifest(self, uri: str, index: str, trace: str | None = None
                       ) -> list[tuple[str, str, int, list]]:
         """One RTT for a whole index's sync state: every (field, view,
         shard) → [(block, checksum)] the peer holds. Protobuf with the
         per-peer 406 JSON fallback; a peer without the route answers
         404/405, recorded in ``_no_manifest_peers`` and re-raised so the
-        caller falls back to the per-fragment blocks path."""
+        caller falls back to the per-fragment blocks path. ``trace``
+        (X-Pilosa-Trace) lets a sampled repair pass attribute the peer's
+        serving cost in its local span ring."""
         from pilosa_tpu.utils.stats import global_stats
 
         url = f"{uri}/internal/sync/manifest?index={index}"
+        trace_headers = None
+        if trace is not None:
+            from pilosa_tpu.utils.tracing import TRACE_HEADER
+
+            trace_headers = {TRACE_HEADER: trace}
         global_stats().count("sync_manifest_fetches", 1)
         if self._proto_ok(uri):
             from pilosa_tpu.wire.serializer import decode_sync_manifest
 
             try:
                 raw = self._call("GET", url, raw=True,
-                                 accept="application/x-protobuf")
+                                 accept="application/x-protobuf",
+                                 headers=trace_headers)
             except ClientError as e:
                 if e.status in (404, 405):
                     self._no_manifest_peers.add(uri)
@@ -434,7 +462,7 @@ class InternalClient:
             else:
                 return decode_sync_manifest(raw)
         try:
-            out = self._call("GET", url)
+            out = self._call("GET", url, headers=trace_headers)
         except ClientError as e:
             if e.status in (404, 405):
                 self._no_manifest_peers.add(uri)
@@ -447,7 +475,8 @@ class InternalClient:
             for e in out.get("fragments", [])
         ]
 
-    def sync_blocks(self, uri: str, index: str, fragments) -> list:
+    def sync_blocks(self, uri: str, index: str, fragments,
+                    trace: str | None = None) -> list:
         """Multi-block delta fetch: ``fragments`` is
         ``[(field, view, shard, [block, ...]), ...]``; returns one parsed
         RoaringBitmap per requested block, in flattened request order.
@@ -473,7 +502,7 @@ class InternalClient:
                         "POST", url,
                         encode_sync_blocks_request(index, fragments),
                         content_type="application/x-protobuf",
-                        headers=self._repair_headers(),
+                        headers=self._repair_headers(trace),
                         want_response=True,
                     )
             except ClientError as e:
@@ -492,7 +521,7 @@ class InternalClient:
             try:
                 with self._repair_slot():
                     resp = self._call("POST", url, body,
-                                      headers=self._repair_headers(),
+                                      headers=self._repair_headers(trace),
                                       want_response=True)
             except ClientError as e:
                 if e.status in (404, 405):

@@ -1119,7 +1119,19 @@ class Cluster:
         delta POST per (fragment, peer). Peers whose wire predates the
         sync routes (404 once) fall back per-peer to the r5 per-fragment
         path; post-repair state is byte-identical either way, and the
-        mutex/bool/BSI conflict-aware merge rules are unchanged."""
+        mutex/bool/BSI conflict-aware merge rules are unchanged.
+
+        A sampled pass (trace-sample-rate) roots a ``sync.pass`` trace:
+        per-peer manifest and delta spans nest under it and each peer's
+        serving-side span lands in that peer's local /debug/traces under
+        the propagated trace id (docs/OBSERVABILITY.md)."""
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        with global_tracer().root_span("sync.pass"):
+            return self._sync_holder_pass(peer_entries, skip)
+
+    def _sync_holder_pass(self, peer_entries: dict | None = None,
+                          skip: set | None = None) -> dict:
         from pilosa_tpu.utils.stats import global_stats
 
         t0 = time.perf_counter()
@@ -1194,8 +1206,20 @@ class Cluster:
         def one(node):
             if not self.client.supports_sync_manifest(node.uri):
                 return node.id, "legacy"
+            from pilosa_tpu.utils.tracing import global_tracer
+
             try:
-                entries = self.client.sync_manifest(node.uri, index_name)
+                # sync.manifest span + X-Pilosa-Trace on the hop when a
+                # sampled sync pass is active (sync_holder roots it);
+                # the kwarg rides only when sampled so client doubles
+                # predating it keep working on the untraced path
+                with global_tracer().span("sync.manifest",
+                                          node=node.id) as span:
+                    kw = ({"trace": span.header_value()}
+                          if span is not None else {})
+                    entries = self.client.sync_manifest(
+                        node.uri, index_name, **kw,
+                    )
             except ClientError:
                 if not self.client.supports_sync_manifest(node.uri):
                     return node.id, "legacy"  # 404/405: old wire
@@ -1294,13 +1318,21 @@ class Cluster:
         /internal/sync/blocks, per-block GETs otherwise (old wire). A
         transport fault skips the peer for this fragment — the next pass
         retries."""
+        from pilosa_tpu.utils.tracing import global_tracer
+
         field_name, view_name, shard = key
         if self.client.supports_sync_manifest(node.uri):
             try:
-                bitmaps = self.client.sync_blocks(
-                    node.uri, index_name,
-                    [(field_name, view_name, shard, wanted)],
-                )
+                with global_tracer().span(
+                    "sync.blocks", node=node.id, blocks=len(wanted),
+                ) as span:
+                    kw = ({"trace": span.header_value()}
+                          if span is not None else {})
+                    bitmaps = self.client.sync_blocks(
+                        node.uri, index_name,
+                        [(field_name, view_name, shard, wanted)],
+                        **kw,
+                    )
                 return list(zip(wanted, bitmaps))
             except ClientError:
                 if self.client.supports_sync_manifest(node.uri):

@@ -315,7 +315,18 @@ class ClusterExecutor:
         p95-tracked hedge delay races a budgeted duplicate at the next
         replica. DeadlineExceeded propagates — an expired budget is a
         property of the REQUEST, so replica retries must not chase it."""
+        from pilosa_tpu.utils.tracing import current_query
+
         pql = call.to_pql()
+        # in-flight inspector (GET /debug/queries): count this fan-out's
+        # shards as outstanding, decrementing as each node's group
+        # settles — plain attribute writes on the request's record
+        inflight = current_query() if _depth == 0 else None
+        if inflight is not None:
+            inflight.shards_outstanding = (
+                (inflight.shards_outstanding or 0)
+                + sum(len(g[1]) for g in groups)
+            )
 
         def one(group):
             node, shard_group = group
@@ -362,7 +373,18 @@ class ClusterExecutor:
                     index_name, call, retry, _depth + 1, deadline=deadline,
                 )
 
-        return [p for chunk in concurrent_map(one, groups) for p in chunk]
+        def one_tracked(group):
+            try:
+                return one(group)
+            finally:
+                if inflight is not None:
+                    inflight.shards_outstanding = max(
+                        0, (inflight.shards_outstanding or 0)
+                        - len(group[1]),
+                    )
+
+        return [p for chunk in concurrent_map(one_tracked, groups)
+                for p in chunk]
 
     # ------------------------------------------------------- hedged reads
 
@@ -403,7 +425,8 @@ class ClusterExecutor:
         at least 1 s) counts even at expiry. A 4xx is a deterministic
         query error every replica would repeat — never node evidence.
         Inconclusive outcomes release a half-open probe seat without
-        moving state."""
+        moving state. (See _map_remote for the inspector's
+        shards-outstanding accounting.)"""
         if isinstance(exc, ClientError) and exc.is_node_fault:
             fair_chance = max(1.0, 4 * self.qos.hedge.delay())
             if (deadline is None or not deadline.expired
@@ -443,14 +466,41 @@ class ClusterExecutor:
         Eligibility: batching enabled, deadline-free, and a depth-0
         primary leg — deadline-capped hops keep their per-hop transport
         cap, and hedge/fallback legs (depth ≥ 1) must not queue behind
-        the very primary they are racing."""
-        if self.remote_batch and deadline is None and _depth == 0:
-            return self.wave_batcher.query(node, index_name, pql,
-                                           shard_group)
-        dl_kw = {"deadline": deadline} if deadline is not None else {}
-        return self.cluster.client.query_node(node.uri, index_name, pql,
-                                              shard_group, remote=True,
-                                              **dl_kw)
+        the very primary they are racing.
+
+        Tracing: when this request is sampled, the leg gets a
+        ``remote.query`` span, the hop carries ``X-Pilosa-Trace``, and
+        the peer's returned span subtree is grafted under the leg — the
+        coordinator's /debug/traces then shows one tree spanning the
+        cluster (docs/OBSERVABILITY.md)."""
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        with global_tracer().span(
+            "remote.query", node=node.id, shards=len(shard_group),
+            depth=_depth,
+        ) as span:
+            trace = span.header_value() if span is not None else None
+            if self.remote_batch and deadline is None and _depth == 0:
+                out = self.wave_batcher.query(node, index_name, pql,
+                                              shard_group, trace=trace)
+            else:
+                # kwargs only when set: test doubles (and older client
+                # shims) that predate the trace/deadline keywords keep
+                # working on the untraced common path
+                kw = {}
+                if deadline is not None:
+                    kw["deadline"] = deadline
+                if trace is not None:
+                    kw["trace"] = trace
+                out = self.cluster.client.query_node(
+                    node.uri, index_name, pql, shard_group, remote=True,
+                    **kw,
+                )
+            if span is not None and isinstance(out, dict):
+                subtree = out.pop("trace", None)
+                if subtree is not None:
+                    span.add_remote(subtree)
+            return out
 
     def _query_group(self, index_name: str, call: Call, pql: str, node,
                      shard_group, _depth, deadline):
@@ -501,6 +551,8 @@ class ClusterExecutor:
             breaker.record_success()
             return [out["results"][0]]
 
+        import contextvars
+
         cv = threading.Condition()
         state: dict = {}
 
@@ -523,7 +575,12 @@ class ClusterExecutor:
                 breaker.record_success()
                 finish("result", ("primary", [out["results"][0]]))
 
-        threading.Thread(target=run_primary, daemon=True,
+        # hedge-race legs run on bare threads: capture this context so
+        # their remote.query spans land in the request's trace instead
+        # of being orphaned (utils/tracing.py)
+        primary_ctx = contextvars.copy_context()
+        threading.Thread(target=lambda: primary_ctx.run(run_primary),
+                         daemon=True,
                          name=f"qos-primary-{node.id}").start()
         delay = qos.hedge.delay()
         if deadline is not None:
@@ -546,17 +603,23 @@ class ClusterExecutor:
                 hedged = True
 
                 def run_hedge():
+                    from pilosa_tpu.utils.tracing import global_tracer
+
                     try:
-                        partials = self._map_remote(
-                            index_name, call, alt_groups, _depth + 1,
-                            deadline=deadline,
-                        )
+                        with global_tracer().span("qos.hedge",
+                                                  primary=node.id):
+                            partials = self._map_remote(
+                                index_name, call, alt_groups, _depth + 1,
+                                deadline=deadline,
+                            )
                     except BaseException as e:
                         finish("hedge_err", e)
                     else:
                         finish("result", ("hedge", partials))
 
-                threading.Thread(target=run_hedge, daemon=True,
+                hedge_ctx = contextvars.copy_context()
+                threading.Thread(target=lambda: hedge_ctx.run(run_hedge),
+                                 daemon=True,
                                  name=f"qos-hedge-{node.id}").start()
 
         def settled():

@@ -36,6 +36,8 @@ import threading
 from collections import deque
 from urllib.parse import urlsplit
 
+from pilosa_tpu.utils.tracing import global_tracer
+
 # Retryable symptoms of the keep-alive race: the server closed a pooled
 # connection between our health check and the request hitting its socket.
 # Only ever retried when the connection was REUSED and nothing of the
@@ -178,10 +180,18 @@ class ConnectionPool:
         effective = self.timeout if timeout is None else timeout
         last_exc: Exception | None = None
         for fresh in (False, True):
-            conn = None if fresh else self._checkout(key)
-            reused = conn is not None
-            if conn is None:
-                conn = self._connect(key)
+            # conn.checkout span: pool acquisition cost per request —
+            # whether this hop rode a pooled keep-alive socket or paid a
+            # fresh TCP connect is exactly the fast-lane property the
+            # pool exists for (no-op when the request is unsampled)
+            with global_tracer().span("conn.checkout",
+                                      host=f"{key[1]}:{key[2]}") as cspan:
+                conn = None if fresh else self._checkout(key)
+                reused = conn is not None
+                if conn is None:
+                    conn = self._connect(key)
+                if cspan is not None:
+                    cspan.tags["reused"] = reused
             # per-request timeout: conn.timeout only applies at connect,
             # so a reused connection's live socket is re-armed explicitly
             # (and RESET when no per-request cap rides this call — the
@@ -189,7 +199,9 @@ class ConnectionPool:
             conn.timeout = effective
             if conn.sock is None:
                 try:
-                    conn.connect()
+                    with global_tracer().span("conn.connect",
+                                              host=f"{key[1]}:{key[2]}"):
+                        conn.connect()
                     # request/response hops are latency-bound small
                     # writes: never let Nagle hold the tail packet
                     conn.sock.setsockopt(socket.IPPROTO_TCP,

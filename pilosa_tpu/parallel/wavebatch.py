@@ -101,27 +101,38 @@ class RemoteWaveBatcher:
 
     # -------------------------------------------------------------- public
 
-    def query(self, node, index: str, pql: str, shards) -> dict:
+    def query(self, node, index: str, pql: str, shards,
+              trace: str | None = None) -> dict:
         """One remote sub-query through the per-node group-commit lane.
         Returns the same ``{"results": [...]}`` dict ``query_node``
-        would; raises ClientError on failure."""
+        would; raises ClientError on failure. ``trace`` (an
+        X-Pilosa-Trace value) rides the batch per item, so a sampled
+        sub-query keeps its trace context even when it shares a POST
+        with unsampled wavemates — the peer's span subtree comes back
+        inside this item's response dict."""
         client = self.client
         if (not getattr(client, "supports_batch", lambda uri: False)(node.uri)
                 or not hasattr(client, "query_batch")):
             # older peer wire, or a test double without the batch verb
             self._count(fallbacks=1)
-            return client.query_node(node.uri, index, pql, shards,
-                                     remote=True)
+            return self._query_direct(node, index, pql, shards, trace)
         nq = self._node_queue(node.id)
         slot = _Slot()
         with nq.lock:
-            nq.pending.append((index, pql, shards, slot))
+            nq.pending.append((index, pql, shards, trace, slot))
             leader = not nq.flushing
             if leader:
                 nq.flushing = True
         if leader:
             self._flush_loop(node, nq)
         return slot.wait()
+
+    def _query_direct(self, node, index, pql, shards, trace):
+        """Per-query path: the trace keyword rides only when set, so
+        client doubles that predate it keep working untraced."""
+        kw = {"trace": trace} if trace is not None else {}
+        return self.client.query_node(node.uri, index, pql, shards,
+                                      remote=True, **kw)
 
     # ------------------------------------------------------------ internals
 
@@ -179,15 +190,23 @@ class RemoteWaveBatcher:
     def _send(self, node, batch: list) -> None:
         client = self.client
         if len(batch) == 1:
-            index, pql, shards, slot = batch[0]
+            index, pql, shards, trace, slot = batch[0]
             self._count(solo=1)
             try:
-                slot.resolve(client.query_node(node.uri, index, pql, shards,
-                                               remote=True))
+                slot.resolve(self._query_direct(node, index, pql, shards,
+                                                trace))
             except BaseException as e:
                 slot.resolve(error=e)
             return
-        items = [(index, pql, shards) for index, pql, shards, _ in batch]
+        # untraced batches (the overwhelmingly common case) keep the
+        # plain 3-tuple item shape; the 4th trace element appears only
+        # when some wavemate is sampled
+        if any(t is not None for _, _, _, t, _ in batch):
+            items = [(index, pql, shards, trace)
+                     for index, pql, shards, trace, _ in batch]
+        else:
+            items = [(index, pql, shards)
+                     for index, pql, shards, _, _ in batch]
         try:
             responses = client.query_batch(node.uri, items)
             if len(responses) != len(batch):
@@ -208,7 +227,8 @@ class RemoteWaveBatcher:
             return
         self._count(batches=1, batched_queries=len(batch))
         try:
-            for (index, pql, shards, slot), resp in zip(batch, responses):
+            for (index, pql, shards, _, slot), resp in zip(batch,
+                                                           responses):
                 if not isinstance(resp, dict):
                     # malformed peer item (e.g. null): this slot fails,
                     # well-formed batchmates still resolve normally
@@ -233,10 +253,10 @@ class RemoteWaveBatcher:
 
     def _replay_individually(self, node, batch: list) -> None:
         def one(entry):
-            index, pql, shards, slot = entry
+            index, pql, shards, trace, slot = entry
             try:
-                slot.resolve(self.client.query_node(node.uri, index, pql,
-                                                    shards, remote=True))
+                slot.resolve(self._query_direct(node, index, pql, shards,
+                                                trace))
             except BaseException as e:
                 slot.resolve(error=e)
 

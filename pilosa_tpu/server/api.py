@@ -80,6 +80,15 @@ class API:
         # deque(maxlen): append is atomic and bounded, so concurrent HTTP
         # handler threads can't interleave an append/trim pair (ADVICE r1)
         self.long_queries: collections.deque[dict] = collections.deque(maxlen=100)
+        # exported from scrape one (/metrics); lock: += from concurrent
+        # handler threads would lose increments (same hazard the deque
+        # comment above documents)
+        self.slow_queries_total = 0
+        self._slow_lock = threading.Lock()
+        # live JAX profiler capture (POST /debug/trace-device): one at a
+        # time; empty dir string = default under the data dir
+        self.trace_log_dir: str = ""
+        self._device_trace_lock = threading.Lock()
         self.logger = None
         # reference max-writes-per-request server knob: reject queries
         # carrying more write calls than this (0 = unlimited)
@@ -138,17 +147,47 @@ class API:
         from pilosa_tpu.executor.executor import PQLError
         from pilosa_tpu.pql import ParseError
         from pilosa_tpu.qos import AdmissionError, DeadlineExceeded
+        from pilosa_tpu.utils.tracing import (
+            global_query_tracker,
+            global_tracer,
+        )
 
+        tracer = global_tracer()
+        tracker = global_query_tracker()
+        inflight = tracker.start(index, pql, tenant=tenant, remote=remote)
+        inflight_token = (tracker.activate(inflight)
+                          if inflight is not None else None)
         slot = None
-        if not remote:
-            try:
-                slot = self.qos.admission.admit(tenant)
-            except AdmissionError as e:
-                err = ApiError(str(e), 429)
-                err.retry_after = e.retry_after
-                raise err from e
+        try:
+            if not remote:
+                if inflight is not None:
+                    inflight.stage = "admission"
+                try:
+                    with tracer.span("qos.admit", tenant=tenant):
+                        slot = self.qos.admission.admit(tenant)
+                except AdmissionError as e:
+                    err = ApiError(str(e), 429)
+                    err.retry_after = e.retry_after
+                    raise err from e
+            return self._query_raw_admitted(
+                index, pql, shards, remote, opts, tenant, deadline,
+                slot, inflight, tracer,
+            )
+        finally:
+            tracker.finish(inflight, inflight_token)
+
+    def _query_raw_admitted(self, index, pql, shards, remote, opts,
+                            tenant, deadline, slot, inflight, tracer):
+        import time
+
+        from pilosa_tpu.executor.executor import PQLError
+        from pilosa_tpu.pql import ParseError
+        from pilosa_tpu.qos import DeadlineExceeded
+
         t0 = time.perf_counter()
         try:
+            if inflight is not None:
+                inflight.stage = "parse"
             query = pql
             if isinstance(pql, str):
                 from pilosa_tpu.pql import parse
@@ -194,6 +233,8 @@ class API:
                         and shards is None and deadline is None
                         and not remote and not opts):
                     key = (index, pql)
+                if inflight is not None:
+                    inflight.stage = "pipeline.wave"
                 deferreds = self._pipeline.run(index, query, kwargs,
                                                key=key)
                 # Same stats/trace envelope as Executor.execute (shared
@@ -201,12 +242,16 @@ class API:
                 # i.e. what this request actually waited for.
                 from pilosa_tpu.executor.executor import instrument_calls
 
+                if inflight is not None:
+                    inflight.stage = "executor.resolve"
                 handles = iter(deferreds)
                 results = instrument_calls(
                     index, query.calls,
                     lambda call: next(handles).result(),
                 )
             else:
+                if inflight is not None:
+                    inflight.stage = "executor.execute"
                 results = self.executor.execute(index, query, **kwargs)
             if opts:
                 results = self._apply_request_opts(index, results, opts)
@@ -217,6 +262,8 @@ class API:
                 # the whole wave of concurrent writers — storage/wal.py);
                 # per-op already fsynced inline, flush-only promises
                 # nothing, and both make this a no-op.
+                if inflight is not None:
+                    inflight.stage = "wal.barrier"
                 self._ack_durable()
             return results
         except DeadlineExceeded as e:
@@ -229,11 +276,27 @@ class API:
                 slot.release()
             elapsed = time.perf_counter() - t0
             if self.long_query_time > 0 and elapsed >= self.long_query_time:
+                from pilosa_tpu.utils.tracing import current_span
+
                 entry = {
-                    "index": index, "pql": pql[:1024],
+                    "index": index,
+                    "pql": (pql if isinstance(pql, str)
+                            else str(pql))[:1024],
                     "seconds": round(elapsed, 4),
                     "at": dt.datetime.now(dt.timezone.utc).isoformat(),
                 }
+                cur = current_span()
+                if cur is not None:
+                    # sampled offender: the ring keeps its FULL span tree
+                    # (snapshot as-of now; open ancestors render with
+                    # duration-to-date), so a slow query is explained,
+                    # not just counted. Unsampled slow queries keep the
+                    # text entry only — raise trace-sample-rate to
+                    # explain a recurring one.
+                    entry["traceId"] = cur.trace_id
+                    entry["trace"] = cur.root().to_json()
+                with self._slow_lock:
+                    self.slow_queries_total += 1
                 self.long_queries.append(entry)
                 if self.logger is not None:
                     self.logger.warning(
@@ -264,21 +327,36 @@ class API:
 
     def query_batch(self, items: list) -> list:
         """Execute a wave-batched internal request (/internal/query-batch):
-        ``items`` is ``[(index, pql, shards), ...]`` — remote sub-queries
-        a peer coalesced toward this node. Every item is SUBMITTED before
-        any is resolved, so the batch shares micro-batched device
-        dispatches exactly like a local wave (server/pipeline.py).
+        ``items`` is ``[(index, pql, shards), ...]`` (optionally a 4th
+        element: the item's ``X-Pilosa-Trace`` context) — remote
+        sub-queries a peer coalesced toward this node. Every item is
+        SUBMITTED before any is resolved, so the batch shares
+        micro-batched device dispatches exactly like a local wave
+        (server/pipeline.py).
 
-        Returns one outcome per item: ``("ok", [raw results])`` or
-        ``("err", message, status)`` — per-item isolation, one bad
-        sub-query cannot poison its batchmates. Write calls are rejected
-        per item: the batch route exists for coalesced reads, and remote
-        write fan-out keeps its eager per-request semantics."""
+        Returns one outcome per item: ``("ok", [raw results])`` —
+        ``("ok", [raw results], span_tree)`` when the item carried trace
+        context — or ``("err", message, status)``; per-item isolation,
+        one bad sub-query cannot poison its batchmates. Write calls are
+        rejected per item: the batch route exists for coalesced reads,
+        and remote write fan-out keeps its eager per-request
+        semantics."""
         from pilosa_tpu.executor.executor import PQLError, instrument_calls
         from pilosa_tpu.pql import ParseError, parse
+        from pilosa_tpu.utils.tracing import global_tracer, use_span
 
+        tracer = global_tracer()
         submitted: list = []
-        for index, pql, shards in items:
+        for item in items:
+            index, pql, shards = item[0], item[1], item[2]
+            trace_hdr = item[3] if len(item) > 3 else None
+            # one remote-root span per traced batch item; its submit and
+            # resolve phases re-activate it below so device spans nest
+            # correctly, and the finished subtree rides the response
+            # back to the coordinator's tree
+            span = tracer.remote_span(trace_hdr, "rpc.query",
+                                      node=self.node_id(), index=index,
+                                      batched=True)
             try:
                 query = parse(pql)
                 if query.write_calls():
@@ -290,12 +368,19 @@ class API:
                 if getattr(self.executor, "accepts_remote", False):
                     kwargs["remote"] = True
                 if hasattr(self.executor, "submit"):
-                    handles = self.executor.submit(index, query, **kwargs)
-                    submitted.append(("defs", index, query, handles))
+                    if span is not None:
+                        with use_span(span):
+                            handles = self.executor.submit(index, query,
+                                                           **kwargs)
+                    else:
+                        handles = self.executor.submit(index, query,
+                                                       **kwargs)
+                    submitted.append(("defs", index, query, handles, span))
                 else:
                     submitted.append(
                         ("eager", index, query,
-                         self.executor.execute(index, query, **kwargs)))
+                         self.executor.execute(index, query, **kwargs),
+                         span))
             except (ParseError, PQLError) as e:
                 submitted.append(("err", str(e), 400))
             except ApiError as e:
@@ -307,17 +392,28 @@ class API:
             if entry[0] == "err":
                 out.append(entry)
                 continue
-            kind, index, query, payload = entry
+            kind, index, query, payload, span = entry
             try:
                 if kind == "defs":
                     handles = iter(payload)
-                    results = instrument_calls(
-                        index, query.calls,
-                        lambda call: next(handles).result(),
-                    )
+                    if span is not None:
+                        with use_span(span):
+                            results = instrument_calls(
+                                index, query.calls,
+                                lambda call: next(handles).result(),
+                            )
+                    else:
+                        results = instrument_calls(
+                            index, query.calls,
+                            lambda call: next(handles).result(),
+                        )
                 else:
                     results = payload
-                out.append(("ok", results))
+                if span is not None:
+                    tracer.finish_root(span)
+                    out.append(("ok", results, span.to_json()))
+                else:
+                    out.append(("ok", results))
             except (ParseError, PQLError) as e:
                 out.append(("err", str(e), 400))
             except ApiError as e:
@@ -336,10 +432,13 @@ class API:
         wal = getattr(self.holder, "wal", None)
         if wal is None or wal.mode == MODE_FLUSH_ONLY:
             return
-        translate = getattr(self.holder, "translate", None)
-        if translate is not None:
-            translate.sync()
-        wal.barrier()
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        with global_tracer().span("wal.barrier"):
+            translate = getattr(self.holder, "translate", None)
+            if translate is not None:
+                translate.sync()
+            wal.barrier()
 
     def _apply_request_opts(self, index: str, results: list,
                             opts: dict) -> list:
@@ -883,6 +982,54 @@ class API:
 
     def version(self) -> dict:
         return {"version": __version__}
+
+    def node_id(self) -> str:
+        return self.cluster.local.id if self.cluster is not None else "local"
+
+    def observability_metrics(self) -> dict:
+        """Tracing / inspector / slow-query series for /metrics and
+        /debug/vars — every key present from scrape one, zeros included,
+        like the other exporter blocks."""
+        from pilosa_tpu.utils.tracing import (
+            global_query_tracker,
+            global_tracer,
+        )
+
+        out = {"slow_queries_total": self.slow_queries_total}
+        out.update(global_tracer().metrics())
+        out.update(global_query_tracker().metrics())
+        return out
+
+    def start_device_trace(self, seconds: float) -> dict:
+        """Capture a live JAX profiler trace around ``seconds`` of real
+        traffic (POST /debug/trace-device) into the configured log dir.
+        One capture at a time — the profiler is a process-global
+        singleton, so a second concurrent request answers 409."""
+        import os
+        import time as _time
+
+        from pilosa_tpu.utils.tracing import start_jax_trace
+
+        seconds = float(seconds)
+        if not 0 < seconds <= 60:
+            raise ApiError("secs must be in (0, 60]")
+        log_dir = os.path.expanduser(
+            self.trace_log_dir
+            or os.path.join(self.holder.data_dir, "jax-traces")
+        )
+        if not self._device_trace_lock.acquire(blocking=False):
+            raise ApiError("a device trace capture is already running", 409)
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            t0 = _time.perf_counter()
+            with start_jax_trace(log_dir):
+                _time.sleep(seconds)
+            return {
+                "logDir": log_dir,
+                "seconds": round(_time.perf_counter() - t0, 3),
+            }
+        finally:
+            self._device_trace_lock.release()
 
     def pipeline_metrics(self) -> dict:
         """Wave-coalescing counters for the exporters (zeros until the

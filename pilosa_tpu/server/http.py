@@ -66,7 +66,10 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
     ("GET", re.compile(r"^/debug/traces$"), "get_traces"),
+    ("GET", re.compile(r"^/debug/queries$"), "get_inflight_queries"),
+    ("GET", re.compile(r"^/debug/queries/slow$"), "get_long_queries"),
     ("GET", re.compile(r"^/debug/long-queries$"), "get_long_queries"),
+    ("POST", re.compile(r"^/debug/trace-device$"), "post_trace_device"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
     ("GET", re.compile(r"^/debug/pprof/?$"), "get_pprof"),
 ]
@@ -333,40 +336,86 @@ class HTTPHandler(BaseHTTPRequestHandler):
         })
 
         tenant, deadline = self._qos_envelope(remote=remote)
-        if not proto_out:
-            if self.api.serve_fastlane:
-                # fast lane: the response envelope arrives pre-serialized
-                # (hot shapes encode straight to bytes; identical deduped
-                # wavemates share one encoding — executor/result.py)
-                self._raw(self.api.query_json_bytes(
-                    index, pql, shards=shards, remote=remote, opts=opts,
-                    tenant=tenant, deadline=deadline))
-            else:  # r5-shaped legacy path (serve_fastlane = False)
-                self._json(self.api.query(index, pql, shards=shards,
-                                          remote=remote, opts=opts,
-                                          tenant=tenant, deadline=deadline))
-            return
-        from pilosa_tpu.wire.serializer import encode_error, encode_results
 
-        retry_after = None
-        try:
-            results = self.api.query_raw(index, pql, shards=shards,
+        # Tracing roots (utils/tracing.py): an EDGE request makes the
+        # sampling decision here (one tree per request, or a suppressed
+        # context so inner sites can't root their own); a REMOTE
+        # sub-query carrying X-Pilosa-Trace joins the coordinator's
+        # trace and returns its finished span subtree in the response so
+        # the caller renders ONE cluster-wide tree.
+        from pilosa_tpu.utils.tracing import TRACE_HEADER, global_tracer
+
+        tracer = global_tracer()
+        trace_hdr = self.headers.get(TRACE_HEADER) if remote else None
+        if remote:
+            root_cm = tracer.remote_root(
+                trace_hdr, "rpc.query", node=self.api.node_id(),
+                index=index,
+            )
+        else:
+            root_cm = tracer.request_root("http.query", index=index,
+                                          tenant=tenant)
+        with root_cm as root:
+            if not proto_out:
+                if self.api.serve_fastlane:
+                    # fast lane: the response envelope arrives
+                    # pre-serialized (hot shapes encode straight to
+                    # bytes; identical deduped wavemates share one
+                    # encoding — executor/result.py)
+                    payload = self.api.query_json_bytes(
+                        index, pql, shards=shards, remote=remote,
+                        opts=opts, tenant=tenant, deadline=deadline)
+                    if root is not None and trace_hdr:
+                        # splice the finished subtree into the closing
+                        # brace of the pre-serialized envelope — sampled
+                        # remote hops are rare (rate-bounded), so the
+                        # fast lane's zero-build path is untouched
+                        root.finish()
+                        payload = (payload[:-1] + b',"trace":'
+                                   + json.dumps(
+                                       root.to_json(),
+                                       separators=(",", ":")).encode()
+                                   + b"}")
+                    self._raw(payload)
+                else:  # r5-shaped legacy path (serve_fastlane = False)
+                    out = self.api.query(index, pql, shards=shards,
                                          remote=remote, opts=opts,
                                          tenant=tenant, deadline=deadline)
-            payload = encode_results(results)
-            status = 200
-        except ApiError as e:
-            payload = encode_error(str(e))
-            status = e.status
-            retry_after = getattr(e, "retry_after", None)
-        self.send_response(status)
-        self.send_header("Content-Type", "application/x-protobuf")
-        self.send_header("Content-Length", str(len(payload)))
-        if retry_after is not None:
-            # admission shed: same backoff hint the JSON route sends
-            self.send_header("Retry-After", str(max(1, int(retry_after))))
-        self.end_headers()
-        self.wfile.write(payload)
+                    if root is not None and trace_hdr:
+                        root.finish()
+                        out["trace"] = root.to_json()
+                    self._json(out)
+                return
+            from pilosa_tpu.wire.serializer import (
+                encode_error,
+                encode_results,
+            )
+
+            retry_after = None
+            try:
+                results = self.api.query_raw(index, pql, shards=shards,
+                                             remote=remote, opts=opts,
+                                             tenant=tenant,
+                                             deadline=deadline)
+                trace_json = None
+                if root is not None and trace_hdr:
+                    root.finish()
+                    trace_json = root.to_json()
+                payload = encode_results(results, trace=trace_json)
+                status = 200
+            except ApiError as e:
+                payload = encode_error(str(e))
+                status = e.status
+                retry_after = getattr(e, "retry_after", None)
+            self.send_response(status)
+            self.send_header("Content-Type", "application/x-protobuf")
+            self.send_header("Content-Length", str(len(payload)))
+            if retry_after is not None:
+                # admission shed: same backoff hint the JSON route sends
+                self.send_header("Retry-After",
+                                 str(max(1, int(retry_after))))
+            self.end_headers()
+            self.wfile.write(payload)
 
     def post_query_batch(self, query=None):
         """Cluster-wide wave batching receiver: several remote
@@ -398,7 +447,8 @@ class HTTPHandler(BaseHTTPRequestHandler):
             raise ApiError(f"invalid JSON body: {e}") from e
         items = [
             (q.get("index", ""), q.get("query", ""),
-             [int(s) for s in (q.get("shards") or [])])
+             [int(s) for s in (q.get("shards") or [])],
+             q.get("trace") or None)
             for q in body.get("queries", [])
         ]
         from pilosa_tpu.executor.result import results_json_bytes
@@ -408,8 +458,16 @@ class HTTPHandler(BaseHTTPRequestHandler):
             if outcome[0] == "ok":
                 # identical bytes to a per-query /index/{i}/query
                 # response — the batch route must be a pure transport
-                # optimization (gated by `make serving-smoke`)
-                parts.append(results_json_bytes(outcome[1]))
+                # optimization (gated by `make serving-smoke`); a traced
+                # item (rare, sample-rate-bounded) splices its span
+                # subtree into the envelope like the per-query route
+                part = results_json_bytes(outcome[1])
+                if len(outcome) > 2 and outcome[2] is not None:
+                    part = (part[:-1] + b',"trace":'
+                            + json.dumps(outcome[2],
+                                         separators=(",", ":")).encode()
+                            + b"}")
+                parts.append(part)
             else:
                 parts.append(json.dumps(
                     {"error": outcome[1], "status": outcome[2]},
@@ -531,58 +589,88 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
     def get_metrics(self, query=None):
         from pilosa_tpu.storage.residency import global_row_cache
-        from pilosa_tpu.utils.stats import global_stats
+        from pilosa_tpu.utils.stats import global_stats, prometheus_block
 
         stats = global_stats()
-        text = stats.prometheus_text()
+        seen: set = set()  # page-wide family-metadata dedupe
+        text = stats.prometheus_text(seen)
         prefix = getattr(stats, "prefix", "pilosa_tpu")
-        text += global_row_cache().prometheus_lines(prefix)
+        text += global_row_cache().prometheus_lines(prefix, seen=seen)
         # wave coalescing health: queries/waves ratio is the batch
         # factor operators size concurrency against (OPERATIONS.md);
         # exported as 0 from scrape one so rate() windows never see the
-        # series appear mid-flight
+        # series appear mid-flight. Every block below renders through
+        # prometheus_block, which leads each family with # HELP/# TYPE
+        # (docs/OBSERVABILITY.md — a stock Prometheus scrape must ingest
+        # the whole page).
         pm = self.api.pipeline_metrics()
-        text += (
-            f"{prefix}_serving_waves_total {pm['waves']}\n"
-            f"{prefix}_serving_coalesced_requests_total "
-            f"{pm['coalesced']}\n"
-            f"{prefix}_serving_deduped_requests_total "
-            f"{pm['deduped']}\n"
+        text += prometheus_block(
+            {"waves_total": pm["waves"],
+             "coalesced_requests_total": pm["coalesced"],
+             "deduped_requests_total": pm["deduped"]},
+            prefix, "serving", seen=seen,
         )
         # serving fast lane (connection pool, remote wave batching, HTTP
         # keep-alive oracle): all series present from scrape one, zeros
         # included, like the qos block below
-        for name, value in sorted(self.api.fastlane_metrics().items()):
-            text += f"{prefix}_serving_{name} {value}\n"
-        # write-path durability (group-commit WAL): zeros from scrape
-        # one, same rate()-window reasoning as the blocks around it
-        for name, value in sorted(self.api.durability_metrics().items()):
-            text += f"{prefix}_wal_{name} {value}\n"
+        fastlane = self.api.fastlane_metrics()
         lock = getattr(self.server, "metrics_lock", None)
         if lock is not None:
             with lock:
-                conns = self.server.connections_opened
-                reqs = self.server.requests_served
-            text += (
-                f"{prefix}_serving_http_connections_total {conns}\n"
-                f"{prefix}_serving_http_requests_total {reqs}\n"
-            )
+                fastlane["http_connections_total"] = \
+                    self.server.connections_opened
+                fastlane["http_requests_total"] = self.server.requests_served
+        text += prometheus_block(fastlane, prefix, "serving",
+                                  seen=seen)
+        # write-path durability (group-commit WAL): zeros from scrape
+        # one, same rate()-window reasoning as the blocks around it
+        text += prometheus_block(self.api.durability_metrics(), prefix,
+                                 "wal", seen=seen)
         # serving-QoS series (admission/deadline/hedge/breaker): emitted
         # from scrape one, zeros included, for the same rate()-window
         # reason as the wave counters above
-        for name, value in sorted(self.api.qos.metrics().items()):
-            text += f"{prefix}_qos_{name} {value}\n"
+        text += prometheus_block(self.api.qos.metrics(), prefix, "qos",
+                                  seen=seen)
+        # observability plane: trace sampling counters, in-flight
+        # inspector gauges, and the slow-query ring's counter
+        text += prometheus_block(self.api.observability_metrics(), prefix,
+                                  seen=seen)
         self._text(text, "text/plain; version=0.0.4")
 
     def get_traces(self, query=None):
         from pilosa_tpu.utils.tracing import global_tracer
 
-        self._json({"enabled": global_tracer().enabled,
-                    "traces": global_tracer().recent()})
+        tracer = global_tracer()
+        self._json({"enabled": tracer.enabled,
+                    "sampleRate": tracer.sample_rate,
+                    "traces": tracer.recent()})
+
+    def get_inflight_queries(self, query=None):
+        """Live queries on this node (upstream's long-running-query
+        view): trace id, PQL, index, age, current stage, shards
+        outstanding — see docs/OBSERVABILITY.md."""
+        from pilosa_tpu.utils.tracing import global_query_tracker
+
+        tracker = global_query_tracker()
+        self._json({"queries": tracker.snapshot(),
+                    "trackedTotal": tracker.started_total})
 
     def get_long_queries(self, query=None):
         self._json({"threshold": self.api.long_query_time,
+                    "total": self.api.slow_queries_total,
                     "queries": list(self.api.long_queries)})
+
+    def post_trace_device(self, query=None):
+        """Live JAX profiler capture around real traffic:
+        ``POST /debug/trace-device?secs=N`` writes an xprof/tensorboard
+        trace into the configured log dir (trace-log-dir knob)."""
+        self._body()  # drain: unread bytes would corrupt keep-alive reuse
+        raw = (query.get("secs") or ["1"])[0] if query else "1"
+        try:
+            secs = float(raw)
+        except ValueError as e:
+            raise ApiError(f"invalid secs parameter {raw!r}") from e
+        self._json(self.api.start_device_trace(secs))
 
     def get_debug_vars(self, query=None):
         from pilosa_tpu.storage.residency import global_row_cache
@@ -601,6 +689,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 fastlane["http_requests_total"] = self.server.requests_served
         snap["serving_fastlane"] = fastlane
         snap["durability"] = self.api.durability_metrics()
+        snap["observability"] = self.api.observability_metrics()
         self._json(snap)
 
     def get_pprof(self, query=None):
@@ -670,32 +759,44 @@ class HTTPHandler(BaseHTTPRequestHandler):
         uses)."""
         from pilosa_tpu.storage.fragment import build_index_manifest
         from pilosa_tpu.utils.stats import global_stats
+        from pilosa_tpu.utils.tracing import TRACE_HEADER, global_tracer
 
         index = (query.get("index") or [""])[0]
-        # An unknown index answers an EMPTY manifest, not 404: sync-wise
-        # this node simply holds nothing for it (a schema broadcast may
-        # not have landed yet), and a 404 here would be misread by peers
-        # as "route missing" — permanently demoting this node to the
-        # per-fragment legacy path. The legacy catalog walk treated the
-        # same condition as "no fragments" too (ClientError → []).
-        idx = self.api.holder.index(index)
-        entries = build_index_manifest(idx) if idx is not None else []
-        global_stats().count("sync_manifest_served", 1)
-        if "application/x-protobuf" in (self.headers.get("Accept") or ""):
-            from pilosa_tpu import wire
+        # a traced repair pass stitches the serving-side cost into the
+        # coordinator's tree via this node's local /debug/traces (the
+        # subtree stays here — manifest responses are binary/protobuf)
+        trace_cm = global_tracer().remote_root(
+            self.headers.get(TRACE_HEADER), "rpc.sync-manifest",
+            node=self.api.node_id(), index=index,
+        )
+        with trace_cm:
+            # An unknown index answers an EMPTY manifest, not 404:
+            # sync-wise this node simply holds nothing for it (a schema
+            # broadcast may not have landed yet), and a 404 here would be
+            # misread by peers as "route missing" — permanently demoting
+            # this node to the per-fragment legacy path. The legacy
+            # catalog walk treated the same condition as "no fragments"
+            # too (ClientError → []).
+            idx = self.api.holder.index(index)
+            entries = build_index_manifest(idx) if idx is not None else []
+            global_stats().count("sync_manifest_served", 1)
+            if "application/x-protobuf" in (self.headers.get("Accept")
+                                            or ""):
+                from pilosa_tpu import wire
 
-            if not wire.available():
-                raise ApiError("protobuf wire format unavailable", 406)
-            from pilosa_tpu.wire.serializer import encode_sync_manifest
+                if not wire.available():
+                    raise ApiError("protobuf wire format unavailable", 406)
+                from pilosa_tpu.wire.serializer import encode_sync_manifest
 
-            self._raw(encode_sync_manifest(entries),
-                      "application/x-protobuf")
-            return
-        self._json({"fragments": [
-            {"field": f, "view": v, "shard": s,
-             "blocks": [{"block": b, "checksum": c} for b, c in blocks]}
-            for f, v, s, blocks in entries
-        ]})
+                self._raw(encode_sync_manifest(entries),
+                          "application/x-protobuf")
+                return
+            self._json({"fragments": [
+                {"field": f, "view": v, "shard": s,
+                 "blocks": [{"block": b, "checksum": c}
+                            for b, c in blocks]}
+                for f, v, s, blocks in entries
+            ]})
 
     def post_sync_blocks(self, query=None):
         """Multi-block delta fetch: the body lists every wanted checksum
@@ -709,8 +810,13 @@ class HTTPHandler(BaseHTTPRequestHandler):
         from pilosa_tpu.roaring import RoaringBitmap
         from pilosa_tpu.roaring.format import serialize
         from pilosa_tpu.utils.stats import global_stats
+        from pilosa_tpu.utils.tracing import TRACE_HEADER, global_tracer
         from pilosa_tpu.wire.serializer import encode_block_frames
 
+        trace_cm = global_tracer().remote_root(
+            self.headers.get(TRACE_HEADER), "rpc.sync-blocks",
+            node=self.api.node_id(),
+        )
         raw = self._body()
         if "application/x-protobuf" in (
                 self.headers.get("Content-Type") or ""):
@@ -741,17 +847,18 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # misread as "route missing" and demote the peer to the legacy
         # path for the process lifetime — and an empty payload is the
         # correct sync answer for data this node doesn't hold
-        idx = self.api.holder.index(index)
-        payloads = []
-        for fname, vname, shard, blocks in fragments:
-            fld = idx.field(fname) if idx is not None else None
-            v = fld.view(vname) if fld is not None else None
-            frag = v.fragment(shard) if v else None
-            for block in blocks:
-                ids = frag.block_ids(block) if frag is not None else []
-                payloads.append(serialize(RoaringBitmap.from_ids(ids)))
-        global_stats().count("sync_delta_blocks_served", len(payloads))
-        self._bytes_negotiated(encode_block_frames(payloads))
+        with trace_cm:
+            idx = self.api.holder.index(index)
+            payloads = []
+            for fname, vname, shard, blocks in fragments:
+                fld = idx.field(fname) if idx is not None else None
+                v = fld.view(vname) if fld is not None else None
+                frag = v.fragment(shard) if v else None
+                for block in blocks:
+                    ids = frag.block_ids(block) if frag is not None else []
+                    payloads.append(serialize(RoaringBitmap.from_ids(ids)))
+            global_stats().count("sync_delta_blocks_served", len(payloads))
+            self._bytes_negotiated(encode_block_frames(payloads))
 
     def get_shards_list(self, query=None):
         index = (query.get("index") or [""])[0]

@@ -23,6 +23,7 @@ This stage restores the reference's concurrency profile the TPU way:
 
 from __future__ import annotations
 
+import contextvars
 import queue
 import threading
 import time
@@ -116,14 +117,27 @@ class QueryPipeline:
         race-free). The API façade only passes a key for plain edge reads
         — no explicit shards, no deadline, no result options — where
         identical PQL strings are guaranteed identical requests."""
+        from pilosa_tpu.utils.tracing import global_tracer
+
         self._ensure_thread()
         now = time.monotonic()
         # benign races: both fields are plain floats read heuristically
         self._recent_gap = now - self._last_arrival
         self._last_arrival = now
         fut: Future = Future()
-        self._q.put((index, query, kwargs, fut, key))
-        return fut.result()
+        # the dispatcher thread submits on this request's behalf: hand it
+        # a COPY of this context so spans started during submit (device
+        # dispatch, remote fan-out departure) join this request's trace
+        # instead of being orphaned on the pipeline thread
+        ctx = contextvars.copy_context()
+        self._q.put((index, query, kwargs, fut, key, ctx))
+        with global_tracer().span("pipeline.wave") as span:
+            defs = fut.result()
+            if span is not None:
+                span.tags["wave"] = getattr(fut, "wave_size", 1)
+                if getattr(fut, "dedupe_hit", False):
+                    span.tags["deduped"] = True
+        return defs
 
     # ----------------------------------------------------------- dispatcher
 
@@ -158,14 +172,20 @@ class QueryPipeline:
             # nor the readback (and the followers' responses reuse the
             # leader's pre-serialized result bytes — executor/result.py)
             leaders: dict = {}
-            for index, q, kwargs, fut, key in wave:
+            wave_size = len(wave)
+            for index, q, kwargs, fut, key, ctx in wave:
+                fut.wave_size = wave_size  # read by the request's span
                 shared = leaders.get(key) if key is not None else None
                 if shared is not None:
                     self.deduped += 1
+                    fut.dedupe_hit = True
                     done.append((fut, shared))
                     continue
                 try:
-                    defs = executor.submit(index, q, **kwargs)
+                    # submit under the REQUEST's captured context: spans
+                    # and inspector updates started inside land in that
+                    # request's trace, not on the dispatcher thread
+                    defs = ctx.run(executor.submit, index, q, **kwargs)
                 except BaseException as e:
                     fut.set_exception(e)
                     continue

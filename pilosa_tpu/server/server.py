@@ -33,6 +33,8 @@ class ServerConfig:
         heartbeat_interval: float = 5.0,
         use_mesh: bool | None = None,
         tracing: bool = False,
+        trace_sample_rate: float = 0.0,
+        trace_log_dir: str = "",
         diagnostics_endpoint: str = "",
         statsd: str = "",
         long_query_time: float = 0.0,
@@ -70,7 +72,19 @@ class ServerConfig:
         self.seeds = seeds or []
         self.heartbeat_interval = heartbeat_interval
         self.use_mesh = use_mesh  # None = auto (mesh when >1 device)
+        # Distributed tracing (docs/OBSERVABILITY.md): `tracing = true`
+        # is the legacy always-on switch (rate 1.0); `trace-sample-rate`
+        # sets probabilistic sampling directly (0 = off, zero-overhead).
+        # `trace-log-dir` is where POST /debug/trace-device writes live
+        # JAX profiler captures (default: <data-dir>/jax-traces).
         self.tracing = tracing
+        self.trace_sample_rate = float(trace_sample_rate)
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                f"invalid trace-sample-rate {trace_sample_rate!r} "
+                "(want 0.0..1.0)"
+            )
+        self.trace_log_dir = trace_log_dir
         self.diagnostics_endpoint = diagnostics_endpoint
         self.statsd = statsd
         self.long_query_time = long_query_time
@@ -145,6 +159,11 @@ class ServerConfig:
             seeds=_parse_list(d.get("seeds", d.get("gossip-seeds", []))),
             heartbeat_interval=float(d.get("heartbeat-interval", 5.0)),
             tracing=_parse_bool(d.get("tracing", False)),
+            trace_sample_rate=float(
+                d.get("trace-sample-rate", d.get("trace_sample_rate", 0.0))
+            ),
+            trace_log_dir=d.get("trace-log-dir",
+                                d.get("trace_log_dir", "")),
             diagnostics_endpoint=d.get("diagnostics-endpoint", ""),
             statsd=d.get("statsd", ""),
             long_query_time=_parse_duration(
@@ -226,6 +245,8 @@ class ServerConfig:
             "seeds": self.seeds,
             "heartbeat-interval": self.heartbeat_interval,
             "tracing": self.tracing,
+            "trace-sample-rate": self.trace_sample_rate,
+            "trace-log-dir": self.trace_log_dir,
             "diagnostics-endpoint": self.diagnostics_endpoint,
             "statsd": self.statsd,
             "long-query-time": self.long_query_time,
@@ -378,10 +399,13 @@ class Server:
             self.config.bind, self.port, self.holder.data_dir,
             self.api.cluster.local.id,
         )
-        if self.config.tracing:
-            from pilosa_tpu.utils.tracing import global_tracer
+        from pilosa_tpu.utils.tracing import global_tracer
 
-            global_tracer().enabled = True
+        rate = self.config.trace_sample_rate
+        if rate <= 0 and self.config.tracing:
+            rate = 1.0  # legacy `tracing = true`: always-on
+        global_tracer().sample_rate = rate
+        self.api.trace_log_dir = self.config.trace_log_dir
         from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 
         self._diagnostics = DiagnosticsCollector(

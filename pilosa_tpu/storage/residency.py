@@ -493,15 +493,25 @@ class DeviceRowCache:
                 "residency_write_events": self.write_events,
             }
 
-    def prometheus_lines(self, prefix: str = "pilosa_tpu") -> str:
+    def prometheus_lines(self, prefix: str = "pilosa_tpu",
+                         seen: set | None = None) -> str:
         """metrics() in Prometheus text form, following the stats
         registry's conventions (one render shared by every consumer):
         counters carry the _total suffix; values are ints emitted
-        exactly (no %g truncation of byte gauges or large counters)."""
-        return "".join(
-            f"{prefix}_{name}"
-            f"{'_total' if name in self._MONOTONIC_METRICS else ''} {v}\n"
-            for name, v in sorted(self.metrics().items())
+        exactly (no %g truncation of byte gauges or large counters).
+        Each family leads with # HELP/# TYPE so a stock Prometheus
+        scrape ingests the block (docs/OBSERVABILITY.md); ``seen``
+        shares the page-wide family-metadata dedupe. One renderer for
+        the whole exposition page — stats.prometheus_block."""
+        from pilosa_tpu.utils.stats import prometheus_block
+
+        return prometheus_block(
+            {
+                (f"{name}_total" if name in self._MONOTONIC_METRICS
+                 else name): v
+                for name, v in self.metrics().items()
+            },
+            prefix, seen=seen,
         )
 
     def clear(self) -> None:

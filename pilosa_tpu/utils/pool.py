@@ -10,6 +10,7 @@ and pool construction cost (~100 µs) is noise against network RTTs.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
@@ -30,6 +31,13 @@ def concurrent_map(fn, items, max_workers: int = MAX_FANOUT,
     abort (or hide the results of) the rest — the routed-import fan-out
     relies on this to report exactly which nodes failed while every
     healthy node's batch still lands.
+
+    Context propagation: each worker invocation runs inside a COPY of the
+    submitting thread's ``contextvars`` context, so the active trace span
+    and in-flight-query record (utils/tracing.py) survive the hop — a
+    span started on a fan-out thread lands in its request's tree instead
+    of being orphaned. Copies are O(1) (immutable HAMT) and per-item, so
+    concurrent workers never contend on one Context object.
     """
     items = list(items)
     call = fn
@@ -41,8 +49,10 @@ def concurrent_map(fn, items, max_workers: int = MAX_FANOUT,
                 return e
     if len(items) <= 1:
         return [call(x) for x in items]
+    ctxs = [contextvars.copy_context() for _ in items]
     with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
-        return list(pool.map(call, items))
+        return list(pool.map(lambda p: p[0].run(call, p[1]),
+                             zip(ctxs, items)))
 
 
 def spawn(thunk):
@@ -55,10 +65,11 @@ def spawn(thunk):
     and the caller's other submits all overlap.
     """
     box: dict = {}
+    ctx = contextvars.copy_context()  # trace/inspector context rides along
 
     def run():
         try:
-            box["value"] = thunk()
+            box["value"] = ctx.run(thunk)
         except BaseException as e:  # joined and re-raised on the caller
             box["error"] = e
 

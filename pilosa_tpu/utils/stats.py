@@ -19,6 +19,16 @@ from collections import defaultdict, deque
 # forgets the old regime after SAMPLE_WINDOW observations.
 SAMPLE_WINDOW = 256
 
+# Cumulative histogram buckets (seconds) for every timing series: the
+# windowed p50/p95 summary lines stay (human-readable, regime-fresh), and
+# each timer ALSO exports stock-Prometheus `_bucket`/`_sum`/`_count`
+# series under the `<name>_hist_seconds` family so a scrape can compute
+# quantiles server-side (histogram_quantile) over any window. Log-spaced
+# 1 ms → 10 s: the serving path lives in single-digit ms, repair/sync
+# passes in seconds.
+HISTOGRAM_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
 
 def _fmt_tags(tags: dict | None) -> str:
     if not tags:
@@ -39,6 +49,49 @@ def _quantile(samples, q: float) -> float:
     return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
 
 
+def _meta_lines(family: str, mtype: str, help_text: str | None,
+                seen: set) -> list[str]:
+    """`# HELP` + `# TYPE` for one metric family, emitted once per
+    exposition (Prometheus text format §comments). ``seen`` dedupes
+    families that appear with several tag sets."""
+    if family in seen:
+        return []
+    seen.add(family)
+    return [
+        f"# HELP {family} {help_text or family.replace('_', ' ')}",
+        f"# TYPE {family} {mtype}",
+    ]
+
+
+def prometheus_block(pairs: dict, prefix: str, subsystem: str = "",
+                     help_map: dict | None = None,
+                     seen: set | None = None) -> str:
+    """Render a name→value dict as Prometheus lines WITH `# HELP`/`# TYPE`
+    metadata: names ending in ``_total`` type as counters, everything
+    else as gauges. Shared by every /metrics block the HTTP handler
+    appends after the stats registry (serving, qos, wal, tracing), so
+    exposition-format compliance lives in one place. ``seen`` dedupes
+    family metadata ACROSS blocks: a family the registry already
+    declared (e.g. the tagged ``qos_shed_total`` beside the block's
+    untagged total) must not get a second TYPE line on the page."""
+    seen = seen if seen is not None else set()
+    lines: list[str] = []
+    middle = f"{subsystem}_" if subsystem else ""
+    for name, value in sorted(pairs.items()):
+        family = f"{prefix}_{middle}{name}"
+        mtype = "counter" if name.endswith("_total") else "gauge"
+        lines.extend(_meta_lines(
+            family, mtype, (help_map or {}).get(name), seen
+        ))
+        # ints emit exactly — %g would quantize large counters (byte
+        # totals, request counts) to 6 significant digits and make
+        # rate() stair-step (the residency exporter documented this
+        # hazard first)
+        rendered = value if isinstance(value, int) else f"{value:g}"
+        lines.append(f"{family} {rendered}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 class StatsClient:
     """In-memory stats registry; thread-safe."""
 
@@ -47,9 +100,13 @@ class StatsClient:
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
-        # [count, sum, sample window] — the window feeds quantile export
+        # [count, sum, sample window, cumulative bucket counts] — the
+        # window feeds the summary-quantile export, the buckets feed the
+        # stock histogram export (one slot per HISTOGRAM_BUCKETS_S bound;
+        # +Inf is implicit — it equals the count)
         self._timings: dict[tuple, list] = defaultdict(
-            lambda: [0, 0.0, deque(maxlen=SAMPLE_WINDOW)]
+            lambda: [0, 0.0, deque(maxlen=SAMPLE_WINDOW),
+                     [0] * len(HISTOGRAM_BUCKETS_S)]
         )
         # unit-free distributions (batch sizes, fan-out widths): same
         # shape as _timings but rendered without the _seconds unit suffix
@@ -71,6 +128,11 @@ class StatsClient:
             entry[0] += 1
             entry[1] += seconds
             entry[2].append(seconds)
+            buckets = entry[3]
+            for i, bound in enumerate(HISTOGRAM_BUCKETS_S):
+                if seconds <= bound:
+                    buckets[i] += 1
+                    break
 
     def timer(self, name: str, tags: dict | None = None):
         return _Timer(self, name, tags)
@@ -97,34 +159,71 @@ class StatsClient:
             samples = list(entry[2]) if entry else []
         return _quantile(samples, q) if samples else None
 
-    def prometheus_text(self) -> str:
-        lines = []
+    def prometheus_text(self, seen: set | None = None) -> str:
+        """Exposition-format render: every family leads with `# HELP` +
+        `# TYPE` (counter/gauge/summary/histogram). Timers export BOTH
+        the windowed summary (`X_seconds{quantile=}` + count/sum, regime-
+        fresh p50/p95) and a cumulative stock histogram under the sibling
+        `X_hist_seconds` family — same observations, two consumers: a
+        human tailing /metrics and a Prometheus computing
+        histogram_quantile over arbitrary windows. ``seen`` (shared with
+        the page's other blocks) dedupes family metadata page-wide."""
+        lines: list[str] = []
+        seen = seen if seen is not None else set()
         with self._lock:
             for (name, tags), v in sorted(self._counters.items()):
-                lines.append(f"{self.prefix}_{name}_total{tags} {v:g}")
+                family = f"{self.prefix}_{name}_total"
+                lines.extend(_meta_lines(family, "counter", None, seen))
+                lines.append(f"{family}{tags} {v:g}")
             for (name, tags), v in sorted(self._gauges.items()):
-                lines.append(f"{self.prefix}_{name}{tags} {v:g}")
-            for (name, tags), (n, total, samples) in sorted(self._timings.items()):
-                lines.append(f"{self.prefix}_{name}_seconds_count{tags} {n:g}")
-                lines.append(f"{self.prefix}_{name}_seconds_sum{tags} {total:g}")
+                family = f"{self.prefix}_{name}"
+                lines.extend(_meta_lines(family, "gauge", None, seen))
+                lines.append(f"{family}{tags} {v:g}")
+            for (name, tags), entry in sorted(self._timings.items()):
+                n, total, samples, buckets = entry
+                family = f"{self.prefix}_{name}_seconds"
+                lines.extend(_meta_lines(
+                    family, "summary",
+                    f"{name} latency (windowed p50/p95 over the last "
+                    f"{SAMPLE_WINDOW} samples)", seen,
+                ))
+                lines.append(f"{family}_count{tags} {n:g}")
+                lines.append(f"{family}_sum{tags} {total:g}")
                 for q in (0.5, 0.95):
                     if samples:
                         qt = _with_tag(tags, f'quantile="{q}"')
                         lines.append(
-                            f"{self.prefix}_{name}_seconds{qt} "
-                            f"{_quantile(samples, q):g}"
+                            f"{family}{qt} {_quantile(samples, q):g}"
                         )
+                hist = f"{self.prefix}_{name}_hist_seconds"
+                lines.extend(_meta_lines(
+                    hist, "histogram",
+                    f"{name} latency (cumulative histogram)", seen,
+                ))
+                acc = 0
+                for bound, count in zip(HISTOGRAM_BUCKETS_S, buckets):
+                    acc += count
+                    bt = _with_tag(tags, f'le="{bound:g}"')
+                    lines.append(f"{hist}_bucket{bt} {acc:g}")
+                bt = _with_tag(tags, 'le="+Inf"')
+                lines.append(f"{hist}_bucket{bt} {n:g}")
+                lines.append(f"{hist}_sum{tags} {total:g}")
+                lines.append(f"{hist}_count{tags} {n:g}")
             for (name, tags), (n, total, samples) in sorted(
                 self._observations.items()
             ):
-                lines.append(f"{self.prefix}_{name}_count{tags} {n:g}")
-                lines.append(f"{self.prefix}_{name}_sum{tags} {total:g}")
+                family = f"{self.prefix}_{name}"
+                lines.extend(_meta_lines(
+                    family, "summary",
+                    f"{name} distribution (windowed p50/p95)", seen,
+                ))
+                lines.append(f"{family}_count{tags} {n:g}")
+                lines.append(f"{family}_sum{tags} {total:g}")
                 for q in (0.5, 0.95):
                     if samples:
                         qt = _with_tag(tags, f'quantile="{q}"')
                         lines.append(
-                            f"{self.prefix}_{name}{qt} "
-                            f"{_quantile(samples, q):g}"
+                            f"{family}{qt} {_quantile(samples, q):g}"
                         )
         return "\n".join(lines) + ("\n" if lines else "")
 
@@ -132,7 +231,8 @@ class StatsClient:
         with self._lock:
             dists = {}
             for source in (self._timings, self._observations):
-                for (n, t), (count, total, samples) in source.items():
+                for (n, t), entry in source.items():
+                    count, total, samples = entry[0], entry[1], entry[2]
                     dists[f"{n}{t}"] = {
                         "count": count, "sum": total,
                         "p50": _quantile(samples, 0.5) if samples else None,

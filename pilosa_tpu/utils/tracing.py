@@ -1,78 +1,343 @@
-"""Tracing: spans through handler → execute → per-shard map.
+"""Distributed tracing: context-propagating sampled spans + query inspector.
 
-Reference: tracing/tracing.go (SURVEY.md §2 #24) — a global tracer wrapper
-(OpenTracing + Jaeger upstream). Here: an in-process tracer recording span
-trees with wall times, exportable as JSON (and gated to zero overhead when
-disabled). On TPU the device-side story is the JAX profiler; start_jax_trace
-wraps ``jax.profiler`` so a query's XLA execution can be captured alongside
-host spans.
+Reference: tracing/tracing.go (SURVEY.md §2 #24) — upstream wraps a global
+OpenTracing tracer (Jaeger) so every request carries a span context across
+goroutines and RPC hops. The r6 port was a thread-local stub: every span
+started on a pool thread was orphaned and nothing crossed a node. This
+rewrite is the real thing, sized for the serving planes PRs 1-6 built:
+
+- **contextvars, not thread-locals**: the active span rides
+  ``contextvars``, and every cross-thread handoff in the system — the
+  ``utils.pool`` fan-outs, the serving pipeline's wave queue, hedge legs,
+  the wave batcher — captures the submitting context and restores it on
+  the worker, so a span started anywhere lands in its request's tree.
+- **Sampling, zero-cost off**: ``sample_rate`` (0..1) decides per REQUEST
+  ROOT. Rate 0 returns a shared no-op handle — no allocation, no context
+  write. Child spans never re-sample: they join the active trace or no-op.
+- **Cross-node propagation**: internal hops carry
+  ``X-Pilosa-Trace: <trace_id>:<parent_span_id>``; the callee roots a
+  remote span under that parent and (for query hops) returns its finished
+  subtree in the response, so the coordinator's ``/debug/traces`` renders
+  ONE tree spanning the cluster.
+- **In-flight inspector**: ``QueryTracker`` (always on, lock-free stage
+  updates) backs ``GET /debug/queries`` — upstream's long-running-query
+  view: trace id, PQL, index, age, current stage, shards outstanding.
+
+On TPU the device-side story stays the JAX profiler; ``start_jax_trace``
+wraps ``jax.profiler`` and is exposed live at ``POST /debug/trace-device``.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import random
 import threading
 import time
+from collections import deque
+
+# Request header carrying trace context on internal hops
+# (cluster_exec sub-queries, wave batches, sync manifest/blocks).
+TRACE_HEADER = "X-Pilosa-Trace"
+
+
+def _new_trace_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+def _new_span_id() -> str:
+    return f"{random.getrandbits(48):012x}"
 
 
 class Span:
-    __slots__ = ("name", "start", "end", "tags", "children")
+    """One timed operation in a trace tree.
 
-    def __init__(self, name: str, tags: dict | None = None):
+    ``children`` may be appended from several threads (list.append is
+    atomic under the GIL); ``to_json`` snapshots. ``remote`` holds
+    already-serialized subtrees returned by peers over the wire — they
+    render as children with their own (peer-assigned) span ids whose
+    ``parentId`` is this span's id."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "parent",
+                 "start", "end", "tags", "children", "remote")
+
+    def __init__(self, name: str, tags: dict | None = None,
+                 trace_id: str | None = None, parent: "Span | None" = None,
+                 parent_id: str | None = None):
         self.name = name
+        self.trace_id = trace_id or _new_trace_id()
+        self.span_id = _new_span_id()
+        self.parent = parent
+        self.parent_id = parent.span_id if parent is not None else parent_id
         self.start = time.perf_counter()
         self.end = None
-        self.tags = tags or {}
+        self.tags = tags if tags is not None else {}
         self.children: list[Span] = []
+        self.remote: list[dict] = []
 
     @property
     def duration(self) -> float:
         return (self.end or time.perf_counter()) - self.start
 
+    def finish(self) -> None:
+        if self.end is None:
+            self.end = time.perf_counter()
+
+    def root(self) -> "Span":
+        s = self
+        while s.parent is not None:
+            s = s.parent
+        return s
+
+    def add_remote(self, subtree: dict) -> None:
+        """Attach a peer's serialized span subtree under this span."""
+        if isinstance(subtree, dict):
+            self.remote.append(subtree)
+
+    def header_value(self) -> str:
+        """This span as an ``X-Pilosa-Trace`` value (child hops parent
+        to it)."""
+        return f"{self.trace_id}:{self.span_id}"
+
     def to_json(self) -> dict:
-        return {
+        out = {
             "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
             "durationMs": round(self.duration * 1e3, 3),
             "tags": self.tags,
-            "children": [c.to_json() for c in self.children],
+            "children": ([c.to_json() for c in list(self.children)]
+                         + list(self.remote)),
         }
+        if self.parent_id is not None:
+            out["parentId"] = self.parent_id
+        return out
+
+
+def parse_trace_header(value: str | None):
+    """``"<trace_id>:<span_id>"`` → tuple, or None when absent/malformed
+    (a malformed header must degrade to untraced, never 500)."""
+    if not value:
+        return None
+    parts = value.strip().split(":")
+    if len(parts) != 2 or not parts[0] or not parts[1]:
+        return None
+    return parts[0], parts[1]
+
+
+# The active span of the current logical request. None = not in a trace;
+# _NOT_SAMPLED = the request's root made a negative sampling decision, so
+# inner span sites must not re-sample their own roots.
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "pilosa_tpu_trace_span", default=None
+)
+_NOT_SAMPLED = object()
+
+
+def current_span() -> Span | None:
+    cur = _current_span.get()
+    return cur if isinstance(cur, Span) else None
+
+
+class _NopHandle:
+    """Shared no-op span handle: tracing off (or unsampled subtree) costs
+    one contextvar read and zero allocations."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOP = _NopHandle()
+
+
+class _SpanHandle:
+    """Context manager activating one span in the current context."""
+
+    __slots__ = ("_tracer", "_span", "_token")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self) -> Span:
+        self._token = _current_span.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        span = self._span
+        span.finish()
+        if exc is not None and "error" not in span.tags:
+            span.tags["error"] = str(exc) or exc_type.__name__
+        _current_span.reset(self._token)
+        if span.parent is None:
+            self._tracer._record_root(span)
+        return False
+
+
+class _SuppressHandle:
+    """Marks the request NOT SAMPLED for its whole context, so inner span
+    sites (executor.Execute, remote legs) cannot root their own traces."""
+
+    __slots__ = ("_token",)
+
+    def __enter__(self):
+        self._token = _current_span.set(_NOT_SAMPLED)
+        return None
+
+    def __exit__(self, *exc):
+        _current_span.reset(self._token)
+        return False
+
+
+@contextlib.contextmanager
+def use_span(span: Span):
+    """Re-activate an existing span in this context (the query-batch
+    receiver runs one item's submit and resolve phases at different
+    points of its loop)."""
+    token = _current_span.set(span)
+    try:
+        yield span
+    finally:
+        _current_span.reset(token)
 
 
 class Tracer:
-    """Per-thread span stacks; keeps the last N finished root spans."""
+    """Sampled, context-propagating tracer; keeps the last N root trees."""
 
-    def __init__(self, enabled: bool = False, keep: int = 64):
-        self.enabled = enabled
+    def __init__(self, enabled: bool = False, keep: int = 64,
+                 sample_rate: float | None = None):
+        # legacy constructor surface: enabled=True meant always-on
+        self.sample_rate = (sample_rate if sample_rate is not None
+                            else (1.0 if enabled else 0.0))
         self.keep = keep
-        self._local = threading.local()
         self._lock = threading.Lock()
-        self.finished: list[Span] = []
+        self.finished: deque = deque(maxlen=keep)
+        self.sampled_traces = 0
+        self.spans_started = 0
 
-    @contextlib.contextmanager
+    # legacy boolean surface (server config `tracing = true`, old tests)
+    @property
+    def enabled(self) -> bool:
+        return self.sample_rate > 0.0
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self.sample_rate = 1.0 if value else 0.0
+
+    # ------------------------------------------------------------ span sites
+
     def span(self, name: str, **tags):
-        if not self.enabled:
-            yield None
-            return
-        stack = getattr(self._local, "stack", None)
-        if stack is None:
-            stack = self._local.stack = []
-        s = Span(name, tags)
-        if stack:
-            stack[-1].children.append(s)
-        stack.append(s)
-        try:
-            yield s
-        finally:
-            s.end = time.perf_counter()
-            stack.pop()
-            if not stack:
-                with self._lock:
-                    self.finished.append(s)
-                    del self.finished[: -self.keep]
+        """Child span joining the active trace; no-op outside one.
+
+        Join-only by design: instrumentation sites scattered through the
+        planes (conn.checkout, wal.barrier, device.dispatch, ...) must
+        never root standalone trees off background traffic — only the
+        designated root sites (``request_root``, ``remote_root``,
+        ``root_span``) start traces."""
+        cur = _current_span.get()
+        if cur is None or cur is _NOT_SAMPLED:
+            return _NOP
+        self.spans_started += 1
+        span = Span(name, tags, trace_id=cur.trace_id, parent=cur)
+        cur.children.append(span)
+        return _SpanHandle(self, span)
+
+    def root_span(self, name: str, **tags):
+        """Join the active trace, or — outside one — ROOT a new trace
+        subject to sampling. For sites that ARE a sensible trace root
+        when reached directly: ``executor.Execute`` (in-process callers,
+        tests, CLI) and ``sync.pass`` (the anti-entropy ticker)."""
+        cur = _current_span.get()
+        if cur is None:
+            return self._maybe_root(name, tags)
+        return self.span(name, **tags)
+
+    def request_root(self, name: str, **tags):
+        """Root span site for an EDGE request: samples once, and on a
+        negative decision suppresses sampling for the whole request so
+        exactly zero or one tree exists per request."""
+        cur = _current_span.get()
+        if isinstance(cur, Span):  # nested (in-process client re-entry)
+            return self.span(name, **tags)
+        rate = self.sample_rate
+        if rate <= 0.0:
+            return _NOP
+        if rate < 1.0 and random.random() >= rate:
+            return _SuppressHandle()
+        self.sampled_traces += 1
+        self.spans_started += 1
+        return _SpanHandle(self, Span(name, tags))
+
+    def remote_span(self, header_value: str | None, name: str,
+                    **tags) -> Span | None:
+        """A DETACHED remote-rooted span for split-phase work: the
+        query-batch receiver runs one item's submit and resolve at
+        different points of its loop, re-activating the span with
+        ``use_span`` each time. Returns None when the header is absent
+        or malformed. Close with ``finish_root``. Single-phase handlers
+        should use ``remote_root`` (the context-manager form) instead —
+        both keep root-span lifecycle accounting inside this class."""
+        parsed = parse_trace_header(header_value)
+        if parsed is None:
+            return None
+        self.spans_started += 1
+        return Span(name, tags, trace_id=parsed[0], parent_id=parsed[1])
+
+    def finish_root(self, span: Span) -> None:
+        """End a detached root span (``remote_span``) and record it in
+        the finished ring."""
+        span.finish()
+        self._record_root(span)
+
+    def remote_root(self, header_value: str | None, name: str, **tags):
+        """Root span for a remote hop carrying ``X-Pilosa-Trace``. The
+        coordinator already sampled, so the callee always traces when the
+        header parses; without one, local sampling is SUPPRESSED — a
+        remote sub-query belongs to its root's decision either way."""
+        parsed = parse_trace_header(header_value)
+        if parsed is None:
+            return _SuppressHandle()
+        trace_id, parent_id = parsed
+        self.spans_started += 1
+        return _SpanHandle(
+            self, Span(name, tags, trace_id=trace_id, parent_id=parent_id)
+        )
+
+    def _maybe_root(self, name: str, tags: dict):
+        rate = self.sample_rate
+        if rate <= 0.0:
+            return _NOP
+        if rate < 1.0 and random.random() >= rate:
+            return _NOP
+        self.sampled_traces += 1
+        self.spans_started += 1
+        return _SpanHandle(self, Span(name, tags))
+
+    # -------------------------------------------------------------- finished
+
+    def _record_root(self, span: Span) -> None:
+        self.finished.append(span)  # deque(maxlen): atomic, bounded
 
     def recent(self) -> list[dict]:
-        with self._lock:
-            return [s.to_json() for s in self.finished]
+        return [s.to_json() for s in list(self.finished)]
+
+    def clear(self) -> None:
+        self.finished.clear()
+        self.sampled_traces = 0
+        self.spans_started = 0
+
+    def metrics(self) -> dict:
+        return {
+            "tracing_sampled_traces_total": self.sampled_traces,
+            "tracing_spans_total": self.spans_started,
+            "tracing_finished_traces": len(self.finished),
+            "tracing_sample_rate": self.sample_rate,
+        }
 
 
 _global_tracer: Tracer | None = None
@@ -90,10 +355,136 @@ def set_global_tracer(tracer: Tracer) -> None:
     _global_tracer = tracer
 
 
+# ------------------------------------------------------ in-flight inspector
+
+
+class InflightQuery:
+    """One live query's inspector record. ``stage`` and
+    ``shards_outstanding`` are plain attribute writes (no lock): the
+    writers are the query's own threads and readers tolerate tearing —
+    this is a debugging view, not an accounting ledger."""
+
+    __slots__ = ("qid", "trace_id", "index", "pql", "tenant", "remote",
+                 "started", "started_wall", "stage", "shards_outstanding")
+
+    def __init__(self, qid: int, index: str, pql: str, tenant: str,
+                 remote: bool, trace_id: str | None):
+        self.qid = qid
+        self.trace_id = trace_id
+        self.index = index
+        self.pql = pql
+        self.tenant = tenant
+        self.remote = remote
+        self.started = time.perf_counter()
+        self.started_wall = time.time()
+        self.stage = "start"
+        self.shards_outstanding: int | None = None
+
+    def to_json(self) -> dict:
+        out = {
+            "id": self.qid,
+            "index": self.index,
+            "pql": self.pql,
+            "tenant": self.tenant,
+            "remote": self.remote,
+            "ageSeconds": round(time.perf_counter() - self.started, 4),
+            "stage": self.stage,
+        }
+        if self.trace_id is not None:
+            out["traceId"] = self.trace_id
+        if self.shards_outstanding is not None:
+            out["shardsOutstanding"] = self.shards_outstanding
+        return out
+
+
+_current_query: contextvars.ContextVar = contextvars.ContextVar(
+    "pilosa_tpu_inflight_query", default=None
+)
+
+
+def current_query() -> InflightQuery | None:
+    """The inspector record of the query owning this context (rides the
+    same capture-and-restore hops as the trace context), so deep layers
+    (cluster fan-out) can update stage/shards without plumbing."""
+    return _current_query.get()
+
+
+class QueryTracker:
+    """Registry of in-flight queries behind ``GET /debug/queries``.
+
+    Always on by default — the long-running-query view matters exactly
+    when something is stuck, regardless of trace sampling. Cost per query
+    is one lock round trip each for start/finish; ``enabled = False``
+    turns even that off (the bench's bare baseline)."""
+
+    def __init__(self):
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._live: dict[int, InflightQuery] = {}
+        self._next = 0
+        self.started_total = 0
+
+    def start(self, index: str, pql, tenant: str = "default",
+              remote: bool = False) -> InflightQuery | None:
+        if not self.enabled:
+            return None
+        cur = current_span()
+        q = InflightQuery(
+            0, index,
+            (pql[:1024] if isinstance(pql, str) else str(pql)[:1024]),
+            tenant, remote, cur.trace_id if cur is not None else None,
+        )
+        with self._lock:
+            self._next += 1
+            q.qid = self._next
+            self.started_total += 1
+            self._live[q.qid] = q
+        return q
+
+    def activate(self, q: InflightQuery):
+        """Bind ``q`` to the current context; returns a reset token."""
+        return _current_query.set(q)
+
+    def finish(self, q: InflightQuery | None, token=None) -> None:
+        if q is None:
+            return
+        if token is not None:
+            _current_query.reset(token)
+        with self._lock:
+            self._live.pop(q.qid, None)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            live = list(self._live.values())
+        return [q.to_json() for q in
+                sorted(live, key=lambda q: q.started)]
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "inflight_queries": len(self._live),
+                "queries_tracked_total": self.started_total,
+            }
+
+
+_global_query_tracker: QueryTracker | None = None
+
+
+def global_query_tracker() -> QueryTracker:
+    global _global_query_tracker
+    if _global_query_tracker is None:
+        _global_query_tracker = QueryTracker()
+    return _global_query_tracker
+
+
+# ----------------------------------------------------------- device tracing
+
+
 @contextlib.contextmanager
 def start_jax_trace(log_dir: str):
     """Capture an XLA/JAX profiler trace around a block (TPU-side tracing;
-    view with xprof/tensorboard)."""
+    view with xprof/tensorboard). Live capture around real traffic is
+    exposed at ``POST /debug/trace-device?secs=N`` (server/http.py)."""
     import jax
 
     jax.profiler.start_trace(log_dir)

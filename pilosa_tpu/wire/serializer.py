@@ -47,12 +47,22 @@ def attrs_from_proto(attrs) -> dict:
     return out
 
 
-def encode_results(results) -> bytes:
+def encode_results(results, trace: dict | None = None) -> bytes:
+    """``trace``: a finished span subtree (dict) from a traced remote
+    sub-query, carried back to the coordinator as QueryResponse.trace_json
+    (silently dropped against a pre-trace generated schema)."""
+    import json as _json
+
     p = pb2()
     resp = p.QueryResponse()
     for res in results:
         qr = resp.results.add()
         _encode_result(qr, res)
+    if trace is not None:
+        try:
+            resp.trace_json = _json.dumps(trace, separators=(",", ":"))
+        except AttributeError:  # stale internal_pb2 without the field
+            pass
     return resp.SerializeToString()
 
 
@@ -201,29 +211,41 @@ def encode_import_value_request(index: str, field: str, columns, values,
 
 
 def encode_batch_request(items) -> bytes:
-    """``items``: [(index, pql, shards), ...] → BatchQueryRequest bytes
-    (the wave-batched internal hop — one request per node per wave)."""
+    """``items``: [(index, pql, shards), ...] — optionally a 4th element
+    carrying the item's X-Pilosa-Trace context — → BatchQueryRequest
+    bytes (the wave-batched internal hop — one request per node per
+    wave)."""
     p = pb2()
     req = p.BatchQueryRequest()
-    for index, pql, shards in items:
+    for item in items:
         unit = req.queries.add()
-        unit.index = index
-        unit.query = pql
-        unit.shards.extend(int(s) for s in shards)
+        unit.index = item[0]
+        unit.query = item[1]
+        unit.shards.extend(int(s) for s in item[2])
+        if len(item) > 3 and item[3]:
+            try:
+                unit.trace = item[3]
+            except AttributeError:  # stale internal_pb2: hop untraced
+                pass
     return req.SerializeToString()
 
 
-def decode_batch_request(data: bytes) -> list[tuple[str, str, list[int]]]:
+def decode_batch_request(data: bytes) -> list[tuple]:
     p = pb2()
     req = p.BatchQueryRequest()
     req.ParseFromString(data)
-    return [(u.index, u.query, list(u.shards)) for u in req.queries]
+    return [(u.index, u.query, list(u.shards),
+             getattr(u, "trace", "") or None)
+            for u in req.queries]
 
 
 def encode_batch_responses(outcomes) -> bytes:
     """``outcomes``: one entry per batched sub-query, either
-    ``("ok", [raw results])`` or ``("err", message, status)`` →
-    BatchQueryResponse bytes (positional with the request)."""
+    ``("ok", [raw results])`` (optionally a 3rd element: the item's span
+    subtree) or ``("err", message, status)`` → BatchQueryResponse bytes
+    (positional with the request)."""
+    import json as _json
+
     p = pb2()
     batch = p.BatchQueryResponse()
     for outcome in outcomes:
@@ -231,6 +253,12 @@ def encode_batch_responses(outcomes) -> bytes:
         if outcome[0] == "ok":
             for res in outcome[1]:
                 _encode_result(resp.results.add(), res)
+            if len(outcome) > 2 and outcome[2] is not None:
+                try:
+                    resp.trace_json = _json.dumps(outcome[2],
+                                                  separators=(",", ":"))
+                except AttributeError:
+                    pass
         else:
             resp.err = outcome[1]
             resp.status = int(outcome[2])
@@ -239,8 +267,9 @@ def encode_batch_responses(outcomes) -> bytes:
 
 def decode_batch_responses(data: bytes) -> list[dict]:
     """BatchQueryResponse bytes → one dict per sub-query, the same
-    shapes query_node returns: ``{"results": [...]}`` on success,
-    ``{"error": ..., "status": ...}`` on a per-item error."""
+    shapes query_node returns: ``{"results": [...]}`` on success (plus a
+    ``"trace"`` key for traced items), ``{"error": ..., "status": ...}``
+    on a per-item error."""
     p = pb2()
     batch = p.BatchQueryResponse()
     batch.ParseFromString(data)
@@ -359,6 +388,15 @@ def decode_results_json(data: bytes) -> dict:
 
 def _response_results_json(resp) -> dict:
     """The result-decoding body shared by single and batched responses."""
+    import json as _json
+
+    trace = None
+    raw_trace = getattr(resp, "trace_json", "")
+    if raw_trace:
+        try:
+            trace = _json.loads(raw_trace)
+        except ValueError:
+            trace = None  # malformed subtree degrades to untraced
     out = []
     for qr in resp.results:
         t = qr.type
@@ -406,4 +444,7 @@ def _response_results_json(resp) -> dict:
             out.append(list(qr.row_keys))
         else:
             out.append(None)
-    return {"results": out}
+    envelope = {"results": out}
+    if trace is not None:
+        envelope["trace"] = trace
+    return envelope
