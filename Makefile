@@ -20,8 +20,8 @@
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
-	durability-smoke obs-smoke bench-ingest bench-serving bench-sync \
-	bench-durability bench-tracing
+	durability-smoke obs-smoke cost-smoke bench-ingest bench-serving \
+	bench-sync bench-durability bench-tracing bench-profiling
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -50,6 +50,13 @@ durability-smoke:
 obs-smoke:
 	$(PYTEST) tests/test_tracing.py -m "not slow"
 
+# cost-smoke: the query cost plane — PQL PROFILE single-node + 3-node
+# stitching, /debug/tenants accounting, /debug/heatmap skew ranking,
+# SLO burn-rate flips, knob roundtrips, and the stats quantile edge
+# cases (docs/OBSERVABILITY.md)
+cost-smoke:
+	$(PYTEST) tests/test_cost.py tests/test_stats_quantiles.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -64,3 +71,8 @@ bench-durability:
 
 bench-tracing:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs tracing
+
+# overhead gate for the query cost plane: profile-off <= 1%,
+# profile-on <= 10% vs the bare fast-lane plateau
+bench-profiling:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs profiling

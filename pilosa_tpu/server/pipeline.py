@@ -29,6 +29,8 @@ import threading
 import time
 from concurrent.futures import Future
 
+from pilosa_tpu.utils.cost import current_cost
+
 
 class _SharedDeferred:
     """Deferred handle shared by deduped wavemates: the first resolver
@@ -137,6 +139,14 @@ class QueryPipeline:
                 span.tags["wave"] = getattr(fut, "wave_size", 1)
                 if getattr(fut, "dedupe_hit", False):
                     span.tags["deduped"] = True
+        cost = current_cost()
+        if cost is not None and cost.profile is not None:
+            # PROFILE wave facts: how many requests shared this wave and
+            # whether this one rode an identical wavemate (a dedupe hit
+            # explains near-zero device counters in the tree)
+            cost.profile.wave_size = getattr(fut, "wave_size", 1)
+            cost.profile.dedupe_hit = bool(getattr(fut, "dedupe_hit",
+                                                   False))
         return defs
 
     # ----------------------------------------------------------- dispatcher

@@ -59,6 +59,10 @@ class ServerConfig:
         durability_mode: str = "group",
         group_commit_max_ms: float = 2.0,
         group_commit_max_ops: int = 256,
+        slow_query_ring: int = 100,
+        heat_half_life: float = 300.0,
+        slo_objectives: list[str] | None = None,
+        slo_windows: list[str] | None = None,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -137,6 +141,29 @@ class ServerConfig:
         self.durability_mode = durability_mode
         self.group_commit_max_ms = float(group_commit_max_ms)
         self.group_commit_max_ops = int(group_commit_max_ops)
+        # Query cost plane (docs/OBSERVABILITY.md): slow-query ring
+        # capacity behind /debug/queries/slow (the threshold is
+        # long-query-time above), per-shard heat decay half-life, and
+        # declarative SLO objectives with their burn-rate windows.
+        # Objectives validate at CONFIG time (a typo'd spec must fail
+        # startup, not silently never alert) — same policy as
+        # trace-sample-rate.
+        self.slow_query_ring = int(slow_query_ring)
+        if self.slow_query_ring < 1:
+            raise ValueError(
+                f"invalid slow-query-ring {slow_query_ring!r} (want >= 1)"
+            )
+        self.heat_half_life = float(heat_half_life)
+        if self.heat_half_life <= 0:
+            raise ValueError(
+                f"invalid heat-half-life {heat_half_life!r} (want > 0)"
+            )
+        self.slo_objectives = list(slo_objectives or [])
+        self.slo_windows = list(slo_windows or [])
+        from pilosa_tpu.qos.slo import SLOEngine
+
+        # build once to validate; Server.open builds the live engine
+        SLOEngine.from_config(self.slo_objectives, self.slo_windows)
 
     @property
     def tls_enabled(self) -> bool:
@@ -230,6 +257,18 @@ class ServerConfig:
                 d.get("group-commit-max-ops",
                       d.get("group_commit_max_ops", 256))
             ),
+            slow_query_ring=int(
+                d.get("slow-query-ring", d.get("slow_query_ring", 100))
+            ),
+            heat_half_life=_parse_duration(
+                d.get("heat-half-life", d.get("heat_half_life", 300.0))
+            ),
+            slo_objectives=_parse_list(
+                d.get("slo-objectives", d.get("slo_objectives", []))
+            ),
+            slo_windows=_parse_list(
+                d.get("slo-windows", d.get("slo_windows", []))
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -273,30 +312,21 @@ class ServerConfig:
             "durability-mode": self.durability_mode,
             "group-commit-max-ms": self.group_commit_max_ms,
             "group-commit-max-ops": self.group_commit_max_ops,
+            "slow-query-ring": self.slow_query_ring,
+            "heat-half-life": self.heat_half_life,
+            "slo-objectives": self.slo_objectives,
+            "slo-windows": self.slo_windows,
         }
 
 
 def _parse_duration(value) -> float:
-    """Seconds from a float or a Go-style duration string ('1m30s', '500ms',
-    '30s' — the reference's TOML uses Go durations). Raises ValueError on
-    malformed input rather than silently dropping trailing text."""
-    if isinstance(value, (int, float)):
-        return float(value)
-    s = str(value).strip().lower()
-    if not s:
-        return 0.0
-    import re
+    """Seconds from a float or a Go-style duration string ('1m30s',
+    '500ms' — the reference's TOML uses Go durations). One shared
+    grammar for every knob (utils/durations.py; the SLO spec parser
+    uses the same one)."""
+    from pilosa_tpu.utils.durations import parse_duration
 
-    number = r"[0-9]+(?:\.[0-9]+)?|\.[0-9]+"
-    if re.fullmatch(rf"(?:(?:{number})(?:ms|us|s|m|h))+", s):
-        total = 0.0
-        for num, unit in re.findall(rf"({number})(ms|us|s|m|h)", s):
-            total += float(num) * {"us": 1e-6, "ms": 1e-3, "s": 1, "m": 60, "h": 3600}[unit]
-        return total
-    try:
-        return float(s)
-    except ValueError:
-        raise ValueError(f"invalid duration: {value!r}") from None
+    return parse_duration(value)
 
 
 def _parse_bool(value) -> bool:
@@ -342,6 +372,21 @@ class Server:
             )
         self.holder.open()
         self.api.long_query_time = self.config.long_query_time
+        # slow-query ring capacity (slow-query-ring knob): replace the
+        # default deque so /debug/queries/slow keeps as many offenders
+        # as the operator asked for
+        import collections as _collections
+
+        self.api.long_queries = _collections.deque(
+            maxlen=self.config.slow_query_ring
+        )
+        from pilosa_tpu.qos.slo import SLOEngine
+        from pilosa_tpu.storage.heat import global_heat
+
+        self.api.slo = SLOEngine.from_config(
+            self.config.slo_objectives, self.config.slo_windows
+        )
+        global_heat().half_life_s = self.config.heat_half_life
         self.api.max_writes_per_request = self.config.max_writes_per_request
         self.api.ingest_workers = max(1, self.config.ingest_workers)
         self.api.logger = self.logger

@@ -40,7 +40,9 @@ from pilosa_tpu.shardwidth import (
 )
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
+from pilosa_tpu.storage.heat import global_heat
 from pilosa_tpu.storage.wal import MODE_PER_OP, fsync_dir, wal_fsync
+from pilosa_tpu.utils.cost import current_cost
 
 # Snapshot (compact) once this many op records have accumulated
 # (reference fragment.go opN threshold; exact upstream value unverifiable —
@@ -221,6 +223,27 @@ class Fragment:
     def row_words(self, row: int) -> np.ndarray:
         """Dense uint32[32768] for one row (host side)."""
         base = row << 20
+        cost = current_cost()
+        if cost is not None:
+            # Container-taxonomy cost accounting (Chambi et al.
+            # 1402.6407): this decode walks the row's 16 containers, so
+            # tally kinds for the active request's profile/ledger. Only
+            # residency MISSES reach this path — steady-state hot
+            # queries pay nothing here.
+            from pilosa_tpu.roaring.bitmap import ARRAY, BITMAP, RUN
+
+            a = b = r = 0
+            for key in range(base >> 16, (base >> 16) + 16):
+                c = self.bitmap.container(key)
+                if c is None or not c.n:
+                    continue
+                if c.kind == ARRAY:
+                    a += 1
+                elif c.kind == BITMAP:
+                    b += 1
+                elif c.kind == RUN:
+                    r += 1
+            cost.note_containers(a, b, r)
         return self.bitmap.dense_range_words32(base, base + SHARD_WIDTH)
 
     def device_row(self, row: int):
@@ -602,6 +625,16 @@ class Fragment:
         from pilosa_tpu.utils.stats import global_stats
 
         global_stats().count("fragment_row_writes", int(uniq.size))
+        if current_cost() is not None:
+            # one heat record per batch, weighted by written bits — same
+            # lock-amortization reasoning as the counter above. Gated on
+            # an ACTIVE request context (like the access side): bulk
+            # imports record at the API layer, and background
+            # anti-entropy repair (add_ids/write_row_words with neither)
+            # must not rank merely-repaired shards hot
+            global_heat().record_write(self.index, self.field, self.shard,
+                                       n=float(rows.size),
+                                       scope=self.scope)
 
     def _after_row_write(self, row: int, positions=None, added=None,
                          count_stat: bool = True) -> None:
@@ -621,6 +654,13 @@ class Fragment:
             from pilosa_tpu.utils.stats import global_stats
 
             global_stats().count("fragment_row_writes", 1)
+            if current_cost() is not None:
+                # per-shard write heat (docs/OBSERVABILITY.md) for PQL
+                # writes — an active request context only: bulk imports
+                # record at the API layer, background repair records
+                # nothing (see _after_rows_added)
+                global_heat().record_write(self.index, self.field,
+                                           self.shard, scope=self.scope)
 
     def _check_pos(self, pos: int) -> None:
         if not 0 <= pos < SHARD_WIDTH:

@@ -1,0 +1,230 @@
+"""Per-shard heat telemetry: decayed access/write counters per
+(index, field, shard), with an HBM-residency overlay at
+``GET /debug/heatmap``.
+
+This is the admission signal ROADMAP open item 5's promote/demote policy
+consumes: under Zipf multitenant traffic the residency manager needs to
+know which fragments are HOT NOW — a raw access counter never forgets a
+bulk scan from an hour ago, so heat decays exponentially
+(``half-life`` knob, default 5 minutes) and a cold tenant's shards sink
+toward zero without any sweeper thread: decay is applied lazily at read
+and update time from the stored (value, last-touch) pair.
+
+Recording cost: the executor records one batched access per resolved
+query leaf (index, field, whole shard list — one lock round trip), and
+fragments record writes per mutation batch. The plane shares the cost
+kill switch (utils/cost.set_cost_enabled) so the bench's bare baseline
+can price the hooks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+DEFAULT_HALF_LIFE_S = 300.0
+
+
+class HeatMap:
+    """Decayed per-(index, field, shard) access/write counters."""
+
+    # Decay is applied lazily and AMORTIZED: between applications the
+    # raw adds accumulate, and once an entry's last decay is older than
+    # this many seconds the pending decay folds in. The bounded error
+    # (an add inside the interval decays as if it landed at the
+    # interval's start) is negligible against a 5-minute half-life, and
+    # it keeps the serving hot path to dict adds — no pow() per query.
+    DECAY_INTERVAL_S = 1.0
+
+    def __init__(self, half_life_s: float = DEFAULT_HALF_LIFE_S):
+        self.half_life_s = float(half_life_s)
+        self._lock = threading.Lock()
+        # (scope, index, field, shard) -> [access, write, last_decay].
+        # scope (the holder-unique data-dir tag, same convention as
+        # frag_id/leaf_key) leads the key: two embedded Servers in one
+        # process hold DIFFERENT replicas' data under identical
+        # index/field names, and merging their heat would corrupt the
+        # promote/demote signal exactly in in-process cluster setups.
+        self._h: dict[tuple, list] = {}
+        self.accesses_total = 0
+        self.writes_total = 0
+
+    def _decayed(self, entry: list, now: float) -> None:
+        dt = now - entry[2]
+        if dt >= self.DECAY_INTERVAL_S or self.half_life_s < 2.0:
+            factor = 0.5 ** (dt / max(self.half_life_s, 1e-9))
+            entry[0] *= factor
+            entry[1] *= factor
+            entry[2] = now
+
+    def record_access(self, index: str, field: str, shards,
+                      n: float = 1.0, scope: str = "") -> None:
+        self.record_access_many(index, (field,), shards, n=n, scope=scope)
+
+    def record_access_many(self, index: str, fields, shards,
+                           n: float = 1.0, scope: str = "") -> None:
+        """One query's resolved leaves touched ``shards`` of every field
+        in ``fields`` — batched: ONE lock round trip for the whole
+        assembly (the executor calls this once per operand resolution,
+        the serving hot path)."""
+        now = time.monotonic()
+        fresh = False
+        with self._lock:
+            self.accesses_total += len(shards) * len(fields)
+            for field in fields:
+                for shard in shards:
+                    key = (scope, index, field, shard)
+                    entry = self._h.get(key)
+                    if entry is None:
+                        self._h[key] = [float(n), 0.0, now]
+                        fresh = True
+                    else:
+                        self._decayed(entry, now)
+                        entry[0] += n
+        if fresh:  # table can only grow when a key was inserted
+            self._maybe_prune()
+
+    def record_write(self, index: str, field: str, shard: int,
+                     n: float = 1.0, scope: str = "") -> None:
+        now = time.monotonic()
+        fresh = False
+        with self._lock:
+            self.writes_total += 1
+            key = (scope, index, field, int(shard))
+            entry = self._h.get(key)
+            if entry is None:
+                self._h[key] = [0.0, float(n), now]
+                fresh = True
+            else:
+                self._decayed(entry, now)
+                entry[1] += n
+        if fresh:  # a write-only workload (bulk ingest) must bound the
+            self._maybe_prune()  # table too, not just the read path
+
+    def _maybe_prune(self, max_entries: int = 65536) -> None:
+        """Bound the table: shard churn across many indexes must not
+        grow it forever. Coldest (fully-decayed) entries drop first."""
+        if len(self._h) <= max_entries:  # racy pre-check: prune is best-
+            return                       # effort, the lock below is exact
+        with self._lock:
+            if len(self._h) <= max_entries:
+                return
+            now = time.monotonic()
+            scored = []
+            for key, entry in self._h.items():
+                self._decayed(entry, now)
+                scored.append((entry[0] + entry[1], key))
+            scored.sort()
+            for _, key in scored[: len(self._h) - max_entries // 2]:
+                del self._h[key]
+
+    # --------------------------------------------------------------- views
+
+    def snapshot(self, k: int = 0, residency_overlay: bool = True
+                 ) -> dict:
+        """Heat table sorted hottest-first (access + write heat), each
+        row overlaid with its device residency: exact bytes for
+        per-fragment row entries, plus the (index, field)-level stacked
+        leaf bytes the batched executor holds (one stacked array spans a
+        whole shard block, so it cannot be attributed to one shard)."""
+        now = time.monotonic()
+        with self._lock:
+            rows = []
+            for (scope, index, field, shard), entry in self._h.items():
+                self._decayed(entry, now)
+                row = {
+                    "index": index, "field": field, "shard": shard,
+                    "access": round(entry[0], 3),
+                    "writes": round(entry[1], 3),
+                }
+                if scope:
+                    row["scope"] = scope
+                rows.append(row)
+        rows.sort(key=lambda r: r["access"] + r["writes"], reverse=True)
+        if k:
+            rows = rows[:k]
+        out = {"halfLifeS": self.half_life_s, "shards": rows}
+        if residency_overlay:
+            from pilosa_tpu.storage.residency import global_row_cache
+
+            per_frag, per_field = global_row_cache().residency_overlay()
+            for r in rows:
+                key = (r.get("scope", ""), r["index"], r["field"],
+                       r["shard"])
+                nbytes = per_frag.get(key, 0)
+                r["residentBytes"] = nbytes
+                r["resident"] = bool(
+                    nbytes or per_field.get(
+                        (r.get("scope", ""), r["index"], r["field"]))
+                )
+            out["stackedBytesByField"] = [
+                {"index": i, "field": f, "bytes": b,
+                 **({"scope": s} if s else {})}
+                for (s, i, f), b in sorted(per_field.items())
+            ]
+        return out
+
+    def hottest(self, k: int = 10) -> list[dict]:
+        return self.snapshot(k=k, residency_overlay=False)["shards"]
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "tracked_shards": len(self._h),
+                "accesses_total": self.accesses_total,
+                "writes_total": self.writes_total,
+                "half_life_seconds": self.half_life_s,
+            }
+
+    def prometheus_lines(self, prefix: str, seen: set | None = None,
+                         max_series: int = 32) -> str:
+        """Untagged summary block plus the ``max_series`` hottest shards
+        as tagged gauges (the full table lives at /debug/heatmap)."""
+        from pilosa_tpu.utils.stats import (
+            _meta_lines,
+            escape_label,
+            prometheus_block,
+        )
+
+        seen = seen if seen is not None else set()
+        text = prometheus_block(self.metrics(), prefix, "heat", seen=seen)
+        lines: list[str] = []
+        family = f"{prefix}_heat_shard"
+        lines.extend(_meta_lines(
+            family, "gauge", "decayed per-shard access+write heat "
+            "(hottest shards only; full table at /debug/heatmap)", seen,
+        ))
+        for r in self.hottest(max_series):
+            # scope ALWAYS in the label set (empty for unscoped direct
+            # constructions): two in-process holders sharing the global
+            # map would otherwise emit duplicate samples under identical
+            # labels — an invalid exposition page
+            lines.append(
+                f'{family}{{scope="{escape_label(r.get("scope", ""))}",'
+                f'index="{escape_label(r["index"])}",'
+                f'field="{escape_label(r["field"])}",'
+                f'shard="{r["shard"]}"}} '
+                f'{r["access"] + r["writes"]:g}'
+            )
+        return text + "\n".join(lines) + ("\n" if lines else "")
+
+    def clear(self) -> None:
+        with self._lock:
+            self._h.clear()
+            self.accesses_total = 0
+            self.writes_total = 0
+
+
+_global_heat: HeatMap | None = None
+
+
+def global_heat() -> HeatMap:
+    global _global_heat
+    if _global_heat is None:
+        _global_heat = HeatMap()
+    return _global_heat
+
+
+def set_global_heat(heat: HeatMap) -> None:
+    global _global_heat
+    _global_heat = heat

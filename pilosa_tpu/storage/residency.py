@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD, next_pow2
+from pilosa_tpu.utils.cost import current_cost
 
 ROW_BYTES = WORDS_PER_SHARD * 4  # 128 KiB per resident row
 
@@ -258,6 +259,9 @@ class DeviceRowCache:
             arr = jax.device_put(host, self.device)
             block_idx = self._host_block_index(host)
         self._insert_dense(key, arr, block_idx)
+        cost = current_cost()
+        if cost is not None:  # host→device bytes for the active request
+            cost.note_upload(int(arr.nbytes))
         return arr
 
     def get_row(self, key: tuple, decode: Callable[[], np.ndarray],
@@ -265,11 +269,16 @@ class DeviceRowCache:
         """Return the device array for ``key``, decoding+uploading on miss.
         ``device_put`` overrides placement (e.g. a NamedSharding put);
         entries with custom placement are never compressed."""
+        cost = current_cost()
         with self._lock:
             arr = self._lookup_locked(key)
             if arr is not None:
+                if cost is not None:
+                    cost.note_cache(True)
                 return arr
             self.misses += 1
+            if cost is not None:
+                cost.note_cache(False)
             # decode under the lock: plain get_row keys are per-fragment
             # (invalidated by their writers), so staleness isn't possible,
             # and single-row decodes are cheap
@@ -293,16 +302,21 @@ class DeviceRowCache:
         event the probe cannot patch (PURGE — multi-host sharded leaves)
         forces one re-decode under the lock, which writers then
         serialize behind."""
+        cost = current_cost()
         with self._lock:
             while True:
                 arr = self._lookup_locked(key)
                 if arr is not None:
                     if tag is not None:
                         self._register_locked(key, tag, probe)
+                    if cost is not None:
+                        cost.note_cache(True)
                     return arr
                 if key not in self._pending_builds:
                     break
                 self._build_done.wait()  # another thread is building key
+            if cost is not None:
+                cost.note_cache(False)
             buf: list = []
             self._pending_builds[key] = buf
             if tag is not None:
@@ -464,6 +478,38 @@ class DeviceRowCache:
                     self._bump_generation()
                 else:
                     self.invalidate(key)
+
+    def residency_overlay(self) -> tuple[dict, dict]:
+        """HBM residency bucketed for the heat map (/debug/heatmap):
+        ``(per_fragment, per_field)`` — exact bytes per (scope, index,
+        field, shard) for per-fragment row/plane entries, and (scope,
+        index, field) totals for the batched executor's stacked leaves
+        (one stacked array spans a whole shard block, so its bytes
+        cannot honestly be attributed to a single shard). Scope leads
+        (the holder tag, as in frag_id/leaf_key) so in-process
+        multi-holder setups never conflate replicas. Key shapes are
+        pinned by executor/batch.leaf_key and Fragment.frag_id."""
+        with self._lock:
+            items = [(k, e.arr.nbytes) for k, e in self._rows.items()]
+            items += [(k, e.nbytes) for k, e in self._compressed.items()]
+        per_frag: dict[tuple, int] = {}
+        per_field: dict[tuple, int] = {}
+        for key, nbytes in items:
+            tag = key[0]
+            if isinstance(tag, str) and tag.startswith("stack"):
+                # ("stack"/"stackp", scope, index, field, ...) and
+                # ("stackm", scope, index, field, view, ...); "stackz"
+                # (the shared zero leaf) belongs to nobody
+                if len(key) >= 4 and tag != "stackz":
+                    fkey = (key[1], key[2], key[3])
+                    per_field[fkey] = per_field.get(fkey, 0) + int(nbytes)
+                continue
+            if len(key) >= 6 and isinstance(key[4], int):
+                # frag_id + (row,) / frag_id + ("__planes__", depth):
+                # (scope, index, field, view, shard, ...)
+                fkey = (key[0], key[1], key[2], key[4])
+                per_frag[fkey] = per_frag.get(fkey, 0) + int(nbytes)
+        return per_frag, per_field
 
     # metrics() keys that are monotonic counters (get the Prometheus
     # _total suffix); the rest are point-in-time gauges

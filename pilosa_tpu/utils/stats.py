@@ -30,10 +30,23 @@ HISTOGRAM_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
+def escape_label(value) -> str:
+    """Prometheus label-value escaping (exposition format §label
+    values): backslash, double-quote, and newline must be escaped —
+    client-controlled values (tenant headers, index names) interpolated
+    unescaped would corrupt the whole /metrics page for every scraper."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_tags(tags: dict | None) -> str:
     if not tags:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    # escape values: tag values include CLIENT-controlled strings (the
+    # qos_shed tenant tag comes straight from X-Pilosa-Tenant), and one
+    # embedded quote would corrupt the whole exposition page
+    inner = ",".join(f'{k}="{escape_label(v)}"'
+                     for k, v in sorted(tags.items()))
     return "{" + inner + "}"
 
 

@@ -1,0 +1,116 @@
+"""Windowed-quantile + histogram edge cases for utils/stats.py
+(ISSUE 8 satellite): empty window, single sample, and observations
+landing exactly on a histogram bucket boundary."""
+
+import numpy as np
+
+from pilosa_tpu.utils.stats import (
+    HISTOGRAM_BUCKETS_S,
+    StatsClient,
+    _quantile,
+)
+
+
+def _hist_buckets(text: str, family: str) -> dict:
+    """le → cumulative count for one family's _bucket lines."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith(f"{family}_bucket"):
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            out[le] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+def test_quantile_empty_window_is_none():
+    s = StatsClient()
+    assert s.quantile("nothing", 0.5) is None
+    # a counted-but-sampleless series cannot exist through the public
+    # API (every timing() adds a sample), but the render must not emit
+    # quantile lines for series that were never observed
+    text = s.prometheus_text()
+    assert "quantile" not in text
+
+
+def test_quantile_single_sample():
+    s = StatsClient()
+    s.timing("t", 0.042)
+    assert s.quantile("t", 0.5) == 0.042
+    assert s.quantile("t", 0.95) == 0.042
+    assert s.quantile("t", 0.0) == 0.042
+    text = s.prometheus_text()
+    assert 'pilosa_tpu_t_seconds{quantile="0.5"} 0.042' in text
+    assert "pilosa_tpu_t_seconds_count 1" in text
+
+
+def test_quantile_observation_single_and_empty():
+    s = StatsClient()
+    assert s.quantile("obs", 0.95) is None
+    s.observe("obs", 7)
+    assert s.quantile("obs", 0.5) == 7
+    assert s.quantile("obs", 0.95) == 7
+
+
+def test_quantile_helper_bounds():
+    # index clamping: q=1.0 must return the max, q=0.0 the min, and a
+    # two-sample window must not index past the end
+    assert _quantile([1.0], 1.0) == 1.0
+    assert _quantile([1.0, 2.0], 1.0) == 2.0
+    assert _quantile([1.0, 2.0], 0.0) == 1.0
+    assert _quantile([3.0, 1.0, 2.0], 0.5) == 2.0  # sorts internally
+
+
+def test_histogram_bucket_boundary_exact():
+    """A sample exactly ON a bucket bound counts in THAT bucket
+    (Prometheus le semantics: cumulative count of observations <= le)."""
+    s = StatsClient()
+    bound = HISTOGRAM_BUCKETS_S[0]  # 1 ms
+    s.timing("edge", bound)          # exactly on the first bound
+    s.timing("edge", np.nextafter(bound, 1.0))  # just above
+    text = s.prometheus_text()
+    buckets = _hist_buckets(text, "pilosa_tpu_edge_hist_seconds")
+    assert buckets[f"{bound:g}"] == 1          # on-edge sample included
+    assert buckets[f"{HISTOGRAM_BUCKETS_S[1]:g}"] == 2
+    assert buckets["+Inf"] == 2
+
+
+def test_histogram_sample_above_last_bound():
+    """Samples past the last finite bound appear ONLY in +Inf."""
+    s = StatsClient()
+    last = HISTOGRAM_BUCKETS_S[-1]
+    s.timing("big", last)        # exactly on the last bound: counted
+    s.timing("big", last * 2)    # beyond every finite bound
+    text = s.prometheus_text()
+    buckets = _hist_buckets(text, "pilosa_tpu_big_hist_seconds")
+    assert buckets[f"{last:g}"] == 1
+    assert buckets["+Inf"] == 2
+    # cumulative monotonicity across ALL bounds
+    ordered = [buckets[f"{b:g}"] for b in HISTOGRAM_BUCKETS_S]
+    assert ordered == sorted(ordered)
+
+
+def test_tag_values_escaped_in_exposition():
+    """Tag values reach the registry from client-controlled strings
+    (the qos_shed tenant tag is the X-Pilosa-Tenant header) — quotes,
+    backslashes, and newlines must be escaped or one request corrupts
+    the whole /metrics page."""
+    s = StatsClient()
+    s.count("qos_shed", 1, {"tenant": 'evil"} 1 back\\slash\nline'})
+    text = s.prometheus_text()
+    assert ('pilosa_tpu_qos_shed_total'
+            '{tenant="evil\\"} 1 back\\\\slash\\nline"} 1') in text
+    # the page stays single-line-per-sample (the raw newline is gone)
+    assert all(l.startswith(("#", "pilosa_tpu_"))
+               for l in text.splitlines() if l)
+
+
+def test_histogram_every_bound_hit_exactly():
+    """One sample exactly on EVERY bound: cumulative counts must step
+    by one per bucket (no off-by-one at any edge)."""
+    s = StatsClient()
+    for b in HISTOGRAM_BUCKETS_S:
+        s.timing("all", b)
+    text = s.prometheus_text()
+    buckets = _hist_buckets(text, "pilosa_tpu_all_hist_seconds")
+    for i, b in enumerate(HISTOGRAM_BUCKETS_S):
+        assert buckets[f"{b:g}"] == i + 1, f"bucket le={b:g}"
+    assert buckets["+Inf"] == len(HISTOGRAM_BUCKETS_S)
