@@ -20,8 +20,9 @@
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
-	durability-smoke obs-smoke cost-smoke bench-ingest bench-serving \
-	bench-sync bench-durability bench-tracing bench-profiling
+	durability-smoke obs-smoke cost-smoke chaos-smoke bench-ingest \
+	bench-serving bench-sync bench-durability bench-tracing \
+	bench-profiling bench-chaos
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -57,6 +58,14 @@ obs-smoke:
 cost-smoke:
 	$(PYTEST) tests/test_cost.py tests/test_stats_quantiles.py -m "not slow"
 
+# chaos-smoke: the partition-tolerance gate — fault-plane semantics,
+# symmetric/asymmetric partition scenarios (minority read-only
+# degradation, corroborated death, epoch fencing, rejoin) and one
+# seeded chaos schedule through the four oracles
+# (docs/OPERATIONS.md failure model)
+chaos-smoke:
+	$(PYTEST) tests/test_faults.py tests/test_partition.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -76,3 +85,10 @@ bench-tracing:
 # profile-on <= 10% vs the bare fast-lane plateau
 bench-profiling:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs profiling
+
+# >=20 randomized partition/kill/heal schedules against a 3-node
+# cluster under mixed read+write load, gated on the four
+# partition-safety oracles (zero lost acked writes, no non-quorum
+# deletion, <=1 coordinator per epoch, byte-identical replicas)
+bench-chaos:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs chaos

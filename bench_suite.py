@@ -2122,6 +2122,52 @@ def config_profiling(n_shards: int = 8, n_queries: int = 256,
     }
 
 
+def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
+                 replica_n: int = 2, n_events: int = 6,
+                 seed: int = 0) -> dict:
+    """Partition-tolerance chaos gate (ISSUE 9 — docs/OPERATIONS.md
+    failure model): ``n_schedules`` independent seeded schedules of
+    randomized partition (symmetric + asymmetric) / heal / kill /
+    restart events against a real ``n_nodes``-node in-process cluster
+    under a mixed read+write workload, each gated on the four oracles:
+
+    1. zero lost acked writes (every 200-acked Set queryable after heal),
+    2. no fragment deleted by a non-quorum node (cleanup decision log),
+    3. at most one coordinator acting per epoch (acted-epoch records),
+    4. byte-identical replicas after heal (the PR-4 sync oracle).
+
+    ``ok`` requires every schedule to pass every oracle AND converge
+    (membership reunified, all NORMAL, nobody degraded). A failing
+    schedule's seed is reported so the run replays deterministically
+    (testing/chaos.py)."""
+    from pilosa_tpu.testing.chaos import run_chaos
+
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        out = run_chaos(
+            tmp, n_schedules=n_schedules, n_nodes=n_nodes,
+            replica_n=replica_n, n_events=n_events, seed=seed,
+        )
+    return {
+        "config": "chaos",
+        "metric": "partition_chaos_oracles",
+        "schedules": out["schedules"],
+        "n_nodes": out["n_nodes"],
+        "replica_n": out["replica_n"],
+        "events_total": out["events_total"],
+        "acked_writes_total": out["acked_writes_total"],
+        "lost_acked_writes": out["lost_acked_writes"],
+        "non_quorum_deletions": out["non_quorum_deletions"],
+        "coordinator_conflicts": out["coordinator_conflicts"],
+        "replica_mismatches": out["replica_mismatches"],
+        "unconverged": out["unconverged"],
+        "failed_seeds": out["failed_seeds"],
+        "failed_diags": out["failed_diags"],
+        "wall_s": round(time.time() - t0, 1),
+        "ok": bool(out["ok"] and out["unconverged"] == 0),
+    }
+
+
 def _spawn_cpu_mesh_entry() -> None:
     """Run config5_mesh_cpu8 in a subprocess pinned to an 8-device
     virtual CPU platform (the axon TPU plugin would otherwise own the
@@ -2158,7 +2204,7 @@ def main() -> None:
     parser.add_argument(
         "--configs",
         default="1,2,3,4,5,mesh8,serving,import,ingest,sync,hostpath,"
-                "durability,tracing,profiling",
+                "durability,tracing,profiling,chaos",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -2210,6 +2256,11 @@ def main() -> None:
         "durability": lambda: config_durability(
             n_ops=1600 if args.full else 800,
             n_clients=32 if args.full else 16,
+        ),
+        "chaos": lambda: config_chaos(
+            n_schedules=30 if args.full else 20,
+            n_nodes=5 if args.full else 3,
+            n_events=8 if args.full else 6,
         ),
     }
     floor = None  # lazy: touching the device backend can BLOCK when the

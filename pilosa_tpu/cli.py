@@ -33,6 +33,10 @@ port = 10101
 replica-n = 1                 # replicas per shard
 anti-entropy-interval = 600.0 # seconds; 0 disables the repair ticker
 heartbeat-interval = 5.0      # seconds; 0 disables death detection
+heartbeat-timeout = 2.0       # tight per-probe timeout for liveness
+                              # checks (heartbeat, quorum, death
+                              # corroboration) — a hung peer must not
+                              # stall detection of other failures
 # use-mesh = true             # force the device-mesh executor (default:
                               # auto - mesh when >1 JAX device)
 # device-budget-bytes = 0     # HBM residency budget; 0 = auto

@@ -564,8 +564,12 @@ class InternalClient:
             json.dumps(message).encode(),
         )
 
-    def status(self, uri: str) -> dict:
-        return self._call("GET", f"{uri}/status")
+    def status(self, uri: str, timeout: float | None = None) -> dict:
+        """``timeout`` overrides the client default for THIS probe —
+        liveness checks (heartbeat, quorum, death corroboration) use a
+        tight dedicated cap so one hung peer cannot stall the loop that
+        detects every other failure."""
+        return self._call("GET", f"{uri}/status", timeout=timeout)
 
     def translate_keys(self, uri: str, namespace: str, keys: list[str],
                        create: bool) -> list:

@@ -26,11 +26,14 @@ only happens across hosts (DCN), exactly where the reference used HTTP.
 from __future__ import annotations
 
 import hashlib
+import os
+import random
 import threading
 import time
 import uuid
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.testing import faults
 from pilosa_tpu.utils.pool import concurrent_map
 
 PARTITION_N = 256
@@ -44,6 +47,27 @@ STATE_DEGRADED = "DEGRADED"
 # node dead and re-replicates its shards (memberlist suspect→dead in the
 # reference — SURVEY.md §2 #14, §5.3).
 DEAD_HEARTBEATS = 3
+
+# Control messages fenced by the cluster epoch: a copy stamped with an
+# epoch older than the receiver's is rejected unapplied. These are the
+# messages a partitioned ex-coordinator could otherwise use to un-gate
+# queries, re-trigger resizes, or delete fragments with commands minted
+# before the partition (docs/OPERATIONS.md failure model). Schema
+# deltas and shard announcements stay unfenced — they are idempotent
+# and monotonic, and fencing them would wedge mixed-epoch metadata.
+FENCED_MESSAGES = frozenset(
+    {"cluster-state", "resize-instruction", "resize-cleanup",
+     "node-leave"}
+)
+
+
+class ClusterDegradedError(Exception):
+    """This node cannot reach a majority of the member list (minority
+    side of a partition): coordination and writes are refused, locally-
+    owned reads still serve. Maps to HTTP 503 + Retry-After at the API
+    edge (server/api.py)."""
+
+    retry_after = 5.0
 
 
 class Node:
@@ -121,6 +145,54 @@ class Cluster:
         # slowest peer's RTTs, not the sum over fragments — which also
         # shrinks the gated self-join window that rides sync_holder.
         self.sync_workers = 8
+        # ---- partition tolerance (docs/OPERATIONS.md failure model) ----
+        # Monotonic cluster epoch: minted by the acting coordinator (with
+        # quorum) at each coordinated action, stamped on every fenced
+        # control message, persisted as the highest epoch SEEN — so a
+        # partitioned ex-coordinator healing back cannot act with
+        # commands minted before the partition. Bare clusters (no holder
+        # data dir) keep it in memory only.
+        self._epoch_path = None
+        data_dir = getattr(holder, "data_dir", None) if holder else None
+        if data_dir:
+            self._epoch_path = os.path.join(data_dir, "cluster.epoch")
+        self.epoch = self._load_epoch()
+        # True while this node cannot reach a member-list majority: the
+        # minority side of a partition serves locally-owned reads only
+        # (writes shed 503, no resize, no cleanup, no death declaring).
+        self.degraded = False
+        # Tight dedicated timeout for liveness probes (heartbeat, quorum
+        # checks, death corroboration): a hung peer's socket must not
+        # stall the whole heartbeat loop and delay detection of OTHER
+        # failures. ServerConfig heartbeat-timeout.
+        self.heartbeat_timeout = 2.0
+        # (epoch, action) every time THIS node acted as coordinator —
+        # the chaos harness's ≤1-coordinator-per-epoch oracle reads it.
+        # Bounded deques: on a long-lived server under churn these are
+        # observability rings, not unbounded history (the harness
+        # drains them between schedules, far below the caps).
+        import collections as _collections
+
+        self.acted_epochs = _collections.deque(maxlen=4096)
+        # every cleanup_unowned decision (epoch, quorum, removed count)
+        # — the no-deletion-without-quorum oracle reads it
+        self.cleanup_log = _collections.deque(maxlen=1024)
+        self._rejoin_lock = threading.Lock()
+        self._left = False  # leave() called: never auto-rejoin
+        # peers this node declared dead (id → uri): a node that ends up
+        # SOLO probes them on heartbeat — if one answers, the "deaths"
+        # were a partition and the sides reunite instead of serving as
+        # split-brained 1-node clusters forever
+        self._forgotten: dict[str, str] = {}
+        # observability counters (api.cluster_metrics → /metrics)
+        self.stale_epoch_rejects = 0
+        self.heartbeat_probes = 0
+        self.heartbeat_probe_failures = 0
+        self.deaths_declared = 0
+        self.deaths_vetoed = 0
+        self.quorum_denials = 0
+        self.rejoins = 0
+        self.cleanups_deferred = 0
 
     @property
     def state(self) -> str:
@@ -162,6 +234,160 @@ class Cluster:
             if self._local_fetch_jobs <= 0:
                 self.state = self._commanded_state
 
+    # --------------------------------------------------- epoch / quorum
+
+    def _load_epoch(self) -> int:
+        if self._epoch_path is None:
+            return 0
+        try:
+            with open(self._epoch_path) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def _persist_epoch_locked(self) -> None:
+        if self._epoch_path is None:
+            return
+        tmp = self._epoch_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(str(self.epoch))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._epoch_path)
+        except OSError as e:  # epoch still advances in memory; fencing
+            # degrades to per-process until the disk recovers
+            self._log_exception("cluster epoch persist", e)
+
+    def adopt_epoch(self, epoch: int) -> None:
+        """Record a higher epoch seen on the wire (messages, peers'
+        /status). The persisted high-water mark is what stops a
+        RESTARTED ex-coordinator from reusing pre-partition epochs."""
+        with self._lock:
+            if epoch > self.epoch:
+                self.epoch = int(epoch)
+                self._persist_epoch_locked()
+
+    # Epochs advance in strides, with each node minting into its own
+    # hash slot: two coordinators acting CONCURRENTLY (possible in the
+    # documented 2-member/asymmetric corner where both sides pass their
+    # quorum check) mint provably DIFFERENT epochs, so "one authority
+    # per epoch" holds by construction and the conflict resolves by
+    # fencing — the higher epoch's commands win, the lower side's are
+    # rejected everywhere (the Raft-term shape, without the election).
+    EPOCH_STRIDE = 1024
+
+    def _bump_epoch(self) -> int:
+        """Mint the next epoch for a coordinated action (caller holds
+        quorum — check_quorum adopted the cluster-wide max first, so
+        the minted epoch exceeds anything any reachable peer has
+        seen)."""
+        with self._lock:
+            slot = _hash64(self.local.id) % self.EPOCH_STRIDE
+            self.epoch = ((self.epoch // self.EPOCH_STRIDE + 1)
+                          * self.EPOCH_STRIDE + slot)
+            self._persist_epoch_locked()
+            return self.epoch
+
+    def quorum_size(self) -> int:
+        """Majority of the CURRENT member list (the list quorum-gated
+        actions froze their decisions against)."""
+        with self._lock:
+            return len(self.nodes) // 2 + 1
+
+    def check_quorum(self) -> bool:
+        """Live quorum probe: concurrently /status every member with the
+        tight heartbeat timeout; this node has quorum when itself plus
+        the reachable peers form a member-list majority. Adopts any
+        higher epoch a peer reports (so an action minted next fences
+        above everything the majority has seen) and updates
+        ``degraded``.
+
+        Two-node special case: a majority of 2 is 2, so a lone survivor
+        could never fail over — the reference has the same blind spot
+        (memberlist cannot distinguish peer death from a cut link with
+        n=2). A 2-node survivor is allowed to act; the tradeoff is
+        documented in docs/OPERATIONS.md: run 3+ nodes for partition
+        safety."""
+        with self._lock:
+            peers = [n for n in self.nodes.values()
+                     if n.id != self.local.id]
+            n = len(peers) + 1
+        if not peers:
+            self.degraded = False
+            return True
+
+        def probe(node):
+            try:
+                st = self.client.status(node.uri,
+                                        timeout=self.heartbeat_timeout)
+            except Exception:  # noqa: BLE001 — any transport symptom
+                # (wrapped or raw) reads as unreachable for the vote
+                return None
+            return int(st.get("epoch", 0) or 0)
+
+        epochs = [e for e in concurrent_map(probe, peers) if e is not None]
+        top = max(epochs, default=0)
+        if top > self.epoch:
+            self.adopt_epoch(top)
+        ok = (1 + len(epochs)) >= (n // 2 + 1) or n <= 2
+        self.degraded = not ok
+        if not ok:
+            self.quorum_denials += 1
+        return ok
+
+    def _note_acted(self, epoch: int, action: str) -> None:
+        self.acted_epochs.append((epoch, action))
+
+    # Bounded jittered retry for control-message sends: one dropped
+    # node-leave/state broadcast must not strand a peer in RESIZING
+    # until the straggler timeout. Class attributes so tests and the
+    # chaos harness can shrink the backoff.
+    SEND_ATTEMPTS = 3
+    SEND_BACKOFF_S = 0.05
+
+    def _send_retry(self, uri: str, message: dict) -> dict:
+        """send_message with bounded jittered-backoff retry on NODE
+        faults (transport, 5xx). Deterministic 4xx never retries —
+        every replay would answer the same. Raises the last ClientError
+        when every attempt fails."""
+        last: ClientError | None = None
+        for attempt in range(max(1, self.SEND_ATTEMPTS)):
+            try:
+                return self.client.send_message(uri, message)
+            except ClientError as e:
+                if not e.is_node_fault:
+                    raise
+                last = e
+                if attempt + 1 < self.SEND_ATTEMPTS:
+                    time.sleep(self.SEND_BACKOFF_S * (2 ** attempt)
+                               * (0.5 + random.random()))
+        raise last
+
+    def metrics(self) -> dict:
+        """Partition-tolerance series for /metrics and /debug/vars
+        (docs/OBSERVABILITY.md) — every key present from scrape one."""
+        with self._lock:
+            members = len(self.nodes)
+            suspects = sum(1 for f in self._heartbeat_failures.values()
+                           if f > 0)
+        return {
+            "cluster_epoch": self.epoch,
+            "cluster_quorum": 0 if self.degraded else 1,
+            "cluster_degraded": 1 if self.degraded else 0,
+            "cluster_members": members,
+            "cluster_suspects": suspects,
+            "cluster_heartbeat_probes_total": self.heartbeat_probes,
+            "cluster_heartbeat_failures_total":
+                self.heartbeat_probe_failures,
+            "cluster_deaths_declared_total": self.deaths_declared,
+            "cluster_deaths_vetoed_total": self.deaths_vetoed,
+            "cluster_stale_epoch_rejects_total": self.stale_epoch_rejects,
+            "cluster_quorum_denials_total": self.quorum_denials,
+            "cluster_rejoins_total": self.rejoins,
+            "cluster_cleanup_deferred_total": self.cleanups_deferred,
+        }
+
     # How long the coordinator waits for every member to drain to NORMAL
     # before the post-resize cleanup. A member still RESIZING runs its
     # own gated self-join fetch, which may be SOURCING from fragments the
@@ -172,7 +398,7 @@ class Cluster:
     # delayed behind an undrainable peer.
     CLEANUP_DRAIN_TIMEOUT = 15.0
 
-    def _broadcast_cleanup(self) -> None:
+    def _broadcast_cleanup(self, epoch: int | None = None) -> None:
         """End-of-resize holder cleanup, coordinator-initiated: every
         member drops fragments for shards it no longer owns. Runs ONLY
         after (a) every receiver reported resize-complete AND (b) every
@@ -226,12 +452,19 @@ class Cluster:
                 return
             time.sleep(0.1)
         try:
-            self.cleanup_unowned(members)
+            self.cleanup_unowned(members, epoch=epoch)
         except Exception as e:  # noqa: BLE001 — must not wedge the resize
             self._log_exception("post-resize holder cleanup", e)
-        self._broadcast({"type": "resize-cleanup", "members": members})
+        message = {"type": "resize-cleanup", "members": members}
+        if epoch is not None:
+            # epoch-fenced: a receiver that has seen a newer epoch (a
+            # later coordinator acted) must not delete by this resize's
+            # now-stale view of ownership
+            message["epoch"] = epoch
+        self._broadcast(message)
 
-    def cleanup_unowned(self, members: list[str] | None = None) -> int:
+    def cleanup_unowned(self, members: list[str] | None = None,
+                        epoch: int | None = None) -> int:
         """Reference post-resize holder cleanup: delete fragments for
         shards this node no longer owns. Without this, a node that loses
         a shard during churn keeps an era-frozen copy; when a later
@@ -250,15 +483,43 @@ class Cluster:
         node-join/leave message landing mid-loop would otherwise swing
         shard_nodes() to the NEW ring before the new ring's resize has
         copied anything — at replica_n=1 deleting by the new ring
-        destroys the sole copy the coming resize needs as its source."""
+        destroys the sole copy the coming resize needs as its source.
+
+        QUORUM-GATED (docs/OPERATIONS.md failure model): fragment
+        deletion is the one irreversible control-plane action, and a
+        minority-side node's ring is by definition a minority view of
+        ownership — under an asymmetric partition the pre-gate code
+        deleted sole surviving copies by it. No member-majority contact
+        → no deletion, logged and counted. Every decision (epoch,
+        quorum, removed) lands in ``cleanup_log`` — the chaos harness's
+        no-deletion-without-quorum oracle reads it."""
         if self.holder is None:
             return 0
+        entry = {
+            "epoch": self.epoch if epoch is None else int(epoch),
+            "quorum": True, "removed": 0, "skipped": None,
+        }
+        self.cleanup_log.append(entry)
+        with self._lock:
+            n_members = len(self.nodes)
+        if n_members > 1 and not self.check_quorum():
+            entry["quorum"] = False
+            entry["skipped"] = "no quorum"
+            if self.logger is not None:
+                self.logger.info(
+                    "skipping holder cleanup on %s: no member quorum",
+                    self.local.id,
+                )
+            return 0
+        faults.crash_point("cluster.pre-cleanup")
         with self._lock:
             local_members = sorted(self.nodes)
             ring = self._frozen_ring()
         if self.local.id not in local_members:
+            entry["skipped"] = "departed"
             return 0  # departed (leave()): never self-wipe on exit
         if members is not None and sorted(members) != local_members:
+            entry["skipped"] = "membership mismatch"
             if self.logger is not None:
                 self.logger.info(
                     "skipping post-resize cleanup: membership %s != "
@@ -266,6 +527,7 @@ class Cluster:
                 )
             return 0
         removed = 0
+        deferred = 0
         for index_name, idx in list(self.holder.indexes.items()):
             owned: dict[int, bool] = {}
             for field in list(idx.fields.values()):
@@ -282,8 +544,24 @@ class Cluster:
                                 )
                             )
                             owned[shard] = mine
-                        if not mine:
-                            unowned.append(shard)
+                        if mine:
+                            continue
+                        frag = view.fragment(shard)
+                        if (frag is not None and frag.count()
+                                and not self._owner_covers(
+                                    ring, index_name, field.name,
+                                    view.name, shard, frag)):
+                            # this copy holds bits NO owner does — a
+                            # write acked under an older ring, or
+                            # divergence a partition left behind.
+                            # Deleting it would lose acked data;
+                            # keep it until an anti-entropy pass
+                            # absorbs it into the owners (stray-copy
+                            # absorption in _sync_fragment), and let
+                            # the resize after that delete it.
+                            deferred += 1
+                            continue
+                        unowned.append(shard)
                     # bulk removal: one durable-tombstone barrier per
                     # view, not one group-commit fsync per shard
                     view_removed = view.remove_fragments(
@@ -293,12 +571,61 @@ class Cluster:
                         # one derived-entry purge per field, not per shard
                         view.invalidate_derived_entries()
                         removed += view_removed
-        if removed and self.logger is not None:
+        entry["removed"] = removed
+        entry["deferred"] = deferred
+        if deferred:
+            self.cleanups_deferred += deferred
+        if (removed or deferred) and self.logger is not None:
             self.logger.info(
-                "post-resize cleanup: removed %d non-owned fragments",
-                removed,
+                "post-resize cleanup: removed %d non-owned fragments"
+                " (%d deferred: owners have not absorbed their bits)",
+                removed, deferred,
             )
         return removed
+
+    def _owner_covers(self, ring, index_name: str, field_name: str,
+                      view_name: str, shard: int, frag) -> bool:
+        """True when some live owner of ``shard`` demonstrably holds a
+        SUPERSET of this fragment's bits, so deleting the local copy
+        cannot lose data. Checksum-equal blocks are covered outright;
+        differing blocks are fetched and compared as sets — a strict
+        subset (the era-frozen-copy case) still deletes, only bits the
+        owner genuinely lacks defer the deletion. Uses the per-block
+        legacy wire so mixed-version owners answer too; an unreachable
+        owner simply fails to cover (the next pass retries)."""
+        local_blocks = dict(frag.blocks())
+        if not local_blocks:
+            return True
+        for node in self._partition_nodes_on(
+                ring, self.partition(index_name, shard)):
+            if node.id == self.local.id:
+                continue
+            try:
+                peer_blocks = dict(self.client.fragment_blocks(
+                    node.uri, index_name, field_name, view_name, shard,
+                ))
+            except ClientError:
+                continue
+            covered = True
+            for block, checksum in local_blocks.items():
+                if peer_blocks.get(block) == checksum:
+                    continue  # identical content
+                try:
+                    bm = self.client.fragment_block_bitmap(
+                        node.uri, index_name, field_name, view_name,
+                        shard, block,
+                    )
+                except ClientError:
+                    covered = False
+                    break
+                peer_ids = {int(i) for i in bm.to_ids()}
+                if not {int(i) for i in
+                        frag.block_ids(block)} <= peer_ids:
+                    covered = False  # we hold bits this owner lacks
+                    break
+            if covered:
+                return True
+        return False
 
     def _log_exception(self, what: str, exc: BaseException) -> None:
         logger = self.logger
@@ -386,12 +713,15 @@ class Cluster:
     def _broadcast(self, message: dict, mark_degraded: bool = False) -> None:
         """Deliver a message to every peer, tolerating per-node failures
         (the one broadcast loop — send_sync/leave/state/shard announcements
-        all route here so error handling can't drift between them)."""
+        all route here so error handling can't drift between them). Each
+        send retries on node faults with jittered backoff (_send_retry):
+        a single dropped state broadcast would otherwise strand a peer
+        in RESIZING until the straggler timeout."""
         for node in self.sorted_nodes():
             if node.id == self.local.id:
                 continue
             try:
-                self.client.send_message(node.uri, message)
+                self._send_retry(node.uri, message)
             except ClientError:
                 if mark_degraded:
                     node.state = STATE_DEGRADED
@@ -402,8 +732,31 @@ class Cluster:
 
     def handle_message(self, message: dict) -> dict:
         """Apply a cluster message received from a peer (reference
-        broadcastHandler)."""
+        broadcastHandler).
+
+        Epoch fencing: a FENCED message stamped with an epoch older
+        than this node's is rejected unapplied — the partitioned
+        ex-coordinator's un-gate/resize/cleanup commands die here. A
+        newer epoch is adopted first (the wire doubles as epoch
+        gossip). Messages without an epoch (older wire, bare test
+        constructions) pass unfenced, same mixed-version posture as
+        every other wire change."""
         kind = message.get("type")
+        msg_epoch = message.get("epoch")
+        if msg_epoch is not None:
+            msg_epoch = int(msg_epoch)
+            if msg_epoch > self.epoch:
+                self.adopt_epoch(msg_epoch)
+            elif msg_epoch < self.epoch and kind in FENCED_MESSAGES:
+                self.stale_epoch_rejects += 1
+                if self.logger is not None:
+                    self.logger.info(
+                        "rejecting stale-epoch %s (%d < %d) on %s",
+                        kind, msg_epoch, self.epoch, self.local.id,
+                    )
+                return {"error": f"stale epoch {msg_epoch} "
+                                 f"(current {self.epoch})",
+                        "epoch": self.epoch}
         if kind == "create-index":
             if self.holder.index(message["index"]) is None:
                 self.holder.create_index(
@@ -429,9 +782,28 @@ class Cluster:
                 idx.delete_field(message["field"])
         elif kind == "resize-cleanup":
             try:
-                self.cleanup_unowned(message.get("members"))
+                self.cleanup_unowned(message.get("members"),
+                                     epoch=msg_epoch)
             except Exception as e:  # noqa: BLE001
                 self._log_exception("post-resize holder cleanup", e)
+        elif kind == "suspect-probe":
+            # death corroboration: the asking coordinator suspects a
+            # node; answer with THIS node's own live view of it (tight
+            # timeout — the answer must arrive inside the asker's
+            # heartbeat pass)
+            uri = message.get("uri")
+            if not uri:
+                with self._lock:
+                    node = self.nodes.get(message.get("id"))
+                uri = node.uri if node is not None else None
+            if uri is None:
+                return {"reachable": False, "known": False}
+            try:
+                self.client.status(uri, timeout=self.heartbeat_timeout)
+            except Exception:  # noqa: BLE001 — unreachable however it
+                # failed; this vote corroborates the suspicion
+                return {"reachable": False}
+            return {"reachable": True}
         elif kind == "recalculate-caches":
             # reference RecalculateCachesMessage: each receiver recounts
             # its own fragments' TopN caches (local-only apply — the
@@ -448,13 +820,21 @@ class Cluster:
             node = Node(message["id"], message["uri"])
             with self._lock:
                 self.nodes[node.id] = node
+                self._forgotten.pop(node.id, None)
             # membership changed ownership: the acting coordinator computes
             # per-node fetch instructions (reference ResizeInstruction)
             if self.is_acting_coordinator:
                 self._spawn_resize()
         elif kind == "node-leave":
             with self._lock:
-                self.nodes.pop(message["id"], None)
+                removed = self.nodes.pop(message["id"], None)
+                if removed is not None:
+                    # remember the uri: if this node later ends up solo
+                    # (everyone amputated during a partition) it probes
+                    # forgotten peers to reunite instead of serving as
+                    # a split-brained 1-node cluster (dead peers just
+                    # fail the probe — tracking them is harmless)
+                    self._forgotten[removed.id] = removed.uri
                 self._heartbeat_failures.pop(message["id"], None)
             self._drop_resize_pending(message["id"])
             if self.is_acting_coordinator:
@@ -573,46 +953,288 @@ class Cluster:
 
     def heartbeat(self) -> None:
         """Liveness probe of peers (memberlist's role — SURVEY.md §2 #14).
-        After DEAD_HEARTBEATS consecutive failures the acting coordinator
-        declares the node dead: removes it, broadcasts node-leave, and
-        drives a resize so surviving replicas restore full replication
-        (reference suspect→dead → coordinator resize — SURVEY.md §5.3)."""
-        dead: list[Node] = []
-        for node in self.sorted_nodes():
-            if node.id == self.local.id:
-                continue
+        Probes run CONCURRENTLY with the tight dedicated
+        ``heartbeat_timeout`` — a hung peer's socket must not stall the
+        whole loop and delay detection of OTHER failures. After
+        DEAD_HEARTBEATS consecutive failures the acting coordinator moves
+        the node suspect→dead — but only with member-majority quorum AND
+        ≥2 corroborating observers (all-but-self in 2-node clusters), so
+        a single-observer flap (one cut link) can no longer amputate a
+        live node (reference: memberlist's peer-corroborated suspect
+        protocol — SURVEY.md §5.3).
+
+        Each pass also (a) tracks quorum → the ``degraded`` read-only
+        flag, (b) adopts any higher epoch a peer reports, and (c)
+        detects EVICTION — a reachable peer whose member list no longer
+        contains this node means the majority declared us dead while we
+        were partitioned; we rejoin through it instead of split-braining
+        forever."""
+        with self._lock:
+            peers = [n for n in self.sorted_nodes()
+                     if n.id != self.local.id]
+        if not peers:
+            self.degraded = False
+            if self._forgotten and not self._left:
+                # solo after declaring everyone dead: if any forgotten
+                # peer answers, the "deaths" were a partition — reunite
+                self._solo_reunion()
+            return
+
+        def probe(node):
             try:
-                self.client.status(node.uri)
+                return node, self.client.status(
+                    node.uri, timeout=self.heartbeat_timeout
+                )
+            except ClientError:
+                return node, None
+
+        results = concurrent_map(probe, peers)
+        dead: list[Node] = []
+        live: list[Node] = []
+        rejoin_via: dict | None = None
+        for node, st in results:
+            self.heartbeat_probes += 1
+            if st is not None:
+                live.append(node)
                 node.state = STATE_NORMAL
                 self._heartbeat_failures.pop(node.id, None)
-            except ClientError:
+                peer_epoch = int(st.get("epoch", 0) or 0)
+                if peer_epoch > self.epoch:
+                    self.adopt_epoch(peer_epoch)
+                peer_ids = {n.get("id") for n in st.get("nodes", [])}
+                if (peer_ids and self.local.id not in peer_ids
+                        and (peer_epoch >= self.epoch
+                             or len(peer_ids) >= len(self.nodes))
+                        and rejoin_via is None):
+                    # evicted while partitioned: the peer's view is at
+                    # least as authoritative as ours (newer epoch, or no
+                    # smaller a cluster) — surrender and rejoin through
+                    # it rather than serving a split-brained ring
+                    rejoin_via = st
+            else:
+                self.heartbeat_probe_failures += 1
                 node.state = STATE_DEGRADED
                 fails = self._heartbeat_failures.get(node.id, 0) + 1
                 self._heartbeat_failures[node.id] = fails
                 if fails >= DEAD_HEARTBEATS:
                     dead.append(node)
+        n = len(peers) + 1
+        self.degraded = not ((1 + len(live)) >= (n // 2 + 1) or n <= 2)
+        if rejoin_via is not None and not self._left:
+            self._rejoin(rejoin_via)
+            return
+        if self._forgotten and not self._left:
+            # peers we (or a coordinator) amputated that turn out to be
+            # alive were partitioned, not dead: INVITE the fully-split
+            # ones back (they add us, see our view on their next probe,
+            # and rejoin through it) — without this, a side that never
+            # probes the forgotten node leaves it serving as a
+            # split-brained cluster forever
+            self._probe_forgotten()
         if dead and self.is_acting_coordinator:
-            for node in dead:
-                self.declare_dead(node.id)
-
-    def declare_dead(self, node_id: str) -> None:
-        """Remove a dead node and re-replicate its shards: broadcast the
-        departure, then send per-node resize instructions."""
-        with self._lock:
-            if self.nodes.pop(node_id, None) is None:
+            if self.degraded:
+                # wanted to declare deaths but holds no quorum: the
+                # minority side of a partition observing exactly the
+                # blast radius the gate exists to stop
+                self.quorum_denials += 1
                 return
+            for node in dead:
+                if self._death_corroborated(node, live):
+                    self.declare_dead(node.id)
+                else:
+                    # suspect stays DEGRADED (unrouted) but keeps its
+                    # membership: a one-link flap must not amputate it
+                    self.deaths_vetoed += 1
+
+    def _death_corroborated(self, suspect: Node, live_peers: list[Node]
+                            ) -> bool:
+        """suspect→dead needs ≥2 observers: this node's failed probes
+        plus at least one live peer that ALSO cannot reach the suspect
+        right now (suspect-probe message → the peer runs its own
+        tight-timeout probe). With no other live peer — a 2-node
+        cluster — all-but-self is just this node and the single
+        observation stands (check_quorum documents the 2-node
+        tradeoff); in larger clusters a coordinator that can reach no
+        corroborator has no business declaring deaths (the quorum gate
+        already vetoes that, belt and braces)."""
+        others = [p for p in live_peers if p.id != suspect.id]
+        if not others:
+            return len(self.nodes) <= 2
+
+        def ask(peer):
+            try:
+                out = self.client.send_message(peer.uri, {
+                    "type": "suspect-probe", "id": suspect.id,
+                    "uri": suspect.uri,
+                })
+            except ClientError:
+                return False
+            return out.get("reachable") is False
+
+        return any(concurrent_map(ask, others))
+
+    def declare_dead(self, node_id: str) -> bool:
+        """Remove a dead node and re-replicate its shards: broadcast the
+        departure (epoch-stamped), then send per-node resize
+        instructions. QUORUM-GATED: a minority-side node must not
+        amputate members it merely cannot see — under an asymmetric
+        partition both sides would otherwise each declare the other
+        dead and resize against disjoint rings. Returns False when
+        vetoed (no quorum / unknown node)."""
+        with self._lock:
+            known = node_id in self.nodes
+            n_members = len(self.nodes)
+        if not known:
+            return False
+        if n_members > 2 and not self.check_quorum():
+            if self.logger is not None:
+                self.logger.info(
+                    "refusing to declare %s dead: no member quorum on %s",
+                    node_id, self.local.id,
+                )
+            return False
+        faults.crash_point("cluster.pre-declare-dead")
+        epoch = self._bump_epoch()
+        with self._lock:
+            node = self.nodes.pop(node_id, None)
+            if node is None:
+                return False
+            self._forgotten[node_id] = node.uri
             self._heartbeat_failures.pop(node_id, None)
+        self.deaths_declared += 1
+        self._note_acted(epoch, f"declare-dead:{node_id}")
         self._drop_resize_pending(node_id)
         for node in self.sorted_nodes():
             if node.id == self.local.id:
                 continue
             try:
-                self.client.send_message(
-                    node.uri, {"type": "node-leave", "id": node_id}
-                )
+                self._send_retry(node.uri, {
+                    "type": "node-leave", "id": node_id, "epoch": epoch,
+                })
             except ClientError:
                 pass
         self.coordinate_resize()
+        return True
+
+    def _probe_forgotten(self) -> None:
+        """Tight-timeout probes of declared-dead peers. A reachable one
+        whose member list no longer names US gets a node-join invite:
+        it adds us, its next heartbeat sees our (no-smaller, no-older)
+        view lacking it, and it rejoins through us. One message; safe —
+        a genuinely removed node either stays unreachable (probe fails)
+        or deliberately left (its _left latch refuses auto-rejoin)."""
+        def one(item):
+            node_id, node_uri = item
+            try:
+                st = self.client.status(node_uri,
+                                        timeout=self.heartbeat_timeout)
+            except Exception:  # noqa: BLE001 — still gone
+                return
+            peer_ids = {n.get("id") for n in st.get("nodes", [])}
+            if self.local.id in peer_ids:
+                return  # it still knows us: its own probes reconcile
+            try:
+                self._send_retry(node_uri, {
+                    "type": "node-join", "id": self.local.id,
+                    "uri": self.local.uri,
+                })
+            except ClientError:
+                pass
+
+        concurrent_map(one, list(self._forgotten.items()))
+
+    def _solo_reunion(self) -> None:
+        """A 1-node 'cluster' probing the peers it declared dead: a
+        reachable one means the declarations were really a partition.
+        Merge memberships (only ADDING — there is nobody left to evict)
+        and announce ourselves so both sides' coordinators reconcile;
+        data differences heal through anti-entropy's stray-copy
+        absorption. Without this, a symmetric 2-way amputation leaves
+        two 1-node clusters serving forever."""
+        for node_id, node_uri in list(self._forgotten.items()):
+            try:
+                st = self.client.status(node_uri,
+                                        timeout=self.heartbeat_timeout)
+            except Exception:  # noqa: BLE001 — still unreachable
+                continue
+            if self.logger is not None:
+                self.logger.info(
+                    "%s rediscovered %s after a partition; reuniting",
+                    self.local.id, node_id,
+                )
+            self.rejoins += 1
+            with self._lock:
+                self.nodes[node_id] = Node(node_id, node_uri)
+                for n in st.get("nodes", []):
+                    if n.get("id") and n["id"] not in self.nodes:
+                        self.nodes[n["id"]] = Node(n["id"], n["uri"])
+                self._forgotten.clear()
+            self.adopt_epoch(int(st.get("epoch", 0) or 0))
+            for node in self.sorted_nodes():
+                if node.id == self.local.id:
+                    continue
+                try:
+                    self._send_retry(node.uri, {
+                        "type": "node-join", "id": self.local.id,
+                        "uri": self.local.uri,
+                    })
+                except ClientError:
+                    pass
+            if self.is_acting_coordinator:
+                self._spawn_resize()
+            return
+
+    def _rejoin(self, via_status: dict) -> None:
+        """This node was evicted while partitioned (a reachable peer's
+        member list no longer contains it): adopt the majority's
+        membership + epoch, announce ourselves (the coordinator's
+        node-join resize re-replicates toward us), and run the gated
+        self-join fetch so the stale window is repaired before the
+        query gate releases. Without this, a healed partition leaves
+        the evicted side split-brained forever — each side serving its
+        own ring."""
+        if not self._rejoin_lock.acquire(blocking=False):
+            return  # one rejoin at a time
+        try:
+            if self.logger is not None:
+                self.logger.info(
+                    "%s was evicted while partitioned; rejoining the "
+                    "majority", self.local.id,
+                )
+            self.rejoins += 1
+            with self._lock:
+                replacement = {self.local.id: self.local}
+                for n in via_status.get("nodes", []):
+                    if n.get("id") and n["id"] != self.local.id:
+                        replacement[n["id"]] = Node(n["id"], n["uri"])
+                # members the adoption DROPS go to the forgotten
+                # registry: if the majority's view is itself missing a
+                # live node (cascading partitions), someone must still
+                # probe-and-invite it back — a silently dropped member
+                # is how split-brained 1-node clusters wedge forever
+                dropped = {
+                    node_id: node.uri
+                    for node_id, node in self.nodes.items()
+                    if node_id not in replacement
+                }
+                self.nodes = replacement
+                self._heartbeat_failures.clear()
+                self._forgotten = dropped
+            self.adopt_epoch(int(via_status.get("epoch", 0) or 0))
+            self.degraded = False
+            for node in self.sorted_nodes():
+                if node.id == self.local.id:
+                    continue
+                try:
+                    self._send_retry(node.uri, {
+                        "type": "node-join", "id": self.local.id,
+                        "uri": self.local.uri,
+                    })
+                except ClientError:
+                    pass
+            self.resize_fetch_async()
+        finally:
+            self._rejoin_lock.release()
 
     # ----------------------------------------------------------- join/resize
 
@@ -624,6 +1246,10 @@ class Cluster:
         status = self.client.status(seed_uri)
         for n in status.get("nodes", []):
             self.nodes[n["id"]] = Node(n["id"], n["uri"])
+        # adopt the cluster's epoch before announcing: a node that
+        # rejoins after an eviction must not carry a pre-partition epoch
+        # into its first broadcasts
+        self.adopt_epoch(int(status.get("epoch", 0) or 0))
         # Gate BEFORE announcing: the announce triggers the coordinator's
         # resize, whose post-resize cleanup waits for every member to
         # drain to NORMAL — this node must never be observable as NORMAL
@@ -632,12 +1258,14 @@ class Cluster:
         # the very fragments that fetch is about to pull.
         self._begin_local_fetch()
         try:
-            # announce to everyone (including seed)
+            # announce to everyone (including seed); retried — a missed
+            # join announcement leaves a peer routing around this node
+            # until the next catalog poll
             for node in self.sorted_nodes():
                 if node.id == self.local.id:
                     continue
                 try:
-                    self.client.send_message(
+                    self._send_retry(
                         node.uri,
                         {"type": "node-join", "id": self.local.id,
                          "uri": self.local.uri},
@@ -819,15 +1447,28 @@ class Cluster:
         schema adoption and fetch nothing; the inventory can race a
         source's cleanup). An earlier claims registry that deduplicated
         them converted a failed instruction fetch into a permanent gap —
-        the skipped inventory pass was the safety net."""
+        the skipped inventory pass was the safety net.
+
+        A fragment created here solely to receive the move is REMOVED
+        again when every source failed to supply data and nothing else
+        has written to it: an empty placeholder would otherwise (a)
+        serve silently-empty reads for a shard whose data exists
+        elsewhere and (b) mask the gap from the self-join inventory's
+        "already held locally" check — the other half of the
+        resize-source race (the receiver was left holding an empty
+        fragment when its last usable source disappeared mid-move)."""
         work = []
+        created: list[tuple] = []
         for src in sources:
             idx = self.holder.index(src["index"])
             field = idx.field(src["field"]) if idx else None
             if field is None:
                 continue
             view = field.view(src["view"], create=True)
+            existed = view.fragment(int(src["shard"])) is not None
             frag = view.fragment(int(src["shard"]), create=True)
+            if not existed:
+                created.append((view, int(src["shard"]), frag))
             work.append((src, frag))
 
         from pilosa_tpu.roaring.format import load_any
@@ -884,7 +1525,14 @@ class Cluster:
                 return 1
             return 0  # no replica holds data (or all are unreachable)
 
-        return sum(concurrent_map(one, work))
+        fetched = sum(concurrent_map(one, work))
+        for view, shard, frag in created:
+            # drop placeholders that never received data; a write that
+            # landed concurrently bumped count() and keeps the fragment
+            # (the identity check guards against a racing re-create)
+            if frag.count() == 0 and view.fragment(shard) is frag:
+                view.remove_fragments([shard])
+        return fetched
 
     # Seconds between resize-progress keepalives while a fetch runs.
     RESIZE_PROGRESS_INTERVAL = 10.0
@@ -934,7 +1582,9 @@ class Cluster:
             ka.join(timeout=5)
         if reply_to:
             try:
-                self.client.send_message(reply_to, {
+                # retried: a single dropped completion report would hold
+                # the cluster RESIZING for the full straggler timeout
+                self._send_retry(reply_to, {
                     "type": "resize-complete", "job": job,
                     "node": self.local.id, "fetched": fetched,
                 })
@@ -960,6 +1610,32 @@ class Cluster:
     def _coordinate_resize_locked(self) -> dict:
         if not self.is_acting_coordinator:
             return {}
+        with self._lock:
+            n_members = len(self.nodes)
+        if n_members == 1:
+            # a 1-node "cluster" has nothing to move, nobody to fence,
+            # and — crucially — no business MINTING epochs: a node that
+            # amputated its peers during a partition must not out-mint
+            # the real majority, or the rejoin direction (lower epoch
+            # surrenders) inverts and the majority would shatter itself
+            self._command_state(STATE_NORMAL)
+            return {}
+        if not self.check_quorum():
+            # minority side of a partition: degrade to serving locally-
+            # owned reads instead of resizing against a minority view of
+            # ownership — the pre-gate code's cleanup then deleted sole
+            # surviving copies by that view (the data-loss scenario the
+            # failure model in docs/OPERATIONS.md walks through)
+            if self.logger is not None:
+                self.logger.info(
+                    "refusing to coordinate resize on %s: no member "
+                    "quorum (cluster degraded)", self.local.id,
+                )
+            return {}
+        # check_quorum adopted the reachable maximum, so this epoch
+        # fences above every command the previous coordinator minted
+        epoch = self._bump_epoch()
+        self._note_acted(epoch, "resize")
         # fragment → holders (node ids), from local + peer catalogs
         holders: dict[tuple, list[Node]] = {}
         for index_name, idx in list(self.holder.indexes.items()):
@@ -977,7 +1653,9 @@ class Cluster:
             live_sources = [n for n in have if n.state != STATE_DEGRADED]
             if not live_sources:
                 continue
-            for owner in self.shard_nodes(index_name, s):
+            owners = self.shard_nodes(index_name, s)
+            owner_ids = {n.id for n in owners}
+            for owner in owners:
                 if owner.state == STATE_DEGRADED or owner.id in have_ids:
                     continue
                 usable = [n for n in live_sources if n.id != owner.id]
@@ -986,7 +1664,12 @@ class Cluster:
                 # extra live holders ride along as fallbacks, tried by
                 # the receiver when the primary source errors mid-move
                 # (fetch_fragments) — same contract as the self-join
-                # inventory
+                # inventory. OWNERS FIRST: a holder that remains an
+                # owner keeps its copy, while a non-owner's copy is
+                # deleted by this very resize's cleanup — a receiver
+                # whose fetch races that cleanup loses its source (the
+                # ~1-in-12 resize-source flake)
+                usable.sort(key=lambda n: (n.id not in owner_ids, n.id))
                 instructions.setdefault(owner.id, []).append({
                     "index": index_name, "field": f, "view": v, "shard": s,
                     "from": usable[0].uri,
@@ -1001,11 +1684,11 @@ class Cluster:
             # the dying coordinator's RESIZING broadcast may have missed
             # THIS node while reaching others — idempotent and serialized
             # under _resize_lock, so always safe.
-            self._broadcast_state(STATE_NORMAL)
+            self._broadcast_state(STATE_NORMAL, epoch)
             # a leave can complete with nothing to move (survivors
             # already hold everything) yet still change ownership —
             # non-owned leftovers must go now, not at the next resize
-            self._broadcast_cleanup()
+            self._broadcast_cleanup(epoch)
             return {}
         job = uuid.uuid4().hex
         with self._resize_cv:
@@ -1014,7 +1697,8 @@ class Cluster:
             self._resize_deadline = (
                 time.monotonic() + self.RESIZE_COMPLETE_TIMEOUT
             )
-        self._broadcast_state(STATE_RESIZING)
+        self._broadcast_state(STATE_RESIZING, epoch)
+        faults.crash_point("cluster.post-resizing-broadcast")
         try:
             local_sources = None
             for node_id, sources in instructions.items():
@@ -1027,10 +1711,11 @@ class Cluster:
                 with self._resize_cv:
                     self._resize_pending.add(node_id)
                 try:
-                    self.client.send_message(
+                    self._send_retry(
                         node.uri,
                         {"type": "resize-instruction", "sources": sources,
-                         "job": job, "reply_to": self.local.uri},
+                         "job": job, "reply_to": self.local.uri,
+                         "epoch": epoch},
                     )
                 except ClientError:
                     # failing the quick ack IS a health signal (unlike a
@@ -1056,26 +1741,32 @@ class Cluster:
             with self._resize_cv:
                 self._resize_job = None
                 self._resize_pending = set()
-            self._broadcast_state(STATE_NORMAL)
-            self._broadcast_cleanup()
+            self._broadcast_state(STATE_NORMAL, epoch)
+            self._broadcast_cleanup(epoch)
         return instructions
 
-    def _broadcast_state(self, state: str) -> None:
+    def _broadcast_state(self, state: str, epoch: int | None = None) -> None:
         # sent to EVERY node, including ones marked DEGRADED mid-resize: a
         # node that received RESIZING but is skipped for NORMAL would stay
-        # gated forever (queries time out with "cluster is resizing")
+        # gated forever (queries time out with "cluster is resizing");
+        # epoch-stamped so a healed ex-coordinator's stale un-gate (or
+        # re-gate) commands are rejected by everyone current
         self._command_state(state)
-        self._broadcast({"type": "cluster-state", "state": state})
+        message = {"type": "cluster-state", "state": state}
+        if epoch is not None:
+            message["epoch"] = epoch
+        self._broadcast(message)
 
     def leave(self) -> None:
         """Graceful departure: announce node-leave so peers re-own our
         shards (they repair from replicas; with replica_n == 1 data must be
         drained beforehand — same caveat as the reference)."""
+        self._left = True  # never auto-rejoin after a deliberate exit
         for node in self.sorted_nodes():
             if node.id == self.local.id:
                 continue
             try:
-                self.client.send_message(
+                self._send_retry(
                     node.uri, {"type": "node-leave", "id": self.local.id}
                 )
             except ClientError:
@@ -1251,6 +1942,20 @@ class Cluster:
             n for n in self.shard_nodes(index_name, shard)
             if n.id != self.local.id
         ]
+        # Stray-copy absorption: a NON-owner whose manifest lists this
+        # fragment still contributes — a write acked under an older
+        # ring (or during a partition) may live only on a node that no
+        # longer owns the shard, and cleanup_unowned refuses to delete
+        # such a copy until an owner has demonstrably absorbed it.
+        # Owners first (authoritative), strays after; the conflict-
+        # aware merge rules below apply to both.
+        replica_ids = {n.id for n in replicas} | {self.local.id}
+        for node in self.sorted_nodes():
+            if node.id in replica_ids:
+                continue
+            stray = manifests.get(node.id)
+            if isinstance(stray, dict) and stray.get(key):
+                replicas.append(node)
         view = field.view(view_name, create=True)
         # fragment created lazily at first merge so a sync pass that
         # repairs nothing leaves no empty fragment files

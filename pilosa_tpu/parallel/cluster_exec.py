@@ -32,7 +32,7 @@ from pilosa_tpu.executor.executor import (
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.ops.packing import pack_bits
 from pilosa_tpu.parallel.client import ClientError
-from pilosa_tpu.parallel.cluster import Cluster, Node
+from pilosa_tpu.parallel.cluster import Cluster, ClusterDegradedError, Node
 from pilosa_tpu.qos.deadline import DeadlineExceeded
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.pql.ast import Query
@@ -358,6 +358,18 @@ class ClusterExecutor:
                     raise PQLError(str(e)) from e
 
                 def give_up():
+                    if (e.is_node_fault
+                            and getattr(self.cluster, "degraded", False)):
+                        # minority side of a partition: name the real
+                        # condition (503 + Retry-After at the edge)
+                        # instead of surfacing one peer's transport
+                        # symptom — locally-owned reads still serve
+                        raise ClusterDegradedError(
+                            "cluster degraded (no member quorum): shards "
+                            "owned by unreachable peers cannot be "
+                            "served; only locally-owned reads are "
+                            "available"
+                        ) from e
                     if e.is_node_fault:
                         raise e
                     raise PQLError(str(e)) from e

@@ -36,6 +36,7 @@ import threading
 from collections import deque
 from urllib.parse import urlsplit
 
+from pilosa_tpu.testing import faults
 from pilosa_tpu.utils.tracing import global_tracer
 
 # Retryable symptoms of the keep-alive race: the server closed a pooled
@@ -73,6 +74,11 @@ class ConnectionPool:
         self.max_per_host = max(1, int(max_per_host))
         self.timeout = timeout
         self.ssl_context = ssl_context
+        # fault-injection source label (testing/faults.py): the node
+        # name this pool sends AS, so partition rules can match one
+        # direction of traffic. Set by the owning server; "" for bare
+        # pools (CLI importer, tests), which rules match via src="*".
+        self.fault_source = ""
         self._idle: dict[tuple, deque] = {}
         self._lock = threading.Lock()
         # lifecycle counters (read by /metrics via the owning server)
@@ -164,7 +170,8 @@ class ConnectionPool:
 
     def request(self, method: str, url: str, body: bytes | None = None,
                 headers: dict | None = None,
-                timeout: float | None = None) -> PoolResponse:
+                timeout: float | None = None,
+                _redelivery: bool = False) -> PoolResponse:
         """One request/response exchange on a pooled connection. Returns
         the status whatever it is (no exception on 4xx/5xx); raises the
         underlying socket/http.client error on transport faults."""
@@ -175,6 +182,36 @@ class ConnectionPool:
         path = parts.path or "/"
         if parts.query:
             path += "?" + parts.query
+        # Fault injection (testing/faults.py): one global load + None
+        # test when no plane is installed — the shipping path pays
+        # nothing. ``_redelivery`` marks a duplicate-rule redelivery so
+        # the second copy isn't itself re-intercepted (infinite
+        # duplication otherwise).
+        duplicate = False
+        plane = faults._PLANE
+        if plane is not None and not _redelivery:
+            directive = plane.intercept(
+                self.fault_source, f"{key[1]}:{key[2]}", parts.path or "/"
+            )
+            if directive is not None:
+                if directive.delay_s > 0:
+                    plane.sleep(directive.delay_s)
+                if directive.drop:
+                    # a partitioned link looks like a transport fault to
+                    # the sender: same exception family a dead peer's
+                    # kernel would produce, mapped to ClientError by the
+                    # internal client
+                    raise OSError(
+                        f"fault injected: drop {self.fault_source or '?'}"
+                        f" -> {key[1]}:{key[2]} {parts.path}"
+                    )
+                if directive.error is not None:
+                    status, body_bytes = directive.error
+                    return PoolResponse(
+                        status, {"Content-Type": "application/json"},
+                        body_bytes,
+                    )
+                duplicate = directive.duplicate
         with self._lock:
             self.requests += 1
         effective = self.timeout if timeout is None else timeout
@@ -245,5 +282,12 @@ class ConnectionPool:
                 self._note_discard(conn)
             else:
                 self._checkin(key, conn)
+            if duplicate:
+                # at-least-once delivery: the peer just processed a
+                # copy; deliver another and return the LAST response —
+                # what a duplicating network shows the sender
+                return self.request(method, url, body=body,
+                                    headers=headers, timeout=timeout,
+                                    _redelivery=True)
             return PoolResponse(resp.status, resp.headers, data)
         raise last_exc  # pragma: no cover — loop always returns or raises

@@ -239,6 +239,7 @@ class API:
         import time
 
         from pilosa_tpu.executor.executor import PQLError
+        from pilosa_tpu.parallel.cluster import ClusterDegradedError
         from pilosa_tpu.pql import ParseError
         from pilosa_tpu.qos import DeadlineExceeded
 
@@ -258,6 +259,12 @@ class API:
                     f"too many writes in request: {writes} > "
                     f"max-writes-per-request {self.max_writes_per_request}"
                 )
+            if writes and not remote:
+                # minority side of a partition is READ-ONLY: an acked
+                # write here could be orphaned by the majority's resize
+                # (docs/OPERATIONS.md failure model); shed with 503 +
+                # Retry-After like the admission gate sheds with 429
+                self._check_not_degraded_write()
             kwargs = {"shards": shards}
             if getattr(self.executor, "accepts_remote", False):
                 kwargs["remote"] = remote
@@ -332,6 +339,11 @@ class API:
         except DeadlineExceeded as e:
             self.qos.note_deadline_expired()
             raise ApiError(str(e), 504) from e
+        except ClusterDegradedError as e:
+            # a read that needed shards owned by unreachable peers while
+            # this node lacks quorum: 503 so clients back off and retry
+            # against a healthy (majority-side) node
+            raise self._degraded_error(str(e)) from e
         except (ParseError, PQLError) as e:
             raise ApiError(str(e)) from e
         finally:
@@ -488,6 +500,30 @@ class API:
                 out.append(("err", f"internal: {e}", 500))
         return out
 
+    def _degraded_error(self, message: str) -> ApiError:
+        """503 + Retry-After for the degraded (minority-partition)
+        read-only mode, counted on the QoS shed path so operators see
+        partition sheds beside admission sheds."""
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats().count("qos_shed", 1, {"reason": "cluster_degraded"})
+        err = ApiError(message, 503)
+        err.retry_after = 5.0
+        return err
+
+    def _check_not_degraded_write(self) -> None:
+        """Shed edge writes while this node is the minority side of a
+        partition (cluster.degraded — docs/OPERATIONS.md failure
+        model); locally-owned reads still serve."""
+        cluster = self.cluster
+        if cluster is None or not getattr(cluster, "degraded", False):
+            return
+        raise self._degraded_error(
+            "cluster degraded (no member quorum): writes are shed on "
+            "this node until the partition heals; locally-owned reads "
+            "still serve"
+        )
+
     def _ack_durable(self) -> None:
         """Group-commit durability barrier for the current request's
         writes (applied on THIS node — a routed write's remote portions
@@ -592,6 +628,8 @@ class API:
         cluster, each shard group is routed to every replica owner."""
         idx = self._index(index)
         fld = self._field(idx, field)
+        if not remote:
+            self._check_not_degraded_write()
         # validate BEFORE routing: the roaring bulk route ships pre-built
         # bitmaps that the receiving end cannot re-validate, so bad input
         # must 400 here, not corrupt or 500 downstream
@@ -914,6 +952,8 @@ class API:
                       clear: bool = False, remote: bool = False) -> int:
         idx = self._index(index)
         fld = self._field(idx, field)
+        if not remote:
+            self._check_not_degraded_write()
         if not remote and self.cluster is not None and len(self.cluster.nodes) > 1:
             return self._route_import(
                 index, field, None, columns, None, clear, values=values
@@ -986,6 +1026,8 @@ class API:
         change (an idempotent retry costs the server the same work)."""
         idx = self._index(index)
         fld = self._field(idx, field)
+        if not remote:
+            self._check_not_degraded_write()
         frag = fld.view(view, create=True).fragment(shard, create=True)
         from pilosa_tpu.roaring.format import load_any
 
@@ -1061,6 +1103,13 @@ class API:
                 "nodes": self.cluster.nodes_json(),
                 "localID": self.cluster.local.id,
                 "maxWritesPerRequest": self.max_writes_per_request,
+                # partition-tolerance surface (docs/OPERATIONS.md
+                # failure model): the cluster epoch doubles as epoch
+                # gossip (peers adopt the max they see), and
+                # clusterDegraded tells operators/clients this node is
+                # the minority side of a partition (read-only)
+                "epoch": self.cluster.epoch,
+                "clusterDegraded": bool(self.cluster.degraded),
             }
         return {
             "state": "NORMAL",
@@ -1068,6 +1117,8 @@ class API:
                        "state": "NORMAL"}],
             "localID": "local",
             "maxWritesPerRequest": self.max_writes_per_request,
+            "epoch": 0,
+            "clusterDegraded": False,
         }
 
     def info(self) -> dict:
@@ -1089,6 +1140,26 @@ class API:
 
     def node_id(self) -> str:
         return self.cluster.local.id if self.cluster is not None else "local"
+
+    def cluster_metrics(self) -> dict:
+        """Partition-tolerance series (epoch, quorum, heartbeat,
+        fencing) for /metrics and /debug/vars — zeros with no cluster
+        wired, so the series exist from scrape one either way."""
+        if self.cluster is not None and hasattr(self.cluster, "metrics"):
+            return self.cluster.metrics()
+        return {
+            "cluster_epoch": 0, "cluster_quorum": 1,
+            "cluster_degraded": 0, "cluster_members": 1,
+            "cluster_suspects": 0,
+            "cluster_heartbeat_probes_total": 0,
+            "cluster_heartbeat_failures_total": 0,
+            "cluster_deaths_declared_total": 0,
+            "cluster_deaths_vetoed_total": 0,
+            "cluster_stale_epoch_rejects_total": 0,
+            "cluster_quorum_denials_total": 0,
+            "cluster_rejoins_total": 0,
+            "cluster_cleanup_deferred_total": 0,
+        }
 
     def observability_metrics(self) -> dict:
         """Tracing / inspector / slow-query series for /metrics and

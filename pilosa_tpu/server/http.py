@@ -66,6 +66,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("GET", re.compile(r"^/internal/schema$"), "get_schema"),
+    ("GET", re.compile(r"^/debug/faults$"), "get_faults"),
+    ("POST", re.compile(r"^/debug/faults$"), "post_faults"),
+    ("DELETE", re.compile(r"^/debug/faults$"), "delete_faults"),
     ("GET", re.compile(r"^/debug/traces$"), "get_traces"),
     ("GET", re.compile(r"^/debug/tenants$"), "get_tenants"),
     ("GET", re.compile(r"^/debug/heatmap$"), "get_heatmap"),
@@ -705,6 +708,10 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # inspector gauges, and the slow-query ring's counter
         text += prometheus_block(self.api.observability_metrics(), prefix,
                                   seen=seen)
+        # partition-tolerance plane (docs/OPERATIONS.md failure model):
+        # epoch, quorum/degraded gauges, heartbeat + fencing counters
+        text += prometheus_block(self.api.cluster_metrics(), prefix,
+                                  seen=seen)
         # query cost plane (docs/OBSERVABILITY.md): per-tenant usage
         # accounting, per-shard heat, and SLO burn-rate gauges — tagged
         # series are cardinality-capped (full tables live on their
@@ -715,6 +722,69 @@ class HTTPHandler(BaseHTTPRequestHandler):
         text += global_heat().prometheus_lines(prefix, seen=seen)
         text += self.api.slo.prometheus_lines(prefix, seen=seen)
         self._text(text, "text/plain; version=0.0.4")
+
+    def get_faults(self, query=None):
+        """Installed fault-injection rules + hit counters
+        (testing/faults.py — docs/OPERATIONS.md failure model)."""
+        from pilosa_tpu.testing import faults
+
+        plane = faults.active()
+        if plane is None:
+            self._json({"enabled": False, "rules": []})
+            return
+        self._json({"enabled": True, **plane.snapshot()})
+
+    def post_faults(self, query=None):
+        """Program the fault plane over HTTP: ``{"rules": [{action, src,
+        dst, route, delayMs, status, count}, ...]}`` installs rules
+        (creating the plane on first use), ``{"heal": true}`` removes
+        every drop rule, ``{"clear": true}`` removes all rules. The
+        serving node registers its own name→endpoint mapping when the
+        plane appears, so rules can target node names."""
+        from pilosa_tpu.testing import faults
+
+        body = self._json_body()
+        plane = faults.active()
+        if plane is None:
+            plane = faults.install()
+        if self.api.cluster is not None:
+            # register EVERY known member's name→endpoint (from the
+            # advertised URIs peers actually dial): rules written
+            # against node names must match traffic toward REMOTE
+            # nodes too, not only the serving node — a dst="n1" rule
+            # posted to n0 is otherwise a silent no-op
+            for node in self.api.cluster.sorted_nodes():
+                plane.name_endpoint(node.id,
+                                    node.uri.split("://", 1)[-1])
+        if body.get("clear"):
+            plane.clear_rules()
+        if body.get("heal"):
+            plane.heal()
+        installed = []
+        for spec in body.get("rules", []):
+            try:
+                rule = plane.add(
+                    spec.get("action", ""),
+                    src=spec.get("src", "*"),
+                    dst=spec.get("dst", "*"),
+                    route=spec.get("route", "*"),
+                    delay_ms=float(spec.get("delayMs", 0.0)),
+                    status=int(spec.get("status", 503)),
+                    count=(int(spec["count"])
+                           if spec.get("count") is not None else None),
+                )
+            except (ValueError, TypeError) as e:
+                raise ApiError(f"invalid fault rule {spec!r}: {e}") from e
+            installed.append(rule.id)
+        self._json({"installed": installed, **plane.snapshot()})
+
+    def delete_faults(self, query=None):
+        """Clear every rule and uninstall the plane — the wire is
+        guaranteed clean afterwards (the zero-overhead off state)."""
+        from pilosa_tpu.testing import faults
+
+        faults.clear()
+        self._json({"enabled": False})
 
     def get_traces(self, query=None):
         from pilosa_tpu.utils.tracing import global_tracer
@@ -804,6 +874,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap["tenants"] = self.api.cost.metrics()
         snap["heat"] = global_heat().metrics()
         snap["slo"] = self.api.slo.metrics()
+        snap["cluster"] = self.api.cluster_metrics()
         self._json(snap)
 
     def get_pprof(self, query=None):

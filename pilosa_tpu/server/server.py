@@ -31,6 +31,7 @@ class ServerConfig:
         advertise: str = "",
         seeds: list[str] | None = None,
         heartbeat_interval: float = 5.0,
+        heartbeat_timeout: float = 2.0,
         use_mesh: bool | None = None,
         tracing: bool = False,
         trace_sample_rate: float = 0.0,
@@ -75,6 +76,16 @@ class ServerConfig:
         self.advertise = advertise
         self.seeds = seeds or []
         self.heartbeat_interval = heartbeat_interval
+        # Tight dedicated timeout for liveness probes (heartbeat, quorum
+        # checks, death corroboration): a hung peer must not stall the
+        # loop that detects every OTHER failure (docs/OPERATIONS.md
+        # failure model).
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        if self.heartbeat_timeout <= 0:
+            raise ValueError(
+                f"invalid heartbeat-timeout {heartbeat_timeout!r} "
+                "(want > 0)"
+            )
         self.use_mesh = use_mesh  # None = auto (mesh when >1 device)
         # Distributed tracing (docs/OBSERVABILITY.md): `tracing = true`
         # is the legacy always-on switch (rate 1.0); `trace-sample-rate`
@@ -185,6 +196,9 @@ class ServerConfig:
             advertise=d.get("advertise", ""),
             seeds=_parse_list(d.get("seeds", d.get("gossip-seeds", []))),
             heartbeat_interval=float(d.get("heartbeat-interval", 5.0)),
+            heartbeat_timeout=_parse_duration(
+                d.get("heartbeat-timeout", d.get("heartbeat_timeout", 2.0))
+            ),
             tracing=_parse_bool(d.get("tracing", False)),
             trace_sample_rate=float(
                 d.get("trace-sample-rate", d.get("trace_sample_rate", 0.0))
@@ -283,6 +297,7 @@ class ServerConfig:
             "advertise": self.advertise,
             "seeds": self.seeds,
             "heartbeat-interval": self.heartbeat_interval,
+            "heartbeat-timeout": self.heartbeat_timeout,
             "tracing": self.tracing,
             "trace-sample-rate": self.trace_sample_rate,
             "trace-log-dir": self.trace_log_dir,
@@ -479,6 +494,21 @@ class Server:
         cluster.api = self.api
         cluster.logger = self.logger
         cluster.sync_workers = max(1, self.config.sync_workers)
+        cluster.heartbeat_timeout = self.config.heartbeat_timeout
+        # fault-injection identity (testing/faults.py): label outbound
+        # traffic with this node's name and register the name→endpoint
+        # mapping when a plane is installed, so partition rules written
+        # against node names match this node's wire both ways
+        from pilosa_tpu.testing import faults as _faults
+
+        cluster.client.pool.fault_source = name
+        _plane = _faults.active()
+        if _plane is not None:
+            # register the ADVERTISED endpoint — the hostname:port
+            # peers dial (and the connpool keys traffic by) — not the
+            # bind address, which differs under advertise= or wildcard
+            # binds and would make name-addressed rules miss this node
+            _plane.name_endpoint(name, uri.split("://", 1)[-1])
         # repair/resize data-plane shaping: one pacer per node's internal
         # client, shared by every transfer path (manifest deltas,
         # per-block fallbacks, whole-fragment resize fetches)

@@ -1,0 +1,8 @@
+"""Deterministic fault-injection and chaos machinery.
+
+``faults`` is the rule engine hooked into the internal HTTP wire
+(parallel/connpool.py) behind a zero-overhead-when-off check; ``chaos``
+drives randomized partition/heal/kill schedules against an in-process
+cluster and checks the four partition-safety oracles
+(docs/OPERATIONS.md failure model).
+"""

@@ -1,0 +1,487 @@
+"""Deterministic chaos harness: randomized partition/heal/kill/churn
+schedules against a real in-process cluster under a mixed read+write
+workload, gated on the four partition-safety oracles
+(docs/OPERATIONS.md failure model):
+
+1. **Zero lost acked writes** — every Set() a client saw acknowledged
+   (HTTP 200, changed=true) is queryable cluster-wide after heal.
+2. **No fragment deleted by a non-quorum node** — every
+   ``cleanup_unowned`` decision is logged with its quorum verdict;
+   any removal without quorum is an oracle failure.
+3. **At most one coordinator acting per epoch** — every coordinated
+   action (declare-dead, resize) records (epoch, node); two actors in
+   one epoch means fencing failed.
+4. **Byte-identical replicas after heal** — the PR-4 sync oracle: once
+   converged, every owner of a fragment holds the same serialized
+   bytes.
+
+Schedules are seeded (``random.Random(seed)``) so a failing run
+replays. Partitions are injected on the internal wire only
+(testing/faults.py through the connection pool); the workload's edge
+requests ride plain urllib, so the observer is never partitioned from
+the nodes — a write acked through a reachable node counts even when
+that node is about to be cut off.
+
+Used by ``bench_suite.py config_chaos`` (the ≥20-schedule gate recorded
+in BENCH_SUITE.json) and the ``slow`` soak in tests/test_partition.py.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import faults
+
+N_ROWS = 4
+INDEX = "chaos"
+FIELD = "f"
+
+
+def _post(base: str, path: str, data: bytes,
+          content_type: str = "application/json", timeout: float = 10.0):
+    r = urllib.request.Request(f"{base}{path}", data=data, method="POST")
+    r.add_header("Content-Type", content_type)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+class ChaosHarness:
+    """One cluster + one seeded schedule of fault events under load."""
+
+    def __init__(self, tmp_dir, n_nodes: int = 3, replica_n: int = 2,
+                 seed: int = 0, n_events: int = 6,
+                 event_gap_s: float = 0.3, writer_threads: int = 2,
+                 reader_threads: int = 1, n_shards: int = 4,
+                 log=lambda msg: None):
+        self.tmp_dir = str(tmp_dir)
+        self.n_nodes = n_nodes
+        self.replica_n = replica_n
+        self.rng = random.Random(seed)
+        self.n_events = n_events
+        self.event_gap_s = event_gap_s
+        self.writer_threads = writer_threads
+        self.reader_threads = reader_threads
+        self.n_shards = n_shards
+        self.log = log
+        self.servers: dict[str, object] = {}   # name -> live Server
+        self.downed: dict[str, int] = {}       # name -> port to rebind
+        self.plane = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # acked-write ledger: (row, col) the workload saw acknowledged
+        self.acked: set[tuple[int, int]] = set()
+        self.write_errors = 0
+        self.writes_acked = 0
+        self.events: list[str] = []
+        # harvested across restarts (a closed Server's cluster object
+        # would otherwise take its logs with it)
+        self.all_acted: list[tuple[int, str, str]] = []  # (epoch, node, act)
+        self.all_cleanups: list[dict] = []
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _make_server(self, name: str, seeds: list[str], port: int = 0):
+        from pilosa_tpu.server import Server, ServerConfig
+
+        server = Server(ServerConfig(
+            data_dir=f"{self.tmp_dir}/{name}", port=port, name=name,
+            replica_n=self.replica_n, seeds=seeds,
+            anti_entropy_interval=0, heartbeat_interval=0,
+            heartbeat_timeout=0.5, use_mesh=False,
+        )).open()
+        cluster = server.api.cluster
+        # instance-attr overrides: fast backoffs + short drains so the
+        # schedule's wall time is events, not timeouts
+        cluster.SEND_BACKOFF_S = 0.01
+        cluster.CLEANUP_DRAIN_TIMEOUT = 2.0
+        cluster.RESIZE_COMPLETE_TIMEOUT = 10.0
+        return server
+
+    def boot(self) -> "ChaosHarness":
+        self.plane = faults.install()
+        for i in range(self.n_nodes):
+            name = f"n{i}"
+            seeds = ([self._uri(next(iter(self.servers.values())))]
+                     if self.servers else [])
+            self.servers[name] = self._make_server(name, seeds)
+        for s in self.servers.values():
+            s.api.cluster.wait_until_normal(30)
+        base = self._uri(self.servers["n0"])
+        _post(base, f"/index/{INDEX}", b"{}")
+        _post(base, f"/index/{INDEX}/field/{FIELD}", b"{}")
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            servers = list(self.servers.values())
+            self.servers = {}
+        for s in servers:
+            self._harvest(s)
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+        faults.clear()
+
+    @staticmethod
+    def _uri(server) -> str:
+        return f"http://localhost:{server.port}"
+
+    def _harvest(self, server) -> None:
+        cluster = server.api.cluster
+        name = cluster.local.id
+        self.all_acted.extend(
+            (epoch, name, action) for epoch, action in cluster.acted_epochs
+        )
+        self.all_cleanups.extend(cluster.cleanup_log)
+        cluster.acted_epochs.clear()
+        cluster.cleanup_log.clear()
+
+    def _live(self) -> list:
+        with self._lock:
+            return list(self.servers.values())
+
+    # -------------------------------------------------------------- workload
+
+    def _writer(self, t: int) -> None:
+        i = 0
+        while not self._stop.is_set():
+            servers = self._live()
+            if not servers:
+                time.sleep(0.05)
+                continue
+            server = self.rng.choice(servers)
+            shard = i % self.n_shards
+            pos = t * 100_000 + (i // self.n_shards)
+            col = shard * SHARD_WIDTH + pos
+            row = 1 + (i % N_ROWS)
+            i += 1
+            try:
+                out = _post(self._uri(server), f"/index/{INDEX}/query",
+                            f"Set({col}, {FIELD}={row})".encode(),
+                            content_type="text/plain", timeout=5.0)
+            except Exception:  # noqa: BLE001 — shed/refused/timeout:
+                # unacked, so the ledger owes nothing for it
+                self.write_errors += 1
+                continue
+            if out.get("results") == [True]:
+                with self._lock:
+                    self.acked.add((row, col))
+                    self.writes_acked += 1
+            time.sleep(0.01)
+
+    def _reader(self) -> None:
+        while not self._stop.is_set():
+            servers = self._live()
+            if servers:
+                try:
+                    _post(self._uri(self.rng.choice(servers)),
+                          f"/index/{INDEX}/query",
+                          f"Count(Row({FIELD}=1))".encode(),
+                          content_type="text/plain", timeout=5.0)
+                except Exception:  # noqa: BLE001 — reads may 503 on a
+                    pass           # degraded minority; that IS the design
+            time.sleep(0.02)
+
+    # --------------------------------------------------------------- events
+
+    def _heartbeat_round(self) -> None:
+        for s in self._live():
+            try:
+                s.api.cluster.heartbeat()
+            except Exception:  # noqa: BLE001 — a heartbeat pass racing
+                pass           # a concurrent kill must not abort the run
+
+    def _event_partition(self) -> str:
+        self.plane.heal()
+        names = sorted(self.servers) + sorted(self.downed)
+        self.rng.shuffle(names)
+        cut = self.rng.randrange(1, len(names))
+        side_a, side_b = names[:cut], names[cut:]
+        symmetric = self.rng.random() < 0.6
+        for a in side_a:
+            for b in side_b:
+                self.plane.partition(a, b, bidirectional=symmetric)
+        kind = "sym" if symmetric else "asym"
+        return f"partition[{kind}] {side_a}|{side_b}"
+
+    def _event_heal(self) -> str:
+        self.plane.heal()
+        return "heal"
+
+    def _event_kill(self) -> str:
+        with self._lock:
+            if len(self.servers) < 3:
+                return "kill-skipped"  # keep ≥2 alive for the workload
+            name = self.rng.choice(sorted(self.servers))
+            server = self.servers.pop(name)
+        self._harvest(server)
+        # remember the PORT: a restarted node comes back on its old
+        # advertised address, like a real deployment — peers' member
+        # lists and forgotten-peer registries hold URIs, and a node
+        # that silently moves ports is undiscoverable by either
+        self.downed[name] = server.port
+        server.close()
+        return f"kill {name}"
+
+    def _event_restart(self) -> str:
+        if not self.downed:
+            return "restart-skipped"
+        name = self.rng.choice(sorted(self.downed))
+        port = self.downed.pop(name)
+        live = self._live()
+        seeds = [self._uri(live[0])] if live else []
+        server = self._make_server(name, seeds, port=port)
+        with self._lock:
+            self.servers[name] = server
+        return f"restart {name}"
+
+    def run_schedule(self) -> dict:
+        """Workload on, randomized events, then heal + converge and
+        check every oracle. Returns the schedule's record."""
+        threads = [
+            threading.Thread(target=self._writer, args=(t,), daemon=True)
+            for t in range(self.writer_threads)
+        ] + [
+            threading.Thread(target=self._reader, daemon=True)
+            for _ in range(self.reader_threads)
+        ]
+        for t in threads:
+            t.start()
+        choices = [
+            (self._event_partition, 4), (self._event_heal, 2),
+            (self._event_kill, 2), (self._event_restart, 2),
+        ]
+        bag = [fn for fn, w in choices for _ in range(w)]
+        t0 = time.monotonic()
+        for _ in range(self.n_events):
+            event = self.rng.choice(bag)()
+            self.events.append(event)
+            self.log(f"  event: {event}")
+            # liveness passes between events: detection, death
+            # declaring, degradation flips all ride heartbeats
+            for _ in range(2):
+                time.sleep(self.event_gap_s / 2)
+                self._heartbeat_round()
+        # end of schedule: stop faults, bring everything back, converge
+        self._stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        self.plane.heal()
+        while self.downed:
+            self.log(f"  finale: {self._event_restart()}")
+        converged = self._converge(deadline_s=60)
+        record = self._check_oracles()
+        record.update({
+            "events": list(self.events),
+            "converged": converged,
+            "converge_diag": getattr(self, "converge_diag", None),
+            "acked_writes": len(self.acked),
+            "write_errors": self.write_errors,
+            "wall_s": round(time.monotonic() - t0, 2),
+        })
+        return record
+
+    # ----------------------------------------------------------- convergence
+
+    def _converge(self, deadline_s: float = 90.0) -> bool:
+        full = {f"n{i}" for i in range(self.n_nodes)}
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            self._heartbeat_round()
+            self._heartbeat_round()  # suspect→dead/rejoin need streaks
+            servers = self._live()
+            # drain any pending/background resizes through the acting
+            # coordinator's serialized resize lock
+            for s in servers:
+                if s.api.cluster.is_acting_coordinator:
+                    try:
+                        s.api.cluster.coordinate_resize()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    break
+            ok = all(
+                set(s.api.cluster.nodes) == full
+                and s.api.cluster.wait_until_normal(5)
+                and not s.api.cluster.degraded
+                for s in servers
+            ) and len(servers) == self.n_nodes
+            if ok:
+                break
+            time.sleep(0.2)
+        else:
+            # capture WHY for the bench record — unconverged runs are
+            # otherwise undebuggable after the fact
+            self.converge_diag = {
+                s.config.name: {
+                    "members": sorted(s.api.cluster.nodes),
+                    "state": s.api.cluster.state,
+                    "degraded": s.api.cluster.degraded,
+                    "epoch": s.api.cluster.epoch,
+                } for s in self._live()
+            }
+            return False
+        # repair passes until quiescent (bounded): every node pulls the
+        # blocks it is missing from its replicas
+        for _ in range(4):
+            repaired = 0
+            for s in self._live():
+                try:
+                    repaired += s.api.cluster.sync_holder()["bits"]
+                except Exception:  # noqa: BLE001
+                    repaired += 1  # retry next round
+            if repaired == 0:
+                break
+        return True
+
+    # -------------------------------------------------------------- oracles
+
+    def _check_oracles(self) -> dict:
+        for s in self._live():
+            self._harvest(s)
+        lost = self._oracle_lost_writes()
+        non_quorum_deletions = [
+            e for e in self.all_cleanups
+            if e.get("removed") and not e.get("quorum")
+        ]
+        actors_by_epoch: dict[int, set[str]] = {}
+        for epoch, name, _action in self.all_acted:
+            actors_by_epoch.setdefault(epoch, set()).add(name)
+        conflicts = {e: sorted(a) for e, a in actors_by_epoch.items()
+                     if len(a) > 1}
+        mismatches = self._oracle_replica_identity()
+        return {
+            "lost_acked_writes": len(lost),
+            "lost_sample": sorted(lost)[:5],
+            "non_quorum_deletions": len(non_quorum_deletions),
+            "coordinator_conflicts": conflicts,
+            "replica_mismatches": mismatches,
+            "epochs_acted": len(actors_by_epoch),
+            "ok": (not lost and not non_quorum_deletions
+                   and not conflicts and not mismatches),
+        }
+
+    def _oracle_lost_writes(self) -> set:
+        """Every acked (row, col) must be queryable cluster-wide."""
+        with self._lock:
+            acked = set(self.acked)
+        if not acked:
+            return set()
+        servers = self._live()
+        missing = set(acked)
+        for attempt in range(3):
+            got: set[tuple[int, int]] = set()
+            probe = servers[attempt % len(servers)]
+            for row in range(1, N_ROWS + 1):
+                try:
+                    out = _post(self._uri(probe), f"/index/{INDEX}/query",
+                                f"Row({FIELD}={row})".encode(),
+                                content_type="text/plain", timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    continue
+                got.update((row, c) for c in
+                           out.get("results", [{}])[0].get("columns", []))
+            missing = acked - got
+            if not missing:
+                return set()
+            # not yet converged: another repair round, then re-ask
+            for s in servers:
+                try:
+                    s.api.cluster.sync_holder()
+                except Exception:  # noqa: BLE001
+                    pass
+        return missing
+
+    def _oracle_replica_identity(self) -> list:
+        """Post-heal, every owner of a fragment holds byte-identical
+        data (the PR-4 sync oracle); an owner missing a fragment other
+        owners hold non-empty is a mismatch too."""
+        servers = self._live()
+        keys: set[tuple[str, str, str, int]] = set()
+        for s in servers:
+            for iname, idx in s.holder.indexes.items():
+                for fname, field in idx.fields.items():
+                    for vname, view in field.views.items():
+                        for shard in view.fragments:
+                            keys.add((iname, fname, vname, shard))
+        mismatches = []
+        for iname, fname, vname, shard in sorted(keys):
+            owners = [s for s in servers
+                      if s.api.cluster.owns_shard(iname, shard)]
+            payloads = {}
+            for s in owners:
+                idx = s.holder.index(iname)
+                field = idx.field(fname) if idx else None
+                view = field.view(vname) if field else None
+                frag = view.fragment(shard) if view else None
+                payloads[s.config.name] = (
+                    frag.serialize_snapshot()
+                    if frag is not None and frag.count() else b""
+                )
+            distinct = set(payloads.values())
+            if len(distinct) > 1:
+                mismatches.append({
+                    "fragment": f"{iname}/{fname}/{vname}/{shard}",
+                    "holders": {k: len(v) for k, v in payloads.items()},
+                })
+        return mismatches
+
+
+def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
+              replica_n: int = 2, seed: int = 0, n_events: int = 6,
+              event_gap_s: float = 0.3, log=lambda msg: None) -> dict:
+    """Run ``n_schedules`` independent seeded schedules (fresh cluster
+    each — a schedule's damage must not leak into the next) and fold
+    the oracle verdicts. Any failing schedule reports its seed so the
+    run replays deterministically."""
+    records = []
+    for i in range(n_schedules):
+        schedule_seed = seed * 1000 + i
+        log(f"chaos schedule {i + 1}/{n_schedules} (seed {schedule_seed})")
+        harness = ChaosHarness(
+            f"{tmp_dir}/sched{i}", n_nodes=n_nodes, replica_n=replica_n,
+            seed=schedule_seed, n_events=n_events,
+            event_gap_s=event_gap_s, log=log,
+        )
+        try:
+            harness.boot()
+            record = harness.run_schedule()
+        finally:
+            harness.close()
+        record["seed"] = schedule_seed
+        records.append(record)
+        log(f"  -> ok={record['ok']} acked={record['acked_writes']} "
+            f"wall={record['wall_s']}s")
+    failed = [r for r in records if not r["ok"]]
+    return {
+        "schedules": n_schedules,
+        "n_nodes": n_nodes,
+        "replica_n": replica_n,
+        "acked_writes_total": sum(r["acked_writes"] for r in records),
+        "events_total": sum(len(r["events"]) for r in records),
+        "lost_acked_writes": sum(r["lost_acked_writes"] for r in records),
+        "non_quorum_deletions": sum(r["non_quorum_deletions"]
+                                    for r in records),
+        "coordinator_conflicts": [r["coordinator_conflicts"]
+                                  for r in records
+                                  if r["coordinator_conflicts"]],
+        "replica_mismatches": sum(len(r["replica_mismatches"])
+                                  for r in records),
+        "unconverged": sum(1 for r in records if not r["converged"]),
+        "failed_seeds": [r["seed"] for r in failed],
+        "failed_diags": [
+            {"seed": r["seed"], "events": r["events"],
+             "lost": r["lost_acked_writes"],
+             "mismatches": len(r["replica_mismatches"]),
+             "diag": r.get("converge_diag")}
+            for r in failed
+        ],
+        "ok": not failed,
+    }

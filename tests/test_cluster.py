@@ -675,6 +675,15 @@ class TestResizeAndReReplication:
             req("POST", f"{uri(servers[0])}/index/i/field/f", {})
             coord = next(s for s in servers
                          if s.api.cluster.is_acting_coordinator)
+            # Drain the join-triggered background resizes (and their
+            # synchronous cleanup broadcasts) BEFORE planting: the
+            # ~1-in-12 flake was the pending join-resize's cleanup
+            # legitimately deleting the planted non-owned copy mid-test,
+            # leaving the receiver's fetch with only the broken source.
+            # coordinate_resize serializes on the resize lock, so this
+            # call returns only after every earlier resize (and its
+            # cleanup) finished.
+            coord.api.cluster.coordinate_resize()
             peers = [s for s in servers if s is not coord]
             # BOTH peers hold shard 3's fragment; the coordinator (an
             # owner for some shard under replicaN=2) may need to fetch it
@@ -715,6 +724,106 @@ class TestResizeAndReReplication:
                         .view("standard").fragment(3))
                 assert frag is not None and frag.count() == 2, (
                     r.config.name)
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_failed_resize_fetch_leaves_no_empty_placeholder(self, tmp_path):
+        """When EVERY source for an instructed move fails, the receiver
+        must not keep the eagerly-created empty fragment: an empty
+        placeholder serves silently-empty reads for a shard whose data
+        exists elsewhere and masks the gap from the self-join
+        inventory's already-held check (the resize-source race's second
+        half; regression proven to fail pre-fix)."""
+        import numpy as np
+
+        from pilosa_tpu.parallel.client import ClientError, InternalClient
+
+        servers = make_cluster(tmp_path, 2, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            coord = next(s for s in servers
+                         if s.api.cluster.is_acting_coordinator)
+            coord.api.cluster.coordinate_resize()  # drain join resizes
+            peer = next(s for s in servers if s is not coord)
+            fp = peer.holder.index("i").field("f")
+            fp.view("standard", create=True).fragment(
+                3, create=True
+            ).bulk_import(np.asarray([2, 2], np.uint64),
+                          np.asarray([5, 9], np.uint64))
+
+            def broken(*a, **k):
+                raise ClientError("injected: source unreachable")
+
+            real_fd = InternalClient.fragment_data
+            real_fb = InternalClient.fragment_blocks
+            InternalClient.fragment_data = broken
+            InternalClient.fragment_blocks = broken
+            try:
+                coord.api.cluster.coordinate_resize()
+            finally:
+                InternalClient.fragment_data = real_fd
+                InternalClient.fragment_blocks = real_fb
+            v = coord.holder.index("i").field("f").view("standard")
+            frag = v.fragment(3) if v is not None else None
+            assert frag is None, (
+                f"receiver kept an empty placeholder (count="
+                f"{frag.count()}) after every source failed"
+            )
+            # the source's copy is untouched and a later healthy resize
+            # still completes the move
+            coord.api.cluster.coordinate_resize()
+            v = coord.holder.index("i").field("f").view("standard")
+            frag = v.fragment(3) if v is not None else None
+            assert frag is not None and frag.count() == 2
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_resize_sources_prefer_surviving_owners(self, tmp_path):
+        """Instruction sources list holders that REMAIN owners first: a
+        non-owner's copy is deleted by this very resize's cleanup, so a
+        receiver whose fetch races that cleanup loses a non-owner
+        primary source — the root of the ~1-in-12 resize-source flake
+        (regression proven to fail pre-fix)."""
+        import numpy as np
+
+        servers = make_cluster(tmp_path, 3, replica_n=2)
+        try:
+            req("POST", f"{uri(servers[0])}/index/i", {})
+            req("POST", f"{uri(servers[0])}/index/i/field/f", {})
+            coord = next(s for s in servers
+                         if s.api.cluster.is_acting_coordinator)
+            coord.api.cluster.coordinate_resize()  # drain join resizes
+            cluster = coord.api.cluster
+            # a shard the COORDINATOR does not own: its two owners are
+            # the peers, and the coordinator's planted copy is the
+            # non-owner source that must NOT be the primary
+            shard = next(
+                s for s in range(64)
+                if cluster.local.id not in
+                {n.id for n in cluster.shard_nodes("i", s)}
+            )
+            owners = cluster.shard_nodes("i", shard)
+            by_id = {s.api.cluster.local.id: s for s in servers}
+            src_owner = by_id[owners[0].id]
+            receiver = by_id[owners[1].id]
+            for holder_server in (coord, src_owner):
+                f = holder_server.holder.index("i").field("f")
+                f.view("standard", create=True).fragment(
+                    shard, create=True
+                ).bulk_import(np.asarray([2], np.uint64),
+                              np.asarray([5], np.uint64))
+            instructions = cluster.coordinate_resize()
+            entries = [e for e in instructions.get(
+                receiver.api.cluster.local.id, []) if e["shard"] == shard]
+            assert entries, instructions
+            # pre-fix the holders-walk order made the coordinator (a
+            # non-owner, swept by cleanup) the primary source
+            assert entries[0]["from"] == src_owner.api.cluster.local.uri, \
+                entries
+            assert coord.api.cluster.local.uri in entries[0]["fallbacks"]
         finally:
             for s in servers:
                 s.close()

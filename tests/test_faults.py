@@ -1,0 +1,285 @@
+"""Fault-injection plane semantics (pilosa_tpu/testing/faults.py).
+
+The rule engine is the machinery every partition/chaos scenario stands
+on, so its own semantics get first-class coverage: matching (src/dst/
+route, names vs endpoints, match budgets), the four actions through a
+REAL pooled HTTP exchange, partition/heal helpers, the /debug/faults
+endpoint, crash-point plumbing, and the zero-overhead-when-off oracle.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cluster_helpers import make_cluster, req, uri
+from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.testing import faults
+from pilosa_tpu.testing.faults import FaultPlane, FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.clear()
+    faults.disarm_crash_points()
+    yield
+    faults.clear()
+    faults.disarm_crash_points()
+
+
+class TestRuleMatching:
+    def test_wildcards_and_exact(self):
+        plane = FaultPlane()
+        plane.name_endpoint("n1", "localhost:1111")
+        rule = plane.add("drop", src="n0", dst="n1", route="/internal/")
+        d = plane.intercept("n0", "localhost:1111", "/internal/schema")
+        assert d is not None and d.drop
+        # wrong source
+        assert plane.intercept("nX", "localhost:1111",
+                               "/internal/schema") is None
+        # wrong route
+        assert plane.intercept("n0", "localhost:1111", "/status") is None
+        # endpoint form matches the same rule as the name form
+        rule2 = plane.add("drop", dst="localhost:2222")
+        assert plane.intercept("anyone", "localhost:2222", "/x") is not None
+        assert rule.matched == 1 and rule2.matched == 1
+
+    def test_match_budget_exhausts(self):
+        plane = FaultPlane()
+        plane.add("drop", count=2)
+        assert plane.intercept("a", "h:1", "/").drop
+        assert plane.intercept("a", "h:1", "/").drop
+        assert plane.intercept("a", "h:1", "/") is None  # budget spent
+        assert plane.dropped == 2
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule("explode")
+
+    def test_partition_helpers(self):
+        plane = FaultPlane()
+        plane.partition("a", "b")
+        assert plane.intercept("a", "b", "/").drop
+        assert plane.intercept("b", "a", "/").drop
+        assert plane.heal() == 2
+        assert plane.intercept("a", "b", "/") is None
+        # asymmetric: only a→b is cut
+        plane.partition("a", "b", bidirectional=False)
+        assert plane.intercept("a", "b", "/").drop
+        assert plane.intercept("b", "a", "/") is None
+        plane.heal()
+        # isolate cuts both directions for every peer
+        plane.isolate("c")
+        assert plane.intercept("c", "anything:1", "/").drop
+        assert plane.intercept("x", "c", "/").drop
+
+    def test_heal_keeps_non_drop_rules(self):
+        plane = FaultPlane()
+        plane.partition("a", "b")
+        delay = plane.add("delay", delay_ms=1.0)
+        plane.heal()
+        assert [r.id for r in plane.rules] == [delay.id]
+
+
+class TestWireActions:
+    """Actions applied to REAL pooled exchanges against a live node."""
+
+    @pytest.fixture
+    def node(self, tmp_path):
+        servers = make_cluster(tmp_path, 1)
+        yield servers[0]
+        for s in servers:
+            s.close()
+
+    def test_drop_surfaces_as_client_error(self, node):
+        client = InternalClient()
+        assert client.status(uri(node))["state"] == "NORMAL"
+        plane = faults.install()
+        plane.add("drop", route="/status")
+        with pytest.raises(ClientError) as e:
+            client.status(uri(node))
+        assert e.value.is_node_fault  # transport-shaped, like a partition
+        # other routes unaffected
+        client._call("GET", f"{uri(node)}/version")
+
+    def test_error_action_synthesizes_status(self, node):
+        client = InternalClient()
+        plane = faults.install()
+        plane.add("error", route="/status", status=503)
+        with pytest.raises(ClientError) as e:
+            client.status(uri(node))
+        assert e.value.status == 503 and e.value.is_node_fault
+
+    def test_delay_action_delays(self, node):
+        client = InternalClient()
+        plane = faults.install()
+        plane.add("delay", route="/status", delay_ms=150)
+        t0 = time.monotonic()
+        client.status(uri(node))
+        assert time.monotonic() - t0 >= 0.14
+        assert plane.delayed == 1
+
+    def test_duplicate_action_delivers_twice(self, node):
+        client = InternalClient()
+        before = node._http.requests_served
+        plane = faults.install()
+        plane.add("duplicate", route="/status", count=1)
+        out = client.status(uri(node))
+        assert out["state"] == "NORMAL"
+        # the node served the probe twice for one caller-visible request
+        assert node._http.requests_served - before == 2
+
+    def test_source_labels_scope_rules(self, node):
+        a, b = InternalClient(), InternalClient()
+        a.pool.fault_source = "a"
+        b.pool.fault_source = "b"
+        plane = faults.install()
+        plane.add("drop", src="a")
+        with pytest.raises(ClientError):
+            a.status(uri(node))
+        assert b.status(uri(node))["state"] == "NORMAL"
+
+
+class TestZeroOverheadOff:
+    def test_plane_never_consulted_when_uninstalled(self, tmp_path,
+                                                    monkeypatch):
+        """The off path is one global load + None test: requests must
+        succeed even if every plane method is booby-trapped, proving
+        nothing touches the plane when none is installed."""
+        servers = make_cluster(tmp_path, 1)
+        try:
+            def boom(*a, **k):  # pragma: no cover - must never run
+                raise AssertionError("fault plane consulted while off")
+
+            monkeypatch.setattr(FaultPlane, "intercept", boom)
+            client = InternalClient()
+            assert client.status(uri(servers[0]))["state"] == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_clear_restores_clean_wire(self, tmp_path):
+        servers = make_cluster(tmp_path, 1)
+        try:
+            client = InternalClient()
+            plane = faults.install()
+            plane.add("drop")
+            with pytest.raises(ClientError):
+                client.status(uri(servers[0]))
+            faults.clear()
+            assert client.status(uri(servers[0]))["state"] == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestCrashPoints:
+    def test_armed_point_kills(self, monkeypatch):
+        import os
+        import signal
+
+        kills = []
+        monkeypatch.setattr(os, "kill",
+                            lambda pid, sig: kills.append((pid, sig)))
+        faults.crash_point("cluster.pre-cleanup")  # unarmed: no-op
+        assert kills == []
+        faults.arm_crash_point("cluster.pre-cleanup")
+        faults.crash_point("cluster.other")  # different point: no-op
+        assert kills == []
+        faults.crash_point("cluster.pre-cleanup")
+        assert kills == [(os.getpid(), signal.SIGKILL)]
+
+    def test_env_armed_point(self, monkeypatch):
+        import os
+        import signal
+
+        kills = []
+        monkeypatch.setattr(os, "kill",
+                            lambda pid, sig: kills.append((pid, sig)))
+        monkeypatch.setattr(faults, "_ENV_CRASH", "cluster.pre-declare-dead")
+        faults.crash_point("cluster.pre-declare-dead")
+        assert kills == [(os.getpid(), signal.SIGKILL)]
+
+
+class TestDebugFaultsEndpoint:
+    def test_programmable_over_http(self, tmp_path):
+        servers = make_cluster(tmp_path, 1)
+        try:
+            base = uri(servers[0])
+            out = req("GET", f"{base}/debug/faults")
+            assert out == {"enabled": False, "rules": []}
+            out = req("POST", f"{base}/debug/faults", {
+                "rules": [{"action": "error", "route": "/internal/schema",
+                           "status": 598}],
+            })
+            assert out["installed"] and out["rules"]
+            # the node's own name→endpoint mapping self-registered
+            assert servers[0].api.cluster.local.id in out["names"].values()
+            # the rule bites internal clients
+            client = InternalClient()
+            with pytest.raises(ClientError) as e:
+                client.schema(base)
+            assert e.value.status == 598
+            out = req("GET", f"{base}/debug/faults")
+            assert out["enabled"] and out["rules"][0]["matched"] == 1
+            # DELETE clears and uninstalls
+            r = urllib.request.Request(f"{base}/debug/faults",
+                                       method="DELETE")
+            with urllib.request.urlopen(r) as resp:
+                assert json.loads(resp.read()) == {"enabled": False}
+            assert faults.active() is None
+            assert client.schema(base) is not None
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_name_addressed_rules_match_remote_nodes(self, tmp_path):
+        """post_faults registers EVERY member's name→endpoint, so a
+        dst=<peer name> rule posted to one node actually bites traffic
+        toward the peer (regression: only the serving node used to
+        self-register, making the documented curl example a no-op)."""
+        servers = make_cluster(tmp_path, 2)
+        try:
+            req("POST", f"{uri(servers[0])}/debug/faults", {
+                "rules": [{"action": "drop", "src": "n0", "dst": "n1"}],
+            })
+            with pytest.raises(ClientError):
+                servers[0].api.cluster.client.status(uri(servers[1]))
+            # reverse direction untouched
+            out = servers[1].api.cluster.client.status(uri(servers[0]))
+            assert out["state"] == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_bad_rule_rejected(self, tmp_path):
+        servers = make_cluster(tmp_path, 1)
+        try:
+            r = urllib.request.Request(
+                f"{uri(servers[0])}/debug/faults",
+                data=json.dumps({"rules": [{"action": "nope"}]}).encode(),
+                method="POST", headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(r)
+            assert e.value.code == 400
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_heal_via_http(self, tmp_path):
+        servers = make_cluster(tmp_path, 1)
+        try:
+            base = uri(servers[0])
+            req("POST", f"{base}/debug/faults",
+                {"rules": [{"action": "drop", "route": "/status"}]})
+            client = InternalClient()
+            with pytest.raises(ClientError):
+                client.status(base)
+            out = req("POST", f"{base}/debug/faults", {"heal": True})
+            assert out["rules"] == []
+            assert client.status(base)["state"] == "NORMAL"
+        finally:
+            for s in servers:
+                s.close()
