@@ -20,9 +20,9 @@
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
-	durability-smoke obs-smoke cost-smoke chaos-smoke bench-ingest \
-	bench-serving bench-sync bench-durability bench-tracing \
-	bench-profiling bench-chaos
+	durability-smoke obs-smoke cost-smoke chaos-smoke scrub-smoke \
+	bench-ingest bench-serving bench-sync bench-durability \
+	bench-tracing bench-profiling bench-chaos bench-scrub
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -66,6 +66,14 @@ cost-smoke:
 chaos-smoke:
 	$(PYTEST) tests/test_faults.py tests/test_partition.py -m "not slow"
 
+# scrub-smoke: the storage-integrity gate — checksum sidecars +
+# verified loads, quarantine at open, every-offset corruption fuzz,
+# scrubber detection / read-repair / self-heal, ENOSPC degraded mode
+# with auto-recovery, epoch-file hardening, restore read-back verify,
+# and the CLI check verb (docs/OPERATIONS.md integrity runbook)
+scrub-smoke:
+	$(PYTEST) tests/test_integrity.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -92,3 +100,9 @@ bench-profiling:
 # deletion, <=1 coordinator per epoch, byte-identical replicas)
 bench-chaos:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs chaos
+
+# storage-integrity gate: scrubber serving overhead >= 0.97x off,
+# detection-latency bound, the corruption-heal + ENOSPC oracles, and
+# randomized storage-fault chaos schedules
+bench-scrub:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs scrub

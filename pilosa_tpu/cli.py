@@ -61,6 +61,16 @@ group-commit-max-ms = 2.0     # max time a record waits for its group's
                               # fsync to start (bounds write ACK latency)
 group-commit-max-ops = 256    # max op records fsynced per group
 
+# Storage integrity (docs/OPERATIONS.md integrity runbook)
+verify-on-load = true         # check fragment snapshots against their
+                              # .checksums sidecars at open; corrupt
+                              # files quarantine (never served) and
+                              # read-repair from replicas
+scrub-interval = 0.0          # seconds between background scrub passes
+                              # over owned fragments' DISK bytes; 0 = off
+scrub-max-bytes-per-sec = 0   # token-bucket budget for scrub reads;
+                              # 0 = unpaced
+
 # Anti-entropy / resize data plane (docs/OPERATIONS.md)
 sync-workers = 8              # fragment diff/fetch/apply pipeline width
                               # per repair pass
@@ -551,24 +561,59 @@ def cmd_restore(args) -> int:
 
 
 def cmd_check(args) -> int:
-    """Verify fragment files parse cleanly (reference ctl/check.go)."""
+    """Integrity check (reference ctl/check.go, grown into the scrub
+    front door — docs/OPERATIONS.md integrity runbook): with ``-d``,
+    an OFFLINE scrub of a data dir — every fragment file decoded AND
+    its block digests verified against the ``.checksums`` sidecar
+    (exactly what verify-on-load does at open); with ``--host``, a
+    LIVE scrub pass triggered on a running node (``POST
+    /internal/scrub`` — the node verifies its own disk bytes,
+    quarantines rot, and read-repairs from replicas). Exit 1 when
+    anything is corrupt or already quarantined."""
+    if getattr(args, "host", None):
+        url = f"{args.host.rstrip('/')}/internal/scrub"
+        try:
+            out = _http("POST", url, b"")
+        except Exception as e:
+            print(f"error: live scrub via {url} failed: {e}",
+                  file=sys.stderr)
+            return 1
+        print(
+            f"live scrub: scanned={out.get('scanned', 0)} "
+            f"bytes={out.get('bytes', 0)} corrupt={out.get('corrupt', 0)} "
+            f"repaired={out.get('repaired', 0)} "
+            f"self_healed={out.get('self_healed', 0)} "
+            f"unrepaired={out.get('unrepaired', 0)}"
+        )
+        return 1 if out.get("unrepaired", 0) else 0
+    if not args.data_dir:
+        print("error: check needs -d/--data-dir or --host",
+              file=sys.stderr)
+        return 1
     import glob
 
-    from pilosa_tpu.roaring.format import load
+    from pilosa_tpu.roaring.format import replay_ops
+    from pilosa_tpu.storage import integrity
 
     bad = 0
-    pattern = os.path.join(os.path.expanduser(args.data_dir), "**", "fragments", "*")
-    for path in glob.glob(pattern, recursive=True):
-        if not os.path.isfile(path) or path.endswith(".cache"):
+    data_dir = os.path.expanduser(args.data_dir)
+    pattern = os.path.join(data_dir, "**", "fragments", "*")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        if (not os.path.isfile(path)
+                or path.endswith((".cache", integrity.CHECKSUM_SUFFIX))
+                or integrity.is_quarantined(os.path.basename(path))):
             continue
         try:
-            with open(path, "rb") as f:
-                bitmap, n_ops = load(f.read())
+            bitmap, data, ops_at = integrity.verify_fragment_file(path)
+            n_ops = replay_ops(bitmap, data, ops_at)
             print(f"ok: {path} bits={bitmap.count()} ops={n_ops}")
         except Exception as e:
             bad += 1
             print(f"CORRUPT: {path}: {e}", file=sys.stderr)
-    return 1 if bad else 0
+    quarantined = integrity.list_quarantined(data_dir)
+    for q in quarantined:
+        print(f"QUARANTINED: {q}", file=sys.stderr)
+    return 1 if bad or quarantined else 0
 
 
 def main(argv=None) -> int:
@@ -623,8 +668,15 @@ def main(argv=None) -> int:
     p.add_argument("-d", "--data-dir", required=True)
     p.set_defaults(fn=cmd_inspect)
 
-    p = sub.add_parser("check", help="verify fragment files")
-    p.add_argument("-d", "--data-dir", required=True)
+    p = sub.add_parser(
+        "check",
+        help="verify fragment files against their checksum sidecars "
+             "(offline -d scrub, or --host live scrub trigger)",
+    )
+    p.add_argument("-d", "--data-dir",
+                   help="offline scrub of a data dir (node stopped)")
+    p.add_argument("--host",
+                   help="trigger a live scrub pass on a running node")
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(

@@ -157,6 +157,10 @@ class Cluster:
         if data_dir:
             self._epoch_path = os.path.join(data_dir, "cluster.epoch")
         self.epoch = self._load_epoch()
+        if getattr(self, "_epoch_file_corrupt", False):
+            # rewrite the corrupt file NOW so the next restart reads a
+            # clean value instead of re-diagnosing the same garbage
+            self._persist_epoch_locked()
         # True while this node cannot reach a member-list majority: the
         # minority side of a partition serves locally-owned reads only
         # (writes shed 503, no resize, no cleanup, no death declaring).
@@ -237,12 +241,37 @@ class Cluster:
     # --------------------------------------------------- epoch / quorum
 
     def _load_epoch(self) -> int:
+        """Read the persisted epoch high-water mark. A corrupt or torn
+        ``cluster.epoch`` (binary garbage, a half-written tmp swap) is
+        an OPERATIONAL event, not a crash: log it, start from 0, and
+        re-persist a clean file — the real epoch is re-adopted from
+        gossip on the first peer contact (adopt_epoch takes the max any
+        peer reports), so fencing recovers to cluster truth without
+        operator surgery."""
+        self._epoch_file_corrupt = False
         if self._epoch_path is None:
             return 0
         try:
-            with open(self._epoch_path) as f:
-                return int(f.read().strip() or 0)
-        except (OSError, ValueError):
+            with open(self._epoch_path, "rb") as f:
+                raw = f.read(64).decode("ascii", errors="replace").strip()
+        except FileNotFoundError:
+            return 0
+        except OSError as e:
+            self._log_exception("cluster epoch read", e)
+            return 0
+        if not raw:
+            return 0
+        try:
+            return int(raw)
+        except ValueError:
+            self._epoch_file_corrupt = True
+            self._log_exception(
+                "cluster epoch file",
+                ValueError(
+                    f"corrupt {self._epoch_path!r} (contents "
+                    f"{raw[:32]!r}): re-adopting epoch from gossip"
+                ),
+            )
             return 0
 
     def _persist_epoch_locked(self) -> None:

@@ -144,6 +144,10 @@ class API:
         self._recalc_lock = threading.Lock()
         self._recalc_thread: threading.Thread | None = None
         self._recalc_rerun = False
+        # background integrity scrubber (parallel/scrub.py); Server.open
+        # wires one when scrub-interval > 0. scrub_now() runs ad-hoc
+        # passes without it.
+        self.scrubber = None
 
     # ---------------------------------------------------------------- query
 
@@ -514,7 +518,10 @@ class API:
     def _check_not_degraded_write(self) -> None:
         """Shed edge writes while this node is the minority side of a
         partition (cluster.degraded — docs/OPERATIONS.md failure
-        model); locally-owned reads still serve."""
+        model) OR while its storage is degraded (ENOSPC/EIO tripped
+        the StorageHealth latch — storage/integrity.py); locally-owned
+        reads still serve either way."""
+        self._check_not_storage_degraded()
         cluster = self.cluster
         if cluster is None or not getattr(cluster, "degraded", False):
             return
@@ -523,6 +530,25 @@ class API:
             "this node until the partition heals; locally-owned reads "
             "still serve"
         )
+
+    def _check_not_storage_degraded(self) -> None:
+        """503 + Retry-After while the disk is sick (a failed WAL
+        fsync, snapshot, or .meta write tripped the read-only
+        storage_degraded latch). Auto-clears when the health probe's
+        write succeeds — clients that honor Retry-After ride it out."""
+        health = getattr(self.holder, "health", None)
+        if health is None or not health.degraded:
+            return
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats().count("qos_shed", 1, {"reason": "storage_degraded"})
+        err = ApiError(
+            f"storage degraded ({health.reason}): writes are shed on "
+            "this node until a probe write succeeds; reads still serve",
+            503,
+        )
+        err.retry_after = 5.0
+        raise err
 
     def _ack_durable(self) -> None:
         """Group-commit durability barrier for the current request's
@@ -573,6 +599,7 @@ class API:
 
     def create_index(self, name: str, keys: bool = False,
                      track_existence: bool = True) -> dict:
+        self._check_not_storage_degraded()  # schema writes hit .meta
         try:
             idx = self.holder.create_index(
                 name, keys=keys, track_existence=track_existence
@@ -596,6 +623,7 @@ class API:
         self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index: str, name: str, options: dict | None = None) -> dict:
+        self._check_not_storage_degraded()  # schema writes hit .meta
         idx = self._index(index)
         try:
             opts = FieldOptions.from_dict(options or {})
@@ -1098,7 +1126,7 @@ class API:
         # importer) can clamp their batch size to this server's limit
         # instead of discovering it via 413s
         if self.cluster is not None:
-            return {
+            out = {
                 "state": self.cluster.state,
                 "nodes": self.cluster.nodes_json(),
                 "localID": self.cluster.local.id,
@@ -1111,15 +1139,25 @@ class API:
                 "epoch": self.cluster.epoch,
                 "clusterDegraded": bool(self.cluster.degraded),
             }
-        return {
-            "state": "NORMAL",
-            "nodes": [{"id": "local", "uri": "localhost", "isCoordinator": True,
-                       "state": "NORMAL"}],
-            "localID": "local",
-            "maxWritesPerRequest": self.max_writes_per_request,
-            "epoch": 0,
-            "clusterDegraded": False,
-        }
+        else:
+            out = {
+                "state": "NORMAL",
+                "nodes": [{"id": "local", "uri": "localhost",
+                           "isCoordinator": True, "state": "NORMAL"}],
+                "localID": "local",
+                "maxWritesPerRequest": self.max_writes_per_request,
+                "epoch": 0,
+                "clusterDegraded": False,
+            }
+        # storage-integrity surface (docs/OPERATIONS.md integrity
+        # runbook): storageDegraded = this node's disk tripped the
+        # read-only latch (writes shed 503 until a probe write clears)
+        health = getattr(self.holder, "health", None)
+        out["storageDegraded"] = bool(health is not None
+                                      and health.degraded)
+        out["storageDegradedReason"] = (health.reason
+                                        if health is not None else "")
+        return out
 
     def info(self) -> dict:
         import jax
@@ -1258,6 +1296,51 @@ class API:
         if wal is None:
             return {}
         return wal.metrics()
+
+    def integrity_metrics(self) -> dict:
+        """Storage-integrity series (docs/OBSERVABILITY.md): the
+        degraded latch, verified-load / quarantine counters, and the
+        scrubber's progress — every key present from scrape one, zeros
+        included, like the sibling exporter blocks."""
+        from pilosa_tpu.storage.integrity import global_integrity
+
+        out = {
+            "storage_degraded": 0,
+            "storage_degraded_total": 0,
+            "storage_recoveries_total": 0,
+            "scrub_passes_total": 0,
+            "scrub_fragments_scanned_total": 0,
+            "scrub_bytes_total": 0,
+            "scrub_corruptions_detected_total": 0,
+            "scrub_read_repairs_total": 0,
+            "scrub_self_heals_total": 0,
+            "scrub_unrepaired_total": 0,
+            "scrub_last_pass_seconds": 0.0,
+            "scrub_paced_sleep_seconds": 0.0,
+        }
+        out.update(global_integrity().metrics())
+        health = getattr(self.holder, "health", None)
+        if health is not None:
+            out.update(health.metrics())
+        if self.scrubber is not None:
+            out.update(self.scrubber.metrics())
+        return out
+
+    def scrub_now(self) -> dict:
+        """One on-demand scrub pass (``POST /internal/scrub``, CLI
+        ``check --host``). Uses the configured scrubber when one is
+        running (sharing its pacing budget), an unpaced ad-hoc one
+        otherwise."""
+        scrubber = self.scrubber
+        if scrubber is None:
+            from pilosa_tpu.parallel.scrub import Scrubber
+
+            # interval 0: no ticker thread — but keep the instance so
+            # repeated on-demand passes accumulate into the scrub_*
+            # series on /metrics
+            scrubber = self.scrubber = Scrubber(self.holder,
+                                                cluster=self.cluster)
+        return scrubber.scrub_pass()
 
     def recalculate_caches(self, remote: bool = False) -> threading.Thread:
         """Authoritative recount of every fragment's TopN row cache

@@ -55,6 +55,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/shards/list$"), "get_shards_list"),
     ("GET", re.compile(r"^/internal/sync/manifest$"), "get_sync_manifest"),
     ("POST", re.compile(r"^/internal/sync/blocks$"), "post_sync_blocks"),
+    ("POST", re.compile(r"^/internal/scrub$"), "post_scrub"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
     ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
     ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
@@ -651,6 +652,13 @@ class HTTPHandler(BaseHTTPRequestHandler):
     def get_version(self, query=None):
         self._json(self.api.version())
 
+    def post_scrub(self, query=None):
+        """Trigger one integrity scrub pass (CLI ``check --host``,
+        operators mid-incident): verify owned fragments' disk bytes,
+        quarantine + read-repair any rot, return the pass record."""
+        self._body()  # drain for keep-alive alignment
+        self._json(self.api.scrub_now())
+
     def post_recalculate_caches(self, query=None):
         """Reference parity: authoritative per-node TopN cache recount;
         204 No Content on success, as upstream."""
@@ -699,6 +707,11 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # one, same rate()-window reasoning as the blocks around it
         text += prometheus_block(self.api.durability_metrics(), prefix,
                                  "wal", seen=seen)
+        # storage-integrity plane (docs/OPERATIONS.md integrity
+        # runbook): degraded latch, verified-load/quarantine counters,
+        # scrubber progress — zeros from scrape one like the rest
+        text += prometheus_block(self.api.integrity_metrics(), prefix,
+                                 seen=seen)
         # serving-QoS series (admission/deadline/hedge/breaker): emitted
         # from scrape one, zeros included, for the same rate()-window
         # reason as the wave counters above
@@ -868,6 +881,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 fastlane["http_requests_total"] = self.server.requests_served
         snap["serving_fastlane"] = fastlane
         snap["durability"] = self.api.durability_metrics()
+        snap["integrity"] = self.api.integrity_metrics()
         snap["observability"] = self.api.observability_metrics()
         from pilosa_tpu.storage.heat import global_heat
 

@@ -64,6 +64,9 @@ class ServerConfig:
         heat_half_life: float = 300.0,
         slo_objectives: list[str] | None = None,
         slo_windows: list[str] | None = None,
+        verify_on_load: bool = True,
+        scrub_interval: float = 0.0,
+        scrub_max_bytes_per_sec: int = 0,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -171,6 +174,20 @@ class ServerConfig:
             )
         self.slo_objectives = list(slo_objectives or [])
         self.slo_windows = list(slo_windows or [])
+        # Storage integrity plane (docs/OPERATIONS.md integrity
+        # runbook): verify-on-load checks fragment snapshots against
+        # their checksum sidecars at open (corrupt files quarantine
+        # instead of serving); scrub-interval > 0 runs the background
+        # scrubber that re-verifies owned fragments' DISK bytes on a
+        # scrub-max-bytes-per-sec token-bucket budget and read-repairs
+        # rot from healthy replicas.
+        self.verify_on_load = _parse_bool(verify_on_load)
+        self.scrub_interval = float(scrub_interval)
+        if self.scrub_interval < 0:
+            raise ValueError(
+                f"invalid scrub-interval {scrub_interval!r} (want >= 0)"
+            )
+        self.scrub_max_bytes_per_sec = int(scrub_max_bytes_per_sec)
         from pilosa_tpu.qos.slo import SLOEngine
 
         # build once to validate; Server.open builds the live engine
@@ -283,6 +300,16 @@ class ServerConfig:
             slo_windows=_parse_list(
                 d.get("slo-windows", d.get("slo_windows", []))
             ),
+            verify_on_load=_parse_bool(
+                d.get("verify-on-load", d.get("verify_on_load", True))
+            ),
+            scrub_interval=_parse_duration(
+                d.get("scrub-interval", d.get("scrub_interval", 0.0))
+            ),
+            scrub_max_bytes_per_sec=int(
+                d.get("scrub-max-bytes-per-sec",
+                      d.get("scrub_max_bytes_per_sec", 0))
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -331,6 +358,9 @@ class ServerConfig:
             "heat-half-life": self.heat_half_life,
             "slo-objectives": self.slo_objectives,
             "slo-windows": self.slo_windows,
+            "verify-on-load": self.verify_on_load,
+            "scrub-interval": self.scrub_interval,
+            "scrub-max-bytes-per-sec": self.scrub_max_bytes_per_sec,
         }
 
 
@@ -366,6 +396,7 @@ class Server:
             durability_mode=self.config.durability_mode,
             group_commit_max_ms=self.config.group_commit_max_ms,
             group_commit_max_ops=self.config.group_commit_max_ops,
+            verify_on_load=self.config.verify_on_load,
         )
         self.api = API(self.holder)
         self._http = None
@@ -472,6 +503,16 @@ class Server:
             self.api, self.config.diagnostics_endpoint
         )
         self._diagnostics.start()
+        if self.config.scrub_interval > 0:
+            from pilosa_tpu.parallel.scrub import Scrubber
+            from pilosa_tpu.utils.stats import global_stats as _gs
+
+            self.api.scrubber = Scrubber(
+                self.holder, cluster=self.api.cluster,
+                interval_s=self.config.scrub_interval,
+                max_bytes_per_sec=self.config.scrub_max_bytes_per_sec,
+                stats=_gs(), logger=self.logger,
+            ).start()
         self._schedule_anti_entropy()
         self._schedule_heartbeat()
         return self
@@ -548,6 +589,8 @@ class Server:
 
     def close(self) -> None:
         self._closed.set()
+        if self.api.scrubber is not None:
+            self.api.scrubber.close()
         if self._anti_entropy_timer is not None:
             self._anti_entropy_timer.cancel()
         if self._heartbeat_timer is not None:

@@ -421,7 +421,8 @@ def restore_holder(src: str, data_dir: str,
                 _atomic_write(fmeta, json.dumps(fopts).encode())
 
     from pilosa_tpu.roaring import RoaringBitmap
-    from pilosa_tpu.roaring.format import serialize
+    from pilosa_tpu.roaring.format import load, serialize
+    from pilosa_tpu.storage import integrity
 
     restored = 0
     for key, blocks in sorted(manifest.get("fragments", {}).items()):
@@ -447,7 +448,27 @@ def restore_holder(src: str, data_dir: str,
                     "verification; refusing to restore corrupt data"
                 ) from e
             bitmap.add_ids(ids)
-        _atomic_write(os.path.join(frag_dir, shard), serialize(bitmap))
+        frag_path = os.path.join(frag_dir, shard)
+        _atomic_write(frag_path, serialize(bitmap))
+        # Read-back verification against the LIVE checksum index: the
+        # blob digests above prove the SOURCE was intact; re-reading
+        # the bytes the target disk actually holds catches a
+        # corrupt-at-rest restore target at restore time instead of at
+        # first query. (The read rides the disk fault plane's seam, so
+        # the oracle can drive this path with injected bit flips.)
+        live = integrity.block_digests(
+            load(integrity.read_file(frag_path))[0].to_ids()
+        )
+        if live != [(int(b), d) for b, d in blocks]:
+            raise ValueError(
+                f"restored fragment {key} at {frag_path} fails digest "
+                "verification against the live checksum index; the "
+                "restore target is corrupting data at rest"
+            )
+        # checksum sidecar: the restored dir is verify-on-load- and
+        # scrub-ready from its first open
+        integrity.save_checksums(frag_path + integrity.CHECKSUM_SUFFIX,
+                                 live)
         restored += 1
     manifest["restoredFragments"] = restored
     return manifest

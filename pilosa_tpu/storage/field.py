@@ -108,10 +108,11 @@ class FieldOptions:
 class Field:
     def __init__(self, path: str, index: str, name: str,
                  options: FieldOptions | None = None, scope: str = "",
-                 wal=None):
+                 wal=None, verify_on_load: bool = False):
         self.path = path
         self.scope = scope
         self.wal = wal  # holder WAL, threaded down to views/fragments
+        self.verify_on_load = verify_on_load
         self.index = index
         self.name = name
         self.options = options or FieldOptions()
@@ -143,6 +144,7 @@ class Field:
                     cache_size=self.options.cache_size,
                     scope=self.scope,
                     wal=self.wal,
+                    verify_on_load=self.verify_on_load,
                 ).open()
         from pilosa_tpu.storage.attrs import AttrStore
 
@@ -168,11 +170,24 @@ class Field:
         # must be able to resolve this field after a power cut, or the
         # acked ops it holds for the field are silently unreplayable
         from pilosa_tpu.storage.wal import fsync_dir
+        from pilosa_tpu.testing import faults
 
-        with open(os.path.join(self.path, ".meta"), "w") as f:
-            json.dump(self.options.to_dict(), f)
-            f.flush()
-            os.fsync(f.fileno())
+        meta = os.path.join(self.path, ".meta")
+        try:
+            faults.disk_check("write", meta)
+            with open(meta, "w") as f:
+                json.dump(self.options.to_dict(), f)
+                f.flush()
+                faults.disk_check("fsync", meta)
+                os.fsync(f.fileno())
+        except OSError as e:
+            # a full disk on a schema write degrades the node read-only
+            # (storage/integrity.py) instead of leaving a half-written
+            # .meta behind a raw traceback
+            health = getattr(self.wal, "health", None) if self.wal else None
+            if health is not None:
+                health.trip(f".meta write of {meta}: {e}")
+            raise
         fsync_dir(self.path)
         fsync_dir(os.path.dirname(self.path) or ".")
 
@@ -193,6 +208,7 @@ class Field:
                         cache_size=self.options.cache_size,
                         scope=self.scope,
                         wal=self.wal,
+                        verify_on_load=self.verify_on_load,
                     ).open()
                     self.views[name] = v
         return v

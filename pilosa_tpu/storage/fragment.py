@@ -19,7 +19,6 @@ trees. The roaring form never reaches the device.
 
 from __future__ import annotations
 
-import hashlib
 import os
 import threading
 
@@ -41,7 +40,17 @@ from pilosa_tpu.shardwidth import (
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
 from pilosa_tpu.storage.heat import global_heat
+from pilosa_tpu.storage.integrity import (
+    CHECKSUM_SUFFIX,
+    CorruptFragmentError,
+    DECODE_ERRORS,
+    block_digests,
+    load_verified,
+    read_file,
+    save_checksums,
+)
 from pilosa_tpu.storage.wal import MODE_PER_OP, fsync_dir, wal_fsync
+from pilosa_tpu.testing import faults as _faults
 from pilosa_tpu.utils.cost import current_cost
 
 # Snapshot (compact) once this many op records have accumulated
@@ -67,6 +76,7 @@ class Fragment:
         snapshot_threshold: int = DEFAULT_SNAPSHOT_OP_THRESHOLD,
         scope: str = "",
         wal=None,
+        verify_on_load: bool = False,
     ):
         self.path = path
         self.index = index
@@ -79,6 +89,13 @@ class Fragment:
         # flush-only path; a holder-provided WAL switches _log_op to the
         # configured durability mode.
         self.wal = wal
+        # Verified loads (storage/integrity.py): open() checks the
+        # snapshot's block digests against the .checksums sidecar
+        # written at snapshot time, so silent media rot surfaces as a
+        # typed CorruptFragmentError instead of being decoded and
+        # served. Hot paths pay nothing — the digests ride the blocks()
+        # memo against the mutation counter.
+        self.verify_on_load = verify_on_load
         self.wal_key = f"{index}/{field}/{view}/{shard}"
         # scope leads the id: residency keys and write-routing tags must
         # never collide across two Holders in one process (in-process
@@ -107,11 +124,23 @@ class Fragment:
     def open(self) -> "Fragment":
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         if os.path.exists(self.path):
-            with open(self.path, "rb") as f:
-                buf = f.read()
+            buf = read_file(self.path)  # disk-fault read seam
             if buf:
-                self.bitmap, ops_at = deserialize(buf)
-                self.op_n = replay_ops(self.bitmap, buf, ops_at)
+                # snapshot decode + (verify-on-load) sidecar digest
+                # check BEFORE op replay: the sidecar describes exactly
+                # the snapshot portion; trailing ops carry their own
+                # CRCs. Any decode error or digest mismatch raises the
+                # typed CorruptFragmentError — View.open quarantines
+                # the file and moves on; direct callers see the error.
+                self.bitmap, ops_at = load_verified(
+                    buf, self.path, verify=self.verify_on_load
+                )
+                try:
+                    self.op_n = replay_ops(self.bitmap, buf, ops_at)
+                except DECODE_ERRORS as e:
+                    raise CorruptFragmentError(
+                        self.path, f"op replay failed: {e}", offset=ops_at,
+                    ) from e
         else:
             with open(self.path, "wb") as f:
                 f.write(serialize(self.bitmap))
@@ -138,9 +167,20 @@ class Fragment:
                     # group mode keeps ops only in the WAL: a clean
                     # close must snapshot so the fragment file is
                     # self-contained (and the holder can truncate the
-                    # WAL afterwards)
-                    self._snapshot_locked()
-                self.row_cache.save(self._cache_path())
+                    # WAL afterwards). A FAILED snapshot (full/dying
+                    # disk) must not abort the close: the ops stay
+                    # durable in their WAL segments — note_snapshot was
+                    # never called, so segment GC keeps them and the
+                    # next open's recover() replays them (the contract
+                    # holder.close documents).
+                    try:
+                        self._snapshot_locked()
+                    except OSError:
+                        pass  # health already tripped by the snapshot
+                try:
+                    self.row_cache.save(self._cache_path())
+                except OSError:
+                    pass  # cache is derived data; recount rebuilds it
             elif self.wal is not None and self.wal.grouped:
                 # delete path: a write in flight during the delete may
                 # have appended AFTER the tombstone's seq — release the
@@ -479,8 +519,16 @@ class Fragment:
     def import_roaring(self, data: bytes) -> int:
         """Union a serialized roaring bitmap into this fragment (reference
         api.ImportRoaring fast path). Accepts either this framework's
-        layout or the upstream pilosa layout (sniffed by cookie)."""
-        other, _ = load_any(data)
+        layout or the upstream pilosa layout (sniffed by cookie).
+        Undecodable payloads (torn wire frames, corrupt import bodies)
+        raise the typed CorruptFragmentError (a ValueError subclass, so
+        existing 400 mappings hold)."""
+        try:
+            other, _ = load_any(data)
+        except DECODE_ERRORS as e:
+            raise CorruptFragmentError(
+                self.path, f"import-roaring payload decode failed: {e}",
+            ) from e
         return self.import_roaring_bitmap(other)
 
     def import_roaring_bitmap(self, other) -> int:
@@ -561,7 +609,12 @@ class Fragment:
             if wal is not None and wal.mode == MODE_PER_OP:
                 # true per-write durability (round 5 only flush()ed —
                 # OS-buffer-deep; see docs/OPERATIONS.md)
-                wal_fsync(self._file.fileno())
+                try:
+                    _faults.disk_check("fsync", self.path)
+                    wal_fsync(self._file.fileno())
+                except OSError as e:
+                    self._trip_health(f"per-op fsync of {self.path}: {e}")
+                    raise
         self.op_n += 1
         if self.op_n > self.snapshot_threshold:
             self.snapshot()
@@ -588,15 +641,50 @@ class Fragment:
         if self._file:
             self._file.close()
         tmp = self.path + ".snapshotting"
-        with open(tmp, "wb") as f:
-            f.write(serialize(self.bitmap))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        try:
+            payload = _faults.disk_filter_write(  # torn-write seam
+                self.path, serialize(self.bitmap)
+            )
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                _faults.disk_check("fsync", self.path)
+                os.fsync(f.fileno())
+            # the OLD sidecar must die BEFORE the new snapshot is
+            # published: a crash between the rename and the new sidecar
+            # landing would otherwise pair the new snapshot with stale
+            # digests, and verify-on-load would quarantine a perfectly
+            # healthy file (a MISSING sidecar only downgrades the next
+            # open to an unverified load — safe)
+            try:
+                os.unlink(self.path + CHECKSUM_SUFFIX)
+            except FileNotFoundError:
+                pass
+            os.replace(tmp, self.path)
+        except OSError as e:
+            # a failed snapshot (ENOSPC, EIO) flips the node to the
+            # read-only storage_degraded mode instead of surfacing a
+            # raw traceback through the write path; the old file is
+            # intact (tmp-then-rename), so reads keep serving
+            self._trip_health(f"snapshot of {self.path}: {e}")
+            if self._open and self._file is not None and self._file.closed:
+                try:
+                    self._file = open(self.path, "ab")
+                except OSError:
+                    self._file = None
+            raise
         # a crash between the rename and the directory entry reaching
         # disk can lose the whole snapshot: rename durability needs the
         # parent fsynced too
         fsync_dir(os.path.dirname(self.path))
+        # checksum sidecar: the block digests of exactly these bytes,
+        # for verify-on-load and the background scrubber. Best-effort —
+        # a torn/missing sidecar downgrades to an unverified load, it
+        # never condemns the healthy snapshot beside it.
+        try:
+            save_checksums(self.path + CHECKSUM_SUFFIX, self.blocks())
+        except OSError as e:
+            self._trip_health(f"checksum sidecar of {self.path}: {e}")
         if self.wal is not None:
             # every op of this fragment appended so far (the lock is
             # held, so the seq covers them all) is in the snapshot —
@@ -666,6 +754,14 @@ class Fragment:
         if not 0 <= pos < SHARD_WIDTH:
             raise ValueError(f"position {pos} outside shard width {SHARD_WIDTH}")
 
+    def _trip_health(self, reason: str) -> None:
+        """Route a disk fault into the holder's StorageHealth latch
+        (read-only degraded mode) via the WAL the storage tree already
+        threads; direct-constructed fragments (wal=None) just raise."""
+        health = getattr(self.wal, "health", None) if self.wal else None
+        if health is not None:
+            health.trip(reason)
+
     # ---------------------------------------------------- anti-entropy blocks
 
     def serialize_snapshot(self) -> bytes:
@@ -688,20 +784,12 @@ class Fragment:
         if memo is not None and memo[0] == self.mutations:
             return memo[1]
         version = self.mutations
-        out = []
         with self.lock:
             ids = self.bitmap.to_ids()
-        if ids.size:
-            block_of = (ids >> np.uint64(20)) // BLOCK_ROWS
-            boundaries = np.concatenate(
-                ([0], np.nonzero(np.diff(block_of))[0] + 1, [ids.size])
-            )
-            for i in range(boundaries.size - 1):
-                lo, hi = int(boundaries[i]), int(boundaries[i + 1])
-                digest = hashlib.blake2b(
-                    ids[lo:hi].astype("<u8").tobytes(), digest_size=16
-                ).hexdigest()
-                out.append((int(block_of[lo]), digest))
+        # one digest implementation (storage/integrity.py) shared by
+        # the sync manifests, backup blob addressing, verify-on-load,
+        # and the scrubber — every plane speaks the same checksums
+        out = block_digests(ids, BLOCK_ROWS)
         self._blocks_memo = (version, out)
         return out
 

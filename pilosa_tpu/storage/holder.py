@@ -18,6 +18,7 @@ import shutil
 import threading
 
 from pilosa_tpu.storage.index import Index, _validate_name
+from pilosa_tpu.storage.integrity import StorageHealth
 from pilosa_tpu.storage.translate import TranslateStore
 from pilosa_tpu.storage.wal import (
     DEFAULT_GROUP_MAX_MS,
@@ -31,18 +32,29 @@ from pilosa_tpu.storage.wal import (
 class Holder:
     def __init__(self, data_dir: str, durability_mode: str = MODE_GROUP,
                  group_commit_max_ms: float = DEFAULT_GROUP_MAX_MS,
-                 group_commit_max_ops: int = DEFAULT_GROUP_MAX_OPS):
+                 group_commit_max_ops: int = DEFAULT_GROUP_MAX_OPS,
+                 verify_on_load: bool = True):
         self.data_dir = os.path.expanduser(data_dir)
         self.indexes: dict[str, Index] = {}
         self._create_lock = threading.Lock()
         self.translate: TranslateStore | None = None
         self._open = False
+        # Storage integrity plane (storage/integrity.py): verified
+        # fragment loads (sidecar digest checks; corrupt files are
+        # quarantined at open instead of decoded into serving state)
+        # and the disk-fault degradation latch — ENOSPC/EIO on the
+        # write paths flips this node read-only until a probe write
+        # succeeds, instead of wedging the commit thread.
+        self.verify_on_load = bool(verify_on_load)
+        self.health = StorageHealth(probe_dir=self.data_dir)
         self.wal = WriteAheadLog(
             os.path.join(self.data_dir, ".wal"),
             mode=durability_mode,
             group_max_ms=group_commit_max_ms,
             group_max_ops=group_commit_max_ops,
         )
+        self.wal.health = self.health
+        self.health.on_clear(self.wal.clear_fault)
 
     def open(self) -> "Holder":
         os.makedirs(self.data_dir, exist_ok=True)
@@ -56,7 +68,10 @@ class Holder:
                 shutil.rmtree(p, ignore_errors=True)
                 continue
             if os.path.isdir(p) and not entry.startswith("."):
-                self.indexes[entry] = Index(p, entry, wal=self.wal).open()
+                self.indexes[entry] = Index(
+                    p, entry, wal=self.wal,
+                    verify_on_load=self.verify_on_load,
+                ).open()
         # crash recovery: replay acked-but-unsnapshotted ops a previous
         # group-mode run left in the WAL, snapshot the touched fragments,
         # and start this run's log fresh (any-mode safe — see wal.py)
@@ -74,6 +89,7 @@ class Holder:
         # (clean close); a failed snapshot leaves its segment for the
         # next open's recover()
         self.wal.close()
+        self.health.close()
         self._open = False
 
     def create_index(self, name: str, keys: bool = False, track_existence: bool = True) -> Index:
@@ -84,6 +100,7 @@ class Holder:
             idx = Index(
                 os.path.join(self.data_dir, name), name, keys=keys,
                 track_existence=track_existence, wal=self.wal,
+                verify_on_load=self.verify_on_load,
             ).open()
             self.indexes[name] = idx
             return idx

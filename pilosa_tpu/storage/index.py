@@ -22,10 +22,12 @@ EXISTENCE_FIELD = "_exists"
 
 class Index:
     def __init__(self, path: str, name: str, keys: bool = False,
-                 track_existence: bool = True, wal=None):
+                 track_existence: bool = True, wal=None,
+                 verify_on_load: bool = False):
         self.path = path
         self.name = name
         self.wal = wal  # holder WAL, threaded down the storage tree
+        self.verify_on_load = verify_on_load
         # Residency-cache scope: unique per holder data dir, so two
         # Holders in ONE process (in-process cluster tests, embedded
         # multi-server use) can never collide on device-cache keys or
@@ -66,9 +68,10 @@ class Index:
                 shutil.rmtree(p, ignore_errors=True)
                 continue
             if os.path.isdir(p) and not entry.startswith("."):
-                self.fields[entry] = Field(p, self.name, entry,
-                                           scope=self.scope,
-                                           wal=self.wal).open()
+                self.fields[entry] = Field(
+                    p, self.name, entry, scope=self.scope, wal=self.wal,
+                    verify_on_load=self.verify_on_load,
+                ).open()
         if self.track_existence and EXISTENCE_FIELD not in self.fields:
             self.create_field(EXISTENCE_FIELD, FieldOptions(type=TYPE_SET, cache_type="none"))
         from pilosa_tpu.storage.attrs import AttrStore
@@ -87,11 +90,22 @@ class Index:
         # (and this directory entry) — a power cut that loses them would
         # make recover() silently drop the field's acked, fsynced ops
         from pilosa_tpu.storage.wal import fsync_dir
+        from pilosa_tpu.testing import faults
 
-        with open(os.path.join(self.path, ".meta"), "w") as f:
-            json.dump({"keys": self.keys, "trackExistence": self.track_existence}, f)
-            f.flush()
-            os.fsync(f.fileno())
+        meta = os.path.join(self.path, ".meta")
+        try:
+            faults.disk_check("write", meta)
+            with open(meta, "w") as f:
+                json.dump({"keys": self.keys,
+                           "trackExistence": self.track_existence}, f)
+                f.flush()
+                faults.disk_check("fsync", meta)
+                os.fsync(f.fileno())
+        except OSError as e:
+            health = getattr(self.wal, "health", None) if self.wal else None
+            if health is not None:
+                health.trip(f".meta write of {meta}: {e}")
+            raise
         fsync_dir(self.path)
         fsync_dir(os.path.dirname(self.path) or ".")
 
@@ -105,6 +119,7 @@ class Index:
             field = Field(
                 os.path.join(self.path, name), self.name, name, options,
                 scope=self.scope, wal=self.wal,
+                verify_on_load=self.verify_on_load,
             ).open()
             self.fields[name] = field
             self.plan_epoch += 1

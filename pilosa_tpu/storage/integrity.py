@@ -1,0 +1,432 @@
+"""Storage integrity plane: end-to-end checksums, quarantine, degradation.
+
+PR 5 proved acked writes survive crashes and PR 9 proved the control
+plane survives partitions — but nothing detected a fragment whose bytes
+rotted ON DISK: a flipped bit in a roaring container would be decoded,
+served, replicated by anti-entropy, and snapshotted into backups as if
+it were truth. This module is the missing trust boundary between the
+disk and everything above it:
+
+- **Checksum sidecars** (``<fragment>.checksums``): every fragment
+  snapshot persists its per-BLOCK_ROWS block digests beside the data
+  file — the SAME blake2b-over-ids digests the sync manifests (PR 4)
+  and backup blobs (PR 5) already speak, so load verification, scrub,
+  anti-entropy, and backup all share one checksum language.
+- **Verified loads**: ``Fragment.open`` re-derives the snapshot's block
+  digests and compares them against the sidecar (``verify-on-load``
+  knob); any decode error or digest mismatch raises the typed
+  :class:`CorruptFragmentError` instead of a raw ``struct.error`` five
+  frames deep. Digests are memoized against the fragment's mutation
+  counter (fragment.blocks), so hot read paths pay nothing.
+- **Quarantine**: a fragment that fails verification is renamed to
+  ``<name>.quarantine-<n>`` (with its sidecars), dropped from the view,
+  and NEVER served; the scrubber / anti-entropy then read-repairs it
+  from a healthy replica (parallel/scrub.py).
+- **StorageHealth**: ENOSPC/EIO on the WAL fsync, snapshot, or
+  ``.meta`` write paths flips the node to a read-only
+  ``storage_degraded`` state (writes shed 503 on the QoS path,
+  ``storageDegraded`` on /status, ``storage_degraded`` gauge on
+  /metrics) instead of wedging the commit thread with a traceback —
+  and auto-clears once a probe write to the data dir succeeds.
+
+Disk faults are injectable deterministically (testing/faults.py disk
+plane: bit-flip-on-read, torn writes, errno on fsync), which is how the
+chaos/scrub oracles drive every path here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+_LOG = logging.getLogger("pilosa_tpu.storage.integrity")
+
+# Sidecar beside every fragment snapshot holding its block digests.
+CHECKSUM_SUFFIX = ".checksums"
+# Quarantined artifacts: "<fragment>.quarantine-<n>" — never decoded,
+# never served, skipped by every directory walk (view open's isdigit()
+# filter, backup's fragments-dir skip), kept for forensics.
+QUARANTINE_MARK = ".quarantine-"
+
+
+class CorruptFragmentError(ValueError):
+    """A fragment's bytes fail structural decode or digest verification.
+
+    Subclasses ValueError so callers already handling decode errors
+    (import paths, restore) keep working; carries the fragment path and
+    the best-known byte offset / block so the operator can find the rot
+    without a hex editor.
+    """
+
+    def __init__(self, path: str, reason: str, offset: int | None = None,
+                 block: int | None = None):
+        self.path = path
+        self.reason = reason
+        self.offset = offset
+        self.block = block
+        where = ""
+        if offset is not None:
+            where = f" at byte {offset}"
+        elif block is not None:
+            where = f" in checksum block {block}"
+        super().__init__(f"corrupt fragment {path}{where}: {reason}")
+
+
+# Decode failures that mean "these bytes are not a fragment" — the set
+# a flipped byte can produce anywhere in the snapshot region. Anything
+# else escaping a decode is a real bug and should surface raw.
+DECODE_ERRORS = (ValueError, struct.error, zlib.error, OverflowError,
+                 IndexError, MemoryError)
+
+
+# ------------------------------------------------------------- digests
+
+
+def block_digests(ids: np.ndarray, block_rows: int = 100
+                  ) -> list[tuple[int, str]]:
+    """Per-block blake2b digests of a fragment's sorted bit ids — THE
+    checksum language (identical to fragment.blocks(), the sync
+    manifests, and backup's blob addressing)."""
+    out: list[tuple[int, str]] = []
+    if ids.size:
+        block_of = (ids >> np.uint64(20)) // block_rows
+        boundaries = np.concatenate(
+            ([0], np.nonzero(np.diff(block_of))[0] + 1, [ids.size])
+        )
+        for i in range(boundaries.size - 1):
+            lo, hi = int(boundaries[i]), int(boundaries[i + 1])
+            digest = hashlib.blake2b(
+                ids[lo:hi].astype("<u8").tobytes(), digest_size=16
+            ).hexdigest()
+            out.append((int(block_of[lo]), digest))
+    return out
+
+
+# ------------------------------------------------------------- sidecar
+
+
+def save_checksums(path: str, blocks) -> None:
+    """Atomically persist a fragment's block digests (snapshot-time
+    sidecar). Self-checksummed so a torn sidecar reads as absent, not
+    as a false corruption verdict against a healthy fragment."""
+    body = json.dumps([[int(b), d] for b, d in blocks],
+                      separators=(",", ":")).encode()
+    payload = json.dumps(
+        {"v": 1, "crc": zlib.crc32(body), "blocks": json.loads(body)},
+        separators=(",", ":"),
+    ).encode()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_checksums(path: str) -> list[tuple[int, str]] | None:
+    """Read a checksum sidecar; None when absent or torn (verification
+    is skipped then — an unreadable sidecar must not condemn a healthy
+    fragment)."""
+    try:
+        with open(path, "rb") as f:
+            doc = json.loads(f.read().decode("utf-8", errors="strict"))
+        blocks = doc["blocks"]
+        body = json.dumps([[int(b), d] for b, d in blocks],
+                          separators=(",", ":")).encode()
+        if zlib.crc32(body) != doc["crc"]:
+            return None
+        return [(int(b), str(d)) for b, d in blocks]
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def verify_snapshot_blocks(bitmap, sidecar: list[tuple[int, str]],
+                           path: str) -> None:
+    """Compare a decoded SNAPSHOT bitmap's block digests against its
+    sidecar (computed before op replay — the sidecar describes exactly
+    the snapshot portion of the file). Raises CorruptFragmentError on
+    the first differing block."""
+    live = block_digests(bitmap.to_ids())
+    if live == sidecar:
+        return
+    want = dict(sidecar)
+    got = dict(live)
+    for block in sorted(set(want) | set(got)):
+        if want.get(block) != got.get(block):
+            raise CorruptFragmentError(
+                path,
+                f"block digest mismatch (have {got.get(block)}, "
+                f"checksum index says {want.get(block)})",
+                block=block,
+            )
+    raise CorruptFragmentError(path, "block digest ordering mismatch")
+
+
+# ---------------------------------------------------------------- load
+
+
+def read_file(path: str) -> bytes:
+    """Whole-file read routed through the disk fault plane's read hook
+    (testing/faults.py) — the one seam bit-flip-on-read injection needs
+    to reach every fragment load and scrub pass."""
+    from pilosa_tpu.testing import faults
+
+    with open(path, "rb") as f:
+        data = f.read()
+    return faults.disk_filter_read(path, data)
+
+
+def load_verified(data: bytes, path: str, verify: bool = False):
+    """Decode a fragment file's snapshot portion with every decode
+    error wrapped as CorruptFragmentError; with ``verify``, also check
+    the snapshot's block digests against the sidecar (when one exists).
+    Returns (bitmap, ops_at). Op replay stays with the caller — ops are
+    individually CRC'd and follow the torn-tail crash model."""
+    from pilosa_tpu.roaring.format import deserialize
+
+    try:
+        bitmap, ops_at = deserialize(data)
+    except DECODE_ERRORS as e:
+        # truncation tears are at EOF by construction; other decode
+        # failures carry no reliable offset — report the path and the
+        # decoder's own message rather than a misleading byte number
+        offset = len(data) if "truncated" in str(e).lower() else None
+        raise CorruptFragmentError(
+            path, f"snapshot decode failed: {e}", offset=offset,
+        ) from e
+    if verify:
+        sidecar = load_checksums(path + CHECKSUM_SUFFIX)
+        if sidecar is not None:
+            verify_snapshot_blocks(bitmap, sidecar, path)
+            global_integrity().count("verified_loads")
+        else:
+            global_integrity().count("unverified_loads")
+    return bitmap, ops_at
+
+
+def verify_fragment_file(path: str):
+    """THE disk-vs-disk verification recipe, shared by the scrubber,
+    the chaos disk-integrity oracle, and the CLI check verb: read the
+    file (through the fault plane's read seam), decode the snapshot
+    with typed errors, and — when a sidecar exists — compare block
+    digests. Raises CorruptFragmentError; returns (bitmap, data,
+    ops_at) so callers can replay/weigh the op tail."""
+    data = read_file(path)
+    bitmap, ops_at = load_verified(data, path, verify=False)
+    sidecar = load_checksums(path + CHECKSUM_SUFFIX)
+    if sidecar is not None:
+        verify_snapshot_blocks(bitmap, sidecar, path)
+    return bitmap, data, ops_at
+
+
+# ----------------------------------------------------------- quarantine
+
+
+def quarantine_paths(path: str, reason: str = "") -> str:
+    """Rename a corrupt fragment file (and its .cache/.checksums
+    sidecars) to ``<path>.quarantine-<n>`` so it is never decoded or
+    served again; the renamed artifacts stay on disk for forensics.
+    Returns the quarantine path (or "" when nothing existed)."""
+    n = 0
+    while os.path.exists(f"{path}{QUARANTINE_MARK}{n}"):
+        n += 1
+    qpath = f"{path}{QUARANTINE_MARK}{n}"
+    moved = ""
+    for src, dst in (
+        (path, qpath),
+        (path + ".cache", f"{qpath}.cache"),
+        (path + CHECKSUM_SUFFIX, f"{qpath}{CHECKSUM_SUFFIX}"),
+    ):
+        try:
+            os.replace(src, dst)
+            if src == path:
+                moved = dst
+        except OSError:
+            continue
+    from pilosa_tpu.storage.wal import fsync_dir
+
+    fsync_dir(os.path.dirname(path) or ".")
+    stats = global_integrity()
+    stats.count("quarantined")
+    _LOG.error("quarantined corrupt fragment %s -> %s (%s)",
+               path, qpath, reason)
+    return moved
+
+
+def is_quarantined(name: str) -> bool:
+    return QUARANTINE_MARK in name
+
+
+def list_quarantined(data_dir: str) -> list[str]:
+    """Every quarantined artifact under a data dir (CLI check, status
+    reporting)."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(data_dir):
+        for name in filenames:
+            if QUARANTINE_MARK in name and not name.endswith(
+                (".cache", CHECKSUM_SUFFIX)
+            ):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+# ------------------------------------------------------- process counters
+
+
+class IntegrityStats:
+    """Process-wide integrity counters (the global_stats shape): every
+    exporter key present from scrape one, zeros included."""
+
+    KEYS = ("verified_loads", "unverified_loads", "verify_failures",
+            "quarantined", "read_repairs", "self_heals")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {k: 0 for k in self.KEYS}
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {f"integrity_{k}_total": v
+                    for k, v in sorted(self._counts.items())}
+
+
+_INTEGRITY = IntegrityStats()
+
+
+def global_integrity() -> IntegrityStats:
+    return _INTEGRITY
+
+
+# ------------------------------------------------------- storage health
+
+
+class StorageHealth:
+    """Per-holder disk-fault degradation latch.
+
+    ``trip(reason)`` flips the node into the read-only
+    ``storage_degraded`` state (the write paths consult ``degraded``
+    and shed 503 — server/api.py) and starts a probe loop that
+    attempts a small fsynced write into the data dir; the first probe
+    that succeeds runs the registered recovery callbacks (the WAL's
+    ``clear_fault``) and clears the latch. The probe write itself
+    routes through the disk fault plane, so an armed ENOSPC/EIO rule
+    keeps the node degraded until the rule clears — exactly how a full
+    disk behaves."""
+
+    PROBE_INTERVAL_S = 1.0
+
+    def __init__(self, probe_dir: str | None = None):
+        self._lock = threading.Lock()
+        self._probe_dir = probe_dir
+        self.degraded = False
+        self.reason = ""
+        self.trips = 0
+        self.recoveries = 0
+        self._on_clear: list = []
+        self._probe_thread: threading.Thread | None = None
+        self._closed = threading.Event()
+
+    def on_clear(self, fn) -> None:
+        """Register a recovery callback run when a probe succeeds
+        (before the latch clears)."""
+        with self._lock:
+            self._on_clear.append(fn)
+
+    def trip(self, reason: str) -> None:
+        with self._lock:
+            already = self.degraded
+            self.degraded = True
+            if not already:
+                self.reason = reason
+                self.trips += 1
+            start_probe = (not already and self._probe_dir is not None
+                           and not self._closed.is_set())
+            if start_probe:
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop, daemon=True,
+                    name="storage-health-probe",
+                )
+        if not already:
+            _LOG.error(
+                "storage degraded (%s): shedding writes read-only until "
+                "a probe write succeeds", reason,
+            )
+        if start_probe:
+            self._probe_thread.start()
+
+    def clear(self) -> None:
+        with self._lock:
+            if not self.degraded:
+                return
+            self.degraded = False
+            self.reason = ""
+            self.recoveries += 1
+        _LOG.warning("storage recovered: probe write succeeded, "
+                     "resuming writes")
+
+    def close(self) -> None:
+        self._closed.set()
+
+    # ------------------------------------------------------------- probe
+
+    def probe_write(self) -> None:
+        """One small durable write into the data dir; raises OSError
+        while the disk is still sick. Routed through the fault plane's
+        fsync hook so injected ENOSPC keeps failing it."""
+        from pilosa_tpu.testing import faults
+
+        path = os.path.join(self._probe_dir, ".probe")
+        with open(path, "wb") as f:
+            f.write(b"probe")
+            f.flush()
+            faults.disk_check("fsync", path)
+            os.fsync(f.fileno())
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def _probe_loop(self) -> None:
+        while not self._closed.is_set():
+            self._closed.wait(self.PROBE_INTERVAL_S)
+            with self._lock:
+                if not self.degraded:
+                    return
+            try:
+                self.probe_write()
+            except OSError:
+                continue
+            with self._lock:
+                callbacks = list(self._on_clear)
+            ok = True
+            for fn in callbacks:
+                try:
+                    if fn() is False:
+                        ok = False  # recovery refused (e.g. WAL could
+                        # not reopen a segment): stay degraded, reprobe
+                except OSError:
+                    ok = False
+            if ok:
+                self.clear()
+                return
+
+    # ----------------------------------------------------------- metrics
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "storage_degraded": int(self.degraded),
+                "storage_degraded_total": self.trips,
+                "storage_recoveries_total": self.recoveries,
+            }

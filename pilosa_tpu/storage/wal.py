@@ -214,7 +214,16 @@ class WriteAheadLog:
         self._group_open_t = 0.0
         self._last_group_size = 0
         self._error: BaseException | None = None
+        # highest seq whose group's fsync FAILED: those records are
+        # gone (torn tail of the poisoned segment), so a barrier for
+        # them must raise forever — even after the disk recovers and
+        # newer groups commit past them (clear_fault)
+        self._failed_seq = 0
         self._closing = False
+        # holder's StorageHealth latch (storage/integrity.py): a commit
+        # fault trips the node read-only; its probe calls clear_fault()
+        # when the disk answers again
+        self.health = None
         self._thread: threading.Thread | None = None
         self._started = False
         # segment bookkeeping (commit/checkpoint threads + note_snapshot)
@@ -234,6 +243,7 @@ class WriteAheadLog:
         self.max_group_ops = 0
         self.checkpoints = 0
         self.recovered_ops = 0
+        self.commit_recoveries = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -404,11 +414,23 @@ class WriteAheadLog:
     def barrier(self, seq: int | None = None) -> None:
         """Block until every op appended so far (or up to ``seq``) is
         durable — the write ACK gate. No-op outside group mode (per-op
-        fsyncs inline; flush-only promises nothing)."""
+        fsyncs inline; flush-only promises nothing). Ops whose group's
+        fsync FAILED raise forever: their bytes are a torn tail of a
+        poisoned segment, and acking them after the disk recovers would
+        be acking lost writes."""
         if not self.grouped:
             return
         with self._cond:
             target = self._seq if seq is None else seq
+            # the lost-group check comes BEFORE the durable check: a
+            # recovered WAL commits newer groups past the failed range,
+            # and a late barrier for a lost seq must still raise — not
+            # convert a lost write into a late ACK
+            if 0 < target <= self._failed_seq:
+                raise OSError(
+                    "wal commit failed: this write's group was lost "
+                    "to a storage fault"
+                )
             while self._durable_seq < target:
                 if self._error is not None:
                     raise OSError(f"wal commit failed: {self._error}")
@@ -425,6 +447,32 @@ class WriteAheadLog:
 
     def flush(self) -> None:
         self.barrier()
+
+    def clear_fault(self) -> bool:
+        """The disk answers again (StorageHealth probe succeeded): drop
+        the recorded fault and resume committing buffered groups into a
+        FRESH segment — the faulted segment's tail may be torn, and
+        appending past a tear would bury good records behind it.
+        Returns False (stay degraded) when the fresh segment itself
+        cannot be opened."""
+        with self._cond:
+            if self._error is None:
+                return True
+        # open the fresh segment BEFORE clearing the error: the commit
+        # loop only writes while _error is None, so clearing first
+        # would let a woken group fsync into the faulted segment PAST
+        # its torn tail — recover()'s sequential replay stops at the
+        # tear and the acked group behind it would be unreachable
+        if self._started:
+            try:
+                self._open_segment()
+            except OSError:
+                return False  # probe retries; _error stays set
+        with self._cond:
+            self._error = None
+            self._cond.notify_all()
+        self.commit_recoveries += 1
+        return True
 
     # ---------------------------------------------------------- commit loop
 
@@ -445,10 +493,21 @@ class WriteAheadLog:
     def _run_commits(self) -> None:
         while True:
             with self._cond:
-                while not self._buffer and not self._closing:
-                    self._cond.wait()
-                if not self._buffer:
-                    break  # clean shutdown
+                # with a fault recorded, hold off instead of burning a
+                # retry loop against a sick disk: clear_fault() (driven
+                # by the health probe) wakes this wait when the disk
+                # answers again. The timeout exists ONLY in the faulted
+                # state (belt-and-braces vs a missed notify); an idle
+                # healthy node sleeps untimed like it always did.
+                while ((not self._buffer or self._error is not None)
+                       and not self._closing):
+                    self._cond.wait(
+                        0.5 if self._error is not None else None
+                    )
+                if self._closing and (not self._buffer
+                                      or self._error is not None):
+                    break  # shutdown (clean, or still-faulted: the
+                    # surviving segments are recover()'s problem)
                 # Self-latching forming window (the serving pipeline's
                 # gather idiom): hold the group open up to max_ms only
                 # when there is evidence of concurrency — this group
@@ -475,18 +534,27 @@ class WriteAheadLog:
             try:
                 with self._seg_lock:
                     f, seg = self._file, self._active
+                    seg_path = seg.path
                     f.write(data)
                     f.flush()
+                from pilosa_tpu.testing import faults as _faults
+
+                _faults.disk_check("fsync", seg_path)
                 self._fsync(f.fileno())
             except (OSError, ValueError) as e:
-                # an fsync/write failure means acked-durability can no
-                # longer be promised: fail every waiting and future
-                # barrier loudly instead of acking silently-volatile
-                # writes
+                # an fsync/write failure means this GROUP is lost (its
+                # bytes are a torn tail): fail its barriers forever,
+                # trip the holder into read-only storage_degraded mode,
+                # and park the loop until the health probe's
+                # clear_fault() says the disk answers again — instead
+                # of dying and wedging the node until restart
                 with self._cond:
                     self._error = e
+                    self._failed_seq = max(self._failed_seq, end_seq)
                     self._cond.notify_all()
-                return
+                if self.health is not None:
+                    self.health.trip(f"wal commit fsync: {e}")
+                continue
             with self._seg_lock:
                 seg.nbytes += len(data)
                 for key, _, seq, frag, rtype in batch:
@@ -716,5 +784,6 @@ class WriteAheadLog:
             "group_max_ops": self.max_group_ops,
             "checkpoints_total": self.checkpoints,
             "recovered_ops_total": self.recovered_ops,
+            "commit_recoveries_total": self.commit_recoveries,
             "segments": segments,
         }

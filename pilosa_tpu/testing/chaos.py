@@ -29,6 +29,7 @@ in BENCH_SUITE.json) and the ``slow`` soak in tests/test_partition.py.
 from __future__ import annotations
 
 import json
+import os
 import random
 import threading
 import time
@@ -58,6 +59,7 @@ class ChaosHarness:
                  seed: int = 0, n_events: int = 6,
                  event_gap_s: float = 0.3, writer_threads: int = 2,
                  reader_threads: int = 1, n_shards: int = 4,
+                 with_storage_faults: bool = False,
                  log=lambda msg: None):
         self.tmp_dir = str(tmp_dir)
         self.n_nodes = n_nodes
@@ -68,6 +70,14 @@ class ChaosHarness:
         self.writer_threads = writer_threads
         self.reader_threads = reader_threads
         self.n_shards = n_shards
+        # storage-fault schedules (ISSUE 10): bit-flip a live replica's
+        # fragment file on disk, ENOSPC one node's fsync path — gated
+        # on the integrity oracle (every fragment's disk bytes verify
+        # clean after heal, on top of the four partition oracles)
+        self.with_storage_faults = with_storage_faults
+        self.disk_plane = None
+        self.corruptions_injected = 0
+        self.disk_fault_rules: list[int] = []
         self.log = log
         self.servers: dict[str, object] = {}   # name -> live Server
         self.downed: dict[str, int] = {}       # name -> port to rebind
@@ -101,10 +111,16 @@ class ChaosHarness:
         cluster.SEND_BACKOFF_S = 0.01
         cluster.CLEANUP_DRAIN_TIMEOUT = 2.0
         cluster.RESIZE_COMPLETE_TIMEOUT = 10.0
+        if self.with_storage_faults:
+            # fast degraded-mode recovery so ENOSPC events heal within
+            # the schedule's gaps, not its lifetime
+            server.holder.health.PROBE_INTERVAL_S = 0.2
         return server
 
     def boot(self) -> "ChaosHarness":
         self.plane = faults.install()
+        if self.with_storage_faults:
+            self.disk_plane = faults.install_disk()
         for i in range(self.n_nodes):
             name = f"n{i}"
             seeds = ([self._uri(next(iter(self.servers.values())))]
@@ -129,6 +145,7 @@ class ChaosHarness:
             except Exception:  # noqa: BLE001 — teardown must finish
                 pass
         faults.clear()
+        faults.clear_disk()
 
     @staticmethod
     def _uri(server) -> str:
@@ -231,6 +248,74 @@ class ChaosHarness:
         server.close()
         return f"kill {name}"
 
+    def _event_corrupt(self) -> str:
+        """Bit-flip one byte of a random live snapshotted fragment ON
+        DISK — silent media rot. The live bitmap stays healthy (that is
+        the point: replicas hold every acked write), and the scrub
+        passes in converge must detect, quarantine, and read-repair it;
+        the integrity oracle then proves the disk verifies clean."""
+        candidates = []
+        for server in self._live():
+            for idx in server.holder.indexes.values():
+                for field in idx.fields.values():
+                    for view in field.views.values():
+                        for frag in view.fragments.values():
+                            # select by LIVE content: in group mode the
+                            # file is a bare header until the snapshot
+                            # below materializes it
+                            if frag.count() > 0:
+                                candidates.append((server, frag))
+        if not candidates:
+            return "corrupt-skipped"
+        server, frag = self.rng.choice(candidates)
+        # ensure file+sidecar describe real content, then flip a byte
+        # of the snapshot payload (past the 20-byte header)
+        try:
+            frag.snapshot()
+            size = os.path.getsize(frag.path)
+            if size <= 20:
+                return "corrupt-skipped"
+            offset = self.rng.randrange(20, size)
+            with open(frag.path, "r+b") as f:
+                f.seek(offset)
+                byte = f.read(1)
+                f.seek(offset)
+                f.write(bytes([byte[0] ^ (1 << self.rng.randrange(8))]))
+        except OSError:
+            return "corrupt-skipped"
+        self.corruptions_injected += 1
+        return (f"corrupt {server.config.name}:"
+                f"{frag.index}/{frag.field}/{frag.view}/{frag.shard}"
+                f"@{offset}")
+
+    def _event_disk_full(self) -> str:
+        """ENOSPC on one node's fsync path: its writes shed 503 and the
+        node flips storage-degraded until the heal event (or finale)
+        removes the rule and the probe clears the latch."""
+        if self.disk_plane is None:
+            return "disk-full-skipped"
+        names = sorted(self.servers)
+        if not names:
+            return "disk-full-skipped"
+        name = self.rng.choice(names)
+        import errno as _errno
+
+        rule = self.disk_plane.add(
+            "fsync", path=f"{self.tmp_dir}/{name}/",
+            errno_=_errno.ENOSPC,
+        )
+        self.disk_fault_rules.append(rule.id)
+        return f"disk-full {name}"
+
+    def _heal_disk(self) -> int:
+        if self.disk_plane is None:
+            return 0
+        removed = 0
+        for rule_id in self.disk_fault_rules:
+            removed += bool(self.disk_plane.remove(rule_id))
+        self.disk_fault_rules = []
+        return removed
+
     def _event_restart(self) -> str:
         if not self.downed:
             return "restart-skipped"
@@ -259,6 +344,9 @@ class ChaosHarness:
             (self._event_partition, 4), (self._event_heal, 2),
             (self._event_kill, 2), (self._event_restart, 2),
         ]
+        if self.with_storage_faults:
+            choices += [(self._event_corrupt, 3),
+                        (self._event_disk_full, 2)]
         bag = [fn for fn, w in choices for _ in range(w)]
         t0 = time.monotonic()
         for _ in range(self.n_events):
@@ -275,6 +363,7 @@ class ChaosHarness:
         for t in threads:
             t.join(timeout=10)
         self.plane.heal()
+        self._heal_disk()
         while self.downed:
             self.log(f"  finale: {self._event_restart()}")
         converged = self._converge(deadline_s=60)
@@ -329,9 +418,26 @@ class ChaosHarness:
             }
             return False
         # repair passes until quiescent (bounded): every node pulls the
-        # blocks it is missing from its replicas
+        # blocks it is missing from its replicas. With storage faults
+        # on, each round leads with a scrub pass — injected rot must be
+        # detected/quarantined BEFORE sync (quarantine-then-sync is the
+        # read-repair; syncing a corrupt-on-disk fragment first would
+        # never surface it)
         for _ in range(4):
             repaired = 0
+            if self.with_storage_faults:
+                # any still-degraded node blocks its own repair writes:
+                # wait out the probe first
+                for s in self._live():
+                    deadline2 = time.monotonic() + 5
+                    while (s.holder.health.degraded
+                           and time.monotonic() < deadline2):
+                        time.sleep(0.1)
+                for s in self._live():
+                    try:
+                        repaired += s.api.scrub_now()["corrupt"]
+                    except Exception:  # noqa: BLE001
+                        repaired += 1
             for s in self._live():
                 try:
                     repaired += s.api.cluster.sync_holder()["bits"]
@@ -357,16 +463,48 @@ class ChaosHarness:
         conflicts = {e: sorted(a) for e, a in actors_by_epoch.items()
                      if len(a) > 1}
         mismatches = self._oracle_replica_identity()
+        dirty_disk = (self._oracle_disk_integrity()
+                      if self.with_storage_faults else [])
+        degraded_stuck = [
+            s.config.name for s in self._live()
+            if self.with_storage_faults and s.holder.health.degraded
+        ]
         return {
             "lost_acked_writes": len(lost),
             "lost_sample": sorted(lost)[:5],
             "non_quorum_deletions": len(non_quorum_deletions),
             "coordinator_conflicts": conflicts,
             "replica_mismatches": mismatches,
+            "corruptions_injected": self.corruptions_injected,
+            "disk_integrity_failures": dirty_disk,
+            "degraded_stuck": degraded_stuck,
             "epochs_acted": len(actors_by_epoch),
             "ok": (not lost and not non_quorum_deletions
-                   and not conflicts and not mismatches),
+                   and not conflicts and not mismatches
+                   and not dirty_disk and not degraded_stuck),
         }
+
+    def _oracle_disk_integrity(self) -> list:
+        """The corruption oracle (ISSUE 10): after heal + scrub, every
+        fragment's BYTES ON DISK decode cleanly and match their
+        checksum sidecar — injected rot was detected, quarantined, and
+        repaired (or rewritten), never left to be served or replicated.
+        Returns the list of still-dirty fragment paths."""
+        from pilosa_tpu.storage import integrity
+
+        dirty = []
+        for server in self._live():
+            for idx in server.holder.indexes.values():
+                for field in idx.fields.values():
+                    for view in field.views.values():
+                        for frag in list(view.fragments.values()):
+                            try:
+                                integrity.verify_fragment_file(frag.path)
+                            except integrity.CorruptFragmentError as e:
+                                dirty.append(str(e))
+                            except OSError:
+                                continue
+        return dirty
 
     def _oracle_lost_writes(self) -> set:
         """Every acked (row, col) must be queryable cluster-wide."""
@@ -436,11 +574,14 @@ class ChaosHarness:
 
 def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
               replica_n: int = 2, seed: int = 0, n_events: int = 6,
-              event_gap_s: float = 0.3, log=lambda msg: None) -> dict:
+              event_gap_s: float = 0.3, with_storage_faults: bool = False,
+              log=lambda msg: None) -> dict:
     """Run ``n_schedules`` independent seeded schedules (fresh cluster
     each — a schedule's damage must not leak into the next) and fold
     the oracle verdicts. Any failing schedule reports its seed so the
-    run replays deterministically."""
+    run replays deterministically. ``with_storage_faults`` adds
+    bit-flip and disk-full events plus the disk-integrity oracle
+    (bench_suite config_scrub)."""
     records = []
     for i in range(n_schedules):
         schedule_seed = seed * 1000 + i
@@ -448,7 +589,8 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
         harness = ChaosHarness(
             f"{tmp_dir}/sched{i}", n_nodes=n_nodes, replica_n=replica_n,
             seed=schedule_seed, n_events=n_events,
-            event_gap_s=event_gap_s, log=log,
+            event_gap_s=event_gap_s,
+            with_storage_faults=with_storage_faults, log=log,
         )
         try:
             harness.boot()
@@ -474,6 +616,13 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
                                   if r["coordinator_conflicts"]],
         "replica_mismatches": sum(len(r["replica_mismatches"])
                                   for r in records),
+        "corruptions_injected": sum(r.get("corruptions_injected", 0)
+                                    for r in records),
+        "disk_integrity_failures": sum(
+            len(r.get("disk_integrity_failures", []))
+            for r in records),
+        "degraded_stuck": sum(len(r.get("degraded_stuck", []))
+                              for r in records),
         "unconverged": sum(1 for r in records if not r["converged"]),
         "failed_seeds": [r["seed"] for r in failed],
         "failed_diags": [

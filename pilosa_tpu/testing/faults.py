@@ -36,6 +36,23 @@ kills the process mid-operation when the name is armed in-process
 (``arm_crash_point``) or via ``PILOSA_TPU_CRASH_POINT`` in a subprocess
 — the crash-recovery oracle's way of landing a kill exactly between two
 control-plane steps.
+
+DISK faults live on a second, independent plane (``install_disk``):
+where the wire plane intercepts node-to-node requests, the disk plane
+intercepts the storage layer's file operations at three seams —
+
+- ``read``:  flip a bit of the bytes a fragment load / scrub pass
+             reads (``flip_offset``/``flip_mask``) — silent media rot;
+- ``write``: truncate a snapshot's payload mid-write
+             (``truncate_to``) — a torn write / lost tail;
+- ``fsync``: raise ``OSError(errno)`` (ENOSPC, EIO) from the WAL group
+             fsync, a snapshot fsync, or the health probe — a full or
+             dying disk.
+
+Rules match by operation and path substring, with the same bounded
+``count`` semantics as wire rules. The storage layer's off-path cost is
+one module-global load + ``is None`` test per file operation (the wire
+plane's contract, applied to the disk).
 """
 
 from __future__ import annotations
@@ -262,4 +279,173 @@ class FaultPlane:
                 "delayed": self.delayed,
                 "errored": self.errored,
                 "duplicated": self.duplicated,
+            }
+
+
+# --------------------------------------------------------------- disk plane
+
+# The one global the storage seams read. None = off: one module-
+# attribute load + identity test per file operation, nothing else.
+_DISK = None
+
+DISK_OPS = ("read", "write", "fsync")
+
+
+def disk_active():
+    """The installed DiskFaultPlane, or None (the normal state)."""
+    return _DISK
+
+
+def install_disk(plane: "DiskFaultPlane | None" = None) -> "DiskFaultPlane":
+    global _DISK
+    _DISK = plane if plane is not None else DiskFaultPlane()
+    return _DISK
+
+
+def clear_disk() -> None:
+    global _DISK
+    _DISK = None
+
+
+def disk_check(op: str, path: str) -> None:
+    """Errno-injection seam: raises OSError when an armed errno rule
+    matches (op, path). The storage layer calls this immediately before
+    the real syscall it models."""
+    plane = _DISK
+    if plane is not None:
+        plane.check(op, path)
+
+
+def disk_filter_read(path: str, data: bytes) -> bytes:
+    """Bit-flip-on-read seam: every fragment load and scrub read passes
+    its bytes through here."""
+    plane = _DISK
+    if plane is None:
+        return data
+    return plane.filter(path, data, "read")
+
+
+def disk_filter_write(path: str, data: bytes) -> bytes:
+    """Torn-write seam: snapshot writers pass their payload through
+    here before the write syscall."""
+    plane = _DISK
+    if plane is None:
+        return data
+    return plane.filter(path, data, "write")
+
+
+class DiskFaultRule:
+    """One disk rule: ``op`` in DISK_OPS, ``path`` a substring match
+    ("*" = any file). Exactly one effect per rule: ``errno_`` raises
+    OSError (read/write/fsync), ``flip_offset`` XORs ``flip_mask`` into
+    one byte (read), ``truncate_to`` drops the tail (write). ``count``
+    bounds firings like wire rules."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, op: str, path: str = "*", errno_: int | None = None,
+                 flip_offset: int | None = None, flip_mask: int = 0x01,
+                 truncate_to: int | None = None, count: int | None = None):
+        if op not in DISK_OPS:
+            raise ValueError(
+                f"unknown disk fault op {op!r} (want one of {DISK_OPS})"
+            )
+        if errno_ is None and flip_offset is None and truncate_to is None:
+            raise ValueError(
+                "disk fault rule needs errno_, flip_offset, or truncate_to"
+            )
+        self.id = next(DiskFaultRule._ids)
+        self.op = op
+        self.path = path
+        self.errno_ = errno_
+        self.flip_offset = flip_offset
+        self.flip_mask = int(flip_mask) & 0xFF
+        self.truncate_to = truncate_to
+        self.count = count if count is None else int(count)
+        self.matched = 0
+
+    def matches(self, op: str, path: str) -> bool:
+        if self.count is not None and self.matched >= self.count:
+            return False
+        if self.op != op:
+            return False
+        return self.path == "*" or self.path in path
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.id, "op": self.op, "path": self.path,
+            "errno": self.errno_, "flipOffset": self.flip_offset,
+            "flipMask": self.flip_mask, "truncateTo": self.truncate_to,
+            "count": self.count, "matched": self.matched,
+        }
+
+
+class DiskFaultPlane:
+    """Rule registry + the per-file-operation intercepts the storage
+    seams call."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rules: list[DiskFaultRule] = []
+        self.read_faults = 0
+        self.write_faults = 0
+        self.fsync_faults = 0
+
+    def add(self, op: str, path: str = "*", **kw) -> DiskFaultRule:
+        rule = DiskFaultRule(op, path=path, **kw)
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    def remove(self, rule_id: int) -> bool:
+        with self._lock:
+            before = len(self.rules)
+            self.rules = [r for r in self.rules if r.id != rule_id]
+            return len(self.rules) != before
+
+    def clear_rules(self) -> None:
+        with self._lock:
+            self.rules = []
+
+    def check(self, op: str, path: str) -> None:
+        with self._lock:
+            for rule in self.rules:
+                if rule.errno_ is None or not rule.matches(op, path):
+                    continue
+                rule.matched += 1
+                if op == "fsync":
+                    self.fsync_faults += 1
+                elif op == "write":
+                    self.write_faults += 1
+                else:
+                    self.read_faults += 1
+                raise OSError(
+                    rule.errno_, os.strerror(rule.errno_), path
+                )
+
+    def filter(self, path: str, data: bytes, op: str) -> bytes:
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(op, path):
+                    continue
+                if op == "read" and rule.flip_offset is not None and data:
+                    rule.matched += 1
+                    self.read_faults += 1
+                    buf = bytearray(data)
+                    pos = rule.flip_offset % len(buf)
+                    buf[pos] ^= rule.flip_mask or 0x01
+                    data = bytes(buf)
+                elif op == "write" and rule.truncate_to is not None:
+                    rule.matched += 1
+                    self.write_faults += 1
+                    data = data[: rule.truncate_to]
+            return data
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "rules": [r.to_json() for r in self.rules],
+                "readFaults": self.read_faults,
+                "writeFaults": self.write_faults,
+                "fsyncFaults": self.fsync_faults,
             }
