@@ -21,8 +21,8 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
 	durability-smoke obs-smoke cost-smoke chaos-smoke scrub-smoke \
-	bench-ingest bench-serving bench-sync bench-durability \
-	bench-tracing bench-profiling bench-chaos bench-scrub
+	mp-smoke bench-ingest bench-serving bench-sync bench-durability \
+	bench-tracing bench-profiling bench-chaos bench-scrub bench-mp
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -74,6 +74,16 @@ chaos-smoke:
 scrub-smoke:
 	$(PYTEST) tests/test_integrity.py -m "not slow"
 
+# mp-smoke: the multi-process serving tier — shm-ring framing/fuzz/
+# backpressure/reclaim units, the end-to-end worker+owner contract
+# (byte-identical responses, WAL ACK barrier under owner SIGKILL,
+# tenant/trace attribution over the ring, degraded shedding, worker
+# respawn, owner-restart re-handshake, single-process fallback), and
+# one kill-a-worker chaos schedule (docs/OPERATIONS.md deployment
+# shapes)
+mp-smoke:
+	$(PYTEST) tests/test_shmring.py tests/test_mpserve.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -100,6 +110,13 @@ bench-profiling:
 # deletion, <=1 coordinator per epoch, byte-identical replicas)
 bench-chaos:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs chaos
+
+# multi-process serving scaling gate: single-process fast-lane plateau
+# vs 1/2/4 SO_REUSEPORT-worker plateaus (subprocess clients, best-of-3
+# interleaved), byte-identical responses across shapes, ring round-trip
+# quantiles, and the kill-a-worker chaos schedule
+bench-mp:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs mp_serving
 
 # storage-integrity gate: scrubber serving overhead >= 0.97x off,
 # detection-latency bound, the corruption-heal + ENOSPC oracles, and

@@ -2425,6 +2425,216 @@ def config_scrub(n_shards: int = 4, n_clients: int = 4,
     return out
 
 
+# Stand-alone client driver for config_mp_serving: client-side load
+# must come from PROCESSES (a threaded driver is itself GIL-bound and
+# would mask the very scaling the config measures). Each proc holds one
+# keep-alive connection, waits for a "run <port> <n> <start_at>" line,
+# fires n requests from the shared deterministic query schedule, and
+# reports wall time + a response digest (the byte-identical oracle).
+_MP_CLIENT_SRC = r"""
+import hashlib, http.client, json, sys, time
+QUERIES = ["Count(Row(f=%d))" % (1 + k) for k in range(4)]
+for line in sys.stdin:
+    parts = line.split()
+    if parts[0] == "exit":
+        break
+    port, n, start_at = int(parts[1]), int(parts[2]), float(parts[3])
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    h = hashlib.sha256()
+    errors = 0
+    while time.time() < start_at:
+        time.sleep(0.001)
+    t0 = time.perf_counter()
+    for k in range(n):
+        try:
+            conn.request("POST", "/index/b/query",
+                         body=QUERIES[k % len(QUERIES)].encode())
+            h.update(conn.getresponse().read())
+        except Exception:
+            errors += 1
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+    wall = time.perf_counter() - t0
+    conn.close()
+    print(json.dumps({"wall": wall, "digest": h.hexdigest(),
+                      "errors": errors}), flush=True)
+"""
+
+
+def config_mp_serving(n_shards: int = 4,
+                      worker_counts=(1, 2, 4),
+                      client_counts=(8, 32, 96),
+                      requests_per_client: int = 80,
+                      rounds: int = 3) -> dict:
+    """Multi-process serving tier scaling gate (ISSUE 11 / ROADMAP open
+    item 1): the SAME hot read mix against the SAME seeded data in two
+    deployment shapes — classic single-process, and N ``SO_REUSEPORT``
+    workers fronting one device owner over shared-memory rings
+    (serving/mpserve.py). Clients are subprocesses (process-level
+    parallelism on both sides of the wire); runs are best-of-``rounds``
+    INTERLEAVED across shapes so drift hits every curve equally.
+
+    The headline is plateau-vs-plateau: max QPS over the client sweep
+    per worker count, plus the worker-reported ring round-trip
+    quantiles. ``ok`` requires byte-identical responses across every
+    shape and run (digest oracle vs a serial pass), a 4-worker plateau
+    ≥ 2× the single-process fast-lane plateau (ROADMAP target ≥4×
+    where cores allow), and one kill-a-worker chaos schedule passing
+    both mp oracles (zero lost acked writes, owner never wedges)."""
+    import http.client as _hc
+    import socket as _socket
+    import subprocess
+    import sys as _sys
+
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    if not hasattr(_socket, "SO_REUSEPORT"):
+        return {"config": "mp_serving", "ok": False,
+                "error": "SO_REUSEPORT unavailable on this platform"}
+
+    def boot(tmp: str, workers: int):
+        server = Server(ServerConfig(
+            data_dir=tmp, port=0, name=f"mp{workers}",
+            serving_workers=workers, anti_entropy_interval=0,
+            heartbeat_interval=0, use_mesh=False,
+        )).open()
+        rng = np.random.default_rng(7)  # same seed: identical data
+        idx = server.holder.create_index("b")
+        f = idx.create_field("f")
+        n = int(SHARD_WIDTH * 0.1)
+        for shard in range(n_shards):
+            frag = f.view(VIEW_STANDARD, create=True).fragment(
+                shard, create=True)
+            for row in range(1, 5):
+                frag.bulk_import(
+                    np.full(n, row, np.uint64),
+                    rng.choice(SHARD_WIDTH, n, replace=False).astype(
+                        np.uint64),
+                )
+        server.api.cluster.note_local_shards("b", list(range(n_shards)))
+        return server
+
+    t0 = time.time()
+    max_clients = max(client_counts)
+    clients = [
+        subprocess.Popen([_sys.executable, "-c", _MP_CLIENT_SRC],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         text=True)
+        for _ in range(max_clients)
+    ]
+
+    def run_once(port: int, n_clients: int):
+        start_at = time.time() + 0.25
+        for p in clients[:n_clients]:
+            p.stdin.write(f"run {port} {requests_per_client} "
+                          f"{start_at}\n")
+            p.stdin.flush()
+        outs = []
+        for p in clients[:n_clients]:
+            line = p.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    "mp_serving client subprocess died mid-run "
+                    f"(exit {p.poll()})")
+            outs.append(json.loads(line))
+        wall = max(o["wall"] for o in outs)
+        errors = sum(o["errors"] for o in outs)
+        digests = {o["digest"] for o in outs}
+        return (n_clients * requests_per_client) / wall, errors, digests
+
+    servers = {}
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            servers[0] = boot(f"{tmp}/w0", 0)
+            for w in worker_counts:
+                servers[w] = boot(f"{tmp}/w{w}", w)
+            # serial ground-truth digest on the single-process shape
+            import hashlib as _hashlib
+
+            conn = _hc.HTTPConnection("127.0.0.1", servers[0].port,
+                                      timeout=60)
+            h = _hashlib.sha256()
+            for k in range(requests_per_client):
+                conn.request("POST", "/index/b/query",
+                             body=f"Count(Row(f={1 + k % 4}))".encode())
+                h.update(conn.getresponse().read())
+            conn.close()
+            want_digest = h.hexdigest()
+            # warm every shape (compile caches, worker pools)
+            for s in servers.values():
+                run_once(s.port, max_clients)
+            best: dict = {w: {} for w in servers}
+            errors_total = 0
+            identical = True
+            for _ in range(rounds):          # interleaved best-of-N
+                for w, s in servers.items():
+                    for n_clients in client_counts:
+                        qps, errs, digests = run_once(s.port, n_clients)
+                        errors_total += errs
+                        identical = identical and digests == {want_digest}
+                        best[w][n_clients] = max(
+                            best[w].get(n_clients, 0.0), qps)
+            curve = [
+                {"workers": w, "clients": c, "qps": round(q, 1)}
+                for w in sorted(best) for c, q in sorted(best[w].items())
+            ]
+            plateaus = {w: round(max(best[w].values()), 1)
+                        for w in sorted(best)}
+            # ring round-trip quantiles, as the workers measured them
+            rtt = {"p50_us": 0, "p99_us": 0}
+            mp = servers[max(worker_counts)]._mpserve
+            rows = [r for r in mp.workers_json() if r.get("ringRttP50Us")]
+            if rows:
+                rtt = {
+                    "p50_us": round(sum(r["ringRttP50Us"]
+                                        for r in rows) / len(rows)),
+                    "p99_us": max(r["ringRttP99Us"] for r in rows),
+                }
+            for s in servers.values():
+                s.close()
+            servers = {}
+            # the kill-a-worker chaos schedule rides the same gate
+            from pilosa_tpu.testing.chaos import run_mp_chaos
+
+            chaos = run_mp_chaos(f"{tmp}/chaos", n_schedules=1,
+                                 n_workers=2, n_kills=3)
+    finally:
+        for s in servers.values():
+            s.close()
+        for p in clients:
+            try:
+                p.stdin.write("exit\n")
+                p.stdin.flush()
+            except OSError:
+                pass
+        for p in clients:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    speedup = (plateaus[max(worker_counts)] / plateaus[0]
+               if plateaus[0] else 0.0)
+    return {
+        "config": "mp_serving",
+        "metric": "mp_serving_plateau_scaling",
+        "n_shards": n_shards,
+        "requests_per_point": requests_per_client * max(client_counts),
+        "curve": curve,
+        "plateau_qps_by_workers": plateaus,
+        "speedup_max_workers": round(speedup, 2),
+        "ring_rtt": rtt,
+        "client_errors": errors_total,
+        "bytes_identical": identical,
+        "kill_worker_chaos": chaos,
+        "wall_s": round(time.time() - t0, 1),
+        "ok": bool(identical and errors_total == 0 and speedup >= 2.0
+                   and chaos["ok"]),
+    }
+
+
 def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
                  replica_n: int = 2, n_events: int = 6,
                  seed: int = 0) -> dict:
@@ -2442,8 +2652,16 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
     ``ok`` requires every schedule to pass every oracle AND converge
     (membership reunified, all NORMAL, nobody degraded). A failing
     schedule's seed is reported so the run replays deterministically
-    (testing/chaos.py)."""
-    from pilosa_tpu.testing.chaos import run_chaos
+    (testing/chaos.py).
+
+    The default config also runs the ISSUE-11 kill-a-worker schedules
+    (multi-process serving tier: SIGKILL workers mid-burst) gated on
+    zero lost acked writes + the owner-never-wedges oracle; skipped
+    (and not counted against ``ok``) only where SO_REUSEPORT is
+    unavailable."""
+    import socket as _socket
+
+    from pilosa_tpu.testing.chaos import run_chaos, run_mp_chaos
 
     t0 = time.time()
     with tempfile.TemporaryDirectory() as tmp:
@@ -2451,7 +2669,13 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
             tmp, n_schedules=n_schedules, n_nodes=n_nodes,
             replica_n=replica_n, n_events=n_events, seed=seed,
         )
+        if hasattr(_socket, "SO_REUSEPORT"):
+            mp = run_mp_chaos(tmp + "/mp", n_schedules=2, n_workers=2,
+                              n_kills=3, seed=seed)
+        else:
+            mp = {"skipped": "SO_REUSEPORT unavailable", "ok": True}
     return {
+        "kill_worker": mp,
         "config": "chaos",
         "metric": "partition_chaos_oracles",
         "schedules": out["schedules"],
@@ -2467,7 +2691,8 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
         "failed_seeds": out["failed_seeds"],
         "failed_diags": out["failed_diags"],
         "wall_s": round(time.time() - t0, 1),
-        "ok": bool(out["ok"] and out["unconverged"] == 0),
+        "ok": bool(out["ok"] and out["unconverged"] == 0
+                   and mp.get("ok")),
     }
 
 
@@ -2506,8 +2731,8 @@ def main() -> None:
                         help="billion-column scale (real TPU)")
     parser.add_argument(
         "--configs",
-        default="1,2,3,4,5,mesh8,serving,import,ingest,sync,hostpath,"
-                "durability,tracing,profiling,chaos,scrub",
+        default="1,2,3,4,5,mesh8,serving,mp_serving,import,ingest,sync,"
+                "hostpath,durability,tracing,profiling,chaos,scrub",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -2530,6 +2755,10 @@ def main() -> None:
             n_shards=64 if args.full else 8,
             n_queries=1024 if args.full else 512,
             client_counts=(16, 64, 128) if args.full else (16, 64),
+        ),
+        "mp_serving": lambda: config_mp_serving(
+            client_counts=(16, 64, 128) if args.full else (8, 32, 96),
+            requests_per_client=160 if args.full else 80,
         ),
         "readwrite": lambda: config_serving_readwrite(
             n_shards=32 if args.full else 8,

@@ -51,6 +51,16 @@ client-pool-size = 8          # keep-alive connections retained per peer
 remote-batch = true           # coalesce same-node remote sub-queries onto
                               # /internal/query-batch (false = per-query)
 
+# Multi-process serving tier (docs/OPERATIONS.md deployment shapes):
+# shatters the single-interpreter serving ceiling with N SO_REUSEPORT
+# worker processes fronting this (device-owner) process over
+# shared-memory rings; requires SO_REUSEPORT (Linux), falls back to
+# single-process otherwise
+serving-workers = 0           # worker processes; 0 = single-process
+ring-slots = 1024             # slots per ring direction per worker
+ring-slot-bytes = 65536       # bytes per slot (large responses span
+                              # consecutive slots)
+
 # Write-path durability (docs/OPERATIONS.md): what an HTTP 200 on a
 # write means
 durability-mode = "group"     # group = one fsync per commit group of
@@ -269,6 +279,16 @@ def cmd_server(args) -> int:
     finally:
         server.close()
     return 0
+
+
+def cmd_serve_worker(args) -> int:
+    """Hidden entry for one SO_REUSEPORT serving worker process —
+    spawned by the device owner's OwnerRuntime with an inherited
+    listening socket, never run by hand (serving/mpserve.py)."""
+    from pilosa_tpu.serving.worker import worker_main
+
+    return worker_main(args.handshake_sock, args.listen_fd,
+                       args.worker_id)
 
 
 def _in_process_api(data_dir: str):
@@ -629,6 +649,13 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int)
     p.add_argument("--verbose", action="store_true")
     p.set_defaults(fn=cmd_server)
+
+    # internal: one SO_REUSEPORT serving worker (spawned by the owner)
+    p = sub.add_parser("serve-worker")
+    p.add_argument("--handshake-sock", required=True)
+    p.add_argument("--listen-fd", type=int, required=True)
+    p.add_argument("--worker-id", type=int, required=True)
+    p.set_defaults(fn=cmd_serve_worker)
 
     p = sub.add_parser("import", help="bulk-import CSV (row,col[,ts] or col,value)")
     p.add_argument("-i", "--index", required=True)

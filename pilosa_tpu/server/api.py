@@ -148,13 +148,19 @@ class API:
         # wires one when scrub-interval > 0. scrub_now() runs ad-hoc
         # passes without it.
         self.scrubber = None
+        # multi-process serving runtime (serving/mpserve.py OwnerRuntime)
+        # when this process is a device owner fronted by SO_REUSEPORT
+        # workers; None in single-process mode.
+        self.mpserve = None
 
     # ---------------------------------------------------------------- query
 
     def query_raw(self, index: str, pql: str, shards=None,
                   remote: bool = False, opts: dict | None = None,
                   tenant: str = "default", deadline=None,
-                  profile_out: list | None = None):
+                  profile_out: list | None = None,
+                  pre_admitted: bool = False,
+                  on_submitted=None):
         """Execute and return raw result objects (serializer-agnostic).
 
         QoS envelope: edge requests (``remote=False``) pass the admission
@@ -198,7 +204,11 @@ class API:
         err_status = None
         slot = None
         try:
-            if not remote:
+            if not remote and not pre_admitted:
+                # pre_admitted: a serving worker's gate already admitted
+                # this request before it crossed the shared-memory ring
+                # (serving/worker.py) — double-gating would shed
+                # requests the node as a whole has capacity for
                 if inflight is not None:
                     inflight.stage = "admission"
                 try:
@@ -210,7 +220,7 @@ class API:
                     raise err from e
             return self._query_raw_admitted(
                 index, pql, shards, remote, opts, tenant, deadline,
-                slot, inflight, tracer,
+                slot, inflight, tracer, on_submitted,
             )
         except ApiError as e:
             err_status = e.status
@@ -239,7 +249,8 @@ class API:
             tracker.finish(inflight, inflight_token)
 
     def _query_raw_admitted(self, index, pql, shards, remote, opts,
-                            tenant, deadline, slot, inflight, tracer):
+                            tenant, deadline, slot, inflight, tracer,
+                            on_submitted=None):
         import time
 
         from pilosa_tpu.executor.executor import PQLError
@@ -311,6 +322,14 @@ class API:
                     inflight.stage = "pipeline.wave"
                 deferreds = self._pipeline.run(index, query, kwargs,
                                                key=key)
+                if on_submitted is not None:
+                    # the wave containing this request has been formed
+                    # and submitted: the multi-process owner uses this
+                    # as the dedupe-join cutoff (serving/mpserve.py) —
+                    # the same boundary the pipeline's own wave dedupe
+                    # draws, so read-your-writes is preserved across
+                    # deployment shapes
+                    on_submitted()
                 # Same stats/trace envelope as Executor.execute (shared
                 # helper) — the timer here observes resolve latency,
                 # i.e. what this request actually waited for.
@@ -326,6 +345,8 @@ class API:
             else:
                 if inflight is not None:
                     inflight.stage = "executor.execute"
+                if on_submitted is not None:
+                    on_submitted()  # eager path: executing right now
                 results = self.executor.execute(index, query, **kwargs)
             if opts:
                 results = self._apply_request_opts(index, results, opts)
@@ -394,7 +415,9 @@ class API:
     def query_json_bytes(self, index: str, pql: str, shards=None,
                          remote: bool = False, opts: dict | None = None,
                          tenant: str = "default", deadline=None,
-                         profile_out: list | None = None) -> bytes:
+                         profile_out: list | None = None,
+                         pre_admitted: bool = False,
+                         on_submitted=None) -> bytes:
         """The whole JSON response envelope, pre-serialized (serving fast
         lane): hot result shapes encode straight to bytes — memoized on
         the result objects, so a deduped wave of identical queries
@@ -404,7 +427,9 @@ class API:
 
         results = self.query_raw(index, pql, shards=shards, remote=remote,
                                  opts=opts, tenant=tenant, deadline=deadline,
-                                 profile_out=profile_out)
+                                 profile_out=profile_out,
+                                 pre_admitted=pre_admitted,
+                                 on_submitted=on_submitted)
         return results_json_bytes(results)
 
     def query_batch(self, items: list) -> list:
@@ -1157,6 +1182,12 @@ class API:
                                       and health.degraded)
         out["storageDegradedReason"] = (health.reason
                                         if health is not None else "")
+        # multi-process serving surface (docs/OPERATIONS.md deployment
+        # shapes): the worker table tells operators (and the chaos
+        # harness) which SO_REUSEPORT workers are alive and which
+        # generation each is on
+        if self.mpserve is not None:
+            out["servingWorkers"] = self.mpserve.workers_json()
         return out
 
     def info(self) -> dict:
@@ -1287,6 +1318,43 @@ class API:
         if batcher is not None:
             out.update(batcher.metrics())
         return out
+
+    def mp_metrics(self) -> dict:
+        """Multi-process serving series (docs/OBSERVABILITY.md) —
+        present from scrape one with zeros in single-process mode, like
+        every sibling exporter block, so the deployment-shape flip
+        never makes a series appear mid-flight."""
+        if self.mpserve is not None:
+            return self.mpserve.metrics()
+        return {
+            "serving_workers": 0,
+            "serving_ring_depth": 0,
+            "serving_ring_full_total": 0,
+            "serving_owner_batch_size": 0.0,
+            "serving_owner_batches_total": 0,
+            "serving_owner_batched_requests_total": 0,
+            "serving_ring_requests_total": 0,
+            "serving_worker_shed_total": 0,
+            "serving_worker_proxied_total": 0,
+            "serving_worker_respawns_total": 0,
+            "serving_workers_reaped_total": 0,
+            "serving_responses_dropped_total": 0,
+            "serving_ring_queries_total": 0,
+            "serving_ring_deduped_total": 0,
+        }
+
+    def workers_json(self) -> dict:
+        """GET /debug/workers: the worker table (id, generation, pid,
+        liveness, ring depth, per-worker counters, ring round-trip
+        quantiles)."""
+        if self.mpserve is None:
+            return {"enabled": False, "workers": []}
+        return {
+            "enabled": True,
+            "port": self.mpserve.port,
+            "ownerPort": self.mpserve.owner_port,
+            "workers": self.mpserve.workers_json(),
+        }
 
     def durability_metrics(self) -> dict:
         """Write-path durability counters (group-commit WAL) for

@@ -74,6 +74,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/tenants$"), "get_tenants"),
     ("GET", re.compile(r"^/debug/heatmap$"), "get_heatmap"),
     ("GET", re.compile(r"^/debug/slo$"), "get_slo"),
+    ("GET", re.compile(r"^/debug/workers$"), "get_workers"),
     ("GET", re.compile(r"^/debug/queries$"), "get_inflight_queries"),
     ("GET", re.compile(r"^/debug/queries/slow$"), "get_long_queries"),
     ("GET", re.compile(r"^/debug/long-queries$"), "get_long_queries"),
@@ -703,6 +704,11 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 fastlane["http_requests_total"] = self.server.requests_served
         text += prometheus_block(fastlane, prefix, "serving",
                                   seen=seen)
+        # multi-process serving tier (docs/OPERATIONS.md deployment
+        # shapes): worker count, ring depth/backpressure, owner batch
+        # sizes — zeros in single-process mode, from scrape one
+        text += prometheus_block(self.api.mp_metrics(), prefix,
+                                 seen=seen)
         # write-path durability (group-commit WAL): zeros from scrape
         # one, same rate()-window reasoning as the blocks around it
         text += prometheus_block(self.api.durability_metrics(), prefix,
@@ -837,6 +843,13 @@ class HTTPHandler(BaseHTTPRequestHandler):
         flags (docs/OBSERVABILITY.md)."""
         self._json(self.api.slo.to_json())
 
+    def get_workers(self, query=None):
+        """Multi-process serving worker table (docs/OPERATIONS.md
+        deployment shapes): one row per SO_REUSEPORT worker with
+        generation, pid, liveness, ring depth, and the worker-reported
+        ring round-trip quantiles."""
+        self._json(self.api.workers_json())
+
     def get_inflight_queries(self, query=None):
         """Live queries on this node (upstream's long-running-query
         view): trace id, PQL, index, age, current stage, shards
@@ -880,6 +893,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
                     self.server.connections_opened
                 fastlane["http_requests_total"] = self.server.requests_served
         snap["serving_fastlane"] = fastlane
+        snap["serving_mp"] = self.api.mp_metrics()
         snap["durability"] = self.api.durability_metrics()
         snap["integrity"] = self.api.integrity_metrics()
         snap["observability"] = self.api.observability_metrics()

@@ -246,18 +246,47 @@ class QueryPipeline:
             return
         # WAITING past one full micro-batch buys nothing, so the window
         # phase caps at the live executor's batch limit (falls back to
-        # the class constant when unwired, e.g. unit tests)
+        # the class constant when unwired, e.g. unit tests). The cap
+        # counts UNIQUE submissions, not wave members: dedupe-eligible
+        # wavemates carrying a key already in the wave share the
+        # leader's submission and consume no micro-batch slot, so a
+        # hot-query burst may ride one wave far past the batch limit —
+        # under the multi-process serving tier this is where worker
+        # waves group-commit into one owner dispatch.
         cap = getattr(getattr(self._api, "executor", None),
                       "microbatch_max", None) or self.GATHER_CAP
+
+        def item_key(item):
+            # run() enqueues (index, query, kwargs, fut, key, ctx);
+            # gather-window unit tests enqueue bare sentinels — treat
+            # anything else as keyless (always unique)
+            return item[4] if isinstance(item, tuple) and len(item) >= 5 \
+                else None
+
+        seen_keys: set = set()
+        unique = 0
+
+        def note(item) -> None:
+            nonlocal unique
+            key = item_key(item)
+            if key is None or key not in seen_keys:
+                unique += 1
+                if key is not None:
+                    seen_keys.add(key)
+
+        for item in wave:
+            note(item)
         deadline = time.monotonic() + self.GATHER_WINDOW_S
         try:
-            while len(wave) < cap:
+            while unique < cap:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return
                 try:
-                    wave.append(self._q.get(timeout=left))
+                    item = self._q.get(timeout=left)
                 except queue.Empty:
                     return
+                wave.append(item)
+                note(item)
         finally:
             self._last_wave_size = len(wave)

@@ -67,6 +67,9 @@ class ServerConfig:
         verify_on_load: bool = True,
         scrub_interval: float = 0.0,
         scrub_max_bytes_per_sec: int = 0,
+        serving_workers: int = 0,
+        ring_slots: int = 1024,
+        ring_slot_bytes: int = 65536,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -188,6 +191,31 @@ class ServerConfig:
                 f"invalid scrub-interval {scrub_interval!r} (want >= 0)"
             )
         self.scrub_max_bytes_per_sec = int(scrub_max_bytes_per_sec)
+        # Multi-process serving tier (docs/OPERATIONS.md deployment
+        # shapes): serving-workers > 0 runs N SO_REUSEPORT worker
+        # processes fronting this (device-owner) process over
+        # per-worker shared-memory rings; 0 = classic single-process.
+        # ring-slots/ring-slot-bytes size each direction of a worker's
+        # ring pair (fixed-slot, so memory is slots x bytes, bounded).
+        from pilosa_tpu.serving.mpserve import MAX_WORKERS
+
+        self.serving_workers = int(serving_workers)
+        if not 0 <= self.serving_workers <= MAX_WORKERS:
+            raise ValueError(
+                f"invalid serving-workers {serving_workers!r} "
+                f"(want 0..{MAX_WORKERS})"
+            )
+        self.ring_slots = int(ring_slots)
+        if self.ring_slots < 2:
+            raise ValueError(
+                f"invalid ring-slots {ring_slots!r} (want >= 2)"
+            )
+        self.ring_slot_bytes = int(ring_slot_bytes)
+        if self.ring_slot_bytes < 256:
+            raise ValueError(
+                f"invalid ring-slot-bytes {ring_slot_bytes!r} "
+                "(want >= 256)"
+            )
         from pilosa_tpu.qos.slo import SLOEngine
 
         # build once to validate; Server.open builds the live engine
@@ -310,6 +338,15 @@ class ServerConfig:
                 d.get("scrub-max-bytes-per-sec",
                       d.get("scrub_max_bytes_per_sec", 0))
             ),
+            serving_workers=int(
+                d.get("serving-workers", d.get("serving_workers", 0))
+            ),
+            ring_slots=int(
+                d.get("ring-slots", d.get("ring_slots", 1024))
+            ),
+            ring_slot_bytes=int(
+                d.get("ring-slot-bytes", d.get("ring_slot_bytes", 65536))
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -361,6 +398,9 @@ class ServerConfig:
             "verify-on-load": self.verify_on_load,
             "scrub-interval": self.scrub_interval,
             "scrub-max-bytes-per-sec": self.scrub_max_bytes_per_sec,
+            "serving-workers": self.serving_workers,
+            "ring-slots": self.ring_slots,
+            "ring-slot-bytes": self.ring_slot_bytes,
         }
 
 
@@ -401,12 +441,18 @@ class Server:
         self.api = API(self.holder)
         self._http = None
         self._http_thread = None
+        self._mpserve = None  # OwnerRuntime when serving-workers > 0
         self._anti_entropy_timer: threading.Timer | None = None
         self._heartbeat_timer: threading.Timer | None = None
         self._closed = threading.Event()
 
     @property
     def port(self) -> int:
+        """The PUBLIC serving port: the SO_REUSEPORT workers' port in
+        multi-process mode (the owner's full server moves to loopback),
+        the single HTTP listener's otherwise."""
+        if self._mpserve is not None:
+            return self._mpserve.port
         return self._http.server_address[1] if self._http else self.config.port
 
     def open(self) -> "Server":
@@ -459,7 +505,30 @@ class Server:
             stats=global_stats(),
         )
         self.api.default_deadline_s = self.config.qos_default_deadline
-        self._http = make_http_server(self.api, self.config.bind, self.config.port)
+        # Multi-process serving (docs/OPERATIONS.md deployment shapes):
+        # with serving-workers > 0 the public port belongs to the
+        # SO_REUSEPORT worker processes and THIS process — the device
+        # owner — keeps its full HTTP surface on loopback (workers
+        # proxy every non-hot route to it). Platforms that can't run
+        # the shape fall back to single-process with a warning instead
+        # of failing startup.
+        mp_workers = 0
+        if self.config.serving_workers > 0:
+            from pilosa_tpu.serving.mpserve import mp_unsupported_reason
+
+            reason = mp_unsupported_reason(self.config)
+            if reason is None:
+                mp_workers = self.config.serving_workers
+            else:
+                self.logger.warning(
+                    "multi-process serving disabled: %s "
+                    "(falling back to single-process mode)", reason,
+                )
+        if mp_workers:
+            self._http = make_http_server(self.api, "127.0.0.1", 0)
+        else:
+            self._http = make_http_server(self.api, self.config.bind,
+                                          self.config.port)
         if self.config.tls_enabled:
             import ssl
 
@@ -483,6 +552,20 @@ class Server:
             target=self._http.serve_forever, daemon=True
         )
         self._http_thread.start()
+        # tracer BEFORE the serving workers: each worker copies the
+        # sample rate out of the handshake cfg, which reads the live
+        # global tracer
+        from pilosa_tpu.utils.tracing import global_tracer
+
+        rate = self.config.trace_sample_rate
+        if rate <= 0 and self.config.tracing:
+            rate = 1.0  # legacy `tracing = true`: always-on
+        global_tracer().sample_rate = rate
+        if mp_workers:
+            from pilosa_tpu.serving.mpserve import OwnerRuntime
+
+            self._mpserve = OwnerRuntime(self).start()
+            self.api.mpserve = self._mpserve
         self._wire_cluster()
         self.logger.info(
             "listening on %s://%s:%d (data-dir %s, node %s)",
@@ -490,12 +573,6 @@ class Server:
             self.config.bind, self.port, self.holder.data_dir,
             self.api.cluster.local.id,
         )
-        from pilosa_tpu.utils.tracing import global_tracer
-
-        rate = self.config.trace_sample_rate
-        if rate <= 0 and self.config.tracing:
-            rate = 1.0  # legacy `tracing = true`: always-on
-        global_tracer().sample_rate = rate
         self.api.trace_log_dir = self.config.trace_log_dir
         from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 
@@ -589,6 +666,13 @@ class Server:
 
     def close(self) -> None:
         self._closed.set()
+        if self._mpserve is not None:
+            # workers first: they proxy to the owner listener below, and
+            # a worker outliving its owner would re-handshake into a
+            # closing runtime
+            self._mpserve.close()
+            self._mpserve = None
+            self.api.mpserve = None
         if self.api.scrubber is not None:
             self.api.scrubber.close()
         if self._anti_entropy_timer is not None:

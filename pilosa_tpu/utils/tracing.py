@@ -323,6 +323,16 @@ class Tracer:
     def _record_root(self, span: Span) -> None:
         self.finished.append(span)  # deque(maxlen): atomic, bounded
 
+    def record_foreign_tree(self, tree: dict) -> None:
+        """Record an ALREADY-SERIALIZED finished tree — a serving
+        worker's edge span (its own process rooted and finished it, the
+        owner-side subtree already grafted) shipped over the handshake
+        channel so this process's /debug/traces shows one tree per
+        request whatever the deployment shape."""
+        if isinstance(tree, dict):
+            self.sampled_traces += 1
+            self.finished.append(_ForeignTree(tree))
+
     def recent(self) -> list[dict]:
         return [s.to_json() for s in list(self.finished)]
 
@@ -338,6 +348,19 @@ class Tracer:
             "tracing_finished_traces": len(self.finished),
             "tracing_sample_rate": self.sample_rate,
         }
+
+
+class _ForeignTree:
+    """A finished span tree serialized by ANOTHER process (serving
+    worker); quacks like a Span for the finished ring."""
+
+    __slots__ = ("tree",)
+
+    def __init__(self, tree: dict):
+        self.tree = tree
+
+    def to_json(self) -> dict:
+        return self.tree
 
 
 _global_tracer: Tracer | None = None

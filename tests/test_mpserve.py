@@ -1,0 +1,577 @@
+"""End-to-end tests for the multi-process serving tier (ISSUE 11):
+SO_REUSEPORT worker subprocesses + one device-owner over pickle-free
+shared-memory rings (pilosa_tpu/serving/).
+
+Covers the contracts the subsystem must carry across the IPC boundary:
+byte-identical responses vs the owner's own handler, WAL-barrier ACK
+semantics (a 200 through a worker still means fsynced — proven by
+SIGKILLing the owner mid-burst), tenant/cost and trace attribution
+surviving the hop, degraded-mode shedding answered worker-side, ring
+backpressure as 429, dead-worker respawn, owner-restart re-handshake,
+and the single-process fallback on platforms without SO_REUSEPORT."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Server, ServerConfig
+
+pytestmark = pytest.mark.skipif(
+    not hasattr(socket, "SO_REUSEPORT"),
+    reason="multi-process serving needs SO_REUSEPORT",
+)
+
+
+def _req(port, method, path, body=None, headers=None, timeout=30):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body, method=method, headers=headers or {},
+    )
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _query(port, pql, headers=None, timeout=30):
+    return _req(port, "POST", "/index/i/query", pql.encode(),
+                headers=headers, timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def mp_server(tmp_path_factory):
+    """One 2-worker server shared by the read-path tests; every request
+    is sampled so trace attribution is assertable."""
+    server = Server(ServerConfig(
+        data_dir=str(tmp_path_factory.mktemp("mp")), port=0,
+        serving_workers=2, ring_slots=128, ring_slot_bytes=8192,
+        trace_sample_rate=1.0,
+        anti_entropy_interval=0, heartbeat_interval=0, use_mesh=False,
+    )).open()
+    try:
+        assert server._mpserve is not None, "mp serving did not start"
+        port = server.port
+        _req(port, "POST", "/index/i", b"{}")
+        _req(port, "POST", "/index/i/field/f", b"{}")
+        for col, row in ((1, 1), (2, 1), (70, 2)):
+            st, _ = _query(port, f"Set({col}, f={row})")
+            assert st == 200
+        yield server
+    finally:
+        server.close()
+
+
+class TestEndToEnd:
+    def test_ring_and_proxy_routes_serve(self, mp_server):
+        port = mp_server.port
+        st, body = _query(port, "Count(Row(f=1))")
+        assert (st, json.loads(body)) == (200, {"results": [2]})
+        # schema (proxied) and the worker-local debug route
+        st, body = _req(port, "GET", "/schema")
+        assert st == 200 and json.loads(body)["indexes"][0]["name"] == "i"
+        st, body = _req(port, "GET", "/debug/worker")
+        stats = json.loads(body)
+        assert st == 200 and stats["requests"] >= 1
+        assert stats["worker"] in (0, 1)
+
+    def test_responses_byte_identical_to_owner_handler(self, mp_server):
+        """The deployment shape must be invisible to clients: the same
+        queries through a worker's ring and through the owner's own
+        loopback listener produce identical bytes."""
+        owner_port = mp_server._mpserve.owner_port
+        queries = ["Count(Row(f=1))", "Row(f=2)", "TopN(f)",
+                   "Count(Intersect(Row(f=1), Row(f=2)))"]
+        for pql in queries:
+            _, via_worker = _query(mp_server.port, pql)
+            _, via_owner = _query(owner_port, pql)
+            assert via_worker == via_owner, pql
+
+    def test_errors_cross_the_ring_with_status(self, mp_server):
+        # unknown index: ApiError from the owner, same text either way
+        st_w = body_w = None
+        try:
+            _req(mp_server.port, "POST", "/index/nope/query",
+                 b"Count(Row(f=1))")
+        except urllib.error.HTTPError as e:
+            st_w, body_w = e.code, e.read()
+        try:
+            _req(mp_server._mpserve.owner_port, "POST",
+                 "/index/nope/query", b"Count(Row(f=1))")
+        except urllib.error.HTTPError as e:
+            assert (st_w, body_w) == (e.code, e.read())
+        assert st_w is not None
+        # parse garbage: rejected worker-side before crossing the ring
+        before = mp_server._mpserve.metrics()["serving_ring_queries_total"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _query(mp_server.port, "NotAQuery(((")
+        assert ei.value.code == 400
+        after = mp_server._mpserve.metrics()["serving_ring_queries_total"]
+        assert after == before
+
+    def test_observability_surfaces(self, mp_server):
+        port = mp_server.port
+        st, body = _req(port, "GET", "/debug/workers")
+        table = json.loads(body)
+        assert table["enabled"] and len(table["workers"]) == 2
+        assert all(w["alive"] for w in table["workers"])
+        st, body = _req(port, "GET", "/status")
+        assert len(json.loads(body)["servingWorkers"]) == 2
+        st, body = _req(port, "GET", "/metrics")
+        text = body.decode()
+        assert "serving_workers 2" in text
+        assert "serving_ring_queries_total" in text
+        assert "serving_ring_full_total" in text
+        assert "serving_owner_batch_size" in text
+        st, body = _req(port, "GET", "/debug/vars")
+        assert json.loads(body)["serving_mp"]["serving_workers"] == 2
+
+    def test_tenant_and_trace_attribution_survive_the_hop(self, mp_server):
+        """The cost plane bills the worker-submitted request to its
+        tenant (including response egress), and the owner's
+        /debug/traces shows ONE stitched tree: the worker-side edge
+        root with the owner-side rpc.query subtree grafted under it."""
+        port = mp_server.port
+        st, body = _query(port, "Count(Row(f=1))",
+                          headers={"X-Pilosa-Tenant": "acct-7"})
+        assert st == 200
+        deadline = time.monotonic() + 10
+        row = None
+        while time.monotonic() < deadline and row is None:
+            _, tbody = _req(port, "GET", "/debug/tenants")
+            for r in json.loads(tbody)["tenants"]:
+                if r["tenant"] == "acct-7":
+                    row = r
+            if row is None:
+                time.sleep(0.1)
+        assert row is not None, "tenant acct-7 never reached the ledger"
+        assert row["queries"] >= 1
+        assert row["egress_bytes"] > 0
+        # the finished tree arrives over the handshake channel slightly
+        # after the response — poll the owner's trace ring
+        deadline = time.monotonic() + 10
+        tree = None
+        while time.monotonic() < deadline and tree is None:
+            _, tr = _req(port, "GET", "/debug/traces")
+            for t in json.loads(tr)["traces"]:
+                blob = json.dumps(t)
+                if (t.get("name") == "http.query"
+                        and t.get("tags", {}).get("worker")
+                        and "rpc.query" in blob):
+                    tree = t
+            if tree is None:
+                time.sleep(0.1)
+        assert tree is not None, \
+            "no stitched worker-edge tree reached the owner tracer"
+
+    def test_ring_backpressure_sheds_429(self, mp_server):
+        """A full submit ring is the backpressure signal: the worker
+        answers 429 + Retry-After without queueing anything."""
+        mp = mp_server._mpserve
+        # saturate the owner pool so drains block and the ring fills
+        permits = 0
+        while mp._capacity.acquire(blocking=False):
+            permits += 1
+        assert permits > 0
+        # burst more requests than the ring holds; with the owner
+        # draining nothing, the overflow must shed 429
+        codes = []
+        lock = threading.Lock()
+
+        def probe():
+            try:
+                st, _ = _query(mp_server.port, "Row(f=1)", timeout=30)
+            except urllib.error.HTTPError as e:
+                st = e.code
+                if st == 429:
+                    assert e.headers.get("Retry-After")
+                e.read()
+            with lock:
+                codes.append(st)
+
+        threads = [threading.Thread(target=probe) for _ in range(300)]
+        try:
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with lock:
+                    if 429 in codes:
+                        break
+                time.sleep(0.05)
+        finally:
+            for _ in range(permits):
+                mp._capacity.release()
+            for t in threads:
+                t.join(60)
+        assert 429 in codes, f"no shed in {sorted(set(codes))}"
+        # everything that wasn't shed completed once capacity returned
+        assert set(codes) <= {200, 429}
+
+    # --- lifecycle drills LAST: they bump worker generations/pids ---
+
+    def test_sigkill_worker_respawns_and_owner_never_wedges(self, mp_server):
+        port = mp_server.port
+        mp = mp_server._mpserve
+        _, body = _req(port, "GET", "/debug/workers")
+        victims = {w["pid"] for w in json.loads(body)["workers"]}
+        os.kill(sorted(victims)[0], signal.SIGKILL)
+        # the owner must keep serving throughout (surviving worker or
+        # respawn) — retry over fresh connections, never wedge
+        deadline = time.monotonic() + 30
+        served = 0
+        while time.monotonic() < deadline and served < 5:
+            try:
+                st, _ = _query(port, "Count(Row(f=1))", timeout=5)
+                served += 1 if st == 200 else 0
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.1)
+        assert served >= 5, "owner wedged after a worker SIGKILL"
+        assert mp.wait_workers(2, timeout=30), "dead worker not respawned"
+        m = mp.metrics()
+        assert m["serving_worker_respawns_total"] >= 1
+        assert m["serving_workers"] == 2
+
+    def test_owner_restart_workers_rehandshake(self, mp_server):
+        mp = mp_server._mpserve
+        gens_before = [w["gen"] for w in mp.workers_json()]
+        mp.simulate_restart()
+        assert mp.wait_workers(2, timeout=30), \
+            "workers did not re-handshake after owner restart"
+        gens_after = [w["gen"] for w in mp.workers_json() if w["alive"]]
+        assert min(gens_after) > min(gens_before)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                st, body = _query(mp_server.port, "Count(Row(f=1))",
+                                  timeout=5)
+                if st == 200 and json.loads(body) == {"results": [2]}:
+                    return
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.1)
+        raise AssertionError("serving did not recover after owner restart")
+
+
+def test_dedupe_followers_share_one_execution(tmp_path):
+    """Identical untraced reads that land while a leader's wave has
+    not yet submitted join it owner-side: one execution, N byte-equal
+    responses, follower-grade accounting. Needs an UNSAMPLED server —
+    a traced request carries its own span context and is never
+    dedupe-eligible."""
+    server = Server(ServerConfig(
+        data_dir=str(tmp_path), port=0, serving_workers=2,
+        anti_entropy_interval=0, heartbeat_interval=0, use_mesh=False,
+    )).open()
+    try:
+        port = server.port
+        _req(port, "POST", "/index/i", b"{}")
+        _req(port, "POST", "/index/i/field/f", b"{}")
+        assert _query(port, "Set(70, f=2)")[0] == 200
+        mp = server._mpserve
+        real = server.api.query_json_bytes
+
+        def slow(*a, **kw):
+            time.sleep(0.25)  # hold the leader open past the burst
+            return real(*a, **kw)
+
+        server.api.query_json_bytes = slow
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def one():
+                r = _query(port, "Count(Row(f=2))", timeout=30)
+                with lock:
+                    results.append(r)
+
+            threads = [threading.Thread(target=one) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+        finally:
+            server.api.query_json_bytes = real
+        assert len(results) == 6
+        assert {st for st, _ in results} == {200}
+        assert {body for _, body in results} == {b'{"results":[1]}'}
+        assert mp.deduped > 0
+        # follower-grade accounting: the ledger saw all 6 queries
+        snap = {r["tenant"]: r for r in server.api.cost.snapshot()}
+        assert snap["default"]["queries"] >= 6
+        assert snap["default"]["egress_bytes"] > 0
+    finally:
+        server.close()
+
+
+class TestDegradedShedding:
+    def test_storage_degraded_sheds_worker_side(self, tmp_path):
+        """Writes shed 503 AT THE WORKER from the shared control block
+        — no ring round-trip — while reads keep serving; recovery
+        un-sheds within a flags tick."""
+        from pilosa_tpu.serving import mpserve as mpsrv
+        from pilosa_tpu.testing import faults
+
+        server = Server(ServerConfig(
+            data_dir=str(tmp_path), port=0, serving_workers=1,
+            anti_entropy_interval=0, heartbeat_interval=0, use_mesh=False,
+        )).open()
+        plane = faults.install_disk()
+        try:
+            port = server.port
+            _req(port, "POST", "/index/i", b"{}")
+            _req(port, "POST", "/index/i/field/f", b"{}")
+            assert _query(port, "Set(1, f=1)")[0] == 200
+            health = server.holder.health
+            health.PROBE_INTERVAL_S = 0.2
+            rule = plane.add("fsync", path=str(tmp_path),
+                             errno_=28)  # ENOSPC
+            health.trip("test: disk full")
+            # wait for the degraded flag to reach the control block:
+            # until it does, writes still cross the ring and the OWNER
+            # sheds them authoritatively; once it lands, the worker
+            # sheds WITHOUT a ring round-trip — observable as a 503
+            # whose request never moved the ring counter
+            def ring_total():
+                return server._mpserve.metrics()[
+                    "serving_ring_queries_total"]
+
+            deadline = time.monotonic() + 10
+            shed = None
+            while time.monotonic() < deadline and shed is None:
+                before = ring_total()
+                try:
+                    _query(port, "Set(2, f=1)", timeout=5)
+                except urllib.error.HTTPError as e:
+                    body = e.read()
+                    if e.code == 503 and ring_total() == before:
+                        shed = body  # worker-side: no ring crossing
+                time.sleep(0.1)
+            assert shed is not None, \
+                "write never shed worker-side while degraded"
+            assert b"storage degraded" in shed
+            # reads still serve while writes shed
+            assert _query(port, "Count(Row(f=1))")[0] == 200
+            # heal: probe clears the latch, flags tick, writes resume
+            plane.remove(rule.id)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    if _query(port, "Set(3, f=1)", timeout=5)[0] == 200:
+                        break
+                except urllib.error.HTTPError:
+                    time.sleep(0.2)
+            else:
+                raise AssertionError("writes never resumed after heal")
+        finally:
+            faults.clear_disk()
+            server.close()
+
+
+class TestFallbackAndConfig:
+    def test_no_reuseport_falls_back_to_single_process(self, tmp_path,
+                                                       monkeypatch):
+        monkeypatch.delattr(socket, "SO_REUSEPORT")
+        server = Server(ServerConfig(
+            data_dir=str(tmp_path), port=0, serving_workers=2,
+            anti_entropy_interval=0, heartbeat_interval=0, use_mesh=False,
+        )).open()
+        try:
+            assert server._mpserve is None
+            st, body = _req(server.port, "GET", "/debug/workers")
+            assert json.loads(body) == {"enabled": False, "workers": []}
+            # the metrics block still exists, zeroed
+            _, body = _req(server.port, "GET", "/metrics")
+            assert "serving_workers 0" in body.decode()
+        finally:
+            server.close()
+
+    def test_tls_is_single_process_only(self, tmp_path):
+        from pilosa_tpu.serving.mpserve import mp_unsupported_reason
+
+        cfg = ServerConfig(data_dir=str(tmp_path), serving_workers=2,
+                           tls_certificate="/c", tls_key="/k")
+        assert "TLS" in mp_unsupported_reason(cfg)
+
+    @pytest.mark.parametrize("kw", [
+        {"serving_workers": -1}, {"serving_workers": 1000},
+        {"ring_slots": 1}, {"ring_slot_bytes": 16},
+    ])
+    def test_config_validation(self, tmp_path, kw):
+        with pytest.raises(ValueError):
+            ServerConfig(data_dir=str(tmp_path), **kw)
+
+
+def test_kill_a_worker_chaos_schedule(tmp_path):
+    """One seeded kill-a-worker schedule through the chaos harness
+    (testing/chaos.py MpServingChaos — the shape the default chaos
+    config runs): zero lost acked writes, owner never wedges."""
+    from pilosa_tpu.testing.chaos import MpServingChaos
+
+    harness = MpServingChaos(str(tmp_path), n_workers=2, seed=7,
+                             n_kills=2, kill_gap_s=0.5)
+    try:
+        harness.boot()
+        record = harness.run_schedule()
+    finally:
+        harness.close()
+    assert record["acked_writes"] > 0
+    assert record["lost_acked_writes"] == 0, record["lost_sample"]
+    assert record["owner_wedges"] == []
+    assert record["ok"]
+
+
+# ------------------------------------------------- subprocess WAL oracle
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_mp(tmp_path, port, workers=2):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "0",
+        "PILOSA_TPU_HEARTBEAT_INTERVAL": "0",
+        "PILOSA_TPU_USE_MESH": "false",
+        "PILOSA_TPU_DURABILITY_MODE": "group",
+        "PILOSA_TPU_SERVING_WORKERS": str(workers),
+        # orphaned workers give up fast so the restarted owner's fresh
+        # workers own the reuseport group without a long steal window
+        "PILOSA_TPU_MP_REHANDSHAKE_S": "2",
+    }
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu", "server",
+         "--data-dir", str(tmp_path / "owner"), "--bind", "127.0.0.1",
+         "--port", str(port)],
+        env=env, cwd=repo_root,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    for _ in range(240):
+        if proc.poll() is not None:
+            raise AssertionError(f"server exited rc={proc.returncode}")
+        try:
+            _req(port, "GET", "/status", timeout=5)
+            return proc
+        except Exception:
+            time.sleep(0.25)
+    proc.terminate()
+    raise AssertionError("mp server never served /status")
+
+
+def test_wal_ack_barrier_survives_owner_sigkill(tmp_path):
+    """The durability contract through a worker: every write a client
+    saw 200-acked via the SO_REUSEPORT port is in the fsynced WAL, so
+    SIGKILLing the device owner mid-burst (workers die orphaned, no
+    clean shutdown anywhere) loses none of them. Attribution rides the
+    same hop: the tenant ledger on the owner bills the worker-submitted
+    writes before the kill."""
+    port = _free_port()
+    proc = _spawn_mp(tmp_path, port)
+    workers_killed: list[int] = []
+    try:
+        _req(port, "POST", "/index/i", b"{}")
+        _req(port, "POST", "/index/i/field/f", b"{}")
+        acked: set[int] = set()
+        lock = threading.Lock()
+        stop = threading.Event()
+        n_writers = 4
+
+        def writer(tid):
+            k = 0
+            while not stop.is_set():
+                col = tid + k * n_writers
+                k += 1
+                try:
+                    st, body = _query(
+                        port, f"Set({col}, f=1)",
+                        headers={"X-Pilosa-Tenant": "writer-tenant"},
+                        timeout=10)
+                except Exception:
+                    return  # the kill landed mid-request: unacked
+                if st == 200 and json.loads(body) == {"results": [True]}:
+                    with lock:
+                        acked.add(col)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_writers)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 60
+        while True:
+            with lock:
+                if len(acked) >= 40:
+                    break
+            assert time.time() < deadline, "burst stalled"
+            time.sleep(0.02)
+        # attribution check mid-flight, through a worker's proxy route
+        _, tbody = _req(port, "GET", "/debug/tenants")
+        tenants = {r["tenant"]: r for r in json.loads(tbody)["tenants"]}
+        assert tenants["writer-tenant"]["queries"] >= 1
+        # find the worker pids (to reap later), then SIGKILL the owner
+        _, wbody = _req(port, "GET", "/debug/workers")
+        workers_killed = [w["pid"] for w in json.loads(wbody)["workers"]
+                          if w["pid"]]
+        proc.kill()
+        proc.wait(15)
+        stop.set()
+        for t in threads:
+            t.join(15)
+        with lock:
+            acked_now = set(acked)
+        # orphaned workers must give up and exit (owner stays gone
+        # beyond their re-handshake window) — the no-zombie half of the
+        # dead-peer contract
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if not any(_pid_alive(p) for p in workers_killed):
+                break
+            time.sleep(0.25)
+        assert not any(_pid_alive(p) for p in workers_killed), \
+            "orphaned workers outlived their owner"
+        # restart on the same port: every acked write must be there
+        proc = _spawn_mp(tmp_path, port)
+        got: set[int] = set()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                st, body = _query(port, "Row(f=1)", timeout=10)
+            except Exception:
+                time.sleep(0.25)
+                continue
+            got = set(json.loads(body)["results"][0]["columns"])
+            if acked_now <= got:
+                break
+            time.sleep(0.25)
+        missing = acked_now - got
+        assert not missing, \
+            f"lost {len(missing)} worker-ACKed writes: {sorted(missing)[:5]}"
+        # and the restarted shape still serves writes end to end
+        assert _query(port, "Set(999999, f=2)")[0] == 200
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(15)
+        for p in workers_killed:
+            if _pid_alive(p):
+                os.kill(p, signal.SIGKILL)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True

@@ -1,0 +1,298 @@
+"""Unit suite for the multi-process serving tier's shared-memory ring
+(pilosa_tpu/serving/shmring.py — ISSUE 11): framing round-trips,
+every-offset torn-record fuzz (the PR-5 torn-tail shape applied to
+shared memory), backpressure/full-ring behavior, and dead-reader slot
+reclaim. Everything here is in-process — the subprocess end-to-end
+contract lives in tests/test_mpserve.py."""
+
+import os
+import struct
+import threading
+
+import pytest
+
+from pilosa_tpu.serving.shmring import (
+    _HDR_SIZE,
+    _SLOT_HDR,
+    RingFull,
+    ShmRing,
+    decode_frame,
+    encode_frame,
+)
+
+_UNIQ = iter(range(1, 1 << 30))
+
+
+def _ring(slots=8, slot_bytes=256) -> ShmRing:
+    name = f"psrv-test-{os.getpid():x}-{next(_UNIQ)}"
+    return ShmRing.create(name, slots, slot_bytes)
+
+
+@pytest.fixture
+def ring():
+    r = _ring()
+    yield r
+    r.close()
+    r.unlink()
+
+
+# ------------------------------------------------------------- framing
+
+
+class TestFraming:
+    def test_round_trip(self):
+        header = {"op": "q", "ix": "i", "t": "tenant-1", "id": 7}
+        body = b"Count(Row(f=1))"
+        h, b = decode_frame(encode_frame(header, body))
+        assert h == header
+        assert b == body
+
+    def test_empty_body(self):
+        h, b = decode_frame(encode_frame({"st": 200}))
+        assert h == {"st": 200}
+        assert b == b""
+
+    def test_binary_body_passes_untouched(self):
+        body = bytes(range(256)) * 3
+        _, b = decode_frame(encode_frame({}, body))
+        assert b == body
+
+    @pytest.mark.parametrize("record", [
+        b"", b"\x01", b"\x00\x00\x00",                 # shorter than prefix
+        struct.pack("<I", 999) + b"{}",                # hlen beyond record
+        struct.pack("<I", 4) + b"nope",                # not JSON
+        struct.pack("<I", 2) + b"[]",                  # JSON, not an object
+    ])
+    def test_malformed_raises_value_error(self, record):
+        with pytest.raises(ValueError):
+            decode_frame(record)
+
+
+# ---------------------------------------------------------- ring basics
+
+
+class TestRingBasics:
+    def test_push_pop_round_trip(self, ring):
+        recs = [f"record-{i}".encode() for i in range(5)]
+        for rec in recs:
+            assert ring.push(rec)
+        assert [ring.pop() for _ in recs] == recs
+        assert ring.pop() is None
+        assert ring.metrics()["pushed"] == 5
+        assert ring.metrics()["popped"] == 5
+
+    def test_attach_sees_creator_records(self, ring):
+        ring.push(b"cross-process bytes")
+        peer = ShmRing.attach(ring.name)
+        try:
+            assert peer.slots == ring.slots
+            assert peer.slot_bytes == ring.slot_bytes
+            assert peer.pop() == b"cross-process bytes"
+        finally:
+            peer.close()
+
+    def test_multi_slot_record_spans_and_round_trips(self):
+        ring = _ring(slots=8, slot_bytes=256)
+        try:
+            big = os.urandom(256 * 3 + 57)  # 4 chunks
+            assert ring.push(big)
+            assert ring.depth() == 4
+            assert ring.pop() == big
+            assert ring.depth() == 0
+            # wrap-around: repeat past the ring's end
+            for _ in range(5):
+                assert ring.push(big)
+                assert ring.pop() == big
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_record_beyond_capacity_raises(self):
+        ring = _ring(slots=4, slot_bytes=256)
+        try:
+            with pytest.raises(RingFull):
+                ring.push(b"x" * (4 * 256 + 1))
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_create_validates_geometry(self):
+        with pytest.raises(ValueError):
+            ShmRing.create(f"psrv-test-{os.getpid():x}-g1", 1, 256)
+        with pytest.raises(ValueError):
+            ShmRing.create(f"psrv-test-{os.getpid():x}-g2", 8, 64)
+
+    def test_drain_returns_batch(self, ring):
+        for i in range(6):
+            ring.push(f"r{i}".encode())
+        assert ring.drain() == [f"r{i}".encode() for i in range(6)]
+        assert ring.drain() == []
+
+    def test_waiting_flag_handoff(self, ring):
+        assert not ring.take_waiting()
+        ring.set_waiting()
+        assert ring.take_waiting()
+        assert not ring.take_waiting()  # consumed
+
+
+# --------------------------------------------------------- backpressure
+
+
+class TestBackpressure:
+    def test_full_ring_rejects_and_counts(self):
+        ring = _ring(slots=4, slot_bytes=256)
+        try:
+            payload = b"y" * 200
+            for _ in range(4):
+                assert ring.push(payload)
+            assert not ring.push(payload)  # full: shed, don't queue
+            assert not ring.push(payload)
+            assert ring.metrics()["full_rejects"] == 2
+            # consuming one slot frees exactly one record's space
+            assert ring.pop() == payload
+            assert ring.push(payload)
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_multi_chunk_needs_contiguous_free_slots(self):
+        ring = _ring(slots=4, slot_bytes=256)
+        try:
+            assert ring.push(b"a" * 256)
+            assert not ring.push(b"b" * (256 * 3 + 1))  # needs 4, has 3
+            assert ring.metrics()["full_rejects"] == 1
+            ring.pop()
+            assert ring.push(b"b" * (256 * 3 + 1))
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_spsc_threaded_ordering_under_backpressure(self):
+        """A producer thread pushing through a tiny ring (retry on
+        full) and a consumer popping: every record arrives, in order —
+        the in-process locks plus the SPSC cursor protocol."""
+        ring = _ring(slots=2, slot_bytes=256)
+        try:
+            n = 500
+            got: list[bytes] = []
+
+            def producer():
+                for i in range(n):
+                    rec = f"m{i}".encode()
+                    while not ring.push(rec):
+                        pass
+
+            t = threading.Thread(target=producer)
+            t.start()
+            while len(got) < n:
+                rec = ring.pop()
+                if rec is not None:
+                    got.append(rec)
+            t.join(10)
+            assert got == [f"m{i}".encode() for i in range(n)]
+        finally:
+            ring.close()
+            ring.unlink()
+
+
+# ------------------------------------------------------ torn-record fuzz
+
+
+class TestTornRecords:
+    """The PR-5 every-offset fuzz shape, applied to the ring: corrupt
+    one byte at EVERY offset of a published record's slot (header and
+    payload) and the consumer must surface either nothing (torn —
+    counted and skipped) or, never, garbage; the following record is
+    always still delivered."""
+
+    def test_corruption_at_every_offset_is_skipped_never_decoded(self):
+        payload = bytes(range(64))
+        follow = b"follower-record"
+        slot_span = _SLOT_HDR.size + len(payload)
+        for off in range(slot_span):
+            ring = _ring(slots=8, slot_bytes=256)
+            try:
+                assert ring.push(payload)
+                assert ring.push(follow)
+                # flip one byte of the first record's slot (slot 0)
+                pos = _HDR_SIZE + off
+                ring._buf[pos] ^= 0xFF
+                first = ring.pop()
+                # either detected-and-skipped (None) or — only when the
+                # flip landed on a byte that round-trips (impossible for
+                # seq/len/crc/payload, all covered by the checks) — the
+                # original bytes; NEVER altered bytes
+                assert first is None, f"offset {off} yielded {first!r}"
+                assert ring.torn == 1, f"offset {off}"
+                assert ring.pop() == follow, f"offset {off}"
+            finally:
+                ring.close()
+                ring.unlink()
+
+    def test_unpublished_record_is_invisible(self, ring):
+        """A producer dying mid-write (head never advanced) leaves
+        nothing: the consumer sees an empty ring, not a torn record."""
+        ring.push(b"will-be-unpublished")
+        # rewind head as if the crash happened before publication
+        struct.pack_into("<Q", ring._buf, 16, 0)
+        assert ring.pop() is None
+        assert ring.torn == 0
+        assert ring.depth() == 0
+
+    def test_torn_multichunk_record_skips_its_whole_chain(self):
+        """Corruption in chunk 0 of a multi-chunk record must consume
+        the WHOLE chunk chain — the surviving continuation chunks
+        (valid seq + crc) must never be reassembled into a headless
+        record; the next pop yields the next real record."""
+        ring = _ring(slots=8, slot_bytes=256)
+        try:
+            big = os.urandom(256 * 2 + 40)  # 3 chunks
+            follow = b"next-record"
+            ring.push(big)
+            ring.push(follow)
+            ring._buf[_HDR_SIZE + _SLOT_HDR.size] ^= 0xFF  # chunk 0 byte
+            assert ring.pop() is None
+            assert ring.torn == 1
+            assert ring.pop() == follow
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_promised_continuation_missing_is_torn(self):
+        """head covering only the first chunk of a multi-chunk record
+        (cannot happen with a live correct producer) is detected as
+        torn, not an infinite wait."""
+        ring = _ring(slots=8, slot_bytes=256)
+        try:
+            ring.push(b"z" * 300)  # 2 chunks
+            struct.pack_into("<Q", ring._buf, 16, 1)  # head: 1 chunk only
+            assert ring.pop() is None
+            assert ring.torn == 1
+        finally:
+            ring.close()
+            ring.unlink()
+
+
+# ------------------------------------------------------------- reclaim
+
+
+class TestReclaim:
+    def test_dead_reader_slots_reclaimed_and_ring_reusable(self):
+        ring = _ring(slots=8, slot_bytes=256)
+        try:
+            ring.push(b"one")
+            ring.push(b"x" * 300)  # 2 chunks — counts as ONE record
+            ring.push(b"three")
+            assert ring.depth() == 4
+            assert ring.reclaim() == 3  # records, not chunks
+            assert ring.depth() == 0
+            assert ring.pop() is None
+            # immediately reusable after the reap
+            assert ring.push(b"after")
+            assert ring.pop() == b"after"
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_reclaim_empty_ring_is_zero(self, ring):
+        assert ring.reclaim() == 0
