@@ -21,8 +21,9 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
 	durability-smoke obs-smoke cost-smoke chaos-smoke scrub-smoke \
-	mp-smoke bench-ingest bench-serving bench-sync bench-durability \
-	bench-tracing bench-profiling bench-chaos bench-scrub bench-mp
+	mp-smoke multitenant-smoke bench-ingest bench-serving bench-sync \
+	bench-durability bench-tracing bench-profiling bench-chaos \
+	bench-scrub bench-mp bench-multitenant
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -84,6 +85,16 @@ scrub-smoke:
 mp-smoke:
 	$(PYTEST) tests/test_shmring.py tests/test_mpserve.py -m "not slow"
 
+# multitenant-smoke: the skewed-traffic actuators — result-cache unit
+# semantics (per-field invalidation, the fill-race version fence,
+# heat-weighted eviction), read-your-writes through the HTTP cache path
+# (sequential, concurrent, and across mp-serving workers' rings),
+# PROFILE/ledger satellites, /debug/rescache + heatmap tier view,
+# tiering demote/promote/hysteresis/pacing, and knob roundtrips
+# (docs/OPERATIONS.md skewed traffic)
+multitenant-smoke:
+	$(PYTEST) tests/test_multitenant.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -123,3 +134,11 @@ bench-mp:
 # randomized storage-fault chaos schedules
 bench-scrub:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs scrub
+
+# skewed-traffic gate: 120 indexes under Zipf traffic with QoS quotas
+# active — hot-tenant p99 within 1.3x the single-index plateau, bounded
+# cold-tenant tail, >50% result-cache hit rate on the Zipf hot set,
+# read-your-writes through the cache path (single-process + mp-serving),
+# and a heat-driven demote/promote cycle with zero serving errors
+bench-multitenant:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs multitenant

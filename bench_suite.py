@@ -2461,6 +2461,336 @@ for line in sys.stdin:
 """
 
 
+def config_multitenant(n_indexes: int = 120, n_clients: int = 8,
+                       requests_per_client: int = 300,
+                       baseline_requests: int = 800,
+                       rounds: int = 3, zipf_s: float = 1.1,
+                       hot_ranks: int = 5, cold_rank_floor: int = 30,
+                       ryw_rounds: int = 40) -> dict:
+    """Skewed-traffic gate (ISSUE 12 / ROADMAP open item 3): 100+
+    indexes on ONE node under Zipf client traffic with QoS quotas
+    active, the write-invalidated result cache and heat-driven
+    residency tiering both ON.
+
+    Gates (``ok``):
+
+    - hot-tenant p99 within 1.3x the single-index plateau p99 on the
+      same server (the Zipf head must serve at cache speed, however
+      many cold tenants share the node);
+    - cold-tenant p99 bounded (≤ max(50x the single-index p99, 0.75 s)
+      — re-decode + fill cost, never an unbounded tail);
+    - result-cache hit rate > 50% on the Zipf hot set (per-tenant
+      ledger result_cache_hits / queries over the head ranks);
+    - read-your-writes oracle: an acked (fsynced, group-commit) write
+      is NEVER masked by a stale cached result — write-then-read
+      through the cache path, single-process AND through different
+      mp-serving workers' rings (the cache lives owner-side);
+    - tiering acts: ≥1 heat-driven demotion to the compressed host
+      tier and ≥1 promotion back, with ZERO serving errors during the
+      transitions (old-resident or new-resident, never absent);
+    - zero client errors anywhere.
+    """
+    import http.client as _hc
+    import socket as _socket
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.serving.rescache import global_result_cache
+    from pilosa_tpu.server import Server, ServerConfig
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.residency import global_row_cache
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+
+    t_start = time.time()
+    rng = np.random.default_rng(12)
+    names = [f"t{i:03d}" for i in range(n_indexes)]
+    # seeded rank permutation: which tenant is rank-0 hot is arbitrary
+    perm = rng.permutation(n_indexes)
+    rank_of = {names[perm[r]]: r for r in range(n_indexes)}
+    by_rank = [names[perm[r]] for r in range(n_indexes)]
+    # Zipf pmf over ranks
+    weights = 1.0 / np.arange(1, n_indexes + 1) ** zipf_s
+    pmf = weights / weights.sum()
+
+    def seed_server(tmp: str, **extra) -> "Server":
+        server = Server(ServerConfig(
+            data_dir=tmp, port=0, name="mt", anti_entropy_interval=0,
+            heartbeat_interval=0, use_mesh=False,
+            result_cache_bytes=64 << 20,
+            residency_promote_interval=0.2,
+            residency_promote_heat=2.0, residency_demote_heat=0.5,
+            heat_half_life=1.5,
+            qos_max_inflight=512, qos_tenant_inflight=64,  # quotas ON
+            **extra,
+        )).open()
+        n = int(SHARD_WIDTH * 0.01)
+        for name in names:
+            idx = server.holder.create_index(name,
+                                             track_existence=False)
+            f = idx.create_field("f")
+            frag = f.view(VIEW_STANDARD, create=True).fragment(
+                0, create=True)
+            for row in range(1, 5):
+                frag.bulk_import(
+                    np.full(n, row, np.uint64),
+                    rng.choice(SHARD_WIDTH, n, replace=False).astype(
+                        np.uint64),
+                )
+            server.api.cluster.note_local_shards(name, [0])
+        return server
+
+    def post(conn, index, pql, tenant=None, suffix=""):
+        headers = {"X-Pilosa-Tenant": tenant} if tenant else {}
+        conn.request("POST", f"/index/{index}/query{suffix}",
+                     body=pql.encode(), headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+
+    errors: list = []
+
+    def client_run(port, plan):
+        """One closed-loop client: ``plan`` is [(index, pql)];
+        returns per-request latencies (seconds) aligned with plan."""
+        conn = _hc.HTTPConnection("127.0.0.1", port, timeout=120)
+        lat = np.zeros(len(plan))
+        try:
+            for k, (index, pql) in enumerate(plan):
+                t0 = time.perf_counter()
+                st, body = post(conn, index, pql, tenant=index)
+                lat[k] = time.perf_counter() - t0
+                if st != 200:
+                    errors.append((index, st, body[:120]))
+        except Exception as e:  # noqa: BLE001 — surfaced via errors
+            errors.append(repr(e))
+        finally:
+            conn.close()
+        return lat
+
+    def run_phase(port, plans):
+        gate = threading.Event()
+        out = [None] * len(plans)
+
+        def worker(i):
+            gate.wait(30)
+            out[i] = client_run(port, plans[i])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(plans))]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join(600)
+        return out
+
+    result: dict = {"config": "multitenant",
+                    "metric": "zipf_multitenant_cache_tiering",
+                    "n_indexes": n_indexes, "n_clients": n_clients,
+                    "zipf_s": zipf_s}
+    with tempfile.TemporaryDirectory() as tmp:
+        server = seed_server(f"{tmp}/s1")
+        try:
+            port = server.port
+            hot0 = by_rank[0]
+            # warm compile caches + the baseline index's cache entries
+            warm_conn = _hc.HTTPConnection("127.0.0.1", port, timeout=120)
+            for row in range(1, 5):
+                post(warm_conn, hot0, f"Count(Row(f={row}))", tenant=hot0)
+            warm_conn.close()
+
+            # ---- phase 1: single-index plateau (the comparison bar)
+            per = baseline_requests // n_clients
+            base_p99 = base_p50 = None
+            for _ in range(rounds):
+                plans = [[(hot0,
+                           f"Count(Row(f={1 + (k % 4)}))")
+                          for k in range(per)]
+                         for _ in range(n_clients)]
+                lat = np.concatenate(run_phase(port, plans))
+                p99 = float(np.percentile(lat, 99))
+                if base_p99 is None or p99 < base_p99:
+                    base_p99 = p99
+                    base_p50 = float(np.percentile(lat, 50))
+
+            # ---- phase 2: Zipf traffic across every tenant
+            hits0 = global_result_cache().metrics()
+            hot_lat_best = cold_lat_best = None
+            for r in range(rounds):
+                plans = []
+                for c in range(n_clients):
+                    crng = np.random.default_rng(1000 + r * 64 + c)
+                    ranks = crng.choice(n_indexes, requests_per_client,
+                                        p=pmf)
+                    plans.append([
+                        (by_rank[rank],
+                         f"Count(Row(f={1 + (k % 4)}))")
+                        for k, rank in enumerate(ranks)])
+                outs = run_phase(port, plans)
+                hot_lat, cold_lat = [], []
+                for plan, lat in zip(plans, outs):
+                    for (index, _), s in zip(plan, lat):
+                        rank = rank_of[index]
+                        if rank < hot_ranks:
+                            hot_lat.append(s)
+                        elif rank >= cold_rank_floor:
+                            cold_lat.append(s)
+                hp99 = float(np.percentile(hot_lat, 99))
+                if hot_lat_best is None or hp99 < hot_lat_best:
+                    hot_lat_best = hp99
+                if cold_lat:
+                    cp99 = float(np.percentile(cold_lat, 99))
+                    if cold_lat_best is None or cp99 < cold_lat_best:
+                        cold_lat_best = cp99
+            hits1 = global_result_cache().metrics()
+            # hot-set hit rate from the per-tenant ledger (cache hits
+            # are billed as queries — the satellite contract)
+            ledger = {r["tenant"]: r
+                      for r in server.api.cost.snapshot()}
+            hot_queries = sum(
+                ledger.get(by_rank[r], {}).get("queries", 0)
+                for r in range(hot_ranks))
+            hot_hits = sum(
+                ledger.get(by_rank[r], {}).get("result_cache_hits", 0)
+                for r in range(hot_ranks))
+            hot_hit_rate = hot_hits / hot_queries if hot_queries else 0.0
+
+            # ---- phase 3: read-your-writes through the cache path
+            ryw_ok = True
+            ryw_conn = _hc.HTTPConnection("127.0.0.1", port, timeout=120)
+            counts: dict = {}
+            for k in range(ryw_rounds):
+                name = by_rank[int(rng.integers(0, 20))]
+                # prime the cached read, then write, then re-read: the
+                # acked (fsynced) write must never be masked
+                post(ryw_conn, name, "Count(Row(f=9))", tenant=name)
+                st, _ = post(ryw_conn, name,
+                             f"Set({2000 + k}, f=9)", tenant=name)
+                if st != 200:
+                    errors.append(("ryw-write", st))
+                counts[name] = counts.get(name, 0) + 1
+                st, body = post(ryw_conn, name, "Count(Row(f=9))",
+                                tenant=name)
+                got = json.loads(body)["results"][0]
+                if got != counts[name]:
+                    ryw_ok = False
+                    errors.append(
+                        ("ryw-stale", name, got, counts[name]))
+            ryw_conn.close()
+
+            # ---- phase 4: heat-driven tier cycle (demote + promote)
+            cache = global_row_cache()
+            tier_conn = _hc.HTTPConnection("127.0.0.1", port,
+                                           timeout=120)
+            # everything cools below demote-heat (half-life 1.5 s);
+            # the 0.2 s tiering worker demotes resident leaves host-side
+            deadline = time.time() + 12.0
+            while (cache.tier_demotions == 0
+                   and time.time() < deadline):
+                time.sleep(0.25)
+            demotions = int(cache.tier_demotions)
+            # re-heat a handful of demoted tenants with explicit-shard
+            # queries (cache-ineligible, so they EXECUTE and record
+            # heat); lookups promote the leaves they touch, the worker
+            # pass promotes the rest of each field
+            tier_errors = 0
+            for name in by_rank[:3]:
+                for k in range(12):
+                    st, _ = post(tier_conn, name,
+                                 f"Count(Row(f={1 + (k % 4)}))",
+                                 tenant=name, suffix="?shards=0")
+                    if st != 200:
+                        tier_errors += 1
+            deadline = time.time() + 8.0
+            while (cache.tier_promotions == 0
+                   and time.time() < deadline):
+                time.sleep(0.25)
+            promotions = int(cache.tier_promotions)
+            tier_metrics = server.api.tierer.metrics()
+            host_bytes_peak = int(cache.host_bytes)
+            tier_conn.close()
+        finally:
+            server.close()
+
+        # ---- phase 5: the mp-serving shape (cache owner-side)
+        if hasattr(_socket, "SO_REUSEPORT"):
+            mp_ok = True
+            mp = Server(ServerConfig(
+                data_dir=f"{tmp}/mp", port=0, serving_workers=2,
+                anti_entropy_interval=0, heartbeat_interval=0,
+                use_mesh=False, result_cache_bytes=16 << 20,
+            )).open()
+            try:
+                mport = mp.port
+
+                def mp_req(method, path, body=None):
+                    r = urllib.request.Request(
+                        f"http://127.0.0.1:{mport}{path}", data=body,
+                        method=method)
+                    with urllib.request.urlopen(r, timeout=60) as resp:
+                        return resp.status, resp.read()
+
+                mp_req("POST", "/index/m", b"{}")
+                mp_req("POST", "/index/m/field/f", b"{}")
+                for k in range(15):
+                    # fresh connection per request: the kernel spreads
+                    # them across the SO_REUSEPORT workers, so the
+                    # write and the read ride DIFFERENT rings
+                    st, _ = mp_req("POST", "/index/m/query",
+                                   f"Set({k}, f=3)".encode())
+                    if st != 200:
+                        mp_ok = False
+                    st, body = mp_req("POST", "/index/m/query",
+                                      b"Count(Row(f=3))")
+                    if json.loads(body)["results"][0] != k + 1:
+                        mp_ok = False
+                        errors.append(("mp-ryw-stale", k))
+            except Exception as e:  # noqa: BLE001
+                mp_ok = False
+                errors.append(repr(e))
+            finally:
+                mp.close()
+        else:
+            mp_ok = True
+            result["mp_skipped"] = "SO_REUSEPORT unavailable"
+
+    cold_bound = max(50 * base_p99, 0.75)
+    result.update({
+        "requests_zipf": n_clients * requests_per_client * rounds,
+        "single_index_p50_ms": round(base_p50 * 1e3, 3),
+        "single_index_p99_ms": round(base_p99 * 1e3, 3),
+        "hot_tenant_p99_ms": round(hot_lat_best * 1e3, 3),
+        "hot_vs_single_ratio": round(hot_lat_best / base_p99, 3),
+        "cold_tenant_p99_ms": round((cold_lat_best or 0.0) * 1e3, 3),
+        "cold_bound_ms": round(cold_bound * 1e3, 1),
+        "hot_hit_rate": round(hot_hit_rate, 4),
+        "result_cache": {
+            k: hits1[k] - hits0.get(k, 0)
+            for k in ("result_cache_hits_total",
+                      "result_cache_misses_total",
+                      "result_cache_fills_total",
+                      "result_cache_invalidations_total")},
+        "tier_demotions": demotions,
+        "tier_promotions": promotions,
+        "tier_pass_metrics": tier_metrics,
+        "host_tier_bytes": host_bytes_peak,
+        "tier_transition_errors": tier_errors,
+        "read_your_writes_ok": ryw_ok,
+        "read_your_writes_mp_ok": mp_ok,
+        "client_errors": len(errors),
+        "error_sample": [str(e)[:160] for e in errors[:5]],
+        "wall_s": round(time.time() - t_start, 1),
+    })
+    result["ok"] = bool(
+        hot_lat_best <= 1.3 * base_p99
+        and (cold_lat_best or 0.0) <= cold_bound
+        and hot_hit_rate > 0.5
+        and ryw_ok and mp_ok
+        and demotions >= 1 and promotions >= 1
+        and tier_errors == 0 and not errors
+    )
+    return result
+
+
 def config_mp_serving(n_shards: int = 4,
                       worker_counts=(1, 2, 4),
                       client_counts=(8, 32, 96),
@@ -2731,8 +3061,9 @@ def main() -> None:
                         help="billion-column scale (real TPU)")
     parser.add_argument(
         "--configs",
-        default="1,2,3,4,5,mesh8,serving,mp_serving,import,ingest,sync,"
-                "hostpath,durability,tracing,profiling,chaos,scrub",
+        default="1,2,3,4,5,mesh8,serving,mp_serving,multitenant,import,"
+                "ingest,sync,hostpath,durability,tracing,profiling,chaos,"
+                "scrub",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -2759,6 +3090,11 @@ def main() -> None:
         "mp_serving": lambda: config_mp_serving(
             client_counts=(16, 64, 128) if args.full else (8, 32, 96),
             requests_per_client=160 if args.full else 80,
+        ),
+        "multitenant": lambda: config_multitenant(
+            n_indexes=256 if args.full else 120,
+            n_clients=16 if args.full else 8,
+            requests_per_client=600 if args.full else 300,
         ),
         "readwrite": lambda: config_serving_readwrite(
             n_shards=32 if args.full else 8,

@@ -61,6 +61,21 @@ ring-slots = 1024             # slots per ring direction per worker
 ring-slot-bytes = 65536       # bytes per slot (large responses span
                               # consecutive slots)
 
+# Skewed traffic (docs/OPERATIONS.md): write-invalidated result cache +
+# heat-driven HBM residency tiering — the actuators on the heat plane
+result-cache-bytes = 0        # pre-serialized hot-query response bytes
+                              # kept across waves, invalidated at every
+                              # (index,field,shard) write; 0 = off
+residency-promote-interval = 0.0  # seconds between tiering passes
+                              # (demote cold fragments to the compressed
+                              # host tier, promote hot ones back); 0 = off
+residency-promote-heat = 4.0  # heat above which host-tier fragments
+                              # promote to device residency
+residency-demote-heat = 1.0   # heat below which device-resident
+                              # fragments demote host-side; the gap to
+                              # promote-heat is the hysteresis dead band
+residency-host-tier-bytes = 1073741824  # compressed host-tier budget
+
 # Write-path durability (docs/OPERATIONS.md): what an HTTP 200 on a
 # write means
 durability-mode = "group"     # group = one fsync per commit group of

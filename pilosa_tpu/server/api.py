@@ -152,6 +152,13 @@ class API:
         # when this process is a device owner fronted by SO_REUSEPORT
         # workers; None in single-process mode.
         self.mpserve = None
+        # heat-driven residency tiering worker (storage/tiering.py);
+        # Server.open wires one when residency-promote-interval > 0.
+        # The write-invalidated result cache itself is the process
+        # global (serving/rescache.py — fragment write hooks reach it
+        # without plumbing), configured by Server.open via
+        # result-cache-bytes; both default OFF.
+        self.tierer = None
 
     # ---------------------------------------------------------------- query
 
@@ -351,6 +358,17 @@ class API:
             if opts:
                 results = self._apply_request_opts(index, results, opts)
             if writes:
+                # attr writes change results (Row responses carry
+                # attrs) WITHOUT a fragment write event — fence every
+                # cached result of the index (serving/rescache.py);
+                # bit writes already invalidated at their fragments
+                if any(c.name in ("SetRowAttrs", "SetColumnAttrs")
+                       for c in query.write_calls()):
+                    from pilosa_tpu.serving import rescache
+
+                    idx = self.holder.index(index)
+                    if idx is not None:
+                        rescache.invalidate_index_wide(idx.scope, index)
                 # ACK gate: a 200 means DURABLE. In group mode this
                 # parks the request until the commit thread has fsynced
                 # the group containing its op records (one fsync covers
@@ -417,20 +435,171 @@ class API:
                          tenant: str = "default", deadline=None,
                          profile_out: list | None = None,
                          pre_admitted: bool = False,
-                         on_submitted=None) -> bytes:
+                         on_submitted=None,
+                         cache_hit_out: list | None = None) -> bytes:
         """The whole JSON response envelope, pre-serialized (serving fast
         lane): hot result shapes encode straight to bytes — memoized on
         the result objects, so a deduped wave of identical queries
         serializes once — instead of dict-building + json.dumps per
-        request (see executor/result.py)."""
+        request (see executor/result.py).
+
+        Result cache (serving/rescache.py): a cache-eligible request —
+        the exact ``_SharedDeferred`` dedupe eligibility, persisted
+        across waves — is first answered from pre-serialized cached
+        bytes (``cache_hit_out`` receives True so callers can tag the
+        hit); a miss snapshots the write-version fence BEFORE execution
+        and fills afterwards, so a write group-committing concurrently
+        with the fill invalidates it (the insert refuses to land)."""
         from pilosa_tpu.executor.result import results_json_bytes
 
+        scope = None
+        snap = None
+        if (not remote and shards is None and deadline is None and not opts
+                and self.serve_fastlane and isinstance(pql, str)):
+            from pilosa_tpu.serving.rescache import global_result_cache
+
+            cache = global_result_cache()
+            # single-node serving shapes only (the mp owner included):
+            # a cluster edge result folds in remote data whose writes
+            # land on OTHER nodes' fragments — no local write event
+            # could invalidate it (docs/OPERATIONS.md skewed traffic)
+            if cache.enabled and (self.cluster is None
+                                  or len(self.cluster.nodes) <= 1):
+                idx = self.holder.index(index)
+                if idx is not None:
+                    scope = idx.scope
+                    payload = cache.peek(scope, index, pql)
+                    if payload is not None:
+                        return self._serve_result_cache_hit(
+                            cache, scope, index, pql, payload, tenant,
+                            profile_out, pre_admitted, on_submitted,
+                            cache_hit_out,
+                        )
+                    if self._result_cacheable(pql):
+                        # a MISS only for fillable queries: writes and
+                        # host-eager reads must not dilute the hit rate
+                        # operators gate on
+                        cache.record_miss()
+                        snap = cache.version()  # the fill-race cutoff
+                    else:
+                        scope = None
         results = self.query_raw(index, pql, shards=shards, remote=remote,
                                  opts=opts, tenant=tenant, deadline=deadline,
                                  profile_out=profile_out,
                                  pre_admitted=pre_admitted,
                                  on_submitted=on_submitted)
-        return results_json_bytes(results)
+        payload = results_json_bytes(results)
+        if snap is not None and scope is not None:
+            from pilosa_tpu.pql import parse
+            from pilosa_tpu.serving.rescache import query_field_deps
+
+            query = parse(pql)  # memoized; the request already paid it
+            cache.insert(scope, index, pql, payload,
+                         query_field_deps(query), snap)
+        return payload
+
+    def _result_cacheable(self, pql: str) -> bool:
+        """Read-only + pipeline-coalescable — the ``_SharedDeferred``
+        dedupe eligibility family, persisted across waves. Parse errors
+        defer to query_raw, which surfaces them properly."""
+        from pilosa_tpu.executor.executor import pipeline_coalescable
+        from pilosa_tpu.pql import parse
+
+        try:
+            query = parse(pql)  # memoized
+        except Exception:
+            return False
+        return not query.write_calls() and pipeline_coalescable(query)
+
+    def _serve_result_cache_hit(self, cache, scope, index, pql, payload,
+                                tenant, profile_out, pre_admitted,
+                                on_submitted, cache_hit_out) -> bytes:
+        """The hit half of query_raw's request envelope: admission
+        (unless the serving worker already admitted), inflight
+        tracking, a trace span, ledger + SLO accounting — a cache hit
+        is billed as a query with near-zero device-ms, never invisible.
+        Heat is deliberately NOT recorded: residency should follow the
+        traffic that actually executes, and a cache hit needs no
+        device bytes (invalidation re-heats the shards on the next
+        miss)."""
+        import time
+
+        from pilosa_tpu.qos import AdmissionError
+        from pilosa_tpu.utils.tracing import (
+            global_query_tracker,
+            global_tracer,
+        )
+
+        tracer = global_tracer()
+        tracker = global_query_tracker()
+        inflight = tracker.start(index, pql, tenant=tenant, remote=False)
+        inflight_token = (tracker.activate(inflight)
+                          if inflight is not None else None)
+        ctx = new_cost_context(tenant, index, None)
+        t_start = time.perf_counter()
+        err_status = None
+        slot = None
+        try:
+            if not pre_admitted:
+                if inflight is not None:
+                    inflight.stage = "admission"
+                try:
+                    with tracer.span("qos.admit", tenant=tenant):
+                        slot = self.qos.admission.admit(tenant)
+                except AdmissionError as e:
+                    err = ApiError(str(e), 429)
+                    err.retry_after = e.retry_after
+                    raise err from e
+            if inflight is not None:
+                inflight.stage = "rescache"
+            with tracer.span("rescache.hit", index=index):
+                cache.record_hit(scope, index, pql)
+            if on_submitted is not None:
+                # the dedupe-join cutoff (serving/mpserve.py): a cache
+                # hit resolves immediately, so late identical arrivals
+                # must start their own (equally cached) pass
+                on_submitted()
+            if cache_hit_out is not None:
+                cache_hit_out.append(True)
+            if profile_out is not None:
+                # the honest near-zero tree: no parse, no plan, no
+                # dispatch happened — the flag explains it, exactly as
+                # dedupeHit does for in-wave followers
+                if ctx is not None:
+                    profile_out.append({
+                        "node": self.node_id(), "index": index,
+                        "pql": pql[:1024], "wave": 1,
+                        "dedupeHit": False, "resultCacheHit": True,
+                        "calls": [], "remote": [],
+                        "totals": ctx.totals(),
+                    })
+                else:
+                    profile_out.append(
+                        {"disabled": True,
+                         "reason": "cost plane is disabled on this node"})
+            return payload
+        except ApiError as e:
+            err_status = e.status
+            raise
+        except Exception:
+            err_status = 500
+            raise
+        finally:
+            if slot is not None:
+                slot.release()
+            elapsed = time.perf_counter() - t_start
+            if ctx is not None:
+                error = err_status is not None and err_status >= 500
+                # a 429-shed request never received the cached bytes:
+                # billed as a query (like query_raw's shed path) but
+                # not as a cache hit
+                self.cost.record_query(
+                    tenant, index, ctx, elapsed, error=error,
+                    result_cache_hit=err_status is None,
+                )
+                if err_status != 429:
+                    self.slo.record(elapsed, error=error)
+            tracker.finish(inflight, inflight_token)
 
     def query_batch(self, items: list) -> list:
         """Execute a wave-batched internal request (/internal/query-batch):
@@ -1356,6 +1525,40 @@ class API:
             "workers": self.mpserve.workers_json(),
         }
 
+    def rescache_metrics(self) -> dict:
+        """result_cache_* series (docs/OBSERVABILITY.md) — present from
+        scrape one with zeros while the cache is disabled, like every
+        sibling exporter block."""
+        from pilosa_tpu.serving.rescache import global_result_cache
+
+        return global_result_cache().metrics()
+
+    def tiering_metrics(self) -> dict:
+        """residency_tier_* pass counters (storage/tiering.py) — zeros
+        with no tierer wired; the per-tier byte gauges ride the
+        residency block."""
+        if self.tierer is not None:
+            return self.tierer.metrics()
+        return {
+            "residency_tier_passes_total": 0,
+            "residency_tier_pass_promotions_total": 0,
+            "residency_tier_pass_demotions_total": 0,
+            "residency_tier_promoted_bytes_total": 0,
+            "residency_tier_demoted_bytes_total": 0,
+            "residency_tier_paced_sleep_seconds_total": 0.0,
+            "residency_tier_last_pass_seconds": 0.0,
+        }
+
+    def rescache_json(self, k: int = 100) -> dict:
+        """GET /debug/rescache: the result-cache inspector — entry
+        table hottest-first plus totals and config."""
+        from pilosa_tpu.serving.rescache import global_result_cache
+
+        cache = global_result_cache()
+        out = cache.inspect(k=k)
+        out["enabled"] = cache.enabled
+        return out
+
     def durability_metrics(self) -> dict:
         """Write-path durability counters (group-commit WAL) for
         /metrics and /debug/vars — every key present from scrape one,
@@ -1429,12 +1632,18 @@ class API:
             self._broadcast({"type": "recalculate-caches"})
 
         def recount():
+            from pilosa_tpu.serving import rescache
+
             while True:
                 for idx in list(self.holder.indexes.values()):
                     for field in list(idx.fields.values()):
                         for view in list(field.views.values()):
                             for frag in list(view.fragments.values()):
                                 frag.recalculate_cache()
+                    # an authoritative recount can change TopN results
+                    # with no write event: fence the index's cached
+                    # responses (serving/rescache.py)
+                    rescache.invalidate_index_wide(idx.scope, idx.name)
                 with self._recalc_lock:
                     if not self._recalc_rerun:
                         self._recalc_thread = None

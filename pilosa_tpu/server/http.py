@@ -73,6 +73,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/traces$"), "get_traces"),
     ("GET", re.compile(r"^/debug/tenants$"), "get_tenants"),
     ("GET", re.compile(r"^/debug/heatmap$"), "get_heatmap"),
+    ("GET", re.compile(r"^/debug/rescache$"), "get_rescache"),
     ("GET", re.compile(r"^/debug/slo$"), "get_slo"),
     ("GET", re.compile(r"^/debug/workers$"), "get_workers"),
     ("GET", re.compile(r"^/debug/queries$"), "get_inflight_queries"),
@@ -709,6 +710,13 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # sizes — zeros in single-process mode, from scrape one
         text += prometheus_block(self.api.mp_metrics(), prefix,
                                  seen=seen)
+        # skewed-traffic actuators (docs/OPERATIONS.md skewed traffic):
+        # the write-invalidated result cache and the heat-driven
+        # residency tiering pass — zeros while disabled, from scrape one
+        text += prometheus_block(self.api.rescache_metrics(), prefix,
+                                 seen=seen)
+        text += prometheus_block(self.api.tiering_metrics(), prefix,
+                                 seen=seen)
         # write-path durability (group-commit WAL): zeros from scrape
         # one, same rate()-window reasoning as the blocks around it
         text += prometheus_block(self.api.durability_metrics(), prefix,
@@ -830,13 +838,63 @@ class HTTPHandler(BaseHTTPRequestHandler):
     def get_heatmap(self, query=None):
         """Decayed per-(index, field, shard) access/write heat with the
         HBM-residency overlay (``?k=100`` caps rows) — the promote/
-        demote signal for residency tiering (docs/OBSERVABILITY.md)."""
+        demote signal for residency tiering (docs/OBSERVABILITY.md).
+
+        ``?tier=true`` adds the tiering manager's world view beside the
+        raw heat: each row gains its current tier (resident /
+        compressed / host / cold), per-tier bytes, and the last pass's
+        decision (promoted / demoted / hold / ...) — so an operator can
+        see WHY a shard was demoted, not just that it is cold."""
         from pilosa_tpu.storage.heat import global_heat
 
         k = _int_param((query.get("k") or ["100"])[0], "k") if query else 100
         if k <= 0:
             raise ApiError(f"k must be positive, got {k}")
-        self._json(global_heat().snapshot(k=k))
+        snap = global_heat().snapshot(k=k)
+        if query and query.get("tier", ["false"])[0] == "true":
+            from pilosa_tpu.storage.residency import global_row_cache
+
+            per_frag, per_stack = global_row_cache().tier_overlay()
+            tierer = self.api.tierer
+            decisions = (tierer.last_decisions()
+                         if tierer is not None else {})
+
+            def label(tiers):
+                if tiers["dense"] + tiers["compressed"] > 0:
+                    return "resident" if tiers["dense"] else "compressed"
+                return "host"
+
+            for r in snap["shards"]:
+                fkey = (r.get("scope", ""), r["index"], r["field"],
+                        r["shard"])
+                tiers = per_frag.get(fkey)
+                stiers = per_stack.get(fkey[:3])
+                if tiers is not None:
+                    r["tier"] = label(tiers)
+                    r["tierBytes"] = tiers
+                elif stiers is not None:
+                    # stacked leaves tier at field granularity: every
+                    # shard of the field shows the leaf's tier
+                    r["tier"] = label(stiers)
+                    r["stackTierBytes"] = stiers
+                else:
+                    r["tier"] = "cold"
+                d = decisions.get(fkey, decisions.get(fkey[:3]))
+                if d is not None:
+                    r["tierDecision"] = d
+            snap["tiering"] = (tierer.to_json() if tierer is not None
+                               else {"enabled": False})
+        self._json(snap)
+
+    def get_rescache(self, query=None):
+        """Result-cache inspector (``?k=100`` caps entries): the entry
+        table hottest-first with per-entry decayed score, hits, bytes,
+        and dependency fields, plus totals — docs/OPERATIONS.md skewed-
+        traffic runbook, step one for a hot-tenant p99 regression."""
+        k = _int_param((query.get("k") or ["100"])[0], "k") if query else 100
+        if k <= 0:
+            raise ApiError(f"k must be positive, got {k}")
+        self._json(self.api.rescache_json(k=k))
 
     def get_slo(self, query=None):
         """Declared objectives with per-window burn rates and breach
@@ -894,6 +952,8 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 fastlane["http_requests_total"] = self.server.requests_served
         snap["serving_fastlane"] = fastlane
         snap["serving_mp"] = self.api.mp_metrics()
+        snap["result_cache"] = self.api.rescache_metrics()
+        snap["residency_tiering"] = self.api.tiering_metrics()
         snap["durability"] = self.api.durability_metrics()
         snap["integrity"] = self.api.integrity_metrics()
         snap["observability"] = self.api.observability_metrics()

@@ -70,6 +70,11 @@ class ServerConfig:
         serving_workers: int = 0,
         ring_slots: int = 1024,
         ring_slot_bytes: int = 65536,
+        result_cache_bytes: int = 0,
+        residency_promote_interval: float = 0.0,
+        residency_promote_heat: float = 4.0,
+        residency_demote_heat: float = 1.0,
+        residency_host_tier_bytes: int = 1 << 30,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -216,6 +221,43 @@ class ServerConfig:
                 f"invalid ring-slot-bytes {ring_slot_bytes!r} "
                 "(want >= 256)"
             )
+        # Skewed-traffic actuators (docs/OPERATIONS.md skewed traffic):
+        # the write-invalidated result cache (bytes of pre-serialized
+        # hot responses; 0 = off) and the heat-driven residency tiering
+        # worker (promote/demote pass interval; 0 = off) with its
+        # hysteresis thresholds — promote must sit above demote or
+        # borderline shards would thrash host<->device every pass.
+        self.result_cache_bytes = int(result_cache_bytes)
+        if self.result_cache_bytes < 0:
+            raise ValueError(
+                f"invalid result-cache-bytes {result_cache_bytes!r} "
+                "(want >= 0)"
+            )
+        self.residency_promote_interval = float(residency_promote_interval)
+        if self.residency_promote_interval < 0:
+            raise ValueError(
+                "invalid residency-promote-interval "
+                f"{residency_promote_interval!r} (want >= 0)"
+            )
+        self.residency_promote_heat = float(residency_promote_heat)
+        self.residency_demote_heat = float(residency_demote_heat)
+        if self.residency_demote_heat < 0:
+            raise ValueError(
+                f"invalid residency-demote-heat {residency_demote_heat!r} "
+                "(want >= 0)"
+            )
+        if self.residency_promote_heat <= self.residency_demote_heat:
+            raise ValueError(
+                f"residency-promote-heat {residency_promote_heat!r} must "
+                f"exceed residency-demote-heat {residency_demote_heat!r} "
+                "(the gap IS the hysteresis dead band)"
+            )
+        self.residency_host_tier_bytes = int(residency_host_tier_bytes)
+        if self.residency_host_tier_bytes < 0:
+            raise ValueError(
+                "invalid residency-host-tier-bytes "
+                f"{residency_host_tier_bytes!r} (want >= 0)"
+            )
         from pilosa_tpu.qos.slo import SLOEngine
 
         # build once to validate; Server.open builds the live engine
@@ -347,6 +389,25 @@ class ServerConfig:
             ring_slot_bytes=int(
                 d.get("ring-slot-bytes", d.get("ring_slot_bytes", 65536))
             ),
+            result_cache_bytes=int(
+                d.get("result-cache-bytes", d.get("result_cache_bytes", 0))
+            ),
+            residency_promote_interval=_parse_duration(
+                d.get("residency-promote-interval",
+                      d.get("residency_promote_interval", 0.0))
+            ),
+            residency_promote_heat=float(
+                d.get("residency-promote-heat",
+                      d.get("residency_promote_heat", 4.0))
+            ),
+            residency_demote_heat=float(
+                d.get("residency-demote-heat",
+                      d.get("residency_demote_heat", 1.0))
+            ),
+            residency_host_tier_bytes=int(
+                d.get("residency-host-tier-bytes",
+                      d.get("residency_host_tier_bytes", 1 << 30))
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -401,6 +462,11 @@ class ServerConfig:
             "serving-workers": self.serving_workers,
             "ring-slots": self.ring_slots,
             "ring-slot-bytes": self.ring_slot_bytes,
+            "result-cache-bytes": self.result_cache_bytes,
+            "residency-promote-interval": self.residency_promote_interval,
+            "residency-promote-heat": self.residency_promote_heat,
+            "residency-demote-heat": self.residency_demote_heat,
+            "residency-host-tier-bytes": self.residency_host_tier_bytes,
         }
 
 
@@ -456,12 +522,29 @@ class Server:
         return self._http.server_address[1] if self._http else self.config.port
 
     def open(self) -> "Server":
-        if self.config.device_budget_bytes:
-            from pilosa_tpu.storage import residency
+        from pilosa_tpu.storage import residency
 
+        if self.config.device_budget_bytes:
             residency.set_global_row_cache(
-                residency.DeviceRowCache(self.config.device_budget_bytes)
+                residency.DeviceRowCache(
+                    self.config.device_budget_bytes,
+                    host_budget_bytes=self.config
+                    .residency_host_tier_bytes,
+                )
             )
+        else:
+            residency.global_row_cache().host_budget_bytes = \
+                self.config.residency_host_tier_bytes
+        # write-invalidated result cache (serving/rescache.py): the
+        # process global — fragment write hooks invalidate through it —
+        # sized here; 0 keeps it disabled (and clears leftovers from a
+        # previous in-process server)
+        from pilosa_tpu.serving.rescache import global_result_cache
+
+        global_result_cache().configure(
+            self.config.result_cache_bytes,
+            half_life_s=self.config.heat_half_life,
+        )
         self.holder.open()
         self.api.long_query_time = self.config.long_query_time
         # slow-query ring capacity (slow-query-ring knob): replace the
@@ -567,6 +650,24 @@ class Server:
             self._mpserve = OwnerRuntime(self).start()
             self.api.mpserve = self._mpserve
         self._wire_cluster()
+        if self.config.residency_promote_interval > 0:
+            from pilosa_tpu.storage.heat import global_heat as _gh
+            from pilosa_tpu.storage.residency import (
+                global_row_cache as _grc,
+            )
+            from pilosa_tpu.storage.tiering import ResidencyTierer
+
+            # promotion uploads share the node's RepairPacer: tiering
+            # competes with repair for the same host<->device and wire
+            # budgets, and must never starve serving of either
+            self.api.tierer = ResidencyTierer(
+                cache=_grc(), heat=_gh(),
+                interval_s=self.config.residency_promote_interval,
+                promote_heat=self.config.residency_promote_heat,
+                demote_heat=self.config.residency_demote_heat,
+                pacer=self.api.cluster.client.pacer,
+                logger=self.logger,
+            ).start()
         self.logger.info(
             "listening on %s://%s:%d (data-dir %s, node %s)",
             "https" if self.config.tls_enabled else "http",
@@ -675,6 +776,9 @@ class Server:
             self.api.mpserve = None
         if self.api.scrubber is not None:
             self.api.scrubber.close()
+        if self.api.tierer is not None:
+            self.api.tierer.close()
+            self.api.tierer = None
         if self._anti_entropy_timer is not None:
             self._anti_entropy_timer.cancel()
         if self._heartbeat_timer is not None:

@@ -739,6 +739,7 @@ class OwnerRuntime:
         st = int(meta.get("st", 200))
         elapsed = float(meta.get("ex") or 0.0)
         error = st >= 500
+        cache_hit = bool(meta.get("rc"))
         billed = cost_enabled()
         for fws, fgen, fheader in ex.followers:
             fmeta = {"st": st, "ex": meta.get("ex", 0.0),
@@ -750,7 +751,8 @@ class OwnerRuntime:
             index = fheader.get("ix", "")
             if billed:
                 self.api.cost.record_query(tenant, index, None, elapsed,
-                                           error=error)
+                                           error=error,
+                                           result_cache_hit=cache_hit)
                 self.api.cost.add_egress(tenant, index, len(payload))
                 if st != 429:
                     self.api.slo.record(elapsed, error=error)
@@ -779,13 +781,20 @@ class OwnerRuntime:
 
         def run() -> bytes:
             try:
+                cache_hit: list = []
                 payload = self.api.query_json_bytes(
                     index, body.decode(), shards=header.get("sh"),
                     opts=header.get("o") or {}, tenant=tenant,
                     deadline=deadline, pre_admitted=True,
                     on_submitted=on_submitted,
+                    cache_hit_out=cache_hit,
                 )
                 meta["st"] = 200
+                if cache_hit:
+                    # result-cache hit (serving/rescache.py): followers
+                    # of this leader bill as cache hits too — they got
+                    # the same cached bytes
+                    meta["rc"] = True
                 if cost_enabled():
                     # egress billing for the worker's response bytes —
                     # the handler's _note_egress, owner-side

@@ -159,11 +159,16 @@ class Field:
         # drop derived device entries (stacked query leaves) tied to this
         # field: files may change while closed, or the field may be
         # deleted and recreated under the same name
+        from pilosa_tpu.serving import rescache
         from pilosa_tpu.storage import residency
 
         residency.global_row_cache().invalidate_tag(
             (self.scope, self.index, self.name)
         )
+        # a field closing (delete, or the holder shutting down) fences
+        # every cached result of the index — deletes change what ANY
+        # query of the index answers (existence columns included)
+        rescache.invalidate_index_wide(self.scope, self.index)
 
     def _save_meta(self) -> None:
         # fsynced for the same reason as Index._save_meta: WAL recovery

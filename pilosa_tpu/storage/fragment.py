@@ -37,6 +37,7 @@ from pilosa_tpu.shardwidth import (
     SHARD_WIDTH_EXP,
     keep_last_unique,
 )
+from pilosa_tpu.serving import rescache
 from pilosa_tpu.storage.cache import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, new_row_cache
 from pilosa_tpu.storage import residency
 from pilosa_tpu.storage.heat import global_heat
@@ -199,6 +200,11 @@ class Fragment:
                 self._file.close()
                 self._file = None
             residency.global_row_cache().invalidate_fragment(self.frag_id)
+            # delete/repair-swap closes change what this fragment will
+            # answer next; clean closes are invalidated too (harmless —
+            # the holder is going away or the file may change while shut)
+            rescache.invalidate_write(self.scope, self.index, self.field,
+                                      self.shard)
             self._open = False
 
     def _cache_path(self) -> str:
@@ -463,9 +469,28 @@ class Fragment:
             self.bitmap.remove_ids(ids)
             self._log_op(OP_REMOVE, ids)
         for r, p in rows_added:
-            self._after_row_write(int(r), positions=p, added=True)
+            self._after_row_write(int(r), positions=p, added=True,
+                                  count_stat=False)
         for r, p in rows_removed:
-            self._after_row_write(int(r), positions=p, added=False)
+            self._after_row_write(int(r), positions=p, added=False,
+                                  count_stat=False)
+        # the batch-amortized tail (same shape as _after_rows_added):
+        # ONE stats bump, ONE result-cache write event, ONE heat record
+        # for the whole batch — a bit_depth-32 BSI import must not take
+        # the global result-cache lock 34x per shard
+        n_rows = len(rows_added) + len(rows_removed)
+        if n_rows:
+            from pilosa_tpu.utils.stats import global_stats
+
+            global_stats().count("fragment_row_writes", n_rows)
+            rescache.invalidate_write(self.scope, self.index, self.field,
+                                      self.shard)
+            if current_cost() is not None:
+                bits = sum(len(p) for _, p in rows_added)
+                bits += sum(len(p) for _, p in rows_removed)
+                global_heat().record_write(self.index, self.field,
+                                           self.shard, n=float(bits),
+                                           scope=self.scope)
 
     def import_bsi(self, positions: np.ndarray, stored: np.ndarray,
                    bit_depth: int, exists_row: int = 0,
@@ -630,6 +655,8 @@ class Fragment:
                 self.bitmap.remove_ids(ids)
             self.mutations += 1
         residency.global_row_cache().invalidate_fragment(self.frag_id)
+        rescache.invalidate_write(self.scope, self.index, self.field,
+                                  self.shard)
 
     def snapshot(self) -> None:
         """Compact: rewrite the file as a clean snapshot, dropping the log
@@ -713,6 +740,11 @@ class Fragment:
         from pilosa_tpu.utils.stats import global_stats
 
         global_stats().count("fragment_row_writes", int(uniq.size))
+        # ONE result-cache write event per batch (the per-row calls
+        # above pass count_stat=False and skip theirs) — unconditional:
+        # the cost kill switch gates accounting, never correctness
+        rescache.invalidate_write(self.scope, self.index, self.field,
+                                  self.shard)
         if current_cost() is not None:
             # one heat record per batch, weighted by written bits — same
             # lock-amortization reasoning as the counter above. Gated on
@@ -739,6 +771,15 @@ class Fragment:
         ))
         self.row_cache.add(row, self.count_row(row))
         if count_stat:
+            # the WAL-visible write point: a cached result depending on
+            # this (index, field, shard) must die BEFORE the write's
+            # durability barrier releases its 200 — the in-memory
+            # mutation above is already reader-visible, so an acked
+            # write can never be masked by stale cached bytes.
+            # Batch paths (count_stat=False, from _after_rows_added)
+            # invalidate once per batch instead of once per row.
+            rescache.invalidate_write(self.scope, self.index, self.field,
+                                      self.shard)
             from pilosa_tpu.utils.stats import global_stats
 
             global_stats().count("fragment_row_writes", 1)

@@ -18,7 +18,14 @@ data at real-world densities this multiplies effective HBM residency by the
 inverse block-occupancy, which matters because a re-upload over host↔device
 is the slowest path in the system.
 
-Writes invalidate the affected row in both tiers; queries call ``get_row``
+A third, HOST tier backs heat-driven residency tiering
+(storage/tiering.py): cold entries demote to compact nonzero-block
+copies in host RAM (own byte budget, ``residency-host-tier-bytes``) and
+promote back to dense on access or when the ResidencyTierer's pass sees
+their heat recover — so far more indexes than fit in HBM stay one paced
+upload away from device residency.
+
+Writes invalidate the affected row in every tier; queries call ``get_row``
 and receive a device array ready for the bitwise kernels.
 
 Derived entries (the batched executor's stacked query leaves,
@@ -51,6 +58,10 @@ ROW_BYTES = WORDS_PER_SHARD * 4  # 128 KiB per resident row
 # Default budget: 4 GiB of HBM for row residency (v5e has 16 GiB; the rest
 # is headroom for query intermediates + XLA workspace). Tests override.
 DEFAULT_BUDGET_BYTES = 4 << 30
+
+# Default compressed host-tier budget (residency-host-tier-bytes knob):
+# host RAM parking for cold demoted entries.
+DEFAULT_HOST_BUDGET_BYTES = 1 << 30
 
 # Compression granularity: 4 KiB device blocks. Row = 32 blocks.
 COMPRESS_BLOCK_WORDS = 1024
@@ -103,11 +114,14 @@ class WriteEvent:
 
 
 class _DenseEntry:
-    __slots__ = ("arr", "block_idx")
+    __slots__ = ("arr", "block_idx", "custom")
 
-    def __init__(self, arr, block_idx):
+    def __init__(self, arr, block_idx, custom=False):
         self.arr = arr
         self.block_idx = block_idx  # np.int32[nb] or None = incompressible
+        # custom placement (mesh-sharded device_put): pinned to its
+        # sharding — never compressed, never tiered to host
+        self.custom = custom
 
 
 class _CompressedEntry:
@@ -125,23 +139,58 @@ class _CompressedEntry:
         return self.blocks.nbytes + self.idx.nbytes
 
 
+class _HostEntry:
+    """Compressed HOST-tier copy (heat-driven residency tiering): the
+    nonzero 4 KiB blocks in host RAM — or the full flat array when the
+    entry is incompressible — one paced upload + scatter away from dense
+    device residency. Cold fragments park here at roaring-like density
+    (Chambi et al. 1402.6407), so 10-100x more indexes stay one promote
+    away from HBM than HBM holds dense."""
+
+    __slots__ = ("blocks", "idx", "shape", "n_blocks", "block_idx")
+
+    def __init__(self, blocks, idx, shape, n_blocks, block_idx):
+        self.blocks = blocks  # np.uint32[nb_padded, bw], or flat full array
+        self.idx = idx  # np.int32[nb_padded], or None = full array
+        self.shape = shape
+        self.n_blocks = n_blocks
+        self.block_idx = block_idx  # original nonzero-block index (or None)
+
+    @property
+    def nbytes(self) -> int:
+        n = int(self.blocks.nbytes)
+        if self.idx is not None:
+            n += int(self.idx.nbytes)
+        return n
+
+
 class DeviceRowCache:
     """Byte-budgeted two-tier LRU of device-resident arrays (dense rows,
     BSI plane matrices, mesh-sharded shard stacks — sized by actual
     nbytes). Sparse entries compress on demotion instead of dropping."""
 
-    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES, device=None):
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES, device=None,
+                 host_budget_bytes: int = DEFAULT_HOST_BUDGET_BYTES):
         self.budget_bytes = budget_bytes
+        self.host_budget_bytes = int(host_budget_bytes)
         self.device = device
         self._rows: OrderedDict[tuple, _DenseEntry] = OrderedDict()
         self._compressed: OrderedDict[tuple, _CompressedEntry] = OrderedDict()
+        # compressed HOST tier (heat-driven tiering): demoted entries in
+        # host RAM, own byte budget + LRU, promoted back on access or by
+        # the ResidencyTierer pass (storage/tiering.py)
+        self._host: OrderedDict[tuple, _HostEntry] = OrderedDict()
         self._bytes = 0
         self._compressed_bytes = 0
+        self._host_bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.compressions = 0
         self.decompressions = 0
+        self.host_hits = 0  # host-tier lookups served (inline promotes)
+        self.tier_promotions = 0  # host -> dense (lookup or pass)
+        self.tier_demotions = 0  # dense/compressed -> host
         self.updates = 0  # in-place scatter updates of derived entries
         self.write_events = 0  # fragment mutations routed through apply_write
         # Snapshot validity counter: bumped whenever an entry is removed
@@ -183,6 +232,10 @@ class DeviceRowCache:
     @property
     def compressed_bytes(self) -> int:
         return self._compressed_bytes
+
+    @property
+    def host_bytes(self) -> int:
+        return self._host_bytes
 
     def touch(self, keys) -> None:
         """Refresh LRU positions without fetching (executor operand-memo
@@ -249,6 +302,19 @@ class DeviceRowCache:
             arr = flat.reshape(centry.shape)
             self._insert_dense(key, arr, centry.block_idx)
             return arr
+        hentry = self._host.pop(key, None)
+        if hentry is not None:
+            # host-tier hit: upload + scatter + promote inline — the
+            # access IS the heat (the tiering pass sweeps what queries
+            # didn't touch). Updaters stayed registered across the
+            # demotion, so the promoted entry keeps its write routing.
+            self.hits += 1
+            self.host_hits += 1
+            self.tier_promotions += 1
+            self._host_bytes -= hentry.nbytes
+            arr = self._upload_host_entry(hentry)
+            self._insert_dense(key, arr, hentry.block_idx)
+            return arr
         return None
 
     def _put_locked(self, key, host, device_put):
@@ -258,7 +324,8 @@ class DeviceRowCache:
         else:
             arr = jax.device_put(host, self.device)
             block_idx = self._host_block_index(host)
-        self._insert_dense(key, arr, block_idx)
+        self._insert_dense(key, arr, block_idx,
+                           custom=device_put is not None)
         cost = current_cost()
         if cost is not None:  # host→device bytes for the active request
             cost.note_upload(int(arr.nbytes))
@@ -379,8 +446,9 @@ class DeviceRowCache:
             return None
         return np.flatnonzero(mask).astype(np.int32)
 
-    def _insert_dense(self, key: tuple, arr, block_idx) -> None:
-        self._rows[key] = _DenseEntry(arr, block_idx)
+    def _insert_dense(self, key: tuple, arr, block_idx,
+                      custom: bool = False) -> None:
+        self._rows[key] = _DenseEntry(arr, block_idx, custom)
         self._bytes += arr.nbytes
         self._evict()
 
@@ -392,13 +460,20 @@ class DeviceRowCache:
             centry = self._compressed.pop(key, None)
             if centry is not None:
                 self._compressed_bytes -= centry.nbytes
-            if entry is not None or centry is not None:
+            # host copies invalidate like compressed ones: decompress+
+            # patch costs more than the re-decode they were demoted to
+            # avoid (apply_write's missing-dense branch lands here)
+            hentry = self._host.pop(key, None)
+            if hentry is not None:
+                self._host_bytes -= hentry.nbytes
+            if entry is not None or centry is not None \
+                    or hentry is not None:
                 self._bump_generation()
             self._drop_updater(key)
 
     def invalidate_fragment(self, frag_id: tuple) -> None:
         with self._lock:
-            for store in (self._rows, self._compressed):
+            for store in (self._rows, self._compressed, self._host):
                 doomed = [k for k in store if k[: len(frag_id)] == frag_id]
                 for k in doomed:
                     self.invalidate(k)
@@ -479,6 +554,210 @@ class DeviceRowCache:
                 else:
                     self.invalidate(key)
 
+# ---------------------------------------------------- host tier (tiering)
+
+    def demote_fragment_to_host(self, scope: str, index: str, field: str,
+                                shard: int) -> tuple[int, int]:
+        """Host-demote every per-fragment entry of one (scope, index,
+        field, shard) — the ResidencyTierer's cold verdict. Returns
+        (entries moved, device bytes freed). A reader between tiers
+        re-decodes from the roaring file (the miss path): old-resident
+        or new-resident, never absent — the scrub read-repair swap
+        discipline."""
+        with self._lock:
+            return self._demote_matching_locked(
+                lambda k: self._frag_match(k, scope, index, field, shard))
+
+    def demote_field_stacks_to_host(self, scope: str, index: str,
+                                    field: str) -> tuple[int, int]:
+        """Host-demote the batched executor's stacked leaves of one
+        field (a leaf spans a whole shard block, so stacks tier at
+        field granularity — the tiering pass uses the field's MAX shard
+        heat). Updaters stay registered: a write routed to a host-tier
+        leaf invalidates it (apply_write's missing-dense branch),
+        exactly like compressed-tier copies."""
+        with self._lock:
+            return self._demote_matching_locked(
+                lambda k: self._stack_match(k, scope, index, field))
+
+    @staticmethod
+    def _frag_match(key: tuple, scope, index, field, shard) -> bool:
+        # frag_id + (row,) / frag_id + ("__planes__", depth):
+        # (scope, index, field, view, shard, ...) — never a stack key
+        # (those lead with a "stack*" tag, not the holder scope)
+        return (len(key) >= 6 and key[0] == scope and key[1] == index
+                and key[2] == field and isinstance(key[4], int)
+                and key[4] == shard
+                and not (isinstance(key[0], str)
+                         and key[0].startswith("stack")))
+
+    @staticmethod
+    def _stack_match(key: tuple, scope, index, field) -> bool:
+        # ("stack"/"stackp", scope, index, field, ...); "stackm"
+        # (mesh-sharded) and "stackz" (the shared zero leaf) never tier
+        return (len(key) >= 4 and key[0] in ("stack", "stackp")
+                and key[1] == scope and key[2] == index
+                and key[3] == field)
+
+    def _demote_matching_locked(self, match) -> tuple[int, int]:
+        moved = 0
+        freed = 0
+        for key in [k for k, e in self._rows.items()
+                    if not e.custom and match(k)]:
+            entry = self._rows.pop(key)
+            self._bytes -= entry.arr.nbytes
+            freed += entry.arr.nbytes
+            self._bump_generation()
+            host = np.asarray(entry.arr).reshape(-1)
+            block_idx = entry.block_idx
+            if block_idx is None:
+                # write-patched entries lost their block index;
+                # recompute from the host copy (occupancy may have
+                # changed either way)
+                block_idx = self._host_block_index(
+                    host.reshape(entry.arr.shape))
+            self._host_insert_locked(key, host, entry.arr.shape,
+                                     block_idx)
+            moved += 1
+        for key in [k for k in self._compressed if match(k)]:
+            centry = self._compressed.pop(key)
+            self._compressed_bytes -= centry.nbytes
+            freed += centry.nbytes
+            self._bump_generation()
+            hentry = _HostEntry(
+                np.asarray(centry.blocks), np.asarray(centry.idx),
+                centry.shape, centry.n_blocks, centry.block_idx,
+            )
+            self._host[key] = hentry
+            self._host_bytes += hentry.nbytes
+            moved += 1
+        if moved:
+            self.tier_demotions += moved
+            self._evict_host_locked()
+        return moved, freed
+
+    def _host_insert_locked(self, key: tuple, flat_host: np.ndarray,
+                            shape, block_idx) -> None:
+        if block_idx is not None and len(block_idx):
+            nb = len(block_idx)
+            nb_padded = next_pow2(nb)
+            idx_host = np.full(nb_padded, block_idx[0], np.int32)
+            idx_host[:nb] = block_idx
+            blocks = flat_host.reshape(
+                -1, COMPRESS_BLOCK_WORDS)[idx_host].copy()
+            hentry = _HostEntry(
+                blocks, idx_host, shape,
+                flat_host.size // COMPRESS_BLOCK_WORDS, block_idx,
+            )
+        else:
+            # incompressible (dense occupancy / odd shape) or all-zero:
+            # park the full flat copy — host RAM is the cheap tier
+            hentry = _HostEntry(flat_host.copy(), None, shape, 0,
+                                block_idx)
+        self._host[key] = hentry
+        self._host_bytes += hentry.nbytes
+
+    def _upload_host_entry(self, hentry: _HostEntry):
+        """Host → device for one host-tier entry: upload the compact
+        blocks and scatter them back to the dense shape (or upload the
+        full array when incompressible). Billed to the active request
+        as upload bytes, like any residency miss."""
+        if hentry.idx is not None:
+            blocks = jax.device_put(hentry.blocks, self.device)
+            idx = jax.device_put(hentry.idx, self.device)
+            flat = _scatter_blocks(blocks, idx, hentry.n_blocks,
+                                   COMPRESS_BLOCK_WORDS)
+            arr = flat.reshape(hentry.shape)
+        else:
+            arr = jax.device_put(
+                hentry.blocks.reshape(hentry.shape), self.device)
+        cost = current_cost()
+        if cost is not None:
+            cost.note_upload(int(arr.nbytes))
+        return arr
+
+    def promote_key(self, key: tuple) -> int:
+        """Tiering-pass promotion of one host-tier entry back to dense
+        residency; returns the host bytes freed, 0 when the key is no
+        longer host-resident (a query's lookup promoted it first — the
+        pacer sleeps OUTSIDE the lock, so this race is expected)."""
+        with self._lock:
+            hentry = self._host.pop(key, None)
+            if hentry is None:
+                return 0
+            self._host_bytes -= hentry.nbytes
+            self.tier_promotions += 1
+            arr = self._upload_host_entry(hentry)
+            self._insert_dense(key, arr, hentry.block_idx)
+            return int(hentry.nbytes)
+
+    def host_keys_of(self, scope: str, index: str, field: str,
+                     shard: int) -> list:
+        """(key, nbytes) of the host-tier entries of one fragment —
+        the tiering pass promotes them outside the lock (paced)."""
+        with self._lock:
+            return [(k, e.nbytes) for k, e in self._host.items()
+                    if self._frag_match(k, scope, index, field, shard)]
+
+    def host_stack_keys_of(self, scope: str, index: str,
+                           field: str) -> list:
+        with self._lock:
+            return [(k, e.nbytes) for k, e in self._host.items()
+                    if self._stack_match(k, scope, index, field)]
+
+    def _evict_host_locked(self) -> None:
+        # LRU within the host tier's own budget; no generation bump
+        # (snapshots only ever hold device arrays)
+        while self._host_bytes > self.host_budget_bytes and self._host:
+            key, hentry = self._host.popitem(last=False)
+            self._host_bytes -= hentry.nbytes
+            self.evictions += 1
+            self._drop_updater(key)
+
+    def tier_overlay(self) -> tuple[dict, dict]:
+        """The tiering manager's world view and the
+        ``/debug/heatmap?tier=true`` column source:
+        ``(per_fragment, per_field_stacks)`` — bytes by tier keyed
+        (scope, index, field, shard) for per-fragment row/plane entries
+        and (scope, index, field) for the batched executor's stacked
+        leaves (a leaf spans a whole shard block). Mesh-sharded and
+        zero leaves are excluded (never tiered)."""
+        with self._lock:
+            stores = (("dense", self._rows,
+                       lambda e: 0 if e.custom else e.arr.nbytes),
+                      ("compressed", self._compressed,
+                       lambda e: e.nbytes),
+                      ("host", self._host, lambda e: e.nbytes))
+            per_frag: dict[tuple, dict] = {}
+            per_stack: dict[tuple, dict] = {}
+            for tier, store, size in stores:
+                for key, entry in store.items():
+                    nbytes = int(size(entry))
+                    if nbytes == 0 and tier == "dense":
+                        continue  # custom placement: not tierable
+                    tag = key[0]
+                    if isinstance(tag, str) and tag.startswith("stack"):
+                        # the stack test runs FIRST (residency_overlay's
+                        # order): a plane-stack key ("stackp", scope,
+                        # index, field, 2+depth, block) is len 6 with an
+                        # int at [4] and would otherwise masquerade as a
+                        # fragment entry under a bogus key with heat 0 —
+                        # demoted every pass no matter how hot the field
+                        if tag not in ("stack", "stackp") or len(key) < 4:
+                            continue  # stackm (mesh) / stackz: not tiered
+                        out, okey = per_stack, (key[1], key[2], key[3])
+                    elif len(key) >= 6 and isinstance(key[4], int):
+                        out, okey = per_frag, (key[0], key[1], key[2],
+                                               key[4])
+                    else:
+                        continue
+                    slot = out.get(okey)
+                    if slot is None:
+                        slot = out[okey] = {"dense": 0, "compressed": 0,
+                                            "host": 0}
+                    slot[tier] += nbytes
+        return per_frag, per_stack
+
     def residency_overlay(self) -> tuple[dict, dict]:
         """HBM residency bucketed for the heat map (/debug/heatmap):
         ``(per_fragment, per_field)`` — exact bytes per (scope, index,
@@ -517,6 +796,8 @@ class DeviceRowCache:
         "residency_hits", "residency_misses", "residency_evictions",
         "residency_compressions", "residency_decompressions",
         "residency_updates", "residency_write_events",
+        "residency_host_hits", "residency_tier_promotions",
+        "residency_tier_demotions",
     })
 
     def metrics(self) -> dict:
@@ -537,6 +818,12 @@ class DeviceRowCache:
                 "residency_decompressions": self.decompressions,
                 "residency_updates": self.updates,
                 "residency_write_events": self.write_events,
+                "residency_entries_host": len(self._host),
+                "residency_bytes_host": self._host_bytes,
+                "residency_host_budget_bytes": self.host_budget_bytes,
+                "residency_host_hits": self.host_hits,
+                "residency_tier_promotions": self.tier_promotions,
+                "residency_tier_demotions": self.tier_demotions,
             }
 
     def prometheus_lines(self, prefix: str = "pilosa_tpu",
@@ -565,10 +852,12 @@ class DeviceRowCache:
             self._bump_generation()
             self._rows.clear()
             self._compressed.clear()
+            self._host.clear()
             self._updaters.clear()
             self._tag_index.clear()
             self._bytes = 0
             self._compressed_bytes = 0
+            self._host_bytes = 0
 
     def _evict(self) -> None:
         # Demotion only under real pressure: the dense tier may use the

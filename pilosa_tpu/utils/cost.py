@@ -176,9 +176,13 @@ class QueryProfile:
         self.remote: list[dict] = []
         # serving-wave facts (set by server/pipeline.py): a dedupe hit
         # means this request rode an identical wavemate's execution —
-        # the honest explanation for a near-zero tree
+        # the honest explanation for a near-zero tree. result_cache_hit
+        # is its cross-wave sibling (serving/rescache.py): the request
+        # was answered from pre-serialized cached bytes, no execution
+        # at all (the API emits a stub tree with the flag set).
         self.wave_size = 1
         self.dedupe_hit = False
+        self.result_cache_hit = False
 
     def node_for(self, i: int, call) -> ProfileNode:
         with self._lock:
@@ -208,6 +212,7 @@ class QueryProfile:
             "pql": self.pql[:1024],
             "wave": self.wave_size,
             "dedupeHit": self.dedupe_hit,
+            "resultCacheHit": self.result_cache_hit,
             "calls": calls,
             "remote": list(self.remote),
         }
@@ -392,10 +397,11 @@ def deactivate_cost(token) -> None:
 # ---------------------------------------------------------------- ledger
 
 
-# Ledger counter names, in snapshot/export order.
+# Ledger counter names, in snapshot/export order. New columns append
+# (the fold indexes below are positional).
 _LEDGER_KEYS = ("queries", "errors", "wall_ms", "device_ms",
                 "container_scans", "row_cache_misses", "rows_materialized",
-                "ingest_rows", "egress_bytes")
+                "ingest_rows", "egress_bytes", "result_cache_hits")
 
 # Bounded tenant-pair cardinality: a tenant-id flood must not grow the
 # ledger (or the /metrics page) without bound; overflow lands in one
@@ -430,13 +436,21 @@ class CostLedger:
 
     def record_query(self, tenant: str, index: str,
                      ctx: CostContext | None, elapsed_s: float,
-                     error: bool = False) -> None:
+                     error: bool = False,
+                     result_cache_hit: bool = False) -> None:
+        """``result_cache_hit`` bills a serving-fast-lane cache hit as a
+        query with near-zero device-ms (its ctx carries no dispatches)
+        instead of letting it vanish from the ledger — /debug/tenants
+        stays the truth about who the node serves, not just who it
+        executes for."""
         with self._lock:
             e = self._entry(tenant, index)
             e[0] += 1
             if error:
                 e[1] += 1
             e[2] += elapsed_s * 1e3
+            if result_cache_hit:
+                e[9] += 1
             if ctx is not None:
                 e[3] += ctx.device_s * 1e3
                 e[4] += ctx.container_scans()

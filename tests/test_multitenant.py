@@ -1,0 +1,767 @@
+"""Skewed-traffic actuators (ISSUE 12): the write-invalidated result
+cache (serving/rescache.py) and heat-driven HBM residency tiering
+(storage/tiering.py + the DeviceRowCache host tier).
+
+Covers: cache unit semantics (eligibility, per-field vs index-wide
+invalidation, the fill-race version fence, heat-weighted eviction),
+read-your-writes through the HTTP cache path (an acked write is never
+masked by stale cached bytes — sequential and under concurrent write/
+fill races, single-process AND through different mp-serving workers'
+rings), the cost-plane satellites (PROFILE resultCacheHit, tenant
+ledger billing), the /debug/rescache + /debug/heatmap?tier= surfaces,
+metrics exposition, tiering demote/promote/hysteresis/pacing, and the
+ServerConfig knob roundtrips."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.pql import parse
+from pilosa_tpu.serving import rescache
+from pilosa_tpu.serving.rescache import (
+    ResultCache,
+    global_result_cache,
+    query_field_deps,
+)
+from pilosa_tpu.server import Server, ServerConfig
+from pilosa_tpu.storage import residency
+from pilosa_tpu.storage.heat import HeatMap
+from pilosa_tpu.storage.residency import DeviceRowCache
+from pilosa_tpu.storage.tiering import ResidencyTierer
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+
+
+@pytest.fixture(autouse=True)
+def _isolated_result_cache():
+    """A fresh disabled global per test: entries are scope-qualified,
+    but counters and budget must not leak across tests."""
+    rescache.set_global_result_cache(ResultCache(0))
+    yield
+    rescache.set_global_result_cache(ResultCache(0))
+
+
+def _req(port, method, path, body=None, headers=None, timeout=30):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body, method=method, headers=headers or {},
+    )
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _query(port, index, pql, headers=None, path_suffix=""):
+    return _req(port, "POST", f"/index/{index}/query{path_suffix}",
+                pql.encode(), headers=headers)
+
+
+# ---------------------------------------------------------------- unit
+
+
+class TestResultCacheUnit:
+    def test_insert_lookup_roundtrip(self):
+        c = ResultCache(1 << 20)
+        snap = c.version()
+        assert c.lookup("s", "i", "Count(Row(f=1))") is None
+        assert c.insert("s", "i", "Count(Row(f=1))", b'{"results":[3]}',
+                        frozenset({"f"}), snap)
+        assert c.lookup("s", "i", "Count(Row(f=1))") == b'{"results":[3]}'
+        # whitespace-trim normalization, scope isolation
+        assert c.peek("s", "i", "  Count(Row(f=1))  ") == b'{"results":[3]}'
+        assert c.peek("other", "i", "Count(Row(f=1))") is None
+        m = c.metrics()
+        assert m["result_cache_hits_total"] == 1
+        assert m["result_cache_misses_total"] == 1
+        assert m["result_cache_fills_total"] == 1
+
+    def test_field_precise_invalidation(self):
+        c = ResultCache(1 << 20)
+        c.insert("s", "i", "Count(Row(f=1))", b"f", frozenset({"f"}),
+                 c.version())
+        c.insert("s", "i", "Count(Row(g=1))", b"g", frozenset({"g"}),
+                 c.version())
+        c.invalidate("s", "i", "g", 0)
+        assert c.peek("s", "i", "Count(Row(f=1))") == b"f"
+        assert c.peek("s", "i", "Count(Row(g=1))") is None
+        # a different index's write touches nothing
+        c.invalidate("s", "other", "f", 0)
+        assert c.peek("s", "i", "Count(Row(f=1))") == b"f"
+        c.invalidate("s", "i", "f", 3)
+        assert c.peek("s", "i", "Count(Row(f=1))") is None
+
+    def test_wildcard_entries_die_on_any_write(self):
+        c = ResultCache(1 << 20)
+        # fields=None = depends on the whole index (TopN/Not/All shapes)
+        c.insert("s", "i", "TopN(f, n=5)", b"t", None, c.version())
+        c.invalidate("s", "i", "unrelated_field", 9)
+        assert c.peek("s", "i", "TopN(f, n=5)") is None
+
+    def test_index_wide_invalidation(self):
+        c = ResultCache(1 << 20)
+        c.insert("s", "i", "Count(Row(f=1))", b"f", frozenset({"f"}),
+                 c.version())
+        c.invalidate_index_wide("s", "i")
+        assert c.peek("s", "i", "Count(Row(f=1))") is None
+
+    def test_fill_race_refused(self):
+        """The cutoff discipline: a write landing between the fill's
+        snapshot and its insert must refuse the insert — for precise,
+        wildcard, AND index-wide events."""
+        c = ResultCache(1 << 20)
+        snap = c.version()
+        c.invalidate("s", "i", "f", 0)
+        assert not c.insert("s", "i", "Count(Row(f=1))", b"stale",
+                            frozenset({"f"}), snap)
+        assert c.peek("s", "i", "Count(Row(f=1))") is None
+        assert c.metrics()["result_cache_fill_races_total"] == 1
+        # unrelated field's write does NOT refuse a precise fill
+        snap = c.version()
+        c.invalidate("s", "i", "g", 0)
+        assert c.insert("s", "i", "Count(Row(f=1))", b"ok",
+                        frozenset({"f"}), snap)
+        # ... but DOES refuse a wildcard fill
+        snap = c.version()
+        c.invalidate("s", "i", "g", 0)
+        assert not c.insert("s", "i", "TopN(f)", b"stale", None, snap)
+        # index-wide event refuses a precise fill of an untouched field
+        snap = c.version()
+        c.invalidate_index_wide("s", "i")
+        assert not c.insert("s", "i", "Count(Row(h=1))", b"stale",
+                            frozenset({"h"}), snap)
+
+    def test_clear_fences_inflight_fills(self):
+        c = ResultCache(1 << 20)
+        snap = c.version()
+        c.clear()
+        assert not c.insert("s", "i", "Count(Row(f=1))", b"stale",
+                            frozenset({"f"}), snap)
+
+    def test_dep_version_table_bounded(self):
+        """Field-cardinality churn must not grow the fence table
+        forever: past MAX_DEP_VERSIONS the oldest half is pruned and the
+        fill floor rises, so a fill snapshotted before the prune refuses
+        (it can no longer prove its deps' history) while a fresh fill
+        still lands."""
+        from pilosa_tpu.serving.rescache import MAX_DEP_VERSIONS
+
+        c = ResultCache(1 << 20)
+        old_snap = c.version()
+        for j in range(MAX_DEP_VERSIONS + 10):
+            c.invalidate("s", "i", f"churn{j}", 0)
+        assert len(c._dep_version) <= MAX_DEP_VERSIONS
+        assert not c.insert("s", "i", "Count(Row(f=1))", b"stale",
+                            frozenset({"f"}), old_snap)
+        assert c.insert("s", "i", "Count(Row(f=1))", b"ok",
+                        frozenset({"f"}), c.version())
+        assert c.peek("s", "i", "Count(Row(f=1))") == b"ok"
+
+    def test_heat_weighted_eviction(self):
+        """Overflow evicts the coldest entries: one hot entry survives
+        a burst of one-off fills that would flush a plain LRU."""
+        c = ResultCache(4096, half_life_s=300.0)
+        payload = b"x" * 64
+        assert c.insert("s", "i", "hot", payload, frozenset({"f"}),
+                        c.version())
+        for _ in range(50):
+            c.record_hit("s", "i", "hot")
+        for j in range(40):  # ~40 * (64+overhead) >> budget
+            c.insert("s", "i", f"cold{j}", payload, frozenset({"f"}),
+                     c.version())
+        assert c.peek("s", "i", "hot") == payload
+        assert c.metrics()["result_cache_evictions_total"] > 0
+        assert c.metrics()["result_cache_bytes"] <= 4096
+
+    def test_disabled_budget_zero(self):
+        c = ResultCache(0)
+        assert not c.enabled
+        assert not c.insert("s", "i", "q", b"x", None, c.version())
+        assert c.peek("s", "i", "q") is None
+
+    def test_configure_shrink_and_disable(self):
+        c = ResultCache(1 << 20)
+        c.insert("s", "i", "q", b"x" * 100, None, c.version())
+        c.configure(0)
+        assert c.peek("s", "i", "q") is None and not c.enabled
+
+
+class TestFieldDeps:
+    @pytest.mark.parametrize("pql,want", [
+        ("Count(Row(f=1))", {"f"}),
+        ("Row(f=1)", {"f"}),
+        ("Count(Intersect(Row(f=1), Row(g=2)))", {"f", "g"}),
+        ("Sum(Row(f=1), field=sal)", {"f", "sal"}),
+        ("Min(field=sal)", {"sal"}),
+        ("Range(fare > 10)", {"fare"}),
+        ("Count(Union(Row(a=1), Xor(Row(b=1), Row(c=1))))",
+         {"a", "b", "c"}),
+        ("Count(Difference(Row(f=1), Row(g=1)))", {"f", "g"}),
+    ])
+    def test_precise_shapes(self, pql, want):
+        assert query_field_deps(parse(pql)) == frozenset(want)
+
+    @pytest.mark.parametrize("pql", [
+        "Count(Not(Row(f=1)))",   # existence field
+        "All()",                  # existence field
+        "TopN(f, n=5)",           # rank cache
+        "GroupBy(Rows(f))",       # row enumeration
+    ])
+    def test_index_wide_shapes(self, pql):
+        assert query_field_deps(parse(pql)) is None
+
+    @pytest.mark.parametrize("pql,want", [
+        # a Condition key IS the field even when it collides with a
+        # parameter name (condition_field applies no reserved filter)
+        ("Range(n > 10)", {"n"}),
+        ("Count(Row(limit > 5))", {"limit"}),
+        # per-call parameters stay skipped without losing precision
+        ("Shift(Row(f=1), n=2)", {"f"}),
+        ("Row(t=1, from='2019-01-01T00:00', to='2019-12-31T00:00')",
+         {"t"}),
+    ])
+    def test_reserved_name_collisions_precise(self, pql, want):
+        assert query_field_deps(parse(pql)) == frozenset(want)
+
+    @pytest.mark.parametrize("pql", [
+        # keys the executor reserves for OTHER call shapes are ambiguous
+        # here: whether Row(n=1) names a field lives in executor code,
+        # so the cache must assume whole-index rather than record a dep
+        # set that misses the write ("n"/"field" are legal field names)
+        "Count(Intersect(Row(n=1), Row(f=2)))",
+        "Count(Row(field=1))",
+        "Count(Row(limit=3))",
+    ])
+    def test_ambiguous_reserved_args_bail_index_wide(self, pql):
+        assert query_field_deps(parse(pql)) is None
+
+    def test_batched_import_one_invalidation_event(self, tmp_path):
+        """The batched import tail (_apply_batch_locked: mutex + BSI
+        paths) issues ONE result-cache write event per batch, like
+        _after_rows_added — not one per touched row (a bit_depth-32 BSI
+        import would otherwise take the global cache lock ~34x per
+        shard and inflate the invalidation counter to match)."""
+        from pilosa_tpu.storage.fragment import Fragment
+
+        rescache.set_global_result_cache(ResultCache(1 << 20))
+        try:
+            frag = Fragment(str(tmp_path / "f"), "i", "f", "standard",
+                            0).open()
+            cache = rescache.global_result_cache()
+            before = cache.metrics()["result_cache_invalidations_total"]
+            frag.import_bsi(np.arange(16, dtype=np.uint64),
+                            np.arange(16, dtype=np.uint64) + 1, 8)
+            after = cache.metrics()["result_cache_invalidations_total"]
+            assert after - before == 1
+            frag.close()
+        finally:
+            rescache.set_global_result_cache(ResultCache(0))
+
+    def test_ambiguous_args_mirror_executor_reserved(self):
+        """_AMBIGUOUS_ARGS is a hand-copied mirror of the executor's
+        reserved-arg set (a module-level import would cycle through the
+        fragment write hooks). Drift is a silent RYW hazard: a new
+        reserved key unknown to the cache would be recorded as a field
+        dependency, and writes to the REAL field would never invalidate
+        the entry."""
+        from pilosa_tpu.executor.executor import _RESERVED_ARGS
+        from pilosa_tpu.serving.rescache import _AMBIGUOUS_ARGS
+
+        assert _AMBIGUOUS_ARGS == set(_RESERVED_ARGS)
+
+
+# ------------------------------------------------------- http integration
+
+
+@pytest.fixture
+def cache_server(tmp_path):
+    server = Server(ServerConfig(
+        data_dir=str(tmp_path / "d"), port=0, anti_entropy_interval=0,
+        heartbeat_interval=0, use_mesh=False,
+        result_cache_bytes=8 << 20,
+    )).open()
+    port = server.port
+    _req(port, "POST", "/index/i", b"{}")
+    _req(port, "POST", "/index/i/field/f", b"{}")
+    _req(port, "POST", "/index/i/field/g", b"{}")
+    for col in (1, 2, 70):
+        assert _query(port, "i", f"Set({col}, f=1)")[0] == 200
+    try:
+        yield server
+    finally:
+        server.close()
+
+
+class TestServingIntegration:
+    def test_hit_serves_identical_bytes(self, cache_server):
+        port = cache_server.port
+        st1, b1 = _query(port, "i", "Count(Row(f=1))")
+        st2, b2 = _query(port, "i", "Count(Row(f=1))")
+        assert (st1, st2) == (200, 200) and b1 == b2 == b'{"results":[3]}'
+        m = global_result_cache().metrics()
+        assert m["result_cache_hits_total"] >= 1
+        assert m["result_cache_fills_total"] >= 1
+
+    def test_read_your_writes_after_ack(self, cache_server):
+        port = cache_server.port
+        _query(port, "i", "Count(Row(f=1))")
+        _query(port, "i", "Count(Row(f=1))")  # cached now
+        assert _query(port, "i", "Set(99, f=1)")[0] == 200
+        st, body = _query(port, "i", "Count(Row(f=1))")
+        assert json.loads(body)["results"] == [4], \
+            "acked write masked by a stale cached result"
+
+    def test_import_invalidates(self, cache_server):
+        port = cache_server.port
+        _query(port, "i", "Count(Row(g=7))")
+        _query(port, "i", "Count(Row(g=7))")
+        st, _ = _req(port, "POST", "/index/i/field/g/import",
+                     json.dumps({"rows": [7, 7], "columns": [5, 6]})
+                     .encode())
+        assert st == 200
+        st, body = _query(port, "i", "Count(Row(g=7))")
+        assert json.loads(body)["results"] == [2]
+
+    def test_unrelated_field_write_keeps_entry(self, cache_server):
+        port = cache_server.port
+        _query(port, "i", "Count(Row(f=1))")
+        fills = global_result_cache().metrics()["result_cache_fills_total"]
+        assert _query(port, "i", "Set(5, g=3)")[0] == 200
+        st, body = _query(port, "i", "Count(Row(f=1))")
+        assert json.loads(body)["results"] == [3]
+        m = global_result_cache().metrics()
+        # served from cache: no refill happened after the g write
+        assert m["result_cache_fills_total"] == fills
+        assert m["result_cache_hits_total"] >= 1
+
+    def test_attr_write_invalidates(self, cache_server):
+        port = cache_server.port
+        st, b1 = _query(port, "i", "Row(f=1)")
+        _query(port, "i", "Row(f=1)")
+        assert _query(port, "i", 'SetRowAttrs(f, 1, tag="hot")')[0] == 200
+        st, b2 = _query(port, "i", "Row(f=1)")
+        assert json.loads(b2)["results"][0]["attrs"] == {"tag": "hot"}, \
+            "attr write masked by a stale cached result"
+
+    def test_profile_reports_result_cache_hit(self, cache_server):
+        port = cache_server.port
+        _query(port, "i", "Count(Row(f=1))")
+        st, body = _query(port, "i", "Count(Row(f=1))",
+                          path_suffix="?profile=true")
+        prof = json.loads(body)["profile"]
+        assert prof["resultCacheHit"] is True
+        assert json.loads(body)["results"] == [3]
+        # a MISS profile carries the flag too, as False
+        st, body = _query(port, "i", "Count(Row(f=2))",
+                          path_suffix="?profile=true")
+        assert json.loads(body)["profile"]["resultCacheHit"] is False
+
+    def test_ledger_bills_hits(self, cache_server):
+        port = cache_server.port
+        hdr = {"X-Pilosa-Tenant": "acme"}
+        _query(port, "i", "Count(Row(f=1))", headers=hdr)
+        for _ in range(3):
+            _query(port, "i", "Count(Row(f=1))", headers=hdr)
+        st, body = _req(port, "GET", "/debug/tenants")
+        rows = {r["tenant"]: r for r in json.loads(body)["tenants"]}
+        assert rows["acme"]["queries"] == 4
+        assert rows["acme"]["result_cache_hits"] == 3
+
+    def test_debug_rescache_endpoint(self, cache_server):
+        port = cache_server.port
+        _query(port, "i", "Count(Row(f=1))")
+        _query(port, "i", "Count(Row(f=1))")
+        st, body = _req(port, "GET", "/debug/rescache")
+        out = json.loads(body)
+        assert st == 200 and out["enabled"] is True
+        assert out["result_cache_entries"] == 1
+        (entry,) = out["entries"]
+        assert entry["pql"] == "Count(Row(f=1))"
+        assert entry["fields"] == ["f"]
+        assert entry["hits"] >= 1
+        # k must be positive, like the sibling debug endpoints
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(port, "GET", "/debug/rescache?k=-1")
+        assert ei.value.code == 400
+
+    def test_metrics_exposition(self, cache_server):
+        port = cache_server.port
+        _query(port, "i", "Count(Row(f=1))")
+        _query(port, "i", "Count(Row(f=1))")
+        st, body = _req(port, "GET", "/metrics")
+        text = body.decode()
+        for family, mtype in [
+            ("pilosa_tpu_result_cache_hits_total", "counter"),
+            ("pilosa_tpu_result_cache_bytes", "gauge"),
+            ("pilosa_tpu_residency_tier_passes_total", "counter"),
+            ("pilosa_tpu_residency_bytes_host", "gauge"),
+            ("pilosa_tpu_residency_tier_promotions_total", "counter"),
+        ]:
+            assert f"# TYPE {family} {mtype}" in text, family
+        st, body = _req(port, "GET", "/debug/vars")
+        out = json.loads(body)
+        assert out["result_cache"]["result_cache_hits_total"] >= 1
+        assert "residency_tier_passes_total" in out["residency_tiering"]
+
+    def test_concurrent_write_read_your_writes(self, cache_server):
+        """The invalidation-race gate: writers group-committing while
+        readers race fills — every writer's own read-after-ack must
+        observe its write (rows disjoint per writer, so each thread's
+        oracle is exact)."""
+        port = cache_server.port
+        errors: list = []
+
+        def writer(row):
+            try:
+                for k in range(12):
+                    st, _ = _query(port, "i", f"Set({1000 + k}, g={row})")
+                    assert st == 200
+                    st, body = _query(port, "i", f"Count(Row(g={row}))")
+                    got = json.loads(body)["results"][0]
+                    assert got == k + 1, \
+                        f"row {row}: acked {k + 1} writes, read {got}"
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                for _ in range(40):
+                    _query(port, "i", "Count(Row(g=21))")
+                    _query(port, "i", "Count(Row(g=22))")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = ([threading.Thread(target=writer, args=(r,))
+                    for r in (21, 22)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+
+
+@pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),
+                    reason="multi-process serving needs SO_REUSEPORT")
+class TestMpServing:
+    def test_read_your_writes_across_worker_rings(self, tmp_path):
+        """The mp-serving variant of the oracle: the cache lives
+        owner-side, writes arrive via one worker's ring, reads via
+        another's (urllib opens a fresh connection per request, so the
+        kernel spreads them across the SO_REUSEPORT group)."""
+        server = Server(ServerConfig(
+            data_dir=str(tmp_path / "mp"), port=0, serving_workers=2,
+            anti_entropy_interval=0, heartbeat_interval=0, use_mesh=False,
+            result_cache_bytes=8 << 20,
+        )).open()
+        try:
+            port = server.port
+            _req(port, "POST", "/index/i", b"{}")
+            _req(port, "POST", "/index/i/field/f", b"{}")
+            for k in range(15):
+                st, _ = _query(port, "i", f"Set({k}, f=3)")
+                assert st == 200
+                st, body = _query(port, "i", "Count(Row(f=3))")
+                got = json.loads(body)["results"][0]
+                assert got == k + 1, \
+                    f"acked {k + 1} writes, worker read {got} (stale)"
+            # write-interleaved reads above each refilled (every write
+            # invalidated); a quiet stretch of identical reads is
+            # cache-served owner-side across whichever workers' rings
+            for _ in range(4):
+                st, body = _query(port, "i", "Count(Row(f=3))")
+                assert json.loads(body)["results"] == [15]
+            assert (global_result_cache().metrics()
+                    ["result_cache_hits_total"]) >= 1
+            st, body = _req(port, "GET", "/debug/tenants")
+            rows = {r["tenant"]: r for r in json.loads(body)["tenants"]}
+            assert rows["default"]["result_cache_hits"] >= 1
+        finally:
+            server.close()
+
+
+# ----------------------------------------------------------- tiering
+
+
+def _mkrow(seed):
+    a = np.zeros(WORDS_PER_SHARD, np.uint32)
+    a[seed * 512:seed * 512 + 8] = 5
+    return a
+
+
+class TestTiering:
+    def test_demote_promote_cycle(self):
+        cache = DeviceRowCache(budget_bytes=64 << 20,
+                               host_budget_bytes=8 << 20)
+        heat = HeatMap(half_life_s=60.0)
+        scope = "/d/i"
+        for shard in range(2):
+            for row in range(2):
+                cache.get_row((scope, "i", "f", "standard", shard, row),
+                              lambda r=row: _mkrow(r + 1))
+        heat.record_access("i", "f", [0], n=50.0, scope=scope)
+        t = ResidencyTierer(cache=cache, heat=heat, interval_s=0,
+                            promote_heat=4.0, demote_heat=1.0,
+                            min_dwell_s=0)
+        out = t.run_pass()
+        assert out["demoted"] == 2  # shard 1's two rows
+        assert cache.metrics()["residency_entries_host"] == 2
+        assert cache.host_bytes > 0
+        per_frag, _ = cache.tier_overlay()
+        assert per_frag[(scope, "i", "f", 1)]["host"] > 0
+        assert per_frag[(scope, "i", "f", 1)]["dense"] == 0
+        # heat returns -> the pass promotes (worker-driven)
+        heat.record_access("i", "f", [1], n=50.0, scope=scope)
+        out = t.run_pass()
+        assert out["promoted"] == 2
+        assert cache.metrics()["residency_entries_host"] == 0
+        # and the data survived the round trip bit-exact
+        arr = cache.get_row((scope, "i", "f", "standard", 1, 0),
+                            lambda: (_ for _ in ()).throw(
+                                AssertionError("should be resident")))
+        assert np.array_equal(np.asarray(arr), _mkrow(1))
+
+    def test_plane_stack_tiers_at_field_granularity(self):
+        """A BSI plane-stack leaf ('stackp', scope, index, field,
+        2+depth, block) is len 6 with an int at [4]: it must classify
+        as a stacked-field entry in tier_overlay, not masquerade as a
+        fragment under a bogus key whose heat is forever 0 (which
+        demoted hot plane stacks every pass, bypassing the field-max
+        heat protection)."""
+        cache = DeviceRowCache(budget_bytes=64 << 20)
+        heat = HeatMap(half_life_s=60.0)
+        scope = "/d/i"
+        key = ("stackp", scope, "i", "f", 5, (0, 4))
+        cache.get_row(key, lambda: _mkrow(1))
+        per_frag, per_stack = cache.tier_overlay()
+        assert (scope, "i", "f") in per_stack
+        assert not any(k[0] == "stackp" for k in per_frag)
+        # hot field -> the pass must leave the stack device-resident
+        heat.record_access("i", "f", [0], n=50.0, scope=scope)
+        t = ResidencyTierer(cache=cache, heat=heat, interval_s=0,
+                            promote_heat=4.0, demote_heat=1.0,
+                            min_dwell_s=0)
+        out = t.run_pass()
+        assert out["demoted"] == 0
+        assert t.last_decisions()[(scope, "i", "f")] == "resident"
+        # cold field -> demoted at field granularity; re-heat -> the
+        # pass promotes it back bit-exact
+        heat.clear()
+        out = t.run_pass()
+        assert out["demoted"] == 1
+        assert cache.metrics()["residency_entries_host"] == 1
+        assert t.last_decisions()[(scope, "i", "f")] == "demoted"
+        heat.record_access("i", "f", [0], n=50.0, scope=scope)
+        out = t.run_pass()
+        assert out["promoted"] == 1
+        assert cache.metrics()["residency_entries_host"] == 0
+        arr = cache.get_row(key, lambda: (_ for _ in ()).throw(
+            AssertionError("should be resident after promote")))
+        assert np.array_equal(np.asarray(arr), _mkrow(1))
+
+    def test_host_hit_promotes_on_access(self):
+        cache = DeviceRowCache(budget_bytes=64 << 20)
+        heat = HeatMap()
+        scope = "/d/i"
+        key = (scope, "i", "f", "standard", 0, 1)
+        cache.get_row(key, lambda: _mkrow(2))
+        cache.demote_fragment_to_host(scope, "i", "f", 0)
+        assert cache.metrics()["residency_entries_host"] == 1
+        arr = cache.get_row(key, lambda: (_ for _ in ()).throw(
+            AssertionError("host tier must serve without a decode")))
+        assert np.array_equal(np.asarray(arr), _mkrow(2))
+        assert cache.host_hits == 1 and cache.tier_promotions == 1
+        assert cache.metrics()["residency_entries_host"] == 0
+
+    def test_write_invalidates_host_copy(self):
+        cache = DeviceRowCache(budget_bytes=64 << 20)
+        scope = "/d/i"
+        key = (scope, "i", "f", "standard", 0, 1)
+        cache.get_row(key, lambda: _mkrow(1))
+        cache.demote_fragment_to_host(scope, "i", "f", 0)
+        cache.invalidate(key)  # what _after_row_write does
+        assert cache.metrics()["residency_entries_host"] == 0
+        # next read decodes fresh (miss), never serves the stale copy
+        fresh = _mkrow(3)
+        arr = cache.get_row(key, lambda: fresh)
+        assert np.array_equal(np.asarray(arr), fresh)
+
+    def test_hysteresis_dwell_blocks_flipflop(self):
+        cache = DeviceRowCache(budget_bytes=64 << 20)
+        heat = HeatMap(half_life_s=60.0)
+        scope = "/d/i"
+        key = (scope, "i", "f", "standard", 0, 1)
+        cache.get_row(key, lambda: _mkrow(1))
+        cache.demote_fragment_to_host(scope, "i", "f", 0)
+        heat.record_access("i", "f", [0], n=50.0, scope=scope)
+        t = ResidencyTierer(cache=cache, heat=heat, interval_s=0,
+                            promote_heat=4.0, demote_heat=1.0,
+                            min_dwell_s=3600.0)
+        assert t.run_pass()["promoted"] == 1
+        heat.clear()  # heat vanishes -> candidate for demotion...
+        out = t.run_pass()
+        assert out["demoted"] == 0  # ...but the dwell holds it resident
+        assert t.last_decisions()[(scope, "i", "f", 0)] == "hold"
+        t.min_dwell_s = 0.0
+        assert t.run_pass()["demoted"] == 1
+
+    def test_host_budget_bounds_tier(self):
+        cache = DeviceRowCache(budget_bytes=64 << 20,
+                               host_budget_bytes=6000)
+        scope = "/d/i"
+        for shard in range(4):
+            cache.get_row((scope, "i", "f", "standard", shard, 1),
+                          lambda s=shard: _mkrow(s + 1))
+            cache.demote_fragment_to_host(scope, "i", "f", shard)
+        assert cache.host_bytes <= 6000
+        assert cache.evictions > 0
+
+    def test_pacer_shapes_promotions(self):
+        from pilosa_tpu.parallel.pacer import RepairPacer
+
+        cache = DeviceRowCache(budget_bytes=64 << 20)
+        heat = HeatMap(half_life_s=60.0)
+        scope = "/d/i"
+        for row in range(3):
+            cache.get_row((scope, "i", "f", "standard", 0, row),
+                          lambda r=row: _mkrow(r + 1))
+        cache.demote_fragment_to_host(scope, "i", "f", 0)
+        heat.record_access("i", "f", [0], n=50.0, scope=scope)
+        pacer = RepairPacer(max_bytes_per_sec=65536)
+        pacer.consume(2 * 65536)  # drain the burst: next debit overdraws
+        t = ResidencyTierer(cache=cache, heat=heat, interval_s=0,
+                            promote_heat=4.0, demote_heat=1.0,
+                            min_dwell_s=0, pacer=pacer)
+        t0 = time.monotonic()
+        out = t.run_pass()
+        assert out["promoted"] == 3
+        assert out["pacedSleepS"] > 0, \
+            "promotion uploads must debit the pacer's token bucket"
+        assert (t.metrics()
+                ["residency_tier_paced_sleep_seconds_total"]) > 0
+        assert time.monotonic() - t0 >= out["pacedSleepS"] * 0.5
+
+    def test_heatmap_tier_view(self, tmp_path):
+        """GET /debug/heatmap?tier=true shows the tiering decisions
+        beside raw heat — resident vs host vs cold, with the last
+        pass's verdicts."""
+        server = Server(ServerConfig(
+            data_dir=str(tmp_path / "d"), port=0, anti_entropy_interval=0,
+            heartbeat_interval=0, use_mesh=False,
+            residency_promote_interval=3600.0,  # worker parked: manual
+            residency_promote_heat=3.0, residency_demote_heat=0.5,
+            heat_half_life=0.4,
+        )).open()
+        try:
+            port = server.port
+            assert server.api.tierer is not None
+            for name in ("hot", "cold"):
+                _req(port, "POST", f"/index/{name}", b"{}")
+                _req(port, "POST", f"/index/{name}/field/f", b"{}")
+                _query(port, name, "Set(1, f=1)")
+                _query(port, name, "Count(Row(f=1))")
+            time.sleep(1.3)  # both cool below demote-heat
+            for _ in range(12):
+                _query(port, "hot", "Count(Row(f=1))")  # re-heat hot
+            out = server.api.tierer.run_pass()
+            assert out["demoted"] >= 1
+            st, body = _req(port, "GET", "/debug/heatmap?tier=true&k=50")
+            snap = json.loads(body)
+            assert snap["tiering"]["enabled"] is True
+            tiers = {(r["index"], r["field"]): r.get("tier")
+                     for r in snap["shards"]}
+            assert tiers[("cold", "f")] == "host"
+            assert tiers[("hot", "f")] in ("resident", "compressed")
+            decisions = {r["index"]: r.get("tierDecision")
+                         for r in snap["shards"] if "tierDecision" in r}
+            assert decisions.get("cold") == "demoted"
+            # serving keeps working across the tier transition
+            st, body = _query(port, "cold", "Count(Row(f=1))")
+            assert (st, json.loads(body)["results"]) == (200, [1])
+        finally:
+            server.close()
+
+
+# ------------------------------------------------------------- config
+
+
+class TestKnobs:
+    def test_roundtrip(self):
+        cfg = ServerConfig.from_dict({
+            "result-cache-bytes": "33554432",
+            "residency-promote-interval": "1m30s",
+            "residency-promote-heat": "6.5",
+            "residency-demote-heat": "2.5",
+            "residency-host-tier-bytes": "2147483648",
+        })
+        assert cfg.result_cache_bytes == 33554432
+        assert cfg.residency_promote_interval == 90.0
+        assert cfg.residency_promote_heat == 6.5
+        assert cfg.residency_demote_heat == 2.5
+        assert cfg.residency_host_tier_bytes == 2 << 30
+        d = cfg.to_dict()
+        assert d["result-cache-bytes"] == 33554432
+        assert d["residency-promote-interval"] == 90.0
+        cfg2 = ServerConfig.from_dict(d)
+        assert cfg2.to_dict() == d
+
+    def test_snake_case_fallback(self):
+        cfg = ServerConfig.from_dict({
+            "result_cache_bytes": 1024,
+            "residency_promote_interval": 2.0,
+        })
+        assert cfg.result_cache_bytes == 1024
+        assert cfg.residency_promote_interval == 2.0
+
+    @pytest.mark.parametrize("kwargs", [
+        {"result_cache_bytes": -1},
+        {"residency_promote_interval": -1.0},
+        {"residency_demote_heat": -0.5},
+        {"residency_host_tier_bytes": -1},
+        # promote must exceed demote: the gap is the hysteresis band
+        {"residency_promote_heat": 1.0, "residency_demote_heat": 1.0},
+        {"residency_promote_heat": 0.5, "residency_demote_heat": 2.0},
+    ])
+    def test_validation(self, kwargs):
+        with pytest.raises(ValueError):
+            ServerConfig(**kwargs)
+
+    def test_generate_config_covers_knobs(self):
+        from pilosa_tpu.cli import _DEFAULT_TOML
+
+        for knob in ("result-cache-bytes", "residency-promote-interval",
+                     "residency-promote-heat", "residency-demote-heat",
+                     "residency-host-tier-bytes"):
+            assert knob in _DEFAULT_TOML, knob
+
+    def test_server_wires_cache_and_tierer(self, tmp_path):
+        server = Server(ServerConfig(
+            data_dir=str(tmp_path / "d"), port=0, anti_entropy_interval=0,
+            heartbeat_interval=0, use_mesh=False,
+            result_cache_bytes=1 << 20,
+            residency_promote_interval=3600.0,
+        )).open()
+        try:
+            assert global_result_cache().budget_bytes == 1 << 20
+            assert server.api.tierer is not None
+            assert server.api.tierer.promote_heat == 4.0
+            # tiering shares the repair pacer (never starves serving)
+            assert (server.api.tierer.pacer
+                    is server.api.cluster.client.pacer)
+        finally:
+            server.close()
+        # a default (cache-off) server later disables the global again
+        server2 = Server(ServerConfig(
+            data_dir=str(tmp_path / "d2"), port=0,
+            anti_entropy_interval=0, heartbeat_interval=0,
+            use_mesh=False,
+        )).open()
+        try:
+            assert not global_result_cache().enabled
+            assert server2.api.tierer is None
+        finally:
+            server2.close()
