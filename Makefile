@@ -21,9 +21,9 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: test test-slow qos-smoke ingest-smoke serving-smoke sync-smoke \
 	durability-smoke obs-smoke cost-smoke chaos-smoke scrub-smoke \
-	mp-smoke multitenant-smoke bench-ingest bench-serving bench-sync \
-	bench-durability bench-tracing bench-profiling bench-chaos \
-	bench-scrub bench-mp bench-multitenant
+	mp-smoke multitenant-smoke mesh-smoke bench-ingest bench-serving \
+	bench-sync bench-durability bench-tracing bench-profiling \
+	bench-chaos bench-scrub bench-mp bench-multitenant bench-mesh
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -95,6 +95,17 @@ mp-smoke:
 multitenant-smoke:
 	$(PYTEST) tests/test_multitenant.py -m "not slow"
 
+# mesh-smoke: the hierarchical reduction plane — byte-identical results
+# vs single-device across mesh sizes 1/2/4/8 incl. 2-D groups x shards
+# factorizations at non-divisible shard counts, the narrowed-lane wire
+# model + PROFILE reduceBytes, the roaring row-frame roundtrip, the
+# experimental-fallback multi-mesh serialization guard, and the
+# query_raw vs cache-hit envelope mirror contract
+# (docs/OPERATIONS.md multi-chip mesh)
+mesh-smoke:
+	$(PYTEST) tests/test_mesh_reduction.py tests/test_envelope_contract.py \
+		-m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -142,3 +153,11 @@ bench-scrub:
 # and a heat-driven demote/promote cycle with zero serving errors
 bench-multitenant:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs multitenant
+
+# multi-chip reduction-plane gate: per-mesh-size (2/4/8, 2-D
+# factorizations) subprocesses over the canonical 20 dryrun shapes —
+# byte-identical vs the dense 1-D path, >=4x reduction-lane wire-byte
+# reduction on Row/TopN, cols/sec + reduce-bytes records written to
+# MULTICHIP_r06.json
+bench-mesh:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs mesh

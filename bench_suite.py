@@ -3055,17 +3055,174 @@ def _spawn_cpu_mesh_entry() -> None:
     print(lines[-1], flush=True)
 
 
+def config_mesh_inner(n_devices: int) -> dict:
+    """One mesh size of the hierarchical-reduction gate: the flat 1-D
+    mesh (the dense baseline every prior PR certified) vs the 2-D
+    groups x shards mesh over the canonical 20 dryrun read shapes.
+
+    Three oracles per size:
+
+    1. byte-identical ``result_to_json`` between the dense and
+       hierarchical executors on all 20 shapes (the narrowed inter-group
+       lanes are lossless by construction — this proves it end to end);
+    2. >=4x fewer reduction-lane wire bytes than the dense equivalent on
+       the Row/TopN subset (roaring row frames + narrow scalar lanes);
+    3. a cols/sec throughput figure so MULTICHIP records stay comparable
+       across mesh sizes.
+    """
+    from __graft_entry__ import DRYRUN_QUERY_SHAPES, _ensure_devices
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor.result import result_to_json
+    from pilosa_tpu.parallel import DistExecutor, make_mesh, mesh_groups
+    from pilosa_tpu.parallel import reduction
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import FieldOptions, Holder
+
+    _ensure_devices(max(n_devices, 2))
+    flat = make_mesh(n_devices)
+    hier = make_mesh(n_devices, groups=2)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp).open()
+        try:
+            idx = holder.create_index("mesh")
+            f = idx.create_field("f")
+            g = idx.create_field("g")
+            fare = idx.create_field(
+                "fare", FieldOptions(type="int", min=0, max=100))
+            idx.create_field("tag", FieldOptions(keys=True))
+            rng = np.random.default_rng(1)
+            n_shards = n_devices + 3  # deliberately not divisible
+            cols = []
+            for shard in range(n_shards):
+                base = shard * SHARD_WIDTH
+                for c in rng.choice(SHARD_WIDTH, 50, replace=False).tolist():
+                    f.set_bit(1 + (c % 3), base + c)
+                    if c % 2 == 0:
+                        g.set_bit(7, base + c)
+                    cols.append(base + c)
+            for c in cols[::10]:
+                fare.set_value(c, int(rng.integers(0, 100)))
+            idx.mark_columns_exist(cols)
+
+            base_ex = Executor(holder)
+            for name, key_cols in [("alpha", cols[:7]), ("amber", cols[7:12]),
+                                   ("beta", cols[12:15])]:
+                for c in key_cols:
+                    base_ex.execute("mesh", f'Set({c}, tag="{name}")')
+
+            dense_ex = DistExecutor(holder, flat)
+            hier_ex = DistExecutor(holder, hier)
+            probe = min(c for c in cols if (c % SHARD_WIDTH) % 3 == 0)
+            queries = [q.format(probe=probe) for q in DRYRUN_QUERY_SHAPES]
+
+            mismatches = []
+            for pql in queries:
+                want = result_to_json(dense_ex.execute("mesh", pql)[0])
+                got = result_to_json(hier_ex.execute("mesh", pql)[0])
+                if got != want:
+                    mismatches.append(pql)
+
+            # reduction-lane wire bytes on the Row/TopN subset: dense
+            # equivalent (flat int32 ring) vs what the hierarchical
+            # plane actually moves (intra-group ICI psum excluded —
+            # reported separately as intra_bytes)
+            stats = reduction.global_reduce_stats()
+            stats.reset()
+            hier_ex.execute("mesh", "Union(Row(f=1), Row(f=2))")
+            hier_ex.execute("mesh", "TopN(f, n=2)")
+            snap = stats.snapshot()
+            row_dense = snap["dense_bytes"] + snap["row_dense_bytes"]
+            row_actual = snap["actual_bytes"] + snap["row_actual_bytes"]
+            ratio = row_dense / max(row_actual, 1)
+
+            stats.reset()
+            for pql in queries:
+                hier_ex.execute("mesh", pql)
+            all_snap = stats.snapshot()
+
+            count_pql = "Count(Row(f=1))"
+            hier_ex.execute("mesh", count_pql)  # warm the program
+            dt, _ = _timed(lambda: hier_ex.execute("mesh", count_pql)[0])
+        finally:
+            holder.close()
+
+    return {
+        "n_devices": n_devices,
+        "mesh_shape": list(mesh_groups(hier)),
+        "n_shards": n_shards,
+        "shapes": len(queries),
+        "identical": not mismatches,
+        "mismatches": mismatches,
+        "cols_per_sec": round(n_shards * SHARD_WIDTH / dt),
+        "row_topn_reduce_bytes": {
+            "dense_equiv": row_dense, "actual": row_actual,
+            "ratio": round(ratio, 1),
+        },
+        "reduce_bytes": all_snap,
+        "ok": not mismatches and ratio >= 4.0,
+    }
+
+
+def config_mesh() -> dict:
+    """Mesh scaling gate: one subprocess per mesh size (2/4/8), each
+    pinned to a virtual CPU platform (same env contract as mesh8),
+    running config_mesh_inner. Aggregates the per-size records, writes
+    MULTICHIP_r06.json next to the prior rounds, and is ``ok`` only when
+    every size is byte-identical AND clears the >=4x Row/TopN wire-byte
+    bar."""
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                      " --xla_force_host_platform_device_count=8").strip(),
+    }
+    records = []
+    for n in (2, 4, 8):
+        proc = subprocess.run(
+            [sys.executable, __file__, "--mesh-inner", str(n)],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            records.append({
+                "n_devices": n, "ok": False,
+                "error": (proc.stderr or "no output")[-500:],
+            })
+        else:
+            records.append(json.loads(lines[-1]))
+    out = {
+        "config": "mesh",
+        "metric": "hier_reduction_mesh_scaling",
+        "meshes": records,
+        "ok": all(r.get("ok") for r in records),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_r06.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+        fh.write("\n")
+    return out
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--full", action="store_true",
                         help="billion-column scale (real TPU)")
     parser.add_argument(
         "--configs",
-        default="1,2,3,4,5,mesh8,serving,mp_serving,multitenant,import,"
+        default="1,2,3,4,5,mesh8,mesh,serving,mp_serving,multitenant,import,"
                 "ingest,sync,hostpath,durability,tracing,profiling,chaos,"
                 "scrub",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--mesh-inner", type=int, default=0,
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.cpu_mesh_inner:
@@ -3073,6 +3230,12 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(config5_mesh_cpu8()), flush=True)
+        return
+    if args.mesh_inner:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(config_mesh_inner(args.mesh_inner)), flush=True)
         return
     n_shards = 954 if args.full else 4
     small = 2 if not args.full else 64
@@ -3134,6 +3297,7 @@ def main() -> None:
             n_chaos_schedules=4 if args.full else 2,
             queries_per_client=240 if args.full else 120,
         ),
+        "mesh": config_mesh,
     }
     floor = None  # lazy: touching the device backend can BLOCK when the
     # relay is down, and mesh8/serving don't need the floor measurement

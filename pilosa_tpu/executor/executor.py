@@ -557,6 +557,19 @@ class Executor:
         the block supplies the global row count for multi-host feeding)."""
         return None
 
+    def _note_reduce(self, reduce_kind: str, out_shape: tuple,
+                     padded: int) -> None:
+        """Reduction-lane wire accounting hook, called once per device
+        dispatch with the packed result shape and the block's padded
+        slot count. Single-device execution has no reduction wire —
+        DistExecutor records dense-equivalent vs actual bytes here."""
+
+    def _row_host(self, stacked, block):
+        """Row-gather readback hook: device [padded, words] result →
+        host array. DistExecutor's hierarchical mesh routes this through
+        the roaring wire simulation (parallel/reduction.py)."""
+        return np.asarray(stacked)
+
     def _program(self, structure, reduce_kind: str, leaf_ranks: tuple,
                  n_scalars: int):
         return batch.local_fn(structure, reduce_kind, leaf_ranks, n_scalars)
@@ -652,15 +665,14 @@ class Executor:
         )
         cost = current_cost()
         with global_tracer().span("device.dispatch", reduce=reduce_kind):
-            if cost is None:
-                return fn(*leaves,
-                          *(jnp.asarray(s, jnp.int32) for s in scalars))
             # same boundaries as the span: enqueue time on the device
             # stream, attributed to the active request/call node
             t0 = time.perf_counter()
             out = fn(*leaves, *(jnp.asarray(s, jnp.int32) for s in scalars))
-            cost.note_dispatch(time.perf_counter() - t0)
-            return out
+            if cost is not None:
+                cost.note_dispatch(time.perf_counter() - t0)
+        self._note_reduce(reduce_kind, out.shape, leaves[0].shape[0])
+        return out
 
     def _resolve_leaves(self, idx: Index, compiled: _Compiled, block,
                         put) -> list:
@@ -830,6 +842,7 @@ class Executor:
                 group["out"] = fn(*args)
                 cost.note_dispatch(time.perf_counter() - t0,
                                    batch=len(rows))
+        self._note_reduce(reduce_kind, group["out"].shape, shapes[0][0])
         if self._pending.get(key) is group:
             del self._pending[key]
 
@@ -858,7 +871,7 @@ class Executor:
         attrs = self._row_result_attrs(idx, call)
 
         def finish() -> RowResult:
-            host = np.asarray(stacked)
+            host = self._row_host(stacked, block)
             segments = {}
             for i, shard in enumerate(block.shards):
                 if host[i].any():
@@ -1722,6 +1735,7 @@ class Executor:
                 args.append(planes)
             args.extend(idx_arrays)
             packs.append(fn(*args, *jscalars))
+            self._note_reduce("groupby", packs[-1].shape, block.padded)
             layout.append((padded, actual))
 
         packed = jnp.concatenate(packs) if len(packs) > 1 else packs[0]

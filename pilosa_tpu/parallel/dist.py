@@ -8,6 +8,16 @@ kernel over its resident block of shards (vmapped over the block), and
 ``psum``/``pmax`` over the ``shards`` axis does the reduce on ICI. No
 serialization, no scatter/gather, no per-node re-dispatch.
 
+On a 2-D ``groups x shards`` mesh (parallel/mesh.py) every reduction
+runs hierarchically: a dense intra-group ``psum``/``pmax`` over the
+cheap axis, then a narrow inter-group lane carrying only encoded
+per-group partials (parallel/reduction.py — uint8/uint16 where the
+static SHARD_WIDTH bound proves the cast lossless, int32 otherwise, and
+roaring containers for materialized row gathers). Results are
+bit-identical to the flat 1-D path; only the wire shape changes, and the
+dispatch path measures it (dense-equivalent vs actual bytes, the
+``dist_reduce_*`` series).
+
 All mapping/result logic lives in the base Executor's batched path
 (executor/batch.py) — this class only swaps the placement/program
 hooks: shard blocks pad to the mesh, stacked leaves are device_put with
@@ -19,8 +29,14 @@ collective reductions.
 
 from __future__ import annotations
 
+import contextlib
+import inspect
+import threading
+import weakref
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 try:  # jax >= 0.6 exports shard_map at top level
@@ -30,8 +46,8 @@ try:  # jax >= 0.6 exports shard_map at top level
 except ImportError:  # older runtimes ship it under experimental; on
     # those, concurrent shard_map programs from SEPARATE executors over
     # the same forced-CPU device set can deadlock in the cross-module
-    # all-reduce rendezvous — single-mesh use is fine, multi-server
-    # in-process meshes should be avoided (tests gate on this flag)
+    # all-reduce rendezvous — single-mesh use is fine; multi-mesh
+    # in-process dispatches are serialized by _fallback_guard below
     from jax.experimental.shard_map import shard_map
 
     SHARD_MAP_NATIVE = False
@@ -40,17 +56,79 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from pilosa_tpu.executor import expr
 from pilosa_tpu.executor.executor import Executor
 from pilosa_tpu.executor import batch
-from pilosa_tpu.parallel.mesh import SHARDS_AXIS, ShardAssignment, make_mesh
+from pilosa_tpu.parallel import reduction
+from pilosa_tpu.parallel.mesh import (
+    GROUPS_AXIS, SHARDS_AXIS, ShardAssignment, make_mesh, mesh_groups,
+    shards_spec,
+)
+from pilosa_tpu.utils.cost import current_cost
 
 _DIST_JIT_CACHE: dict = {}
 
+# ---------------------------------------------------------------------------
+# Experimental-fallback dispatch guard.
+#
+# The experimental shard_map can deadlock when programs built over
+# DIFFERENT meshes (separate in-process executors — e.g. a test server's
+# auto-mesh next to a bench's explicit submesh) launch concurrently:
+# both enter the collective rendezvous over the same forced-CPU device
+# set and wait on each other. Native shard_map keys the rendezvous by
+# mesh and doesn't need this. Rather than a comment asking callers not
+# to do that, dispatches take a process-wide lock whenever more than one
+# distinct live mesh exists under the fallback; single-mesh deployments
+# (every production shape) never pay it. tests/test_mesh_reduction.py
+# holds the regression.
 
-def _dist_body(structure, reduce_kind: str, leaf_ranks: tuple):
+_FALLBACK_DISPATCH_LOCK = threading.RLock()
+_LIVE_EXECUTORS: "weakref.WeakSet" = weakref.WeakSet()
+_guard_serialized_count = 0
+
+
+def _multi_mesh_live(mesh) -> bool:
+    meshes = {e.mesh for e in _LIVE_EXECUTORS}
+    meshes.add(mesh)
+    return len(meshes) > 1
+
+
+@contextlib.contextmanager
+def _fallback_guard(mesh):
+    if SHARD_MAP_NATIVE or not _multi_mesh_live(mesh):
+        yield
+        return
+    global _guard_serialized_count
+    with _FALLBACK_DISPATCH_LOCK:
+        _guard_serialized_count += 1
+        yield
+
+
+# hierarchical bodies produce replicated outputs via all_gather + local
+# fold, which the rep checker cannot infer — disable it for those
+# programs only (kwarg name varies across shard_map generations)
+if "check_rep" in inspect.signature(shard_map).parameters:
+    _LOOSE_REP = {"check_rep": False}
+elif "check_vma" in inspect.signature(shard_map).parameters:
+    _LOOSE_REP = {"check_vma": False}
+else:
+    _LOOSE_REP = {}
+
+
+def _smap(body, mesh, in_specs, out_specs, hier):
+    kwargs = _LOOSE_REP if hier is not None else {}
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kwargs)
+
+
+def _dist_body(structure, reduce_kind: str, leaf_ranks: tuple, hier=None):
     """Uncompiled per-query SPMD evaluator body (runs inside shard_map):
     vmap over the local shard slots, then collective reduction over the
-    mesh axis. Shared by the per-query program (_dist_fn) and the
-    micro-batched program (_dist_fn_batched), mirroring
-    batch._local_body / batch.local_fn_batched."""
+    mesh. ``hier`` is (groups, shards_per_group) for the 2-D mesh —
+    intra-group psum/pmax over the shards axis, then the narrow encoded
+    inter-group lane (reduction.py); None is the flat 1-D reduce. Both
+    forms return BIT-IDENTICAL packed results (integer adds are exact
+    and associative; narrowing only where the static bound proves it).
+    Shared by the per-query program (_dist_fn) and the micro-batched
+    program (_dist_fn_batched), mirroring batch._local_body /
+    batch.local_fn_batched."""
     n_leaves = len(leaf_ranks)
     count_sub = (batch.count_elementwise_sub(structure, leaf_ranks)
                  if reduce_kind == "count" else None)
@@ -58,30 +136,38 @@ def _dist_body(structure, reduce_kind: str, leaf_ranks: tuple):
     def body(*args):
         leaves = args[:n_leaves]
         scalars = args[n_leaves:]
+        # static per-group slot count for the lossless-narrowing bounds:
+        # local slots x group width (leaf shapes are concrete at trace)
+        group_slots = leaves[0].shape[0] * (hier[1] if hier else 1)
+
+        def reduce_split(packed_local):
+            part = lax.psum(packed_local, SHARDS_AXIS)
+            if hier is None:
+                return part
+            return reduction.hier_split_channels(
+                part, GROUPS_AXIS, group_slots
+            )
 
         if count_sub is not None:
             # elementwise count: reduce the local block flat in wide
-            # chunks (batch.count_flat), then psum the packed channels
-            return lax.psum(
-                batch.count_flat(count_sub, leaves, scalars), SHARDS_AXIS
-            )
+            # chunks (batch.count_flat), then reduce the packed channels
+            return reduce_split(batch.count_flat(count_sub, leaves, scalars))
 
         def per_shard(*ls):
             return expr._go(structure, ls, scalars)
 
         out = jax.vmap(per_shard)(*leaves)
         if reduce_kind == "count":
-            return lax.psum(batch.split_sum(out), SHARDS_AXIS)
+            return reduce_split(batch.split_sum(out))
         if reduce_kind == "countrows":
-            return lax.psum(batch.split_sum(out, axis=0), SHARDS_AXIS)
+            return reduce_split(batch.split_sum(out, axis=0))
         if reduce_kind == "bsisum":
             plane_counts, n = out  # [S_loc, depth], [S_loc]
-            return lax.psum(
+            return reduce_split(
                 jnp.concatenate(
                     [batch.split_sum(plane_counts, axis=0),
                      batch.split_sum(n)[:, None]], axis=1
-                ),
-                SHARDS_AXIS,
+                )
             )
         if reduce_kind in ("min", "max"):
             values, counts = out
@@ -91,12 +177,18 @@ def _dist_body(structure, reduce_kind: str, leaf_ranks: tuple):
                 best = lax.pmax(jnp.max(masked), SHARDS_AXIS)
             else:
                 best = lax.pmin(jnp.min(masked), SHARDS_AXIS)
-            any_valid = lax.pmax(
-                jnp.any(valid).astype(jnp.int32), SHARDS_AXIS
-            ) > 0
-            n = lax.psum(
-                batch.minmax_at_best(values, counts, valid, best),
-                SHARDS_AXIS,
+            valid_g = lax.pmax(jnp.any(valid).astype(jnp.int32), SHARDS_AXIS)
+            if hier is not None:
+                # the group best is exact int32 (sentinel-masked values
+                # can be negative — no narrowing bound); the valid flag
+                # is 0/1 and crosses as uint8
+                best = reduction.gather_extreme(best, GROUPS_AXIS, want_max)
+                valid_g = reduction.gather_extreme(
+                    valid_g, GROUPS_AXIS, True, bound=1
+                )
+            any_valid = valid_g > 0
+            n = reduce_split(
+                batch.minmax_at_best(values, counts, valid, best)
             )
             return batch.minmax_finalize(best, n, any_valid)
         return out  # 'row': stays shard-sharded
@@ -113,16 +205,19 @@ def _dist_fn(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
     if fn is not None:
         return fn
 
-    leaf_specs = tuple(P(SHARDS_AXIS) for _ in leaf_ranks)
+    hier = mesh_groups(mesh)
+    spec = shards_spec(mesh)
+    leaf_specs = tuple(spec for _ in leaf_ranks)
     scalar_specs = tuple(P() for _ in range(n_scalars))
-    out_specs = P(SHARDS_AXIS) if reduce_kind == "row" else P()
+    out_specs = spec if reduce_kind == "row" else P()
 
     fn = jax.jit(
-        shard_map(
-            _dist_body(structure, reduce_kind, leaf_ranks),
+        _smap(
+            _dist_body(structure, reduce_kind, leaf_ranks, hier),
             mesh=mesh,
             in_specs=leaf_specs + scalar_specs,
             out_specs=out_specs,
+            hier=hier,
         )
     )
     _DIST_JIT_CACHE[key] = fn
@@ -134,31 +229,34 @@ def _dist_fn_batched(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
     """ONE SPMD program evaluating ``n_queries`` same-shape pipelined
     queries over the mesh (the mesh counterpart of
     batch.local_fn_batched): per query the shared per-shard body runs
-    vmapped over the local slots and psum-reduces over the shard axis;
-    results come back stacked [B, ...] and replicated. Only scalar
-    reductions micro-batch (count/bsisum/min/max — Executor.submit never
-    coalesces 'row'), so out_specs is always replicated. Args: B
-    repetitions of the sharded leaves, then (when the shape has scalars)
-    ONE replicated int32[B, n_scalars] array."""
+    vmapped over the local slots and reduces over the mesh (flat psum or
+    the hierarchical two-stage form — _dist_body); results come back
+    stacked [B, ...] and replicated. Only scalar reductions micro-batch
+    (count/bsisum/min/max — Executor.submit never coalesces 'row'), so
+    out_specs is always replicated. Args: B repetitions of the sharded
+    leaves, then (when the shape has scalars) ONE replicated
+    int32[B, n_scalars] array."""
     key = ("distB", mesh, structure, reduce_kind, leaf_ranks, n_scalars,
            n_queries)
     fn = _DIST_JIT_CACHE.get(key)
     if fn is not None:
         return fn
 
+    hier = mesh_groups(mesh)
     n_leaves = len(leaf_ranks)
-    body1 = _dist_body(structure, reduce_kind, leaf_ranks)
+    body1 = _dist_body(structure, reduce_kind, leaf_ranks, hier)
     in_specs = (
-        tuple(P(SHARDS_AXIS) for _ in range(n_leaves * n_queries))
+        tuple(shards_spec(mesh) for _ in range(n_leaves * n_queries))
         + ((P(),) if n_scalars else ())
     )
 
     fn = jax.jit(
-        shard_map(
+        _smap(
             batch.batched_body(body1, n_leaves, n_scalars, n_queries),
             mesh=mesh,
             in_specs=in_specs,
             out_specs=P(),
+            hier=hier,
         )
     )
     _DIST_JIT_CACHE[key] = fn
@@ -168,15 +266,17 @@ def _dist_fn_batched(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
 def _dist_groupby_level_fn(mesh, filt_structure, n_filt: int, n_scalars: int,
                            n_gather: int, has_agg: bool):
     """SPMD GroupBy level program (same per-shard body as the local
-    builder, psum-reduced over the mesh)."""
+    builder, reduced over the mesh — hierarchically on a 2-D mesh, like
+    every other split-sum lane)."""
     key = ("gbl", mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg)
     fn = _DIST_JIT_CACHE.get(key)
     if fn is not None:
         return fn
 
+    hier = mesh_groups(mesh)
     n_leaves = n_filt + n_gather + (1 if has_agg else 0)
     in_specs = (
-        tuple(P(SHARDS_AXIS) for _ in range(n_leaves))
+        tuple(shards_spec(mesh) for _ in range(n_leaves))
         + tuple(P() for _ in range(n_gather))  # candidate index arrays
         + tuple(P() for _ in range(n_scalars))
     )
@@ -185,6 +285,15 @@ def _dist_groupby_level_fn(mesh, filt_structure, n_filt: int, n_scalars: int,
         leaves = args[:n_leaves]
         idxs = args[n_leaves:n_leaves + n_gather]
         scalars = args[n_leaves + n_gather:]
+        group_slots = leaves[0].shape[0] * (hier[1] if hier else 1)
+
+        def reduce_split(packed_local):
+            part = lax.psum(packed_local, SHARDS_AXIS)
+            if hier is None:
+                return part
+            return reduction.hier_split_channels(
+                part, GROUPS_AXIS, group_slots
+            )
 
         def per_shard(*ls):
             return batch.groupby_level_body(
@@ -193,20 +302,13 @@ def _dist_groupby_level_fn(mesh, filt_structure, n_filt: int, n_scalars: int,
 
         out = jax.vmap(per_shard)(*leaves)
         if not has_agg:
-            return lax.psum(
-                batch.split_sum(out, axis=0), SHARDS_AXIS
-            ).ravel()
-        counts, n_g, plane_counts = (
-            batch.split_sum(o, axis=0) for o in out
-        )
+            return reduce_split(batch.split_sum(out, axis=0)).ravel()
         return jnp.concatenate([
-            lax.psum(counts, SHARDS_AXIS).ravel(),
-            lax.psum(n_g, SHARDS_AXIS).ravel(),
-            lax.psum(plane_counts, SHARDS_AXIS).ravel(),
+            reduce_split(batch.split_sum(o, axis=0)).ravel() for o in out
         ])
 
     fn = jax.jit(
-        shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P())
+        _smap(body, mesh=mesh, in_specs=in_specs, out_specs=P(), hier=hier)
     )
     _DIST_JIT_CACHE[key] = fn
     return fn
@@ -216,7 +318,13 @@ class DistExecutor(Executor):
     """Executor whose shard map phase runs as one SPMD program on a mesh.
 
     Single-process: the mesh spans all local devices and behaves like the
-    base executor with on-device reduction.
+    base executor with on-device reduction. A 2-D ``groups x shards``
+    mesh (``DistExecutor(holder, groups=2)`` or an explicit
+    ``make_mesh(groups=...)``) engages the hierarchical reduction plane:
+    identical results, but cross-group traffic crosses as narrow encoded
+    lanes and row gathers as roaring containers, with per-dispatch
+    dense-vs-actual wire bytes recorded (reduction.global_reduce_stats,
+    the cost plane's reduceBytes, and the dist_reduce_* series).
 
     Multi-host (exercised for real by tests/test_multihost.py, two
     jax.distributed processes on the CPU backend): the same mesh spans
@@ -237,18 +345,20 @@ class DistExecutor(Executor):
     through the HTTP layer (parallel/cluster_exec.py), as the reference's
     do."""
 
-    def __init__(self, holder, mesh=None):
+    def __init__(self, holder, mesh=None, groups: int | None = None):
         super().__init__(holder)
-        self.mesh = mesh if mesh is not None else make_mesh()
+        self.mesh = mesh if mesh is not None else make_mesh(groups=groups)
         # micro-batch argument budgeting counts per-DEVICE bytes: leaves
         # are sharded over the mesh, so each chip holds 1/size of them
         self.arg_shard_factor = self.mesh.size
+        self._hier = mesh_groups(self.mesh)
+        _LIVE_EXECUTORS.add(self)
 
     def _make_block(self, shard_list):
         return ShardAssignment(shard_list, self.mesh)
 
     def _leaf_put(self, block):
-        sharding = NamedSharding(self.mesh, P(SHARDS_AXIS))
+        sharding = NamedSharding(self.mesh, shards_spec(self.mesh))
         if jax.process_count() == 1:
             return lambda host: jax.device_put(host, sharding)
         # Multi-host: ``host`` holds only this process's slot rows
@@ -279,3 +389,63 @@ class DistExecutor(Executor):
         return _dist_groupby_level_fn(
             self.mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg
         )
+
+    # ------------------------------------------------ dispatch wrapping
+
+    def _dispatch(self, node, reduce_kind, leaves, scalars):
+        with _fallback_guard(self.mesh):
+            return super()._dispatch(node, reduce_kind, leaves, scalars)
+
+    def _flush_group_locked(self, key, group):
+        with _fallback_guard(self.mesh):
+            return super()._flush_group_locked(key, group)
+
+    def _groupby_level_enqueue(self, *args, **kwargs):
+        with _fallback_guard(self.mesh):
+            return super()._groupby_level_enqueue(*args, **kwargs)
+
+    # ------------------------------------------- wire-byte accounting
+
+    def _note_reduce(self, reduce_kind: str, out_shape: tuple,
+                     padded: int) -> None:
+        """Per-dispatch reduction-lane bytes, from static shapes only
+        (host side, nothing blocks on the device). dense-equivalent =
+        flat int32 ring all-reduce over the whole mesh; actual = the
+        narrow inter-group hop (equal to dense on a 1-D mesh, where the
+        plane is pass-through); intra = per-group dense traffic,
+        reported separately as the cheap-axis cost."""
+        if reduce_kind == "row":
+            return  # row gathers are accounted in _row_host
+        elems = 1
+        for d in out_shape:
+            elems *= int(d)
+        dense = reduction.dense_reduce_bytes(self.mesh.size, elems)
+        if self._hier is None:
+            actual, intra = dense, 0
+        else:
+            g, spg = self._hier
+            actual, intra = reduction.hier_reduce_bytes(
+                reduce_kind, elems, g, spg, max(padded // g, 1)
+            )
+        reduction.global_reduce_stats().note_reduce(
+            dense, actual, intra, self._hier is not None
+        )
+        cost = current_cost()
+        if cost is not None:
+            cost.note_reduce(dense, actual)
+
+    def _row_host(self, stacked, block):
+        """Row-gather readback. On the hierarchical mesh the dense
+        [padded, words] device result crosses the (simulated) wire as
+        per-slot roaring containers in block frames — the result is
+        decoded FROM those frames, so the compression is load-bearing,
+        not just counted."""
+        host = np.asarray(stacked)
+        if self._hier is None or jax.process_count() > 1:
+            return host
+        frames, actual = reduction.encode_row_frames(host)
+        reduction.global_reduce_stats().note_row_gather(host.nbytes, actual)
+        cost = current_cost()
+        if cost is not None:
+            cost.note_reduce(host.nbytes, actual)
+        return reduction.decode_row_frames(frames, host.shape)

@@ -1,0 +1,250 @@
+"""Compressed/quantized reduction lanes + cross-chip wire-byte model.
+
+ROADMAP open item 2: before real multi-chip hardware shows up, make
+cross-chip reduction cost a *measured* quantity and shrink it. Two ideas,
+both borrowed from systems that already pay this bill:
+
+* EQuARX-style narrow collectives (arXiv:2506.17615): the inter-group hop
+  of a hierarchical reduction carries per-group partials, and a partial's
+  value range is statically bounded (every per-shard summand is at most
+  SHARD_WIDTH), so the lane can often be cast to uint8/uint16 and summed
+  exactly on the receiver. Unlike EQuARX's lossy block scaling, every
+  lane here must stay BIT-EXACT — counts and BSI aggregates are answers,
+  not gradients — so narrowing only happens where the static bound proves
+  losslessness, with an int32 exact fallback. (Lossy scaling stays
+  reserved for TopN *candidate ranking* lanes, where a final exact
+  re-verify would bound the error; no lane uses it yet.)
+
+* Roaring-compressed row gathers (Chambi et al., arXiv:1402.6407): a
+  materialized Row result crossing the wire as dense words pays
+  padded x 128 KiB regardless of cardinality; the same payload as
+  serialized roaring containers (the repair plane's format,
+  roaring/format.py) is proportional to what's actually set.
+
+The traced helpers (hier_split_channels / gather_extreme) run INSIDE
+shard_map bodies on the 2-D ``groups x shards`` mesh (parallel/mesh.py);
+the byte-model functions run host-side at dispatch time. Both derive
+lane dtypes from the same ``lane_dtype`` bound logic so the accounting
+can never drift from the program.
+
+Wire model (documented in docs/OPERATIONS.md "Multi-chip mesh"):
+
+* dense-equivalent — what the flat 1-D path moves: a ring all-reduce of
+  the int32 packed lanes over all N mesh devices, total
+  ``2*(N-1) * payload`` bytes on the wire.
+* actual (headline ``dist_reduce_actual_bytes``) — the inter-group hop
+  only: a ring all-gather of the encoded per-group partials over the G
+  group leads, ``G*(G-1) * enc_payload`` total. Groups model the
+  cross-chip/DCN boundary; that hop is the one the ROADMAP's
+  85%-of-linear target lives or dies on.
+* intra — the per-group dense all-reduce (``G * 2*(S/G - 1) * payload``)
+  reported separately as on-chip/ICI traffic, which is not the scarce
+  resource the plane optimizes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+SPLIT_SHIFT = 15  # mirrors executor/batch.py (import cycle: keep literal)
+SPLIT_MASK = (1 << SPLIT_SHIFT) - 1
+# per-shard summand ceiling: any popcount/count lane sums values <=
+# SHARD_WIDTH per slot, so split channels are bounded per slot by
+# SPLIT_MASK (lo) and SHARD_WIDTH >> SPLIT_SHIFT (hi)
+HI_PER_SLOT = SHARD_WIDTH >> SPLIT_SHIFT
+
+
+def lane_dtype_bytes(bound: int) -> int:
+    """Width of the narrowest integer lane proven lossless for values in
+    [0, bound]. int32 is the exact fallback."""
+    if bound <= 0xFF:
+        return 1
+    if bound <= 0xFFFF:
+        return 2
+    return 4
+
+
+def lane_dtype(bound: int):
+    import jax.numpy as jnp
+
+    return {1: jnp.uint8, 2: jnp.uint16, 4: jnp.int32}[lane_dtype_bytes(bound)]
+
+
+def split_channel_bounds(group_slots: int) -> tuple[int, int]:
+    """Static (lo, hi) channel bounds for a per-group split-sum partial
+    over ``group_slots`` shard slots."""
+    return group_slots * SPLIT_MASK, group_slots * HI_PER_SLOT
+
+
+# ------------------------------------------------------- traced helpers
+#
+# These run inside shard_map bodies. The contract with the flat path is
+# BIT-IDENTICAL packed results: integer adds are exact and associative,
+# so (psum over the shards axis) + (gather + local sum over groups)
+# equals the flat psum channel-for-channel, and the narrow cast is a
+# no-op on values the static bound covers.
+
+
+def hier_split_channels(part, groups_axis: str, group_slots: int):
+    """Inter-group hop for a split-sum packed partial ``[2, ...]``:
+    all_gather each channel at its narrowest lossless dtype, then
+    accumulate exactly in int32 on every receiver."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    lo_b, hi_b = split_channel_bounds(group_slots)
+    lo = lax.all_gather(part[0].astype(lane_dtype(lo_b)), groups_axis)
+    hi = lax.all_gather(part[1].astype(lane_dtype(hi_b)), groups_axis)
+    return jnp.stack([jnp.sum(lo.astype(jnp.int32), axis=0),
+                      jnp.sum(hi.astype(jnp.int32), axis=0)])
+
+
+def gather_extreme(part, groups_axis: str, want_max: bool, bound=None):
+    """Inter-group hop for an extremum lane: gather the per-group bests
+    (narrowed when ``bound`` proves it lossless) and fold locally."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    dt = part.dtype if bound is None else lane_dtype(bound)
+    g = lax.all_gather(part.astype(dt), groups_axis).astype(jnp.int32)
+    return jnp.max(g, axis=0) if want_max else jnp.min(g, axis=0)
+
+
+# ------------------------------------------------------ host byte model
+
+
+def inter_group_payload_bytes(reduce_kind: str, out_elems: int,
+                              group_slots: int) -> int:
+    """Encoded bytes ONE group contributes to the inter-group hop, for a
+    packed result of ``out_elems`` int32 lanes (batched dispatches pass
+    the batch-multiplied element count)."""
+    lo_b, hi_b = split_channel_bounds(group_slots)
+    lo_w, hi_w = lane_dtype_bytes(lo_b), lane_dtype_bytes(hi_b)
+    if reduce_kind in ("min", "max"):
+        # [best, count_lo, count_hi] per query -> best int32 + any_valid
+        # uint8 + narrowed count channels
+        return (out_elems // 3) * (4 + 1 + lo_w + hi_w)
+    # every other packed kind is pairs of split channels
+    return (out_elems // 2) * (lo_w + hi_w)
+
+
+def dense_reduce_bytes(n_devices: int, out_elems: int) -> int:
+    """Flat-path equivalent: ring all-reduce of the int32 packed lanes
+    over the whole mesh."""
+    return 2 * (n_devices - 1) * out_elems * 4
+
+
+def hier_reduce_bytes(reduce_kind: str, out_elems: int, groups: int,
+                      shards_per_group: int, group_slots: int
+                      ) -> tuple[int, int]:
+    """(inter_group_bytes, intra_group_bytes) for one hierarchical
+    dispatch: narrow ring all-gather across the G group leads, dense
+    int32 ring all-reduce inside each group."""
+    inter = groups * (groups - 1) * inter_group_payload_bytes(
+        reduce_kind, out_elems, group_slots
+    )
+    intra = groups * 2 * max(shards_per_group - 1, 0) * out_elems * 4
+    return inter, intra
+
+
+# -------------------------------------------------- row-gather wire sim
+
+
+def encode_row_frames(host: np.ndarray) -> tuple[list[bytes], int]:
+    """Serialize a [slots, WORDS_PER_SHARD] dense row readback as
+    per-slot roaring payloads framed like the repair plane's block frames
+    (wire/serializer.py). Empty slots frame as b"" (1-byte tag + length
+    prefix on the wire). Returns (frames, framed_bytes)."""
+    from pilosa_tpu.roaring.bitmap import RoaringBitmap
+    from pilosa_tpu.roaring import format as rformat
+    from pilosa_tpu.wire.serializer import encode_block_frames
+
+    payloads = []
+    for slot in range(host.shape[0]):
+        words = host[slot]
+        if words.any():
+            payloads.append(
+                rformat.serialize(RoaringBitmap.from_dense_words(words))
+            )
+        else:
+            payloads.append(b"")
+    return payloads, len(encode_block_frames(payloads))
+
+
+def decode_row_frames(payloads: list[bytes], shape: tuple) -> np.ndarray:
+    """Inverse of encode_row_frames: rebuild the dense [slots, words]
+    uint32 array. Byte-identical round trip — this IS the result path
+    when the wire sim is on, so a codec bug is a visible wrong answer,
+    not a silent accounting error."""
+    from pilosa_tpu.roaring import format as rformat
+
+    out = np.zeros(shape, np.uint32)
+    for slot, payload in enumerate(payloads):
+        if not payload:
+            continue
+        bm, _ = rformat.deserialize(payload)
+        out[slot] = bm.dense_range_words32(0, WORDS_PER_SHARD * 32)
+    return out
+
+
+# ------------------------------------------------------ global counters
+
+
+class ReduceStats:
+    """Process-wide dist_reduce_* counters (served on /metrics and
+    /debug/vars). Lock kept tiny: a handful of integer adds per device
+    dispatch, invisible next to the dispatch itself."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self.dispatches = 0
+            self.hier_dispatches = 0
+            self.dense_bytes = 0
+            self.actual_bytes = 0
+            self.intra_bytes = 0
+            self.row_gathers = 0
+            self.row_dense_bytes = 0
+            self.row_actual_bytes = 0
+
+    def note_reduce(self, dense: int, actual: int, intra: int,
+                    hier: bool) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.hier_dispatches += 1 if hier else 0
+            self.dense_bytes += dense
+            self.actual_bytes += actual
+            self.intra_bytes += intra
+
+    def note_row_gather(self, dense: int, actual: int) -> None:
+        with self._lock:
+            self.row_gathers += 1
+            self.row_dense_bytes += dense
+            self.row_actual_bytes += actual
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "hier_dispatches": self.hier_dispatches,
+                "dense_bytes": self.dense_bytes,
+                "actual_bytes": self.actual_bytes,
+                "intra_bytes": self.intra_bytes,
+                "row_gathers": self.row_gathers,
+                "row_dense_bytes": self.row_dense_bytes,
+                "row_actual_bytes": self.row_actual_bytes,
+            }
+
+
+_STATS = ReduceStats()
+
+
+def global_reduce_stats() -> ReduceStats:
+    return _STATS

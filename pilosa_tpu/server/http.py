@@ -726,6 +726,14 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # scrubber progress — zeros from scrape one like the rest
         text += prometheus_block(self.api.integrity_metrics(), prefix,
                                  seen=seen)
+        # multi-chip reduction plane (docs/OPERATIONS.md multi-chip
+        # mesh): per-dispatch reduction-lane bytes, dense-equivalent vs
+        # actual encoded inter-group traffic plus roaring row gathers —
+        # zeros on flat 1-D meshes, where the plane is pass-through
+        from pilosa_tpu.parallel.reduction import global_reduce_stats
+
+        text += prometheus_block(global_reduce_stats().snapshot(), prefix,
+                                 "dist_reduce", seen=seen)
         # serving-QoS series (admission/deadline/hedge/breaker): emitted
         # from scrape one, zeros included, for the same rate()-window
         # reason as the wave counters above
@@ -957,6 +965,9 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap["durability"] = self.api.durability_metrics()
         snap["integrity"] = self.api.integrity_metrics()
         snap["observability"] = self.api.observability_metrics()
+        from pilosa_tpu.parallel.reduction import global_reduce_stats
+
+        snap["dist_reduce"] = global_reduce_stats().snapshot()
         from pilosa_tpu.storage.heat import global_heat
 
         snap["tenants"] = self.api.cost.metrics()

@@ -33,6 +33,7 @@ class ServerConfig:
         heartbeat_interval: float = 5.0,
         heartbeat_timeout: float = 2.0,
         use_mesh: bool | None = None,
+        mesh_groups: int = 0,
         tracing: bool = False,
         trace_sample_rate: float = 0.0,
         trace_log_dir: str = "",
@@ -98,6 +99,14 @@ class ServerConfig:
                 "(want > 0)"
             )
         self.use_mesh = use_mesh  # None = auto (mesh when >1 device)
+        # 2-D mesh factorization (docs/OPERATIONS.md multi-chip mesh):
+        # 0/1 = flat 1-D mesh; >1 = hierarchical groups x shards
+        # reductions with the compressed inter-group lane
+        if mesh_groups < 0:
+            raise ValueError(
+                f"invalid mesh-groups {mesh_groups!r} (want >= 0)"
+            )
+        self.mesh_groups = mesh_groups
         # Distributed tracing (docs/OBSERVABILITY.md): `tracing = true`
         # is the legacy always-on switch (rate 1.0); `trace-sample-rate`
         # sets probabilistic sampling directly (0 = off, zero-overhead).
@@ -317,6 +326,7 @@ class ServerConfig:
                 _parse_bool(d["use-mesh"])
                 if d.get("use-mesh") not in (None, "") else None
             ),
+            mesh_groups=int(d.get("mesh-groups", 0) or 0),
             qos_max_inflight=int(d.get("qos-max-inflight", 0)),
             qos_tenant_inflight=int(d.get("qos-tenant-inflight", 0)),
             qos_default_deadline=_parse_duration(
@@ -436,6 +446,7 @@ class ServerConfig:
             "tls-skip-verify": self.tls_skip_verify,
             "device-budget-bytes": self.device_budget_bytes,
             "use-mesh": self.use_mesh,
+            "mesh-groups": self.mesh_groups,
             "qos-max-inflight": self.qos_max_inflight,
             "qos-tenant-inflight": self.qos_tenant_inflight,
             "qos-default-deadline": self.qos_default_deadline,
@@ -750,7 +761,8 @@ class Server:
         if use_mesh:
             from pilosa_tpu.parallel.dist import DistExecutor
 
-            local = DistExecutor(self.holder)
+            local = DistExecutor(self.holder,
+                                 groups=self.config.mesh_groups or None)
         else:
             local = Executor(self.holder)
         self.api.executor = ClusterExecutor(

@@ -70,6 +70,7 @@ class ProfileNode:
                  "max_batch", "shards", "c_array", "c_bitmap", "c_run",
                  "row_cache_hits", "row_cache_misses", "plan_cache_hit",
                  "operand_memo_hit", "rows_materialized", "device_bytes",
+                 "reduce_dense_bytes", "reduce_actual_bytes",
                  "children", "leaves")
 
     def __init__(self, name: str, pql: str = ""):
@@ -89,6 +90,8 @@ class ProfileNode:
         self.operand_memo_hit = False
         self.rows_materialized = 0
         self.device_bytes = 0
+        self.reduce_dense_bytes = 0
+        self.reduce_actual_bytes = 0
         # static AST skeleton (ready-to-emit dicts, shared via the
         # skeleton memo — never mutated)
         self.children: list[dict] = []
@@ -112,6 +115,12 @@ class ProfileNode:
             "operandMemoHit": self.operand_memo_hit,
             "bytesMoved": self.device_bytes,
         }
+        if self.reduce_dense_bytes:
+            # hierarchical reduction plane engaged (parallel/reduction.py):
+            # what the flat dense path would have moved vs the encoded
+            # inter-group lane this dispatch actually paid for
+            out["reduceBytes"] = {"denseEquiv": self.reduce_dense_bytes,
+                                  "actual": self.reduce_actual_bytes}
         if self.leaves:
             out["leaves"] = self.leaves
         if self.children:
@@ -231,7 +240,8 @@ class CostContext:
     __slots__ = ("tenant", "index", "device_s", "dispatches", "shards",
                  "c_array", "c_bitmap", "c_run", "row_cache_hits",
                  "row_cache_misses", "plan_cache_hits", "plan_cache_misses",
-                 "rows_materialized", "device_bytes", "profile", "current")
+                 "rows_materialized", "device_bytes", "reduce_dense_bytes",
+                 "reduce_actual_bytes", "profile", "current")
 
     def __init__(self, tenant: str = "default", index: str = "",
                  profile: QueryProfile | None = None):
@@ -249,6 +259,8 @@ class CostContext:
         self.plan_cache_misses = 0
         self.rows_materialized = 0
         self.device_bytes = 0
+        self.reduce_dense_bytes = 0
+        self.reduce_actual_bytes = 0
         self.profile = profile
         self.current: ProfileNode | None = None
 
@@ -306,6 +318,17 @@ class CostContext:
         if node is not None:
             node.rows_materialized += n
 
+    def note_reduce(self, dense: int, actual: int) -> None:
+        """One reduction-lane crossing on the hierarchical mesh
+        (parallel/reduction.py): flat dense-equivalent bytes vs the
+        encoded bytes actually modeled on the inter-group wire."""
+        self.reduce_dense_bytes += dense
+        self.reduce_actual_bytes += actual
+        node = self.current
+        if node is not None:
+            node.reduce_dense_bytes += dense
+            node.reduce_actual_bytes += actual
+
     def note_plan(self, hit: bool) -> None:
         if hit:
             self.plan_cache_hits += 1
@@ -319,7 +342,7 @@ class CostContext:
         return self.c_array + self.c_bitmap + self.c_run
 
     def totals(self) -> dict:
-        return {
+        out = {
             "deviceMs": round(self.device_s * 1e3, 3),
             "dispatches": self.dispatches,
             "shards": self.shards,
@@ -332,6 +355,10 @@ class CostContext:
             "rowsMaterialized": self.rows_materialized,
             "bytesMoved": self.device_bytes,
         }
+        if self.reduce_dense_bytes:
+            out["reduceBytes"] = {"denseEquiv": self.reduce_dense_bytes,
+                                  "actual": self.reduce_actual_bytes}
+        return out
 
 
 class _NodeScope:

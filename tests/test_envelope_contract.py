@@ -1,0 +1,123 @@
+"""Envelope-drift contract: query_raw vs _serve_result_cache_hit.
+
+server/api.py deliberately maintains the request envelope TWICE: the
+miss half (query_raw: admission → execute → ledger/SLO/profile/tracker)
+and the hit half (_serve_result_cache_hit: admission → cached bytes →
+the same billing). PR 12 shipped the duplication with a comment asking
+future editors to keep them in lockstep; this test makes the ask
+executable. It reads both function sources and fails when an
+envelope-plane call appears in one half but not the other — so adding,
+say, a quota debit to query_raw without mirroring it (or explicitly
+classifying it execution-only below) breaks CI instead of silently
+unbilling every cache hit.
+"""
+
+import inspect
+import re
+
+from pilosa_tpu.server.api import API
+
+
+def _src(name: str) -> str:
+    return inspect.getsource(getattr(API, name))
+
+
+# Envelope-plane call sites: anything the request envelope does to the
+# QoS/billing/observability planes. The regex is deliberately broad —
+# new verbs on these planes are caught without editing the test.
+_PLANE_CALL = re.compile(
+    r"(?:"
+    r"tracker\.\w+"                 # inflight tracking
+    r"|inflight\.stage"             # stage labels
+    r"|self\.qos\.admission\.\w+"   # admission gate
+    r"|self\.cost\.\w+"             # tenant ledger
+    r"|self\.slo\.\w+"              # SLO engine
+    r"|new_cost_context"            # cost context lifecycle
+    r"|activate_cost|deactivate_cost"
+    r"|profile_out\.append"         # PROFILE delivery
+    r"|on_submitted\(\)"            # dedupe-join cutoff
+    r")"
+)
+
+# Miss-half calls that legitimately have no mirror in the hit half:
+# they only exist because the miss half EXECUTES the query. Everything
+# else must appear in both halves.
+EXECUTION_ONLY = {
+    # the hit half never runs device work, so nothing to attribute —
+    # its CostContext is created (for billing) but never activated
+    "activate_cost",
+    "deactivate_cost",
+}
+
+# Hit-half calls whose miss-half equivalents live inside
+# _query_raw_admitted / the rescache store path rather than in
+# query_raw's own body.
+HIT_ONLY = {
+    "on_submitted()",
+}
+
+
+def _plane_calls(src: str) -> set:
+    return set(_PLANE_CALL.findall(src))
+
+
+class TestEnvelopeMirror:
+    def test_every_miss_plane_call_is_mirrored(self):
+        miss = _plane_calls(_src("query_raw"))
+        hit = _plane_calls(_src("_serve_result_cache_hit"))
+        unmirrored = miss - hit - EXECUTION_ONLY
+        assert not unmirrored, (
+            f"query_raw's envelope gained plane calls the cache-hit "
+            f"mirror lacks: {sorted(unmirrored)} — update "
+            f"_serve_result_cache_hit (server/api.py) or classify them "
+            f"in EXECUTION_ONLY here"
+        )
+
+    def test_hit_half_invents_no_planes(self):
+        miss = _plane_calls(_src("query_raw"))
+        hit = _plane_calls(_src("_serve_result_cache_hit"))
+        # verbs only the hit half performs must be explicitly listed —
+        # an unexplained extra usually means the mirror drifted the
+        # other way
+        extras = hit - miss - HIT_ONLY
+        assert not extras, (
+            f"_serve_result_cache_hit performs plane calls query_raw "
+            f"never does: {sorted(extras)}"
+        )
+
+    def test_error_envelope_shape(self):
+        """Both halves classify outcomes identically: ApiError keeps its
+        status, anything else is a 500, sheds (429) bill the ledger but
+        not the SLO."""
+        for name in ("query_raw", "_serve_result_cache_hit"):
+            src = _src(name)
+            assert "except ApiError as e:" in src, name
+            assert "err_status = e.status" in src, name
+            assert re.search(r"except Exception:\s*\n\s*err_status = 500",
+                             src), name
+            assert "finally:" in src, name
+            assert "err_status != 429" in src, (
+                f"{name}: SLO must skip shed (429) outcomes"
+            )
+            assert "err_status is not None and err_status >= 500" in src, (
+                f"{name}: ledger error flag must mean 5xx only"
+            )
+
+    def test_admission_shed_contract(self):
+        """Both halves surface admission sheds as ApiError 429 with the
+        Retry-After hint, gated on pre_admitted."""
+        for name in ("query_raw", "_serve_result_cache_hit"):
+            src = _src(name)
+            assert "self.qos.admission.admit(tenant)" in src, name
+            assert "ApiError(str(e), 429)" in src, name
+            assert "err.retry_after = e.retry_after" in src, name
+            assert "pre_admitted" in src, name
+
+    def test_billing_mirror_flags(self):
+        """The hit half bills record_query with result_cache_hit=True
+        only when the cached bytes were actually served (not on a shed);
+        the miss half never sets the flag."""
+        hit = _src("_serve_result_cache_hit")
+        assert "result_cache_hit=err_status is None" in hit
+        miss = _src("query_raw")
+        assert "result_cache_hit" not in miss

@@ -1,0 +1,227 @@
+"""Hierarchical reduction plane (parallel/reduction.py + the 2-D mesh).
+
+Three contracts, gated here and again (at scale, with records) by the
+bench_suite ``mesh`` config:
+
+* bit-exactness — every reduce kind on every mesh factorization returns
+  byte-identical results to the single-device Executor, including
+  non-divisible shard counts (padded slots);
+* the wire model — dense-equivalent vs actual reduction-lane bytes are
+  recorded per dispatch, actual is smaller on hierarchical meshes, and
+  Row/TopN shapes clear the ≥4x bar the ROADMAP target needs;
+* the experimental-fallback guard — concurrent dispatches from
+  executors over DIFFERENT meshes serialize instead of deadlocking when
+  shard_map comes from jax.experimental.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.result import result_to_json
+from pilosa_tpu.parallel import DistExecutor, make_mesh, mesh_groups
+from pilosa_tpu.parallel import dist as dist_mod
+from pilosa_tpu.parallel import reduction
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.utils import cost as cost_mod
+
+N_SHARDS = 13  # deliberately not a multiple of any mesh size
+
+# mesh sizes 1/2/4/8 including 2-D groups x shards factorizations
+MESH_CONFIGS = [(1, None), (2, None), (2, 2), (4, 2), (8, 2), (8, 4)]
+
+# one query per reduce kind: count, row, bsisum, min, max,
+# countrows (TopN), groupby + aggregate
+KIND_QUERIES = [
+    "Count(Row(f=1))",
+    "Union(Row(f=2), Row(g=3))",
+    "Sum(Row(f=1), field=fare)",
+    "Min(field=fare)",
+    "Max(field=fare)",
+    "TopN(f, n=2)",
+    "GroupBy(Rows(f), aggregate=Sum(field=fare))",
+]
+
+
+@pytest.fixture(scope="module")
+def holder(tmp_path_factory):
+    holder = Holder(str(tmp_path_factory.mktemp("mesh") / "data")).open()
+    idx = holder.create_index("big")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    fare = idx.create_field("fare",
+                            FieldOptions(type="int", min=-5, max=1000))
+    rng = np.random.default_rng(11)
+    all_cols = []
+    for shard in range(N_SHARDS):
+        base = shard * SHARD_WIDTH
+        cols = np.sort(rng.choice(SHARD_WIDTH, 150, replace=False)) + base
+        f.view("standard", create=True).fragment(
+            shard, create=True
+        ).bulk_import(np.repeat([1, 2], 75), cols % SHARD_WIDTH)
+        for c in cols[::5]:
+            g.set_bit(3, int(c))
+        for c in cols[:15]:
+            fare.set_value(int(c), int(rng.integers(-5, 1000)))
+        all_cols.extend(cols.tolist())
+    idx.mark_columns_exist(all_cols)
+    yield holder
+    holder.close()
+
+
+@pytest.fixture(scope="module")
+def executors(holder):
+    """One DistExecutor per mesh config, shared across tests so compiled
+    programs amortize over the whole module."""
+    return {
+        cfg: DistExecutor(holder, make_mesh(cfg[0], groups=cfg[1]))
+        for cfg in MESH_CONFIGS
+    }
+
+
+@pytest.fixture(scope="module")
+def base(holder):
+    return Executor(holder)
+
+
+class TestPaddedShardParity:
+    """Satellite: DistExecutor vs single-device results at non-divisible
+    shard counts x mesh sizes, all reduce kinds — byte-identical JSON."""
+
+    @pytest.mark.parametrize("cfg", MESH_CONFIGS,
+                             ids=[f"{n}dev-g{g or 1}" for n, g in MESH_CONFIGS])
+    def test_all_kinds_all_shard_counts(self, cfg, base, executors):
+        dist = executors[cfg]
+        for k in (1, 5, N_SHARDS):
+            shards = list(range(k))
+            for pql in KIND_QUERIES:
+                (want,) = base.execute("big", pql, shards=shards)
+                (got,) = dist.execute("big", pql, shards=shards)
+                assert result_to_json(got) == result_to_json(want), (
+                    f"mesh={cfg} shards={k} {pql}"
+                )
+
+    def test_hier_mesh_shape(self, executors):
+        assert mesh_groups(executors[(8, 2)].mesh) == (2, 4)
+        assert mesh_groups(executors[(8, 4)].mesh) == (4, 2)
+        assert mesh_groups(executors[(2, None)].mesh) is None
+        with pytest.raises(ValueError):
+            make_mesh(8, groups=3)
+
+
+class TestWireAccounting:
+    def test_lane_dtype_bounds(self):
+        assert reduction.lane_dtype_bytes(0) == 1
+        assert reduction.lane_dtype_bytes(255) == 1
+        assert reduction.lane_dtype_bytes(256) == 2
+        assert reduction.lane_dtype_bytes(0xFFFF) == 2
+        assert reduction.lane_dtype_bytes(0x10000) == 4
+
+    def test_byte_model(self):
+        # count on an 8-device 2x4 mesh, 16 padded slots: the flat ring
+        # moves 2*(8-1)*2*4 bytes; the inter-group hop moves
+        # G*(G-1)*(lo int32 + hi uint16)
+        assert reduction.dense_reduce_bytes(8, 2) == 112
+        inter, intra = reduction.hier_reduce_bytes("count", 2, 2, 4, 8)
+        assert inter == 2 * 1 * (4 + 2)
+        assert intra == 2 * 2 * 3 * 2 * 4
+
+    def test_row_frames_roundtrip(self):
+        rng = np.random.default_rng(3)
+        host = np.zeros((4, WORDS_PER_SHARD), np.uint32)
+        host[1, rng.integers(0, WORDS_PER_SHARD, 300)] = 0x80000001
+        host[2, :7] = 0xFFFFFFFF
+        frames, nbytes = reduction.encode_row_frames(host)
+        assert nbytes < host.nbytes
+        back = reduction.decode_row_frames(frames, host.shape)
+        np.testing.assert_array_equal(back, host)
+
+    def test_flat_mesh_is_passthrough(self, executors):
+        stats = reduction.global_reduce_stats()
+        stats.reset()
+        executors[(2, None)].execute("big", "Count(Row(f=1))")
+        snap = stats.snapshot()
+        assert snap["dispatches"] >= 1
+        assert snap["hier_dispatches"] == 0
+        assert snap["actual_bytes"] == snap["dense_bytes"]
+        assert snap["row_gathers"] == 0
+
+    def test_hier_row_topn_4x(self, executors):
+        """The bench gate's core assertion, in miniature: Row and TopN
+        shapes move >=4x fewer reduction-lane bytes than the dense
+        equivalent on the hierarchical mesh."""
+        dist = executors[(8, 2)]
+        stats = reduction.global_reduce_stats()
+        stats.reset()
+        dist.execute("big", "Union(Row(f=2), Row(g=3))")
+        dist.execute("big", "TopN(f, n=2)")
+        snap = stats.snapshot()
+        assert snap["row_gathers"] >= 1
+        assert snap["row_dense_bytes"] >= 4 * snap["row_actual_bytes"]
+        assert snap["hier_dispatches"] >= 1
+        assert snap["dense_bytes"] >= 4 * snap["actual_bytes"]
+
+    def test_profile_reduce_bytes(self, executors):
+        """reduceBytes rides the PROFILE tree + context totals when the
+        hierarchical plane is engaged."""
+        prof = cost_mod.QueryProfile("big", "Count(Row(f=1))")
+        ctx = cost_mod.new_cost_context("t", "big", profile=prof)
+        tok = cost_mod.activate_cost(ctx)
+        try:
+            executors[(8, 2)].execute("big", "Count(Row(f=1))")
+        finally:
+            cost_mod.deactivate_cost(tok)
+        totals = ctx.totals()
+        assert totals["reduceBytes"]["denseEquiv"] > \
+            totals["reduceBytes"]["actual"] > 0
+
+
+class TestFallbackGuard:
+    """Satellite: when shard_map is the experimental fallback, dispatches
+    from executors over DIFFERENT meshes must serialize (the documented
+    cross-module all-reduce rendezvous deadlock) instead of relying on a
+    comment."""
+
+    def test_concurrent_multi_mesh_serializes(self, holder, executors):
+        if dist_mod.SHARD_MAP_NATIVE:
+            pytest.skip("native shard_map keys rendezvous by mesh")
+        a = executors[(8, 2)]
+        b = executors[(4, 2)]
+        # warm both programs single-threaded first (compilation under
+        # the guard is fine but slow inside threads)
+        (want_a,) = a.execute("big", "Count(Row(f=1))")
+        (want_b,) = b.execute("big", "Count(Row(f=1))")
+        before = dist_mod._guard_serialized_count
+        results, errors = {}, []
+
+        def run(name, ex, want):
+            try:
+                for _ in range(5):
+                    (got,) = ex.execute("big", "Count(Row(f=1))")
+                    assert got == want
+                results[name] = True
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append((name, e))
+
+        threads = [threading.Thread(target=run, args=("a", a, want_a)),
+                   threading.Thread(target=run, args=("b", b, want_b))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors
+        assert results == {"a": True, "b": True}
+        assert dist_mod._guard_serialized_count > before
+
+    def test_single_mesh_unaffected_semantics(self, executors):
+        """The guard only engages for multi-mesh: _multi_mesh_live is the
+        predicate, and a lone mesh must not trip it."""
+        if dist_mod.SHARD_MAP_NATIVE:
+            pytest.skip("native shard_map keys rendezvous by mesh")
+        mesh = executors[(8, 2)].mesh
+        live = {e.mesh for e in dist_mod._LIVE_EXECUTORS}
+        # other module-scoped executors exist, so multi-mesh is live now
+        assert dist_mod._multi_mesh_live(mesh) == (len(live | {mesh}) > 1)
