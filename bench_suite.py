@@ -3026,6 +3026,560 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
     }
 
 
+def config_autopilot(n_hot: int = 12, n_clients: int = 12,
+                     inflight_cap: int = 5, hot_run_s: float = 24.0,
+                     base_run_s: float = 8.0, n_chaos_schedules: int = 3,
+                     seed: int = 0) -> dict:
+    """Autopilot placement gate (ISSUE 15): a 3-process cluster under
+    hot-spotted Zipf traffic must recover its p99 automatically.
+
+    The hot spot is REAL, not simulated: ``n_hot`` single-shard indexes
+    are chosen (by walking candidate names through the same blake2b
+    ring the cluster uses) so that hash placement puts every one of
+    them on ONE node, and closed-loop clients drive a Zipf-weighted
+    query mix at them, owner-routed the way a shard-aware client
+    routes. Under ``qos-max-inflight`` admission the overloaded owner
+    sheds the excess with 429 + Retry-After and clients retry after
+    backoff — so the measured (retry-inclusive) p99 is exactly the
+    client-visible cost of the skew. This makes the gate meaningful on
+    a 1-core CI box too: sheds are near-free for the server, so the
+    hot node's p99 is backpressure wait, which the autopilot removes
+    by SPREADING admission capacity, not by needing N cores to race.
+
+    Three measured placements on identical data and workload shape:
+
+    - ``uniform``: owners round-robin all nodes (control cluster,
+      autopilot off) — the baseline the gate compares against;
+    - ``hot unmanaged``: every hot index on one owner, autopilot OFF —
+      the injury persists (reported, not gated: it must be > baseline
+      for the run to mean anything);
+    - ``hot autopiloted``: same skew with the planner ON — the first
+      windows show the injury, the tail windows must show recovery.
+
+    Gate (``ok``): tail-window p99 ≤ 1.5× the uniform p99 AND zero
+    client errors (a 429 retried to success is backpressure, not an
+    error; anything else — 5xx, transport failure, retry exhaustion —
+    fails the gate) AND zero lost acked writes (a ledgered Set that
+    rode through the autopilot's resizes must stay queryable) AND the
+    planner actually acted (≥1 executed move, live overrides) AND the
+    kill-switch control cluster stayed byte-identical to hash
+    placement (epoch 0, no overrides, every probe write's heat row
+    lands on the ring-computed owner and nowhere else)."""
+    import bisect as _bisect
+    import http.client as _hc
+    import os
+    import random as _random
+    import socket
+    import subprocess
+    import sys
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.parallel.cluster import PARTITION_N, _hash64
+
+    NAMES = ("ap0", "ap1", "ap2")
+    ZIPF_S = 1.1
+    RETRY_CAP = 400  # per-request attempt bound before it counts as an error
+
+    def _ring_owner(index: str, shard: int = 0) -> str:
+        # replica-n=1 rendition of Cluster.shard_nodes' hash walk; the
+        # control cluster's byte-identity check holds this replica and
+        # the server's walk to the same answer through real traffic
+        ring = sorted(NAMES, key=lambda n: (_hash64(n), n))
+        part = _hash64(f"{index}:{shard}") % PARTITION_N
+        return ring[part % len(ring)]
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def req(method, base, path, body=None, timeout=30):
+        r = urllib.request.Request(f"{base}{path}", data=body,
+                                   method=method)
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def spawn_cluster(tmp: str, autopilot_on: bool) -> dict:
+        os.makedirs(tmp, exist_ok=True)
+        ports = {name: free_port() for name in NAMES}
+        bases = {n: f"http://127.0.0.1:{p}" for n, p in ports.items()}
+        procs = {}
+
+        def wait_status(name) -> None:
+            for _ in range(240):
+                if procs[name].poll() is not None:
+                    raise AssertionError(f"{name} exited "
+                                         f"rc={procs[name].returncode}")
+                try:
+                    req("GET", bases[name], "/status", timeout=5)
+                    return
+                except Exception:
+                    time.sleep(0.25)
+            raise AssertionError(f"{name} never served /status")
+
+        for i, name in enumerate(NAMES):
+            env = {
+                **os.environ, "JAX_PLATFORMS": "cpu",
+                "PILOSA_TPU_NAME": name,
+                "PILOSA_TPU_REPLICA_N": "1",
+                # anti-entropy ON: a shard move pulls the fragment
+                # snapshot; writes racing the move land as stray
+                # residue on the old owner, which cleanup refuses to
+                # delete until a sync pass absorbs it into the new
+                # owner — with the ticker off, acked bits would sit
+                # unreadable in deferred strays forever
+                "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "2",
+                "PILOSA_TPU_HEARTBEAT_INTERVAL": "0",
+                "PILOSA_TPU_USE_MESH": "false",
+                "PILOSA_TPU_QOS_MAX_INFLIGHT": str(inflight_cap),
+            }
+            if i > 0:
+                env["PILOSA_TPU_SEEDS"] = bases[NAMES[0]]
+            if autopilot_on:
+                env.update({
+                    "PILOSA_TPU_AUTOPILOT_ENABLED": "true",
+                    "PILOSA_TPU_AUTOPILOT_INTERVAL": "1s",
+                    "PILOSA_TPU_AUTOPILOT_HEAT_BUDGET": "1.3",
+                    "PILOSA_TPU_AUTOPILOT_MAX_MOVES": "4",
+                    "PILOSA_TPU_AUTOPILOT_MIN_DWELL": "2s",
+                })
+            log = open(f"{tmp}/{name}.log", "wb")
+            procs[name] = subprocess.Popen(
+                [sys.executable, "-m", "pilosa_tpu", "server",
+                 "--data-dir", f"{tmp}/{name}", "--bind", "127.0.0.1",
+                 "--port", str(ports[name])],
+                env=env, stdout=log, stderr=subprocess.STDOUT,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            log.close()
+            # join is a single shot at startup (no retry loop), so the
+            # seed must be listening before any joiner boots — spawn
+            # strictly seed-first and gate on its /status
+            if i == 0:
+                wait_status(name)
+        for name in NAMES[1:]:
+            wait_status(name)
+        # EVERY node must see the full membership — the seed converges
+        # first (joiners announce to it directly), but a joiner that
+        # missed the join relay would serve an asymmetric ring whose
+        # reads route around data the other joiner holds
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            views = [{n["id"] for n in
+                      req("GET", bases[name], "/status")["nodes"]}
+                     for name in NAMES]
+            if all(v == set(NAMES) for v in views):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"cluster never reached full membership: {views}")
+        return {"procs": procs, "bases": bases}
+
+    def terminate(cluster) -> None:
+        for p in cluster["procs"].values():
+            p.terminate()
+        for p in cluster["procs"].values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=15)
+
+    # ---- index pools: names bucketed by ring owner ---------------------
+    buckets: dict[str, list] = {n: [] for n in NAMES}
+    i = 0
+    while any(len(b) < 2 * n_hot for b in buckets.values()):
+        name = f"t{i:03d}"
+        buckets[_ring_owner(name)].append(name)
+        i += 1
+    hot_node = NAMES[1]  # any bucket works; fixed for deterministic replay
+    hot_set = buckets[hot_node][:n_hot]
+    # uniform set: Zipf rank r owned by node r % 3, so the popularity
+    # mass lands evenly — the placement the autopilot should converge to.
+    # Disjoint from hot_set (the hot bucket's cursor starts past it).
+    cursors = {n: (n_hot if n == hot_node else 0) for n in NAMES}
+    uniform_set = []
+    for r in range(n_hot):
+        node = NAMES[r % len(NAMES)]
+        uniform_set.append(buckets[node][cursors[node]])
+        cursors[node] += 1
+    weights = np.array([1.0 / (r + 1) ** ZIPF_S for r in range(n_hot)])
+    cum = np.cumsum(weights / weights.sum()).tolist()
+
+    def seed_indexes(bases, names) -> None:
+        entry = bases[NAMES[0]]
+        for name in names:
+            req("POST", entry, f"/index/{name}", b"{}")
+            req("POST", entry, f"/index/{name}/field/f", b"{}")
+            for col in (1, 2, 3):
+                req("POST", entry, f"/index/{name}/query",
+                    f"Set({col}, f=1)".encode())
+
+    # ---- owner-routed closed-loop load --------------------------------
+    class Router:
+        """Client-side shard-aware routing: ring walk + the override
+        table polled from /debug/autopilot (what a topology-aware
+        client library would cache)."""
+
+        def __init__(self, bases):
+            self.bases = bases
+            self.overrides: dict = {}
+            self.lock = threading.Lock()
+
+        def refresh(self) -> None:
+            try:
+                j = req("GET", self.bases[NAMES[0]], "/debug/autopilot",
+                        timeout=5)
+                ov = {}
+                for e in (j.get("placement") or {}).get("overrides", []):
+                    ov[(e["index"], int(e["shard"]))] = list(e["nodes"])
+                with self.lock:
+                    self.overrides = ov
+            except Exception:
+                pass  # stale routing is legal; owners still fan out
+
+        def owner(self, index: str) -> str:
+            with self.lock:
+                ids = self.overrides.get((index, 0))
+            if ids and all(i in self.bases for i in ids):
+                return ids[0]
+            return _ring_owner(index)
+
+    def run_load(bases, router, index_set, duration_s, *,
+                 write_ledger=None, refresh=False):
+        """``n_clients`` closed-loop Zipf query threads (+1 ledgered
+        writer when ``write_ledger`` is given). Returns (samples,
+        errors, retries): samples are (completed_at_s, latency_s)
+        with latency INCLUDING 429-retry backoff."""
+        samples: list = []
+        errors: list = []
+        retries = [0]
+        lock = threading.Lock()
+        stop = threading.Event()
+        t_start = time.monotonic()
+
+        def do_request(conns, name, path, body):
+            conn = conns.get(name)
+            if conn is None:
+                host = bases[name].split("//")[1]
+                h, _, p = host.partition(":")
+                conn = conns[name] = _hc.HTTPConnection(h, int(p),
+                                                        timeout=30)
+            conn.request("POST", path, body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+
+        def drop_conn(conns, name) -> None:
+            stale = conns.pop(name, None)
+            if stale is not None:
+                try:
+                    stale.close()
+                except Exception:
+                    pass
+
+        def one_op(conns, rng, index, body):
+            """POST until acked; latency includes every retry. Returns
+            (latency_s, None) or (None, error)."""
+            t0 = time.monotonic()
+            attempts = 0
+            while True:
+                name = router.owner(index)
+                try:
+                    status, data = do_request(
+                        conns, name, f"/index/{index}/query", body)
+                except Exception:
+                    # stale keep-alive: reconnect, bounded retries
+                    drop_conn(conns, name)
+                    attempts += 1
+                    if attempts > RETRY_CAP:
+                        return None, "transport retries exhausted"
+                    continue
+                if status == 200:
+                    return time.monotonic() - t0, None
+                if status == 429:
+                    attempts += 1
+                    retries[0] += 1
+                    if attempts > RETRY_CAP:
+                        return None, "429 retries exhausted"
+                    # client-side backoff on the bench's timescale (the
+                    # server's Retry-After floor is a whole second —
+                    # honoring it verbatim would quantize every p99 to
+                    # 1s buckets); jittered linear ramp, 4→40ms
+                    time.sleep(min(0.004 * attempts, 0.04)
+                               * (0.5 + rng.random()))
+                    continue
+                return None, f"HTTP {status}: {data[:120]!r}"
+
+        def query_worker(tid: int):
+            conns: dict = {}
+            rng = _random.Random(seed * 1000 + tid)
+            while not stop.is_set():
+                r = min(_bisect.bisect_left(cum, rng.random()),
+                        len(index_set) - 1)
+                lat, err = one_op(conns, rng, index_set[r],
+                                  b"Count(Row(f=1))")
+                with lock:
+                    if err is not None:
+                        errors.append(err)
+                    elif lat is not None:
+                        samples.append(
+                            (time.monotonic() - t_start, lat))
+            for c in conns.values():
+                c.close()
+
+        def writer_worker():
+            # the acked-write ledger rider: a 200 on Set IS the ack —
+            # every ledgered (index, col) must be queryable at the end,
+            # however many placement moves its shard rode through
+            conns: dict = {}
+            rng = _random.Random(seed * 1000 + 777)
+            col = 1000
+            k = 0
+            while not stop.is_set():
+                index = index_set[k % len(index_set)]
+                k += 1
+                col += 1
+                _lat, err = one_op(conns, rng, index,
+                                   f"Set({col}, f=2)".encode())
+                with lock:
+                    if err is not None:
+                        errors.append(f"write: {err}")
+                    else:
+                        write_ledger.add((index, col))
+                time.sleep(0.02)  # read-dominated mix
+            for c in conns.values():
+                c.close()
+
+        threads = [threading.Thread(target=query_worker, args=(t,),
+                                    daemon=True)
+                   for t in range(n_clients)]
+        if write_ledger is not None:
+            threads.append(threading.Thread(target=writer_worker,
+                                            daemon=True))
+
+        def refresher():
+            while not stop.is_set():
+                router.refresh()
+                time.sleep(0.3)
+
+        if refresh:
+            threads.append(threading.Thread(target=refresher,
+                                            daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        return samples, errors, retries[0]
+
+    def p99_ms(samples, t_lo, t_hi) -> float:
+        lats = [lat for at, lat in samples if t_lo <= at < t_hi]
+        if not lats:
+            return float("nan")
+        return round(float(np.percentile(np.array(lats), 99)) * 1e3, 2)
+
+    t0 = time.time()
+    record: dict = {"config": "autopilot",
+                    "metric": "hotspot_p99_recovery"}
+    with tempfile.TemporaryDirectory() as tmp:
+        # ---- phase A: control cluster, kill switch OFF ----------------
+        control = spawn_cluster(f"{tmp}/off", autopilot_on=False)
+        try:
+            bases = control["bases"]
+            kill_switch_ok = True
+            for name in NAMES:
+                j = req("GET", bases[name], "/debug/autopilot")
+                pl = j.get("placement") or {}
+                kill_switch_ok &= (j.get("enabled") is False
+                                   and pl.get("epoch", -1) == 0
+                                   and not pl.get("overrides"))
+            seed_indexes(bases, uniform_set + hot_set)
+            # byte-identity probe: every seeded index's WRITE heat (the
+            # Sets above, posted at ap0) must surface on exactly the
+            # ring-computed owner — real traffic observing placement
+            time.sleep(0.3)
+            heat_rows = {
+                name: req("GET", bases[name], "/debug/heatmap")
+                .get("shards", []) for name in NAMES
+            }
+            placement_mismatches = []
+            for index in uniform_set + hot_set:
+                holders = {
+                    name for name, rows in heat_rows.items()
+                    if any(r.get("index") == index
+                           and r.get("writes", 0) > 0 for r in rows)
+                }
+                if holders != {_ring_owner(index)}:
+                    placement_mismatches.append(
+                        {"index": index, "want": _ring_owner(index),
+                         "got": sorted(holders)})
+            router = Router(bases)
+            u_samples, u_errors, _ = run_load(
+                bases, router, uniform_set, base_run_s)
+            h_samples, h_errors, _ = run_load(
+                bases, router, hot_set, base_run_s * 0.75)
+            p99_uniform = p99_ms(u_samples, 2.0, base_run_s)
+            p99_hot_unmanaged = p99_ms(h_samples, 2.0, base_run_s * 0.75)
+        finally:
+            terminate(control)
+
+        # ---- phase B: autopilot ON, same skew -------------------------
+        managed = spawn_cluster(f"{tmp}/on", autopilot_on=True)
+        try:
+            bases = managed["bases"]
+            seed_indexes(bases, hot_set)
+            router = Router(bases)
+            ledger: set = set()
+            m_samples, m_errors, m_retries = run_load(
+                bases, router, hot_set, hot_run_s,
+                write_ledger=ledger, refresh=True)
+            p99_hot_early = p99_ms(m_samples, 0.0, 4.0)
+            p99_recovered = p99_ms(m_samples, hot_run_s - 6.0, hot_run_s)
+            timeline = [
+                {"window_s": [w, w + 2], "p99_ms": p99_ms(m_samples,
+                                                          w, w + 2)}
+                for w in range(0, int(hot_run_s), 2)
+            ]
+            recover_at = next(
+                (w["window_s"][0] for w in timeline
+                 if w["window_s"][0] >= 4
+                 and w["p99_ms"] <= 1.5 * p99_uniform), None)
+            pilot = req("GET", bases[NAMES[0]], "/debug/autopilot")
+            moves = (pilot.get("metrics") or {}).get(
+                "autopilot_moves_executed_total", 0)
+            overrides_live = len(
+                (pilot.get("placement") or {}).get("overrides", []))
+            # acked-write ledger: every Set acked through the resizes
+            # must become queryable cluster-wide. Bounded retry: bits
+            # that raced a move sit as stray residue until the next
+            # anti-entropy pass (2s ticker) absorbs them into the new
+            # owner — convergence, not loss
+            lost = []
+            for attempt in range(8):
+                lost = []
+                for index in hot_set:
+                    want = {c for ix, c in ledger if ix == index}
+                    if not want:
+                        continue
+                    out = req("POST", bases[NAMES[0]],
+                              f"/index/{index}/query", b"Row(f=2)")
+                    got = set(out.get("results", [{}])[0]
+                              .get("columns", []))
+                    lost.extend((index, c) for c in want - got)
+                if not lost:
+                    break
+                time.sleep(2.0)
+            lost_debug = {}
+            if lost:
+                # per-node view of every lost index while the cluster
+                # still serves: local fragment inventory, per-node
+                # placement epoch/overrides, per-node readback
+                for index in sorted({ix for ix, _ in lost}):
+                    per = {}
+                    for name in NAMES:
+                        ent = {}
+                        try:
+                            cat = req("GET", bases[name],
+                                      f"/internal/fragments?index={index}")
+                            ent["fragments"] = cat.get("fragments", [])
+                        except Exception as e:  # noqa: BLE001
+                            ent["fragments"] = f"ERR {e}"
+                        try:
+                            out = req("POST", bases[name],
+                                      f"/index/{index}/query",
+                                      b"Row(f=2)")
+                            ent["row_f2"] = sorted(
+                                out.get("results", [{}])[0]
+                                .get("columns", []))[-8:]
+                        except Exception as e:  # noqa: BLE001
+                            ent["row_f2"] = f"ERR {e}"
+                        try:
+                            pl = req("GET", bases[name],
+                                     "/debug/autopilot")["placement"]
+                            ent["placement"] = [
+                                o for o in pl.get("overrides", [])
+                                if o["index"] == index]
+                            ent["epoch"] = pl.get("epoch")
+                        except Exception as e:  # noqa: BLE001
+                            ent["placement"] = f"ERR {e}"
+                        per[name] = ent
+                    lost_debug[index] = per
+                for name in NAMES:
+                    try:
+                        with open(f"{tmp}/on/{name}.log", "rb") as f:
+                            tail = f.read()[-6000:]
+                        lost_debug[f"log_{name}"] = [
+                            ln for ln in
+                            tail.decode("utf-8", "replace").splitlines()
+                            if any(ix in ln for ix, _ in lost)
+                            or "autopilot" in ln or "cleanup" in ln][-30:]
+                    except Exception:  # noqa: BLE001
+                        pass
+        finally:
+            terminate(managed)
+
+        # ---- phase C: autopilot-active chaos schedules ----------------
+        # the planner minting overrides and resizing WHILE partitions,
+        # kills, and restarts land — gated on the same five oracles as
+        # config_chaos (testing/chaos.py with_autopilot)
+        from pilosa_tpu.testing.chaos import run_chaos
+
+        chaos = run_chaos(
+            f"{tmp}/chaos", n_schedules=n_chaos_schedules, n_nodes=3,
+            replica_n=2, seed=seed, n_events=6, with_autopilot=True,
+        )
+
+    errors_total = len(u_errors) + len(h_errors) + len(m_errors)
+    record.update({
+        "n_nodes": len(NAMES), "n_hot_indexes": n_hot,
+        "n_clients": n_clients, "inflight_cap": inflight_cap,
+        "zipf_s": ZIPF_S, "hot_node": hot_node,
+        "p99_uniform_ms": p99_uniform,
+        "p99_hot_unmanaged_ms": p99_hot_unmanaged,
+        "p99_hot_early_ms": p99_hot_early,
+        "p99_recovered_ms": p99_recovered,
+        "recovery_ratio": (round(p99_recovered / p99_uniform, 3)
+                           if p99_uniform else None),
+        "recovered_at_s": recover_at,
+        "timeline": timeline,
+        "autopilot_moves": moves,
+        "placement_overrides_live": overrides_live,
+        "retries_429": m_retries,
+        "acked_writes": len(ledger),
+        "lost_acked_writes": len(lost),
+        "lost_sample": lost[:5],
+        "lost_debug": lost_debug,
+        "client_errors": errors_total,
+        "error_sample": (u_errors + h_errors + m_errors)[:5],
+        "kill_switch_byte_identical": bool(
+            kill_switch_ok and not placement_mismatches),
+        "placement_mismatches": placement_mismatches[:5],
+        "chaos": {
+            "schedules": chaos["schedules"],
+            "autopilot_moves_total": chaos["autopilot_moves_total"],
+            "lost_acked_writes": chaos["lost_acked_writes"],
+            "replica_mismatches": chaos["replica_mismatches"],
+            "failed_seeds": chaos["failed_seeds"],
+            "unconverged": chaos["unconverged"],
+            "ok": chaos["ok"],
+        },
+        "wall_s": round(time.time() - t0, 1),
+        "ok": bool(
+            kill_switch_ok and not placement_mismatches
+            and errors_total == 0 and not lost
+            and moves >= 1 and overrides_live >= 1
+            and p99_recovered == p99_recovered  # not NaN
+            and p99_uniform == p99_uniform
+            and p99_recovered <= 1.5 * p99_uniform
+            and chaos["ok"] and chaos["unconverged"] == 0),
+    })
+    return record
+
+
 def _spawn_cpu_mesh_entry() -> None:
     """Run config5_mesh_cpu8 in a subprocess pinned to an 8-device
     virtual CPU platform (the axon TPU plugin would otherwise own the
@@ -3218,7 +3772,7 @@ def main() -> None:
         "--configs",
         default="1,2,3,4,5,mesh8,mesh,serving,mp_serving,multitenant,import,"
                 "ingest,sync,hostpath,durability,tracing,profiling,chaos,"
-                "scrub",
+                "scrub,autopilot",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -3296,6 +3850,10 @@ def main() -> None:
         "scrub": lambda: config_scrub(
             n_chaos_schedules=4 if args.full else 2,
             queries_per_client=240 if args.full else 120,
+        ),
+        "autopilot": lambda: config_autopilot(
+            hot_run_s=32.0 if args.full else 24.0,
+            n_chaos_schedules=6 if args.full else 3,
         ),
         "mesh": config_mesh,
     }

@@ -1,0 +1,21 @@
+"""Autopilot placement plane: heat-weighted shard rebalancing that
+recovers hot-spot p99 without operator action (ROADMAP item 4). The
+pure planner and the ticker live in ``planner``; the actuator surface
+(the epoch-stamped placement-override table) lives beside the hash
+ring in ``pilosa_tpu.parallel.cluster``."""
+
+from pilosa_tpu.autopilot.planner import (
+    DEFAULT_HEAT_BUDGET,
+    DEFAULT_MAX_MOVES,
+    Autopilot,
+    plan_moves,
+    shaped_move_budget,
+)
+
+__all__ = [
+    "Autopilot",
+    "plan_moves",
+    "shaped_move_budget",
+    "DEFAULT_HEAT_BUDGET",
+    "DEFAULT_MAX_MOVES",
+]

@@ -1,0 +1,433 @@
+"""Autopilot placement plane: heat-weighted shard rebalancing.
+
+ROADMAP item 4's closing move: PRs 8-12 built the sensors (decayed
+per-shard heat, SLO burn rates) and the safe actuators (epoch-fenced
+quorum-gated resize, paced transfers), but placement stayed pure
+``hash(index, shard) % 256`` — a Zipf-skewed tenant pins its hot shards
+on whichever node the hash picked, and that node's queue becomes the
+cluster's p99 while its peers idle. This module closes the loop:
+
+- :func:`plan_moves` is the PURE planner — given per-(index, shard)
+  heat, the current owner map, and the live membership, it greedily
+  moves the hottest shard groups off nodes above a per-node heat budget
+  until every node fits (or the per-pass move budget runs out). The
+  budget is a *multiple of the mean node load* (``heat_budget = 1.5`` ⇒
+  a node may run 50% hotter than average before the planner acts), with
+  a hysteresis dead band: rebalancing starts only above the high
+  watermark but drains the node down to the midpoint between mean and
+  budget, so a node hovering AT the budget doesn't flap every pass.
+  Properties the tests pin: uniform heat ⇒ zero moves, and re-planning
+  after applying a plan ⇒ zero moves (idempotent fixpoint).
+
+- :class:`Autopilot` is the ticker (same lifecycle as the residency
+  tierer): every node runs one, but a pass acts only on the acting
+  coordinator with quorum — so the planner fails over with coordination
+  itself. A pass gathers cluster-wide heat (each node records heat
+  where shards EXECUTE, so the coordinator polls every member's
+  /debug/heatmap and max-merges), reads the SLO burn rate to size the
+  move budget (an actively-burning latency objective unlocks the full
+  budget; otherwise rebalancing is background maintenance at half
+  rate), shapes that budget by the RepairPacer's byte rate (moves ride
+  the same paced repair wire, so the planner never schedules more
+  transfer than the pacer would admit per interval), and executes via
+  the EXISTING machinery: install the override table with
+  ``Cluster.apply_placement`` (quorum-gated, epoch-minted, gossiped),
+  then ``coordinate_resize`` moves the data and the post-resize cleanup
+  drops the old copies — which is exactly why the chaos oracles (zero
+  lost acked writes, byte-identical replicas, no non-quorum deletion)
+  gate the autopilot itself.
+
+- **Dwell**: a shard moved by a pass is immune from further moves for
+  ``min_dwell_s`` (default two intervals) — heat redistributes slowly
+  after a move (decayed counters), and without dwell the planner would
+  chase its own tail, bouncing the same hot shard between nodes.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+DEFAULT_HEAT_BUDGET = 1.5
+DEFAULT_MAX_MOVES = 4
+
+# Nominal per-move transfer estimate for pacer shaping: the planner
+# runs BEFORE fragments move, so exact sizes are unknowable — one
+# roaring fragment of serving-shaped data lands around a MiB (Chambi
+# et al. 1402.6407 compression on the delta wire), and the estimate
+# only needs to be right within an order of magnitude to keep a
+# tightly-paced cluster from scheduling transfers it cannot absorb.
+NOMINAL_MOVE_BYTES = 1 << 20
+
+
+def plan_moves(shard_heat: dict, owners_of, node_ids, *,
+               heat_budget: float = DEFAULT_HEAT_BUDGET,
+               max_moves: int = DEFAULT_MAX_MOVES,
+               frozen=()) -> list[dict]:
+    """Greedy heat rebalance. Pure: no clocks, no cluster handles.
+
+    ``shard_heat``: {(index, shard): heat ≥ 0} — the unit of movement.
+    ``owners_of``: callable (index, shard) → ordered owner node-id list
+    (the live placement, overrides included).
+    ``node_ids``: live members eligible to receive shards.
+    ``frozen``: (index, shard) keys under dwell — immune this pass.
+
+    Returns moves ``{"index", "shard", "from", "to", "heat",
+    "owners"}`` hottest-first, where ``owners`` is the full new owner
+    list for the override table (source replaced by target, order
+    preserved — order is the query-routing preference)."""
+    node_ids = sorted(set(node_ids))
+    if len(node_ids) < 2 or max_moves <= 0:
+        return []
+    frozen = set(frozen)
+
+    # Attribute each group's heat evenly across its owners (replicas
+    # share the serving load), building per-node load + the owner map.
+    loads = dict.fromkeys(node_ids, 0.0)
+    owners: dict[tuple, list[str]] = {}
+    shares: dict[tuple, float] = {}
+    for key, heat in shard_heat.items():
+        own = [i for i in (owners_of(*key) or []) if i in loads]
+        if not own or heat <= 0:
+            continue
+        owners[key] = list(own)
+        share = float(heat) / len(own)
+        shares[key] = share
+        for node_id in own:
+            loads[node_id] += share
+
+    mean = sum(loads.values()) / len(node_ids)
+    if mean <= 0:
+        return []
+    high = heat_budget * mean
+    # hysteresis dead band: act above ``high``, stop draining at the
+    # midpoint — a node sitting exactly at budget neither starts nor
+    # endlessly continues a rebalance
+    low = mean + (high - mean) / 2.0
+
+    moves: list[dict] = []
+    moved: set[tuple] = set()
+    while len(moves) < max_moves:
+        src = max(loads, key=loads.get)
+        if loads[src] <= high:
+            break
+        # hottest movable groups on the overloaded node first: fewest
+        # moves to drain the most heat
+        candidates = sorted(
+            (key for key, own in owners.items()
+             if src in own and key not in frozen and key not in moved),
+            key=lambda k: shares[k], reverse=True,
+        )
+        applied = False
+        for key in candidates:
+            share = shares[key]
+            own = owners[key]
+            # least-loaded node not already replicating this group
+            targets = [i for i in node_ids if i not in own]
+            if not targets:
+                continue
+            dst = min(targets, key=loads.get)
+            # accept only a strict improvement that keeps the target
+            # under the source's new load (otherwise the "rebalance"
+            # just relocates the hot spot) and never drains below the
+            # low watermark's need
+            if loads[dst] + share >= loads[src]:
+                continue
+            loads[src] -= share
+            loads[dst] += share
+            own[own.index(src)] = dst
+            moved.add(key)
+            moves.append({
+                "index": key[0], "shard": key[1], "from": src,
+                "to": dst, "heat": round(shares[key] * len(own), 3),
+                "owners": list(own),
+            })
+            applied = True
+            if loads[src] <= low:
+                break  # drained into the dead band: next hottest node
+            if len(moves) >= max_moves:
+                break
+        if not applied:
+            break  # nothing movable improves the worst node: stop
+    return moves
+
+
+def shaped_move_budget(max_moves: int, pacer, interval_s: float,
+                       est_move_bytes: int = NOMINAL_MOVE_BYTES) -> int:
+    """Per-pass move budget shaped by the RepairPacer: never schedule
+    more transfer than the pacer admits in one interval (the moves ride
+    the same paced repair wire — scheduling past the rate just queues
+    paced sleeps into the resize window and starves serving of exactly
+    the bandwidth the pacer protects). Unpaced clusters keep the
+    configured budget."""
+    max_moves = max(0, int(max_moves))
+    rate = float(getattr(pacer, "rate", 0) or 0)
+    if rate <= 0 or interval_s <= 0:
+        return max_moves
+    cap = int((rate * interval_s) / max(int(est_move_bytes), 1))
+    return max(1, min(max_moves, cap)) if max_moves else 0
+
+
+class Autopilot:
+    """Planner ticker: heat in, epoch-fenced placement changes out."""
+
+    MAX_DECISIONS = 256
+    # dwell stamps are an observability/thrash ring, not history
+    MAX_TRACKED = 65536
+
+    def __init__(self, cluster, heat=None, slo=None, *,
+                 interval_s: float = 0.0,
+                 heat_budget: float = DEFAULT_HEAT_BUDGET,
+                 max_moves: int = DEFAULT_MAX_MOVES,
+                 min_dwell_s: float | None = None,
+                 pacer=None, logger=None):
+        if heat is None:
+            from pilosa_tpu.storage.heat import global_heat
+
+            heat = global_heat()
+        self.cluster = cluster
+        self.heat = heat
+        self.slo = slo
+        self.interval_s = float(interval_s)
+        self.heat_budget = float(heat_budget)
+        self.max_moves = int(max_moves)
+        # dwell immunity defaults to two intervals, like the residency
+        # tierer: one pass of post-move heat noise cannot bounce the
+        # shard straight back
+        self.min_dwell_s = (float(min_dwell_s)
+                            if min_dwell_s is not None and min_dwell_s > 0
+                            else max(2 * self.interval_s, 1.0))
+        self.pacer = pacer
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._moved_at: dict[tuple, float] = {}
+        self._decisions = collections.deque(maxlen=self.MAX_DECISIONS)
+        self._closed = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.passes = 0
+        self.plans = 0
+        self.moves_planned = 0
+        self.moves_executed = 0
+        self.prunes = 0
+        self.skips: dict[str, int] = {}
+        self.last_pass_s = 0.0
+        self.last_burn = 0.0
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "Autopilot":
+        if self.interval_s > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="autopilot"
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._closed.wait(self.interval_s):
+            try:
+                self.run_pass()
+            except Exception as e:  # noqa: BLE001 — ticker must not die
+                if self.logger is not None:
+                    self.logger.warning("autopilot pass failed: %s", e)
+
+    def close(self) -> None:
+        self._closed.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    # ---------------------------------------------------------------- pass
+
+    def _skip(self, reason: str) -> dict:
+        self.skips[reason] = self.skips.get(reason, 0) + 1
+        return {"acted": False, "reason": reason}
+
+    def _gather_heat(self, peers) -> dict:
+        """Cluster-wide (index, shard) → heat: local snapshot plus every
+        reachable peer's /debug/heatmap, max-merged by full row key (an
+        unreachable peer contributes nothing — its shards read as cold
+        this pass, and moving TOWARD a node we cannot see is what the
+        live-membership check in plan_moves prevents)."""
+        from pilosa_tpu.storage.heat import merge_shard_heat
+        from pilosa_tpu.utils.pool import concurrent_map
+
+        row_lists = [
+            self.heat.snapshot(residency_overlay=False)["shards"]
+        ]
+
+        def one(node):
+            try:
+                return self.cluster.client.heatmap(
+                    node.uri, timeout=self.cluster.heartbeat_timeout,
+                )["shards"]
+            except Exception:  # noqa: BLE001 — cold this pass
+                return []
+
+        if peers:
+            row_lists.extend(concurrent_map(one, peers))
+        return merge_shard_heat(row_lists)
+
+    def run_pass(self) -> dict:
+        """One plan/execute pass. Acts only as the acting coordinator
+        with quorum and a NORMAL, non-degraded cluster — every other
+        node's ticker idles (and takes over when coordination fails
+        over to it). Returns the pass record (tests, /debug)."""
+        from pilosa_tpu.parallel.cluster import STATE_NORMAL
+
+        t0 = time.monotonic()
+        self.passes += 1
+        c = self.cluster
+        record: dict
+        try:
+            if not c.is_acting_coordinator:
+                return self._skip("not-coordinator")
+            if c.degraded:
+                return self._skip("degraded")
+            if c.state != STATE_NORMAL:
+                return self._skip("not-normal")
+            with c._lock:
+                node_ids = sorted(c.nodes)
+                peers = [n for n in c.nodes.values()
+                         if n.id != c.local.id]
+            if len(node_ids) < 2:
+                return self._skip("single-node")
+
+            shard_heat = self._gather_heat(peers)
+            holder = c.holder
+            if holder is not None:
+                # deleted indexes' heat decays but may linger — never
+                # mint overrides for shards that no longer exist
+                shard_heat = {k: v for k, v in shard_heat.items()
+                              if k[0] in holder.indexes}
+
+            burn = 0.0
+            if self.slo is not None:
+                try:
+                    burn = float(self.slo.max_burn_rate())
+                except Exception:  # noqa: BLE001 — plan without SLO
+                    burn = 0.0
+            self.last_burn = burn
+            budget = shaped_move_budget(self.max_moves, self.pacer,
+                                        self.interval_s)
+            if burn < 1.0:
+                # no error budget burning: rebalance is background
+                # maintenance, run at half throttle
+                budget = max(1, budget // 2) if budget else 0
+
+            now = time.monotonic()
+            with self._lock:
+                if len(self._moved_at) > self.MAX_TRACKED:
+                    self._moved_at.clear()
+                frozen = {k for k, t in self._moved_at.items()
+                          if now - t < self.min_dwell_s}
+            moves = plan_moves(
+                shard_heat,
+                owners_of=lambda i, s: [n.id
+                                        for n in c.shard_nodes(i, s)],
+                node_ids=node_ids,
+                heat_budget=self.heat_budget,
+                max_moves=budget,
+                frozen=frozen,
+            )
+            self.plans += 1
+            self.moves_planned += len(moves)
+
+            # assemble the new table: current overrides, minus entries
+            # gone stale (departed owners — hash placement already
+            # resumed for them, materialize it) or redundant (equal to
+            # the hash walk), plus this pass's moves
+            live = set(node_ids)
+            table = {}
+            pruned = 0
+            for key, ids in c.placement.snapshot().items():
+                hash_ids = tuple(
+                    n.id for n in c.partition_nodes(c.partition(*key)))
+                if not set(ids) <= live or tuple(ids) == hash_ids:
+                    pruned += 1
+                    continue
+                table[key] = ids
+            for m in moves:
+                key = (m["index"], m["shard"])
+                hash_ids = tuple(
+                    n.id for n in c.partition_nodes(c.partition(*key)))
+                if tuple(m["owners"]) == hash_ids:
+                    table.pop(key, None)  # moved back home: no entry
+                else:
+                    table[key] = tuple(m["owners"])
+
+            if not moves and not pruned:
+                return self._skip("in-budget")
+
+            epoch = c.apply_placement(table)
+            if not epoch:
+                return self._skip("no-quorum")
+            with self._lock:
+                for m in moves:
+                    self._moved_at[(m["index"], m["shard"])] = now
+            self.moves_executed += len(moves)
+            self.prunes += pruned
+            if self.logger is not None:
+                self.logger.info(
+                    "autopilot epoch %d: %d move(s), %d pruned, "
+                    "burn %.2f, budget %d: %s",
+                    epoch, len(moves), pruned, burn, budget,
+                    [f"{m['index']}/{m['shard']} {m['from']}→{m['to']}"
+                     for m in moves],
+                )
+            record = {
+                "acted": True, "epoch": epoch, "moves": moves,
+                "pruned": pruned, "burn": round(burn, 3),
+                "budget": budget,
+                "heatGroups": len(shard_heat),
+            }
+            self._decisions.append({"at": time.time(), **record})
+            if moves:
+                # the actuator: new owners pull their fragments through
+                # the epoch-fenced resize, cleanup drops the old copies
+                c.coordinate_resize()
+            return record
+        finally:
+            self.last_pass_s = time.monotonic() - t0
+
+    # -------------------------------------------------------- observability
+
+    def last_decisions(self, k: int = 32) -> list[dict]:
+        with self._lock:
+            return list(self._decisions)[-k:]
+
+    def metrics(self) -> dict:
+        """autopilot_* series for /metrics and /debug/vars — every key
+        present from scrape one (api.autopilot_metrics zero-fills when
+        the ticker is off)."""
+        skipped = sum(self.skips.values())
+        return {
+            "autopilot_passes_total": self.passes,
+            "autopilot_plans_total": self.plans,
+            "autopilot_moves_planned_total": self.moves_planned,
+            "autopilot_moves_executed_total": self.moves_executed,
+            "autopilot_overrides_pruned_total": self.prunes,
+            "autopilot_passes_skipped_total": skipped,
+            "autopilot_placement_overrides": len(self.cluster.placement),
+            "autopilot_placement_epoch": self.cluster.placement.epoch,
+            "autopilot_last_pass_seconds": round(self.last_pass_s, 6),
+            "autopilot_slo_burn_rate": round(self.last_burn, 4),
+        }
+
+    def to_json(self) -> dict:
+        """GET /debug/autopilot: knobs, planner state, the decision log,
+        and the live override table."""
+        return {
+            "enabled": True,
+            "intervalS": self.interval_s,
+            "heatBudget": self.heat_budget,
+            "maxMoves": self.max_moves,
+            "minDwellS": self.min_dwell_s,
+            "actingCoordinator": self.cluster.is_acting_coordinator,
+            "skips": dict(self.skips),
+            "metrics": self.metrics(),
+            "placement": self.cluster.placement.to_json(),
+            "decisions": self.last_decisions(),
+        }

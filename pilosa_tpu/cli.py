@@ -39,6 +39,8 @@ heartbeat-timeout = 2.0       # tight per-probe timeout for liveness
                               # stall detection of other failures
 # use-mesh = true             # force the device-mesh executor (default:
                               # auto - mesh when >1 JAX device)
+# mesh-groups = 0             # reduction groups for multi-chip meshes;
+                              # 0 = auto (flat 1-D mesh)
 # device-budget-bytes = 0     # HBM residency budget; 0 = auto
 long-query-time = 0.0         # log queries slower than this; 0 = off
 max-writes-per-request = 5000 # reject larger write batches; 0 = unlimited
@@ -75,6 +77,21 @@ residency-demote-heat = 1.0   # heat below which device-resident
                               # fragments demote host-side; the gap to
                               # promote-heat is the hysteresis dead band
 residency-host-tier-bytes = 1073741824  # compressed host-tier budget
+
+# Autopilot placement plane (docs/OPERATIONS.md autopilot): the
+# coordinator periodically rebalances the hottest (index,shard) groups
+# off overloaded nodes via epoch-fenced placement overrides + resize.
+# The kill switch gates only the planner — overrides minted elsewhere
+# are still honored by every node, keeping placement consistent.
+autopilot-enabled = false     # master kill switch for the planner ticker
+autopilot-interval = 30.0     # seconds between planner passes
+autopilot-heat-budget = 1.5   # per-node heat ceiling as a multiple of
+                              # mean node heat; the margin over 1.0 is
+                              # the hysteresis dead band
+autopilot-max-moves = 4       # shard-group moves per pass (further
+                              # shaped by repair-max-bytes-per-sec)
+autopilot-min-dwell = 0.0     # seconds a moved shard is frozen before
+                              # it may move again; 0 = two intervals
 
 # Write-path durability (docs/OPERATIONS.md): what an HTTP 200 on a
 # write means

@@ -564,6 +564,16 @@ class InternalClient:
             json.dumps(message).encode(),
         )
 
+    def heatmap(self, uri: str, k: int = 0,
+                timeout: float | None = None) -> dict:
+        """Peer heat snapshot (``/debug/heatmap``; ``k=0`` = full
+        table). The autopilot coordinator assembles cluster-wide shard
+        heat from every member's local decayed counters — heat is
+        recorded where the shard EXECUTES, so no single node sees the
+        whole picture."""
+        return self._call("GET", f"{uri}/debug/heatmap?k={int(k)}",
+                          timeout=timeout)
+
     def status(self, uri: str, timeout: float | None = None) -> dict:
         """``timeout`` overrides the client default for THIS probe —
         liveness checks (heartbeat, quorum, death corroboration) use a

@@ -57,7 +57,7 @@ DEAD_HEARTBEATS = 3
 # and monotonic, and fencing them would wedge mixed-epoch metadata.
 FENCED_MESSAGES = frozenset(
     {"cluster-state", "resize-instruction", "resize-cleanup",
-     "node-leave"}
+     "node-leave", "placement-update"}
 )
 
 
@@ -85,6 +85,144 @@ class Node:
 
 def _hash64(data: str) -> int:
     return int.from_bytes(hashlib.blake2b(data.encode(), digest_size=8).digest(), "big")
+
+
+class PlacementTable:
+    """Epoch-stamped (index, shard) → owner-node-id override map — the
+    autopilot's actuator surface, living BESIDE the hash ring rather
+    than replacing it.
+
+    The contract that makes mixed-version clusters safe: an EMPTY table
+    leaves every ownership decision byte-identical to the pure hash
+    walk, and an entry only applies while every listed owner is a live
+    member — otherwise the shard falls back to hash placement, which is
+    the view an override-unaware (older) node computes anyway. Entries
+    are stamped with the cluster epoch the coordinator minted when it
+    installed them; a stale copy (gossiped by a healed ex-coordinator)
+    loses to any newer table. Persisted beside ``cluster.epoch`` with
+    the same tmp+fsync+replace discipline; a corrupt file starts empty
+    and the table is re-adopted from gossip (/status, placement-update
+    messages) — same recovery posture as the epoch file."""
+
+    def __init__(self, path: str | None = None, logger=None):
+        self._lock = threading.Lock()
+        self._overrides: dict[tuple[str, int], tuple[str, ...]] = {}
+        self.epoch = 0
+        self._path = path
+        self.logger = logger
+        self.updates_applied = 0
+        self.updates_rejected = 0
+        self._load()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._overrides)
+
+    def get(self, index: str, shard: int) -> tuple[str, ...] | None:
+        with self._lock:
+            return self._overrides.get((index, int(shard)))
+
+    def snapshot(self) -> dict[tuple[str, int], tuple[str, ...]]:
+        """Point-in-time copy, for callers that make several ownership
+        decisions against ONE view (cleanup_unowned's frozen walk)."""
+        with self._lock:
+            return dict(self._overrides)
+
+    def replace(self, overrides: dict, epoch: int) -> bool:
+        """Install a whole new table stamped ``epoch``. Applies only
+        when the stamp beats the current one (strictly newer — the
+        coordinator mints a fresh epoch per change, so ties mean a
+        duplicate delivery of the same table). Returns applied?"""
+        cleaned: dict[tuple[str, int], tuple[str, ...]] = {}
+        for (index, shard), ids in (overrides or {}).items():
+            ids = tuple(str(i) for i in ids)
+            if ids:
+                cleaned[(str(index), int(shard))] = ids
+        with self._lock:
+            if int(epoch) <= self.epoch:
+                self.updates_rejected += 1
+                return False
+            self._overrides = cleaned
+            self.epoch = int(epoch)
+            self.updates_applied += 1
+            self._persist_locked()
+        return True
+
+    # ------------------------------------------------------------- wire
+
+    @staticmethod
+    def wire_entries(overrides: dict) -> list[dict]:
+        return [
+            {"index": index, "shard": shard, "nodes": list(ids)}
+            for (index, shard), ids in sorted(overrides.items())
+        ]
+
+    @staticmethod
+    def from_wire(entries) -> dict:
+        out: dict[tuple[str, int], tuple[str, ...]] = {}
+        for e in entries or []:
+            try:
+                key = (str(e["index"]), int(e["shard"]))
+                ids = tuple(str(i) for i in e.get("nodes", []))
+            except (KeyError, TypeError, ValueError):
+                continue  # one malformed entry must not poison the rest
+            if ids:
+                out[key] = ids
+        return out
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "overrides": self.wire_entries(self._overrides),
+            }
+
+    # ------------------------------------------------------ persistence
+
+    def _load(self) -> None:
+        if self._path is None:
+            return
+        import json
+
+        try:
+            with open(self._path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        except OSError:
+            return
+        try:
+            d = json.loads(raw)
+            epoch = int(d.get("epoch", 0) or 0)
+            overrides = self.from_wire(d.get("overrides", []))
+        except (ValueError, TypeError, AttributeError):
+            # corrupt/torn file: start empty, re-adopt from gossip —
+            # an override table is always reconstructible cluster state
+            if self.logger is not None:
+                self.logger.error(
+                    "corrupt placement table %r: starting empty "
+                    "(re-adopted from gossip)", self._path,
+                )
+            return
+        self._overrides = overrides
+        self.epoch = epoch
+
+    def _persist_locked(self) -> None:
+        if self._path is None:
+            return
+        import json
+
+        tmp = self._path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"epoch": self.epoch,
+                           "overrides": self.wire_entries(self._overrides)},
+                          f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path)
+        except OSError:  # table still applies in memory; gossip
+            pass         # re-seeds it after a restart
 
 
 class Cluster:
@@ -157,6 +295,24 @@ class Cluster:
         if data_dir:
             self._epoch_path = os.path.join(data_dir, "cluster.epoch")
         self.epoch = self._load_epoch()
+        # Heat-weighted placement overrides (autopilot actuator): empty
+        # table ⇒ byte-identical to the pure hash ring. Persisted beside
+        # the epoch file; bare clusters keep it in memory only.
+        self.placement = PlacementTable(
+            path=(os.path.join(data_dir, "cluster.placement")
+                  if data_dir else None),
+        )
+        # Ring memoization: _frozen_ring re-sorted (and re-blake2b'd
+        # every node id) per shard per query fan-out. The generation
+        # counter bumps at every membership mutation; the hash memo
+        # never invalidates (a node id's hash is immutable), only
+        # bounded. Belt-and-braces validation against a missed bump:
+        # the cached ring must also match the live dict's identity and
+        # size (membership changes always change one or the other,
+        # except same-id object replacement — covered by the bump).
+        self._ring_gen = 0
+        self._ring_cache: tuple[int, int, int, list[Node]] | None = None
+        self._ring_hash_memo: dict[str, int] = {}
         if getattr(self, "_epoch_file_corrupt", False):
             # rewrite the corrupt file NOW so the next restart reads a
             # clean value instead of re-diagnosing the same garbage
@@ -297,6 +453,51 @@ class Cluster:
                 self.epoch = int(epoch)
                 self._persist_epoch_locked()
 
+    def adopt_placement(self, d) -> bool:
+        """Apply a placement table seen on the wire (placement-update
+        message, a peer's /status, the join seed). Strictly-newer
+        stamps win; anything malformed is ignored — the table is
+        always reconstructible from the coordinator's next gossip."""
+        if not isinstance(d, dict):
+            return False
+        try:
+            epoch = int(d.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return False
+        if epoch <= self.placement.epoch:
+            return False  # cheap pre-check; replace() re-checks locked
+        overrides = PlacementTable.from_wire(d.get("overrides", []))
+        applied = self.placement.replace(overrides, epoch)
+        if applied and self.logger is not None:
+            self.logger.info(
+                "%s adopted placement table epoch %d (%d overrides)",
+                self.local.id, epoch, len(overrides),
+            )
+        return applied
+
+    def apply_placement(self, overrides: dict) -> int:
+        """Coordinator-side install of a new override table, the
+        autopilot's single actuator: quorum-gated, epoch-minted (so the
+        broadcast fences above every stale copy), persisted, and pushed
+        to every peer. The caller then drives coordinate_resize() — new
+        owners pull their fragments through the existing epoch-fenced
+        machinery and the post-resize cleanup drops the old copies.
+        Returns the minted epoch, or 0 when refused (not coordinator /
+        no quorum)."""
+        if not self.is_acting_coordinator:
+            return 0
+        if len(self.nodes) > 1 and not self.check_quorum():
+            return 0
+        epoch = self._bump_epoch()
+        self._note_acted(epoch, "placement-update")
+        self.placement.replace(overrides, epoch)
+        self._broadcast({
+            "type": "placement-update", "epoch": epoch,
+            "overrides": PlacementTable.wire_entries(
+                self.placement.snapshot()),
+        })
+        return epoch
+
     # Epochs advance in strides, with each node minting into its own
     # hash slot: two coordinators acting CONCURRENTLY (possible in the
     # documented 2-member/asymmetric corner where both sides pass their
@@ -415,6 +616,8 @@ class Cluster:
             "cluster_quorum_denials_total": self.quorum_denials,
             "cluster_rejoins_total": self.rejoins,
             "cluster_cleanup_deferred_total": self.cleanups_deferred,
+            "cluster_placement_overrides": len(self.placement),
+            "cluster_placement_epoch": self.placement.epoch,
         }
 
     # How long the coordinator waits for every member to drain to NORMAL
@@ -544,6 +747,9 @@ class Cluster:
         with self._lock:
             local_members = sorted(self.nodes)
             ring = self._frozen_ring()
+            # overrides freeze WITH the ring: a placement-update landing
+            # mid-walk must not swing ownership under the deletions
+            placement = self.placement.snapshot()
         if self.local.id not in local_members:
             entry["skipped"] = "departed"
             return 0  # departed (leave()): never self-wipe on exit
@@ -567,9 +773,8 @@ class Cluster:
                         if mine is None:
                             mine = any(
                                 n.id == self.local.id
-                                for n in self._partition_nodes_on(
-                                    ring,
-                                    self.partition(index_name, shard),
+                                for n in self._shard_nodes_on(
+                                    ring, placement, index_name, shard,
                                 )
                             )
                             owned[shard] = mine
@@ -578,8 +783,8 @@ class Cluster:
                         frag = view.fragment(shard)
                         if (frag is not None and frag.count()
                                 and not self._owner_covers(
-                                    ring, index_name, field.name,
-                                    view.name, shard, frag)):
+                                    ring, placement, index_name,
+                                    field.name, view.name, shard, frag)):
                             # this copy holds bits NO owner does — a
                             # write acked under an older ring, or
                             # divergence a partition left behind.
@@ -612,8 +817,9 @@ class Cluster:
             )
         return removed
 
-    def _owner_covers(self, ring, index_name: str, field_name: str,
-                      view_name: str, shard: int, frag) -> bool:
+    def _owner_covers(self, ring, placement, index_name: str,
+                      field_name: str, view_name: str, shard: int,
+                      frag) -> bool:
         """True when some live owner of ``shard`` demonstrably holds a
         SUPERSET of this fragment's bits, so deleting the local copy
         cannot lose data. Checksum-equal blocks are covered outright;
@@ -625,8 +831,8 @@ class Cluster:
         local_blocks = dict(frag.blocks())
         if not local_blocks:
             return True
-        for node in self._partition_nodes_on(
-                ring, self.partition(index_name, shard)):
+        for node in self._shard_nodes_on(
+                ring, placement, index_name, shard):
             if node.id == self.local.id:
                 continue
             try:
@@ -706,13 +912,42 @@ class Cluster:
             self._frozen_ring(), partition
         )
 
+    def _note_membership_changed_locked(self) -> None:
+        """Caller holds _lock and just mutated ``self.nodes``: the
+        memoized ring is stale."""
+        self._ring_gen += 1
+
     def _frozen_ring(self) -> list[Node]:
         """Hash-ordered snapshot of the current membership. Callers that
         make several ownership decisions against ONE membership view
         (cleanup_unowned) take this once under _lock and walk it, so a
-        join/leave landing mid-walk cannot shift ownership under them."""
-        return sorted(self.nodes.values(),
-                      key=lambda n: (_hash64(n.id), n.id))
+        join/leave landing mid-walk cannot shift ownership under them.
+
+        Memoized per ring generation (bumped on every membership
+        mutation): the blake2b per node per call showed up per shard
+        per query fan-out. Callers treat the returned list as frozen —
+        never mutate it."""
+        with self._lock:
+            cached = self._ring_cache
+            if (cached is not None and cached[0] == self._ring_gen
+                    and cached[1] == id(self.nodes)
+                    and cached[2] == len(self.nodes)):
+                return cached[3]
+            memo = self._ring_hash_memo
+            if len(memo) > 4096:  # bound, not invalidate: id→hash is
+                memo.clear()      # immutable, churn just grows the map
+
+            def ring_key(n: Node) -> tuple[int, str]:
+                h = memo.get(n.id)
+                if h is None:
+                    h = _hash64(n.id)
+                    memo[n.id] = h
+                return (h, n.id)
+
+            ring = sorted(self.nodes.values(), key=ring_key)
+            self._ring_cache = (self._ring_gen, id(self.nodes),
+                                len(self.nodes), ring)
+            return ring
 
     def _partition_nodes_on(self, ring: list[Node],
                             partition: int) -> list[Node]:
@@ -723,7 +958,32 @@ class Cluster:
         return [ring[(start + i) % len(ring)] for i in range(n)]
 
     def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        """Owners of one shard: the placement override when one applies
+        (every listed owner a live member), else the pure hash walk.
+        With an empty override table this is byte-identical to the
+        pre-autopilot placement — the mixed-version safety contract."""
+        override = self.placement.get(index, shard)
+        if override is not None:
+            with self._lock:
+                nodes = [self.nodes[i] for i in override
+                         if i in self.nodes]
+            if len(nodes) == len(override):
+                return nodes
+            # a listed owner left the membership: hash placement
+            # resumes for this shard until the planner re-plans
         return self.partition_nodes(self.partition(index, shard))
+
+    def _shard_nodes_on(self, ring: list[Node], placement: dict,
+                        index: str, shard: int) -> list[Node]:
+        """shard_nodes against a FROZEN (ring, placement) snapshot —
+        the cleanup walk's TOCTOU discipline extended to overrides."""
+        ids = placement.get((index, int(shard)))
+        if ids:
+            by_id = {n.id: n for n in ring}
+            nodes = [by_id[i] for i in ids if i in by_id]
+            if len(nodes) == len(ids):
+                return nodes
+        return self._partition_nodes_on(ring, self.partition(index, shard))
 
     def owns_shard(self, index: str, shard: int) -> bool:
         return any(n.id == self.local.id for n in self.shard_nodes(index, shard))
@@ -848,8 +1108,48 @@ class Cluster:
         elif kind == "node-join":
             node = Node(message["id"], message["uri"])
             with self._lock:
+                known = node.id in self.nodes
                 self.nodes[node.id] = node
                 self._forgotten.pop(node.id, None)
+                self._note_membership_changed_locked()
+                relay_to = ([n for n in self.nodes.values()
+                             if n.id != node.id
+                             and n.id != self.local.id]
+                            if not known else [])
+            if relay_to:
+                # Join gossip (reference: memberlist broadcasts joins).
+                # A joiner announces only to the members the seed's
+                # /status listed at ITS join time, so two nodes joining
+                # the same seed CONCURRENTLY each adopt [seed, self] and
+                # announce to the seed alone — neither ever learns the
+                # other, and each serves its own asymmetric ring (reads
+                # through one routes around data the other holds). On
+                # first learning of a node, relay the join both ways:
+                # the new member to every known member, every known
+                # member to the new one. A relay of an already-known
+                # node is a no-op here (known ⇒ no further relay), so
+                # the wave terminates after one generation per edge.
+                def _relay_join():
+                    for peer in relay_to:
+                        try:
+                            self._send_retry(peer.uri, {
+                                "type": "node-join",
+                                "id": node.id, "uri": node.uri,
+                            })
+                        except ClientError:
+                            pass
+                        try:
+                            self._send_retry(node.uri, {
+                                "type": "node-join",
+                                "id": peer.id, "uri": peer.uri,
+                            })
+                        except ClientError:
+                            pass
+
+                # async: this handler runs on the serving thread of the
+                # announce POST — the relay fan-out must not hold it
+                threading.Thread(target=_relay_join, daemon=True,
+                                 name="join-relay").start()
             # membership changed ownership: the acting coordinator computes
             # per-node fetch instructions (reference ResizeInstruction)
             if self.is_acting_coordinator:
@@ -857,6 +1157,7 @@ class Cluster:
         elif kind == "node-leave":
             with self._lock:
                 removed = self.nodes.pop(message["id"], None)
+                self._note_membership_changed_locked()
                 if removed is not None:
                     # remember the uri: if this node later ends up solo
                     # (everyone amputated during a partition) it probes
@@ -917,6 +1218,10 @@ class Cluster:
                             node.state = STATE_DEGRADED
                     self._resize_pending.discard(message.get("node"))
                     self._resize_cv.notify_all()
+        elif kind == "placement-update":
+            # fenced above: a healed ex-coordinator's stale table was
+            # already rejected; what reaches here is current-or-newer
+            self.adopt_placement(message)
         elif kind == "resize-progress":
             with self._resize_cv:
                 if message.get("job") == self._resize_job:
@@ -1030,6 +1335,10 @@ class Cluster:
                 peer_epoch = int(st.get("epoch", 0) or 0)
                 if peer_epoch > self.epoch:
                     self.adopt_epoch(peer_epoch)
+                # placement gossips with the heartbeat: a node that
+                # missed the placement-update broadcast (partitioned,
+                # restarting) converges on the next probe round
+                self.adopt_placement(st.get("placement"))
                 peer_ids = {n.get("id") for n in st.get("nodes", [])}
                 if (peer_ids and self.local.id not in peer_ids
                         and (peer_epoch >= self.epoch
@@ -1128,6 +1437,7 @@ class Cluster:
             node = self.nodes.pop(node_id, None)
             if node is None:
                 return False
+            self._note_membership_changed_locked()
             self._forgotten[node_id] = node.uri
             self._heartbeat_failures.pop(node_id, None)
         self.deaths_declared += 1
@@ -1198,7 +1508,9 @@ class Cluster:
                     if n.get("id") and n["id"] not in self.nodes:
                         self.nodes[n["id"]] = Node(n["id"], n["uri"])
                 self._forgotten.clear()
+                self._note_membership_changed_locked()
             self.adopt_epoch(int(st.get("epoch", 0) or 0))
+            self.adopt_placement(st.get("placement"))
             for node in self.sorted_nodes():
                 if node.id == self.local.id:
                     continue
@@ -1249,7 +1561,9 @@ class Cluster:
                 self.nodes = replacement
                 self._heartbeat_failures.clear()
                 self._forgotten = dropped
+                self._note_membership_changed_locked()
             self.adopt_epoch(int(via_status.get("epoch", 0) or 0))
+            self.adopt_placement(via_status.get("placement"))
             self.degraded = False
             for node in self.sorted_nodes():
                 if node.id == self.local.id:
@@ -1273,12 +1587,18 @@ class Cluster:
         (reference: memberlist join + coordinator ResizeInstructions —
         SURVEY.md §3.5)."""
         status = self.client.status(seed_uri)
-        for n in status.get("nodes", []):
-            self.nodes[n["id"]] = Node(n["id"], n["uri"])
+        with self._lock:
+            for n in status.get("nodes", []):
+                self.nodes[n["id"]] = Node(n["id"], n["uri"])
+            self._note_membership_changed_locked()
         # adopt the cluster's epoch before announcing: a node that
         # rejoins after an eviction must not carry a pre-partition epoch
         # into its first broadcasts
         self.adopt_epoch(int(status.get("epoch", 0) or 0))
+        # the placement table rides the same status payload: a joiner
+        # must compute the SAME ownership as the members from its first
+        # resize-instruction onward
+        self.adopt_placement(status.get("placement"))
         # Gate BEFORE announcing: the announce triggers the coordinator's
         # resize, whose post-resize cleanup waits for every member to
         # drain to NORMAL — this node must never be observable as NORMAL

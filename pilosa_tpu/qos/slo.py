@@ -174,6 +174,25 @@ class SLOEngine:
                 out.append(row)
         return out
 
+    def max_burn_rate(self, kind: str | None = None,
+                      rows: list | None = None) -> float:
+        """Worst burn rate across objectives (optionally one ``kind``,
+        e.g. "latency") and every window — the single scalar the
+        autopilot planner reads: ≥1.0 means an error budget is actively
+        burning and rebalancing is urgent rather than routine."""
+        if rows is None:
+            rows = self.burn_rates()
+        worst = 0.0
+        for row in rows:
+            if kind is not None and row.get("kind") != kind:
+                continue
+            for w in (row.get("windows") or {}).values():
+                try:
+                    worst = max(worst, float(w.get("burnRate", 0.0)))
+                except (TypeError, ValueError):
+                    continue
+        return worst
+
     def to_json(self) -> dict:
         return {
             "windows": [int(w) for w in self.windows_s],

@@ -159,6 +159,13 @@ class API:
         # without plumbing), configured by Server.open via
         # result-cache-bytes; both default OFF.
         self.tierer = None
+        # autopilot placement planner (autopilot/planner.py); Server.open
+        # wires one when autopilot-enabled = true. The placement-override
+        # TABLE it writes lives on the cluster and is honored by every
+        # node whenever non-empty — the kill switch gates only the
+        # planner ticker, never table adoption, so placement stays
+        # consistent cluster-wide under mixed configs.
+        self.autopilot = None
 
     # ---------------------------------------------------------------- query
 
@@ -1333,6 +1340,13 @@ class API:
                 "epoch": self.cluster.epoch,
                 "clusterDegraded": bool(self.cluster.degraded),
             }
+            # placement-override gossip rides /status (like the epoch):
+            # joiners and heartbeat pollers adopt the freshest table
+            # without a dedicated round trip. Omitted while no override
+            # was ever minted so the common case stays byte-identical
+            # to the pre-autopilot wire format.
+            if self.cluster.placement.epoch > 0:
+                out["placement"] = self.cluster.placement.to_json()
         else:
             out = {
                 "state": "NORMAL",
@@ -1547,6 +1561,29 @@ class API:
             "residency_tier_demoted_bytes_total": 0,
             "residency_tier_paced_sleep_seconds_total": 0.0,
             "residency_tier_last_pass_seconds": 0.0,
+        }
+
+    def autopilot_metrics(self) -> dict:
+        """autopilot_* series (autopilot/planner.py) — zeros while the
+        planner is off, EXCEPT the placement gauges, which read the
+        cluster's override table directly: a node with the kill switch
+        off still adopts (and must report) overrides minted elsewhere."""
+        if self.autopilot is not None:
+            return self.autopilot.metrics()
+        placement = getattr(self.cluster, "placement", None)
+        return {
+            "autopilot_passes_total": 0,
+            "autopilot_plans_total": 0,
+            "autopilot_moves_planned_total": 0,
+            "autopilot_moves_executed_total": 0,
+            "autopilot_overrides_pruned_total": 0,
+            "autopilot_passes_skipped_total": 0,
+            "autopilot_placement_overrides":
+                len(placement) if placement is not None else 0,
+            "autopilot_placement_epoch":
+                placement.epoch if placement is not None else 0,
+            "autopilot_last_pass_seconds": 0.0,
+            "autopilot_slo_burn_rate": 0.0,
         }
 
     def rescache_json(self, k: int = 100) -> dict:

@@ -74,6 +74,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/tenants$"), "get_tenants"),
     ("GET", re.compile(r"^/debug/heatmap$"), "get_heatmap"),
     ("GET", re.compile(r"^/debug/rescache$"), "get_rescache"),
+    ("GET", re.compile(r"^/debug/autopilot$"), "get_autopilot"),
     ("GET", re.compile(r"^/debug/slo$"), "get_slo"),
     ("GET", re.compile(r"^/debug/workers$"), "get_workers"),
     ("GET", re.compile(r"^/debug/queries$"), "get_inflight_queries"),
@@ -717,6 +718,12 @@ class HTTPHandler(BaseHTTPRequestHandler):
                                  seen=seen)
         text += prometheus_block(self.api.tiering_metrics(), prefix,
                                  seen=seen)
+        # autopilot placement plane (docs/OPERATIONS.md autopilot):
+        # planner passes/plans/moves plus the placement-override gauges —
+        # the gauges stay live even with the planner off, because this
+        # node still adopts overrides minted by the coordinator
+        text += prometheus_block(self.api.autopilot_metrics(), prefix,
+                                 seen=seen)
         # write-path durability (group-commit WAL): zeros from scrape
         # one, same rate()-window reasoning as the blocks around it
         text += prometheus_block(self.api.durability_metrics(), prefix,
@@ -856,8 +863,11 @@ class HTTPHandler(BaseHTTPRequestHandler):
         from pilosa_tpu.storage.heat import global_heat
 
         k = _int_param((query.get("k") or ["100"])[0], "k") if query else 100
-        if k <= 0:
-            raise ApiError(f"k must be positive, got {k}")
+        if k < 0:
+            raise ApiError(f"k must be non-negative, got {k}")
+        # k=0 = the FULL table (snapshot's own convention): the autopilot
+        # coordinator's peer fetch (client.heatmap) needs every row — a
+        # capped view would hide heat and silently blank the plan
         snap = global_heat().snapshot(k=k)
         if query and query.get("tier", ["false"])[0] == "true":
             from pilosa_tpu.storage.residency import global_row_cache
@@ -903,6 +913,23 @@ class HTTPHandler(BaseHTTPRequestHandler):
         if k <= 0:
             raise ApiError(f"k must be positive, got {k}")
         self._json(self.api.rescache_json(k=k))
+
+    def get_autopilot(self, query=None):
+        """Autopilot inspector (docs/OPERATIONS.md autopilot runbook):
+        planner config + pass counters, the live placement-override
+        table, and the recent decision log — or just the adopted table
+        when the planner is off on this node (kill switch gates the
+        ticker, not table adoption)."""
+        autopilot = self.api.autopilot
+        if autopilot is not None:
+            self._json(autopilot.to_json())
+            return
+        placement = getattr(self.api.cluster, "placement", None)
+        self._json({
+            "enabled": False,
+            "placement": (placement.to_json() if placement is not None
+                          else {"epoch": 0, "overrides": []}),
+        })
 
     def get_slo(self, query=None):
         """Declared objectives with per-window burn rates and breach
@@ -962,6 +989,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap["serving_mp"] = self.api.mp_metrics()
         snap["result_cache"] = self.api.rescache_metrics()
         snap["residency_tiering"] = self.api.tiering_metrics()
+        snap["autopilot"] = self.api.autopilot_metrics()
         snap["durability"] = self.api.durability_metrics()
         snap["integrity"] = self.api.integrity_metrics()
         snap["observability"] = self.api.observability_metrics()

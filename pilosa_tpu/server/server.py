@@ -76,6 +76,11 @@ class ServerConfig:
         residency_promote_heat: float = 4.0,
         residency_demote_heat: float = 1.0,
         residency_host_tier_bytes: int = 1 << 30,
+        autopilot_enabled: bool = False,
+        autopilot_interval: float = 30.0,
+        autopilot_heat_budget: float = 1.5,
+        autopilot_max_moves: int = 4,
+        autopilot_min_dwell: float = 0.0,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -267,6 +272,42 @@ class ServerConfig:
                 "invalid residency-host-tier-bytes "
                 f"{residency_host_tier_bytes!r} (want >= 0)"
             )
+        # Autopilot placement plane (docs/OPERATIONS.md autopilot):
+        # the kill switch is OFF by default — with it off no placement
+        # overrides are ever minted and shard placement stays
+        # byte-identical to the pure hash ring. heat-budget is a
+        # multiple of the mean per-node heat (> 1; the planner acts on
+        # nodes above it, and the gap between mean and budget IS the
+        # hysteresis dead band); max-moves bounds one pass (further
+        # shaped down by the repair pacer); min-dwell is the post-move
+        # immunity window (0 = auto: two intervals).
+        self.autopilot_enabled = _parse_bool(autopilot_enabled)
+        self.autopilot_interval = float(autopilot_interval)
+        if self.autopilot_interval <= 0:
+            raise ValueError(
+                f"invalid autopilot-interval {autopilot_interval!r} "
+                "(want > 0; use autopilot-enabled=false to turn the "
+                "planner off)"
+            )
+        self.autopilot_heat_budget = float(autopilot_heat_budget)
+        if self.autopilot_heat_budget <= 1.0:
+            raise ValueError(
+                f"invalid autopilot-heat-budget {autopilot_heat_budget!r} "
+                "(want > 1.0: the margin over mean node heat IS the "
+                "hysteresis dead band)"
+            )
+        self.autopilot_max_moves = int(autopilot_max_moves)
+        if self.autopilot_max_moves < 1:
+            raise ValueError(
+                f"invalid autopilot-max-moves {autopilot_max_moves!r} "
+                "(want >= 1)"
+            )
+        self.autopilot_min_dwell = float(autopilot_min_dwell)
+        if self.autopilot_min_dwell < 0:
+            raise ValueError(
+                f"invalid autopilot-min-dwell {autopilot_min_dwell!r} "
+                "(want >= 0; 0 = two intervals)"
+            )
         from pilosa_tpu.qos.slo import SLOEngine
 
         # build once to validate; Server.open builds the live engine
@@ -278,6 +319,15 @@ class ServerConfig:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServerConfig":
+        # Accept snake_case for EVERY knob by normalizing up front —
+        # the per-field d.get("kebab", d.get("snake", ...)) fallbacks
+        # below predate this and had drifted (several newer knobs only
+        # answered to kebab); the knob-parity contract test now pins
+        # the whole surface (tests/test_config_parity.py).
+        d = dict(d)
+        for k in list(d):
+            if isinstance(k, str) and "_" in k:
+                d.setdefault(k.replace("_", "-"), d[k])
         tls = d.get("tls") if isinstance(d.get("tls"), dict) else {}
         return cls(
             data_dir=d.get("data-dir", d.get("data_dir", "~/.pilosa_tpu")),
@@ -418,6 +468,21 @@ class ServerConfig:
                 d.get("residency-host-tier-bytes",
                       d.get("residency_host_tier_bytes", 1 << 30))
             ),
+            autopilot_enabled=_parse_bool(
+                d.get("autopilot-enabled", False)
+            ),
+            autopilot_interval=_parse_duration(
+                d.get("autopilot-interval", 30.0)
+            ),
+            autopilot_heat_budget=float(
+                d.get("autopilot-heat-budget", 1.5)
+            ),
+            autopilot_max_moves=int(
+                d.get("autopilot-max-moves", 4)
+            ),
+            autopilot_min_dwell=_parse_duration(
+                d.get("autopilot-min-dwell", 0.0)
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -478,6 +543,11 @@ class ServerConfig:
             "residency-promote-heat": self.residency_promote_heat,
             "residency-demote-heat": self.residency_demote_heat,
             "residency-host-tier-bytes": self.residency_host_tier_bytes,
+            "autopilot-enabled": self.autopilot_enabled,
+            "autopilot-interval": self.autopilot_interval,
+            "autopilot-heat-budget": self.autopilot_heat_budget,
+            "autopilot-max-moves": self.autopilot_max_moves,
+            "autopilot-min-dwell": self.autopilot_min_dwell,
         }
 
 
@@ -679,6 +749,22 @@ class Server:
                 pacer=self.api.cluster.client.pacer,
                 logger=self.logger,
             ).start()
+        if self.config.autopilot_enabled:
+            from pilosa_tpu.autopilot import Autopilot
+            from pilosa_tpu.storage.heat import global_heat as _ap_heat
+
+            # rebalance transfers ride the SAME RepairPacer as repair
+            # and tiering: the autopilot's moves are maintenance traffic
+            # and must never starve serving of wire or device budget
+            self.api.autopilot = Autopilot(
+                self.api.cluster, heat=_ap_heat(), slo=self.api.slo,
+                interval_s=self.config.autopilot_interval,
+                heat_budget=self.config.autopilot_heat_budget,
+                max_moves=self.config.autopilot_max_moves,
+                min_dwell_s=self.config.autopilot_min_dwell or None,
+                pacer=self.api.cluster.client.pacer,
+                logger=self.logger,
+            ).start()
         self.logger.info(
             "listening on %s://%s:%d (data-dir %s, node %s)",
             "https" if self.config.tls_enabled else "http",
@@ -788,6 +874,9 @@ class Server:
             self.api.mpserve = None
         if self.api.scrubber is not None:
             self.api.scrubber.close()
+        if self.api.autopilot is not None:
+            self.api.autopilot.close()
+            self.api.autopilot = None
         if self.api.tierer is not None:
             self.api.tierer.close()
             self.api.tierer = None

@@ -215,6 +215,37 @@ class HeatMap:
             self.writes_total = 0
 
 
+def merge_shard_heat(row_lists) -> dict:
+    """Cluster-wide per-(index, shard) heat from several nodes'
+    ``snapshot()["shards"]`` row lists — the autopilot planner's unit
+    of movement is the (index, shard) group, summing field-level rows.
+
+    Rows are first deduped by their full (scope, index, field, shard)
+    key with MAX-merge: an in-process cluster shares one global heat
+    map, so polling every member returns the same entries n times —
+    max is exact dedup there, while genuinely distinct nodes (unique
+    data-dir scope tags) contribute their own entries. Malformed rows
+    are skipped, not fatal: one old-wire peer must not blank the
+    plan."""
+    by_key: dict[tuple, float] = {}
+    for rows in row_lists:
+        for r in rows or []:
+            try:
+                key = (str(r.get("scope", "")), str(r["index"]),
+                       str(r["field"]), int(r["shard"]))
+                heat = (float(r.get("access", 0.0))
+                        + float(r.get("writes", 0.0)))
+            except (AttributeError, KeyError, TypeError, ValueError):
+                continue
+            if heat > by_key.get(key, -1.0):
+                by_key[key] = heat
+    out: dict[tuple, float] = {}
+    for (_scope, index, _field, shard), heat in by_key.items():
+        group = (index, shard)
+        out[group] = out.get(group, 0.0) + heat
+    return out
+
+
 _global_heat: HeatMap | None = None
 
 

@@ -60,6 +60,7 @@ class ChaosHarness:
                  event_gap_s: float = 0.3, writer_threads: int = 2,
                  reader_threads: int = 1, n_shards: int = 4,
                  with_storage_faults: bool = False,
+                 with_autopilot: bool = False,
                  log=lambda msg: None):
         self.tmp_dir = str(tmp_dir)
         self.n_nodes = n_nodes
@@ -75,6 +76,12 @@ class ChaosHarness:
         # on the integrity oracle (every fragment's disk bytes verify
         # clean after heal, on top of the four partition oracles)
         self.with_storage_faults = with_storage_faults
+        # autopilot-active schedules (ISSUE 15): every node runs the
+        # placement-plane ticker on a hot interval, plus a forced-pass
+        # event in the bag — the five oracles must hold while the
+        # autopilot mints overrides and resizes UNDER the same faults
+        self.with_autopilot = with_autopilot
+        self.autopilot_moves = 0
         self.disk_plane = None
         self.corruptions_injected = 0
         self.disk_fault_rules: list[int] = []
@@ -99,11 +106,18 @@ class ChaosHarness:
     def _make_server(self, name: str, seeds: list[str], port: int = 0):
         from pilosa_tpu.server import Server, ServerConfig
 
+        autopilot_cfg = dict(
+            # hot enough that the ticker fires between events; the
+            # tight 1.2 budget makes even mild skew actionable, so
+            # schedules actually exercise placement moves under faults
+            autopilot_enabled=True, autopilot_interval=0.5,
+            autopilot_heat_budget=1.2, autopilot_min_dwell=1.0,
+        ) if self.with_autopilot else {}
         server = Server(ServerConfig(
             data_dir=f"{self.tmp_dir}/{name}", port=port, name=name,
             replica_n=self.replica_n, seeds=seeds,
             anti_entropy_interval=0, heartbeat_interval=0,
-            heartbeat_timeout=0.5, use_mesh=False,
+            heartbeat_timeout=0.5, use_mesh=False, **autopilot_cfg,
         )).open()
         cluster = server.api.cluster
         # instance-attr overrides: fast backoffs + short drains so the
@@ -160,6 +174,13 @@ class ChaosHarness:
         self.all_cleanups.extend(cluster.cleanup_log)
         cluster.acted_epochs.clear()
         cluster.cleanup_log.clear()
+        pilot = getattr(server.api, "autopilot", None)
+        if pilot is not None:
+            # zero after read: kills, oracle checks, and close() all
+            # harvest the same server — a counter read twice would
+            # double-count the schedule's move total
+            self.autopilot_moves += pilot.moves_executed
+            pilot.moves_executed = 0
 
     def _live(self) -> list:
         with self._lock:
@@ -328,6 +349,27 @@ class ChaosHarness:
             self.servers[name] = server
         return f"restart {name}"
 
+    def _event_autopilot_pass(self) -> str:
+        """Force a planner pass NOW on the acting coordinator — the
+        0.5s tickers run too, but a bag event guarantees the schedule
+        exercises plan/apply/resize at adversarial moments (right
+        after a kill, inside a partition) instead of between them."""
+        for s in self._live():
+            if s.api.cluster.is_acting_coordinator:
+                pilot = s.api.autopilot
+                if pilot is None:
+                    return "autopilot-skipped (pilot not wired)"
+                try:
+                    record = pilot.run_pass()
+                except Exception as e:  # noqa: BLE001 — an event must
+                    return f"autopilot-error {e!r}"  # not kill the run
+                if record.get("acted"):
+                    return (f"autopilot-pass {s.config.name} "
+                            f"moves={len(record.get('moves', []))}")
+                return (f"autopilot-pass {s.config.name} "
+                        f"skip={record.get('reason')}")
+        return "autopilot-skipped (no live coordinator)"
+
     def run_schedule(self) -> dict:
         """Workload on, randomized events, then heal + converge and
         check every oracle. Returns the schedule's record."""
@@ -347,6 +389,8 @@ class ChaosHarness:
         if self.with_storage_faults:
             choices += [(self._event_corrupt, 3),
                         (self._event_disk_full, 2)]
+        if self.with_autopilot:
+            choices += [(self._event_autopilot_pass, 3)]
         bag = [fn for fn, w in choices for _ in range(w)]
         t0 = time.monotonic()
         for _ in range(self.n_events):
@@ -478,6 +522,7 @@ class ChaosHarness:
             "corruptions_injected": self.corruptions_injected,
             "disk_integrity_failures": dirty_disk,
             "degraded_stuck": degraded_stuck,
+            "autopilot_moves": self.autopilot_moves,
             "epochs_acted": len(actors_by_epoch),
             "ok": (not lost and not non_quorum_deletions
                    and not conflicts and not mismatches
@@ -810,13 +855,16 @@ def run_mp_chaos(tmp_dir, n_schedules: int = 2, n_workers: int = 2,
 def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
               replica_n: int = 2, seed: int = 0, n_events: int = 6,
               event_gap_s: float = 0.3, with_storage_faults: bool = False,
+              with_autopilot: bool = False,
               log=lambda msg: None) -> dict:
     """Run ``n_schedules`` independent seeded schedules (fresh cluster
     each — a schedule's damage must not leak into the next) and fold
     the oracle verdicts. Any failing schedule reports its seed so the
     run replays deterministically. ``with_storage_faults`` adds
     bit-flip and disk-full events plus the disk-integrity oracle
-    (bench_suite config_scrub)."""
+    (bench_suite config_scrub); ``with_autopilot`` runs the placement
+    plane live (fast tickers + forced-pass events) so the same oracles
+    gate autopilot-minted resizes (bench_suite config_autopilot)."""
     records = []
     for i in range(n_schedules):
         schedule_seed = seed * 1000 + i
@@ -825,7 +873,8 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
             f"{tmp_dir}/sched{i}", n_nodes=n_nodes, replica_n=replica_n,
             seed=schedule_seed, n_events=n_events,
             event_gap_s=event_gap_s,
-            with_storage_faults=with_storage_faults, log=log,
+            with_storage_faults=with_storage_faults,
+            with_autopilot=with_autopilot, log=log,
         )
         try:
             harness.boot()
@@ -858,6 +907,8 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
             for r in records),
         "degraded_stuck": sum(len(r.get("degraded_stuck", []))
                               for r in records),
+        "autopilot_moves_total": sum(r.get("autopilot_moves", 0)
+                                     for r in records),
         "unconverged": sum(1 for r in records if not r["converged"]),
         "failed_seeds": [r["seed"] for r in failed],
         "failed_diags": [

@@ -1,0 +1,457 @@
+"""Autopilot placement plane: the override table beside the hash ring,
+the pure planner's properties, and the end-to-end move loop.
+
+The contract that keeps mixed configs safe — and that these tests pin
+hardest — is byte-identity: with an EMPTY override table (equivalently,
+with the autopilot kill switch off, since only the planner mints
+entries) every ownership decision must equal the pure hash walk,
+bit for bit, across arbitrary memberships."""
+
+import json
+import random
+import threading
+
+import pytest
+
+from cluster_helpers import make_cluster, req, seed, uri
+from pilosa_tpu.autopilot import plan_moves, shaped_move_budget
+from pilosa_tpu.autopilot.planner import Autopilot
+from pilosa_tpu.parallel.cluster import (
+    PARTITION_N,
+    Cluster,
+    Node,
+    PlacementTable,
+    _hash64,
+)
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def _reference_owners(nodes, replica_n, index, shard):
+    """The pre-autopilot placement, reimplemented from scratch: ring of
+    nodes ordered by (hash64(id), id), walk min(replica_n, n) from the
+    partition point."""
+    ring = sorted(nodes, key=lambda n: (_hash64(n.id), n.id))
+    partition = _hash64(f"{index}:{shard}") % PARTITION_N
+    start = partition % len(ring)
+    k = min(replica_n, len(ring))
+    return [ring[(start + i) % len(ring)].id for i in range(k)]
+
+
+def _bare_cluster(node_ids, replica_n=1) -> Cluster:
+    nodes = [Node(i, f"http://{i}:1") for i in node_ids]
+    return Cluster(nodes[0], peers=nodes[1:], replica_n=replica_n)
+
+
+class TestPlacementFallback:
+    def test_empty_table_byte_identical_across_random_memberships(self):
+        """The mixed-version safety contract: no overrides ⇒ shard_nodes
+        equals the pure hash walk for every (membership, replica_n,
+        index, shard) — randomized, seeded."""
+        rng = random.Random(1138)
+        for _ in range(40):
+            n = rng.randint(1, 8)
+            ids = rng.sample(
+                [f"node-{i}" for i in range(64)] + ["a", "zz", "n0"], n)
+            replica_n = rng.randint(1, 3)
+            c = _bare_cluster(ids, replica_n=replica_n)
+            assert len(c.placement) == 0
+            for _ in range(25):
+                index = rng.choice(["i", "tenants", "x-y"])
+                shard = rng.randint(0, 5000)
+                got = [x.id for x in c.shard_nodes(index, shard)]
+                assert got == _reference_owners(
+                    list(c.nodes.values()), replica_n, index, shard)
+
+    def test_kill_switch_off_server_mints_nothing(self, tmp_path):
+        """autopilot-enabled=false (the default): no planner is wired
+        and the table stays empty, so placement is the hash walk."""
+        servers = make_cluster(tmp_path, 2, replica_n=1)
+        try:
+            for s in servers:
+                c = s.api.cluster
+                assert s.api.autopilot is None
+                assert len(c.placement) == 0 and c.placement.epoch == 0
+                for shard in range(8):
+                    got = [x.id for x in c.shard_nodes("i", shard)]
+                    assert got == _reference_owners(
+                        list(c.nodes.values()), 1, "i", shard)
+            out = req("GET", f"{uri(servers[0])}/debug/autopilot")
+            assert out["enabled"] is False
+            assert out["placement"] == {"epoch": 0, "overrides": []}
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_override_applies_only_while_all_owners_live(self):
+        c = _bare_cluster(["n0", "n1", "n2"], replica_n=2)
+        hash_owners = [x.id for x in c.shard_nodes("i", 3)]
+        override = tuple(
+            i for i in ("n0", "n1", "n2") if i not in hash_owners
+        )[:1] + (hash_owners[0],)
+        c.placement.replace({("i", 3): override}, epoch=10)
+        assert [x.id for x in c.shard_nodes("i", 3)] == list(override)
+        # a listed owner departs: hash placement resumes for the shard
+        with c._lock:
+            c.nodes.pop(override[0])
+            c._note_membership_changed_locked()
+        assert [x.id for x in c.shard_nodes("i", 3)] == \
+            _reference_owners(list(c.nodes.values()), 2, "i", 3)
+        # other shards were never overridden
+        assert [x.id for x in c.shard_nodes("i", 4)] == \
+            _reference_owners(list(c.nodes.values()), 2, "i", 4)
+
+    def test_stale_epoch_loses(self):
+        t = PlacementTable()
+        assert t.replace({("i", 0): ("a",)}, epoch=5)
+        assert not t.replace({("i", 0): ("b",)}, epoch=5)  # duplicate
+        assert not t.replace({("i", 0): ("b",)}, epoch=4)  # stale
+        assert t.get("i", 0) == ("a",)
+        assert t.replace({("i", 0): ("b",)}, epoch=6)
+        assert t.get("i", 0) == ("b",)
+        assert t.updates_applied == 2 and t.updates_rejected == 2
+
+    def test_wire_round_trip_skips_malformed(self):
+        table = {("i", 0): ("a", "b"), ("j", 7): ("c",)}
+        entries = PlacementTable.wire_entries(table)
+        assert PlacementTable.from_wire(entries) == table
+        entries.append({"index": "k"})             # no shard
+        entries.append({"shard": 1, "nodes": []})  # no index
+        entries.append("garbage")
+        assert PlacementTable.from_wire(entries) == table
+
+    def test_persistence_and_corrupt_file_recovery(self, tmp_path):
+        path = str(tmp_path / "cluster.placement")
+        t = PlacementTable(path=path)
+        t.replace({("i", 2): ("a", "b")}, epoch=9)
+        reloaded = PlacementTable(path=path)
+        assert reloaded.epoch == 9
+        assert reloaded.get("i", 2) == ("a", "b")
+        with open(path, "w") as f:
+            f.write("{torn write")
+        assert PlacementTable(path=path).epoch == 0  # empty, not fatal
+
+    def test_placement_update_message_is_epoch_fenced(self):
+        c = _bare_cluster(["n0", "n1"])
+        wire = PlacementTable.wire_entries({("i", 0): ("n1",)})
+        c.adopt_epoch(5000)
+        # stale fenced message: rejected before adoption
+        c.handle_message({"type": "placement-update", "epoch": 400,
+                          "overrides": wire})
+        assert c.placement.epoch == 0
+        c.handle_message({"type": "placement-update", "epoch": 6000,
+                          "overrides": wire})
+        assert c.placement.epoch == 6000
+        assert c.placement.get("i", 0) == ("n1",)
+
+    def test_status_gossip_rides_only_when_minted(self, tmp_path):
+        servers = make_cluster(tmp_path, 2, replica_n=1)
+        try:
+            st = req("GET", f"{uri(servers[0])}/status")
+            assert "placement" not in st  # empty table: legacy wire shape
+            c0 = servers[0].api.cluster
+            epoch = c0.apply_placement(
+                {("i", 0): (servers[1].api.cluster.local.id,)})
+            assert epoch > 0
+            st = req("GET", f"{uri(servers[0])}/status")
+            assert st["placement"]["epoch"] == epoch
+            assert st["placement"]["overrides"] == [
+                {"index": "i", "shard": 0,
+                 "nodes": [servers[1].api.cluster.local.id]}]
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestRingMemo:
+    def test_memoized_ring_tracks_membership_churn(self):
+        c = _bare_cluster(["n0", "n1", "n2"])
+        c._spawn_resize = lambda: None  # no wire in this unit test
+        ring1 = c._frozen_ring()
+        assert c._frozen_ring() is ring1  # cache hit: same object
+        assert [n.id for n in ring1] == [
+            n.id for n in sorted(c.nodes.values(),
+                                 key=lambda n: (_hash64(n.id), n.id))]
+        c.handle_message({"type": "node-join", "id": "n3",
+                          "uri": "http://n3:1"})
+        ring2 = c._frozen_ring()
+        assert ring2 is not ring1
+        assert {n.id for n in ring2} == {"n0", "n1", "n2", "n3"}
+        assert [n.id for n in ring2] == [
+            n.id for n in sorted(c.nodes.values(),
+                                 key=lambda n: (_hash64(n.id), n.id))]
+        c.handle_message({"type": "node-leave", "id": "n1",
+                          "epoch": c.epoch})
+        ring3 = c._frozen_ring()
+        assert {n.id for n in ring3} == {"n0", "n2", "n3"}
+        assert c._frozen_ring() is ring3
+
+    def test_hash_memo_is_bounded(self):
+        c = _bare_cluster(["n0"])
+        c._ring_hash_memo.update(
+            {f"x{i}": i for i in range(5000)})
+        with c._lock:
+            c._note_membership_changed_locked()
+        c._frozen_ring()
+        assert len(c._ring_hash_memo) <= 4096
+
+
+class TestPlannerProperties:
+    def _owners_from(self, table):
+        return lambda i, s: list(table[(i, s)])
+
+    def test_uniform_heat_plans_zero_moves(self):
+        rng = random.Random(7)
+        for n in (2, 3, 5, 8):
+            nodes = [f"n{i}" for i in range(n)]
+            table, heat = {}, {}
+            for s in range(n * 6):
+                key = ("i", s)
+                table[key] = [nodes[s % n]]
+                heat[key] = 10.0
+            for budget in (1.2, 1.5, 3.0):
+                assert plan_moves(
+                    heat, self._owners_from(table), nodes,
+                    heat_budget=budget, max_moves=8) == []
+            # jitter within the dead band is also quiescent
+            jittered = {k: v * rng.uniform(0.95, 1.05)
+                        for k, v in heat.items()}
+            assert plan_moves(
+                jittered, self._owners_from(table), nodes,
+                heat_budget=1.5, max_moves=8) == []
+
+    def test_hot_spot_drains_and_replan_is_idempotent(self):
+        nodes = ["n0", "n1", "n2"]
+        table = {("i", s): [nodes[s % 3]] for s in range(12)}
+        heat = {k: 1.0 for k in table}
+        for s in (0, 3, 6, 9):  # all of n0's shards run hot
+            heat[("i", s)] = 80.0
+        moves = plan_moves(heat, self._owners_from(table), nodes,
+                           heat_budget=1.3, max_moves=8)
+        assert moves, "overloaded node must shed"
+        assert all(m["from"] == "n0" for m in moves)
+        for m in moves:
+            table[(m["index"], m["shard"])] = list(m["owners"])
+            assert "n0" not in m["owners"]
+        # idempotent fixpoint: applying the plan leaves nothing to do
+        assert plan_moves(heat, self._owners_from(table), nodes,
+                          heat_budget=1.3, max_moves=8) == []
+
+    def test_frozen_keys_are_immune(self):
+        nodes = ["n0", "n1"]
+        table = {("i", 0): ["n0"], ("i", 1): ["n1"]}
+        heat = {("i", 0): 100.0, ("i", 1): 1.0}
+        assert plan_moves(heat, self._owners_from(table), nodes,
+                          heat_budget=1.2, max_moves=4,
+                          frozen={("i", 0)}) == []
+
+    def test_replicated_groups_move_one_owner(self):
+        nodes = ["n0", "n1", "n2", "n3"]
+        table = {("i", s): ["n0", "n1"] for s in range(4)}
+        heat = {k: 40.0 for k in table}
+        moves = plan_moves(heat, self._owners_from(table), nodes,
+                           heat_budget=1.3, max_moves=8)
+        assert moves
+        for m in moves:
+            assert len(m["owners"]) == 2
+            assert len(set(m["owners"])) == 2  # never twice on one node
+            assert m["to"] in ("n2", "n3")
+
+    def test_never_moves_onto_hotter_node(self):
+        """Two nodes, one hot indivisible group: relocating it would
+        just move the hot spot — the planner must refuse."""
+        nodes = ["n0", "n1"]
+        table = {("i", 0): ["n0"], ("i", 1): ["n1"]}
+        heat = {("i", 0): 100.0, ("i", 1): 10.0}
+        assert plan_moves(heat, self._owners_from(table), nodes,
+                          heat_budget=1.2, max_moves=4) == []
+
+    def test_degenerate_inputs(self):
+        assert plan_moves({}, lambda i, s: [], ["n0", "n1"]) == []
+        assert plan_moves({("i", 0): 5.0}, lambda i, s: ["n0"],
+                          ["n0"]) == []          # single node
+        assert plan_moves({("i", 0): 5.0}, lambda i, s: ["n0"],
+                          ["n0", "n1"], max_moves=0) == []
+        # owners outside the live membership contribute nothing
+        assert plan_moves({("i", 0): 5.0}, lambda i, s: ["ghost"],
+                          ["n0", "n1"]) == []
+
+    def test_shaped_move_budget(self):
+        class Pacer:
+            def __init__(self, rate):
+                self.rate = rate
+
+        assert shaped_move_budget(8, None, 30.0) == 8       # unpaced
+        assert shaped_move_budget(8, Pacer(0), 30.0) == 8
+        # 2 MiB/s × 1 s / 1 MiB nominal = 2 moves
+        assert shaped_move_budget(8, Pacer(2 << 20), 1.0) == 2
+        # pacer never zeroes a nonzero configured budget
+        assert shaped_move_budget(8, Pacer(1), 1.0) == 1
+        assert shaped_move_budget(0, Pacer(2 << 20), 1.0) == 0
+
+
+class TestAutopilotEndToEnd:
+    N_SHARDS = 6
+
+    def test_pass_moves_hot_shards_and_data_survives(self, tmp_path):
+        from pilosa_tpu.storage.heat import global_heat
+
+        servers = make_cluster(tmp_path, 2, replica_n=1)
+        ap = None
+        try:
+            s0, s1 = servers
+            seed(s0, n_shards=self.N_SHARDS)
+            coord = s0 if s0.api.cluster.is_acting_coordinator else s1
+            ap = Autopilot(coord.api.cluster, heat=global_heat(),
+                           slo=coord.api.slo, interval_s=0.0,
+                           heat_budget=1.2, max_moves=4)
+            rec = ap.run_pass()
+            # seeding skews heat toward whichever node owned more
+            # shards; whether the pass acts depends on the hash layout —
+            # but acting or not, placement must stay consistent and the
+            # data fully queryable from BOTH nodes
+            if rec.get("acted"):
+                assert coord.api.cluster.placement.epoch == rec["epoch"]
+                assert s1.api.cluster.placement.epoch == \
+                    s0.api.cluster.placement.epoch
+                assert ap.moves_executed == len(rec["moves"])
+            for s in servers:
+                assert s.api.cluster.wait_until_normal(10)
+                out = req("POST", f"{uri(s)}/index/i/query",
+                          b"Count(Row(f=1))")
+                assert out["results"][0] == self.N_SHARDS * 4
+                out = req("POST", f"{uri(s)}/index/i/query",
+                          b"Count(Intersect(Row(f=1), Row(f=2)))")
+                assert out["results"][0] == self.N_SHARDS * 2
+            # both nodes agree on every shard's owner
+            for shard in range(self.N_SHARDS):
+                assert [n.id for n in
+                        s0.api.cluster.shard_nodes("i", shard)] == \
+                       [n.id for n in
+                        s1.api.cluster.shard_nodes("i", shard)]
+        finally:
+            if ap is not None:
+                ap.close()
+            for s in servers:
+                s.close()
+
+    def test_forced_override_executes_through_resize(self, tmp_path):
+        """Drive the actuator directly: force every shard onto one node
+        via apply_placement + coordinate_resize, then verify the mover
+        now owns them, queries still answer, and a kill-switch-off peer
+        adopted the table."""
+        servers = make_cluster(tmp_path, 2, replica_n=1)
+        try:
+            s0, s1 = servers
+            seed(s0, n_shards=self.N_SHARDS)
+            c0 = s0.api.cluster
+            # force everything onto the node the hash gave the FEWEST
+            # shards, so the override genuinely moves data
+            owned = {nid: 0 for nid in c0.nodes}
+            for s in range(self.N_SHARDS):
+                owned[c0.shard_nodes("i", s)[0].id] += 1
+            target = min(owned, key=owned.get)
+            hash_owned_by_target = owned[target]
+            table = {("i", s): (target,) for s in range(self.N_SHARDS)}
+            epoch = c0.apply_placement(table)
+            assert epoch > 0
+            c0.coordinate_resize()
+            assert c0.wait_until_normal(15)
+            assert s1.api.cluster.wait_until_normal(15)
+            # both nodes route every shard to the target now
+            for c in (c0, s1.api.cluster):
+                for s in range(self.N_SHARDS):
+                    assert [n.id for n in c.shard_nodes("i", s)] == \
+                        [target]
+            assert s1.api.cluster.placement.epoch == epoch
+            # the target node sees itself as owner of every shard
+            mover = next(s for s in servers
+                         if s.api.cluster.local.id == target)
+            assert all(mover.api.cluster.owns_shard("i", s)
+                       for s in range(self.N_SHARDS))
+            for srv in servers:
+                out = req("POST", f"{uri(srv)}/index/i/query",
+                          b"Count(Row(f=1))")
+                assert out["results"][0] == self.N_SHARDS * 4
+            # sanity: the move was real for at least one shard
+            assert hash_owned_by_target < self.N_SHARDS
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_pass_gates(self, tmp_path):
+        from pilosa_tpu.storage.heat import HeatMap
+
+        c = _bare_cluster(["n0"])
+        ap = Autopilot(c, heat=HeatMap(), interval_s=0.0)
+        assert ap.run_pass() == {"acted": False, "reason": "single-node"}
+        c2 = _bare_cluster(["n0", "n1"])
+        c2.is_acting_coordinator  # n0 may or may not coordinate
+        ap2 = Autopilot(c2, heat=HeatMap(), interval_s=0.0)
+        ap2.cluster.degraded = True
+        if c2.is_acting_coordinator:
+            assert ap2.run_pass()["reason"] == "degraded"
+        else:
+            assert ap2.run_pass()["reason"] == "not-coordinator"
+        assert ap2.metrics()["autopilot_passes_skipped_total"] >= 1
+
+    def test_dwell_freezes_moved_shards(self):
+        from pilosa_tpu.storage.heat import HeatMap
+
+        c = _bare_cluster(["n0", "n1"])
+        ap = Autopilot(c, heat=HeatMap(), interval_s=10.0)
+        assert ap.min_dwell_s == 20.0  # default: two intervals
+        ap._moved_at[("i", 0)] = __import__("time").monotonic()
+        moves = plan_moves(
+            {("i", 0): 100.0, ("i", 1): 1.0},
+            lambda i, s: ["n0"] if s == 0 else ["n1"],
+            ["n0", "n1"], heat_budget=1.2, max_moves=4,
+            frozen={k for k, t in ap._moved_at.items()})
+        assert moves == []
+
+
+class TestHeatMerge:
+    def test_merge_dedups_shared_map_and_sums_scopes(self):
+        from pilosa_tpu.storage.heat import merge_shard_heat
+
+        row = {"scope": "a", "index": "i", "field": "f", "shard": 0,
+               "access": 5.0, "writes": 1.0}
+        # the same global map polled twice (in-process cluster): exact
+        # dedup by max, not doubling
+        assert merge_shard_heat([[row], [dict(row)]]) == {("i", 0): 6.0}
+        other_scope = dict(row, scope="b", access=2.0, writes=0.0)
+        out = merge_shard_heat([[row], [other_scope]])
+        assert out == {("i", 0): 8.0}  # distinct nodes sum
+        # field-level rows sum into the (index, shard) group
+        f2 = dict(row, field="g", access=1.0, writes=0.0)
+        assert merge_shard_heat([[row, f2]]) == {("i", 0): 7.0}
+        # malformed rows are skipped, not fatal
+        assert merge_shard_heat([[{"index": "i"}, row, None]]) == \
+            {("i", 0): 6.0}
+
+
+class TestDebugSurface:
+    def test_debug_autopilot_and_metrics(self, tmp_path):
+        servers = make_cluster(tmp_path, 2, replica_n=1,
+                               autopilot_enabled=True,
+                               autopilot_interval=3600.0)
+        try:
+            s0 = servers[0]
+            assert s0.api.autopilot is not None
+            out = req("GET", f"{uri(s0)}/debug/autopilot")
+            assert out["enabled"] is True
+            assert out["heatBudget"] == 1.5 and out["maxMoves"] == 4
+            assert out["minDwellS"] == 7200.0
+            assert "placement" in out and "decisions" in out
+            body = req("GET", f"{uri(s0)}/metrics", raw=True).decode()
+            for series in ("autopilot_passes_total",
+                           "autopilot_moves_executed_total",
+                           "autopilot_placement_overrides",
+                           "autopilot_placement_epoch"):
+                assert series in body
+            snap = req("GET", f"{uri(s0)}/debug/vars")
+            assert "autopilot_passes_total" in snap["autopilot"]
+            m = req("GET", f"{uri(s0)}/debug/vars")["cluster"]
+            assert "cluster_placement_overrides" in m
+        finally:
+            for s in servers:
+                s.close()

@@ -71,6 +71,38 @@ class TestMembership:
         }
         assert len(coords) == 1  # everyone agrees on the coordinator
 
+    def test_concurrent_joins_relay_membership(self, cluster3):
+        """Two joiners racing through ONE seed each adopt the seed's
+        /status member list as of THEIR join and announce only to those
+        nodes — so neither ever learns the other, and each serves its
+        own asymmetric ring (reads through one route around data the
+        other holds: indistinguishable from lost acked writes at the
+        edge). The node-join handler must gossip a first-seen join both
+        ways; this pins that relay."""
+        import time
+
+        n0, n1, n2 = (s.api.cluster for s in cluster3)
+        uris = {c.local.id: c.local.uri for c in (n0, n1, n2)}
+        # hand-craft the race end-state: n1 joined first (seed+n1 know
+        # each other), n2 fetched the seed's status BEFORE n1's announce
+        # landed (knows the seed only), n2's own announce still in flight
+        for c, drop in ((n0, "n2"), (n1, "n2"), (n2, "n1")):
+            with c._lock:
+                c.nodes.pop(drop, None)
+                c._note_membership_changed_locked()
+        # ... and now n2's announce arrives at the seed
+        n0.handle_message(
+            {"type": "node-join", "id": "n2", "uri": uris["n2"]})
+        want = {"n0", "n1", "n2"}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(set(c.nodes) == want for c in (n0, n1, n2)):
+                break
+            time.sleep(0.05)
+        assert set(n1.nodes) == want  # the relay told the earlier joiner
+        assert set(n2.nodes) == want  # ...and the new joiner about it
+        assert set(n0.nodes) == want
+
     def test_schema_broadcast(self, cluster3):
         req("POST", f"{uri(cluster3[1])}/index/repos", {})
         req("POST", f"{uri(cluster3[1])}/index/repos/field/stargazer", {})

@@ -1,0 +1,158 @@
+"""Knob-parity contract: every ServerConfig field must round-trip
+through ALL FOUR config surfaces — to_dict/from_dict (kebab), the
+snake_case spelling from_dict also accepts, the env-var path (string
+values, `PILOSA_TPU_FOO_BAR` → `foo-bar`), and the generated TOML
+template (`pilosa-tpu config`). Fields are ENUMERATED from the
+constructor signature, so adding a knob without wiring every surface
+fails here instead of shipping a knob that silently ignores its env
+var (the drift this test was written to stop: several newer knobs
+answered only to kebab until the normalization fix in from_dict)."""
+
+import inspect
+
+from pilosa_tpu import cli
+from pilosa_tpu.server.server import ServerConfig
+
+# Fields whose "just perturb the default" heuristic would trip
+# validation or needs a domain-shaped value.
+_NON_DEFAULT = {
+    "durability_mode": "per-op",
+    "seeds": ["http://seed-a:10101", "http://seed-b:10101"],
+    "slo_objectives": ["reads:latency:100ms:0.99", "avail:errors:0.999"],
+    "slo_windows": ["60s", "600s"],
+    "use_mesh": True,          # default None = auto
+    "device_budget_bytes": 123456,  # default None = auto
+    "qos_hedge_budget": 0.5,
+    "trace_sample_rate": 0.5,
+    "autopilot_heat_budget": 2.5,
+}
+
+# Knobs that ride the [tls] TOML section in the generated template
+# (the flat tls-* spellings are what to_dict emits and from_dict
+# prefers; the section is the operator-facing spelling).
+_TEMPLATE_SPELLING = {
+    "tls_certificate": "certificate",
+    "tls_key": "key",
+    "tls_skip_verify": "skip-verify",
+}
+
+
+def _fields() -> dict:
+    """name → default, from the constructor signature (the single
+    source of truth for the knob surface)."""
+    sig = inspect.signature(ServerConfig.__init__)
+    return {name: p.default for name, p in sig.parameters.items()
+            if name != "self"}
+
+
+def _non_default(name, default):
+    if name in _NON_DEFAULT:
+        return _NON_DEFAULT[name]
+    if isinstance(default, bool):
+        return not default
+    if isinstance(default, int):
+        return default + 3
+    if isinstance(default, float):
+        return default + 1.5
+    if isinstance(default, str):
+        return default + "/nondefault" if default else "nondefault"
+    if default is None:
+        raise AssertionError(
+            f"field {name!r} defaults to None: add it to _NON_DEFAULT "
+            "so the parity contract covers it"
+        )
+    raise AssertionError(f"no non-default rule for {name!r} ({default!r})")
+
+
+def _env_string(value) -> str:
+    """How the value looks arriving via PILOSA_TPU_* (cli._load_config
+    passes env values through as raw strings)."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, list):
+        return ",".join(str(v) for v in value)
+    return str(value)
+
+
+class TestKnobParity:
+    def test_every_field_survives_to_dict_from_dict(self):
+        fields = _fields()
+        cfg = ServerConfig(**{n: _non_default(n, d)
+                              for n, d in fields.items()})
+        rebuilt = ServerConfig.from_dict(cfg.to_dict())
+        for name in fields:
+            assert getattr(rebuilt, name) == getattr(cfg, name), (
+                f"{name} lost in to_dict→from_dict round-trip"
+            )
+        assert rebuilt.to_dict() == cfg.to_dict()
+
+    def test_every_field_accepts_kebab_and_snake(self):
+        for name, default in _fields().items():
+            value = _non_default(name, default)
+            for key in (name.replace("_", "-"), name):
+                got = getattr(ServerConfig.from_dict({key: value}), name)
+                assert got == getattr(ServerConfig(**{name: value}), name), (
+                    f"{name} not settable via from_dict key {key!r}"
+                )
+
+    def test_every_field_parses_env_style_strings(self):
+        """Env vars deliver strings; every knob must parse its string
+        rendering (the exact dict cli._load_config builds)."""
+        for name, default in _fields().items():
+            value = _non_default(name, default)
+            kebab = name.replace("_", "-")
+            cfg = ServerConfig.from_dict({kebab: _env_string(value)})
+            want = getattr(ServerConfig(**{name: value}), name)
+            assert getattr(cfg, name) == want, (
+                f"{name} does not parse its env-var string "
+                f"{_env_string(value)!r}"
+            )
+
+    def test_env_key_mapping_matches_load_config(self, monkeypatch):
+        """The documented PILOSA_TPU_FOO_BAR → foo-bar mapping, through
+        the real cli._load_config, for a representative of each parse
+        family (bool, duration, int, float, str, list)."""
+        samples = {
+            "PILOSA_TPU_AUTOPILOT_ENABLED": "true",
+            "PILOSA_TPU_AUTOPILOT_INTERVAL": "90s",
+            "PILOSA_TPU_AUTOPILOT_MAX_MOVES": "7",
+            "PILOSA_TPU_AUTOPILOT_HEAT_BUDGET": "2.5",
+            "PILOSA_TPU_DURABILITY_MODE": "per-op",
+            "PILOSA_TPU_SEEDS": "http://a:1,http://b:2",
+        }
+        for k, v in samples.items():
+            monkeypatch.setenv(k, v)
+        cfg = ServerConfig.from_dict(cli._load_config(None))
+        assert cfg.autopilot_enabled is True
+        assert cfg.autopilot_interval == 90.0
+        assert cfg.autopilot_max_moves == 7
+        assert cfg.autopilot_heat_budget == 2.5
+        assert cfg.durability_mode == "per-op"
+        assert cfg.seeds == ["http://a:1", "http://b:2"]
+
+    def test_every_field_appears_in_generated_config(self):
+        """`pilosa-tpu config` must mention every knob (commented-out
+        entries count — the template is the discovery surface)."""
+        template = cli._DEFAULT_TOML
+        for name in _fields():
+            spelling = _TEMPLATE_SPELLING.get(
+                name, name.replace("_", "-"))
+            assert spelling in template, (
+                f"knob {name} ({spelling!r}) missing from the "
+                "generated config template"
+            )
+
+    def test_template_round_trips_through_toml(self):
+        """The generated template itself must parse as TOML and load
+        into a ServerConfig (uncommented defaults only)."""
+        try:
+            import tomllib
+        except ImportError:
+            import tomli as tomllib
+
+        parsed = tomllib.loads(cli._DEFAULT_TOML)
+        cfg = ServerConfig.from_dict(parsed)
+        # template documents the shipped defaults for the autopilot
+        assert cfg.autopilot_enabled is False
+        assert cfg.autopilot_interval == 30.0
+        assert cfg.autopilot_heat_budget == 1.5

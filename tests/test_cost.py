@@ -392,6 +392,27 @@ def test_debug_k_must_be_positive(server):
         assert ei.value.code == 400
 
 
+def test_heatmap_k_zero_is_full_table(server):
+    """``?k=0`` = the FULL heat table — the exact request
+    ``client.heatmap`` (the autopilot coordinator's peer heat gather)
+    sends. Rejecting or capping it makes every peer read cold and the
+    planner skip 'in-budget' forever, silently."""
+    from pilosa_tpu.parallel.client import InternalClient
+
+    _seed_one(server, index="hot2", n_shards=2)
+    _seed_one(server, index="cold2", n_shards=2)
+    for _ in range(3):
+        _post(server, "/index/hot2/query", b"Count(Row(f=1))")
+    full = req("GET", f"{uri(server)}/debug/heatmap?k=0")
+    capped = req("GET", f"{uri(server)}/debug/heatmap?k=1")
+    assert len(capped["shards"]) == 1
+    assert len(full["shards"]) > 1
+    # and over the planner's actual wire path
+    wired = InternalClient().heatmap(uri(server))
+    assert {(r["index"], r["field"], r["shard"]) for r in wired["shards"]} \
+        == {(r["index"], r["field"], r["shard"]) for r in full["shards"]}
+
+
 def test_roaring_import_bills_submitted_bits(server):
     """Re-importing an identical roaring payload must bill the same
     ingest_rows as the first import (rows SUBMITTED, like the
