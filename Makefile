@@ -24,7 +24,7 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 	mp-smoke multitenant-smoke mesh-smoke autopilot-smoke bench-ingest \
 	bench-serving bench-sync bench-durability bench-tracing \
 	bench-profiling bench-chaos bench-scrub bench-mp bench-multitenant \
-	bench-mesh bench-autopilot
+	bench-mesh bench-autopilot cdc-smoke bench-cdc
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -116,6 +116,14 @@ autopilot-smoke:
 	$(PYTEST) tests/test_autopilot.py tests/test_config_parity.py \
 		-m "not slow"
 
+# cdc-smoke: the CDC backbone — WAL tail cursor semantics (resume,
+# rotation survival, segment-GC pinning, 410 on truncation AND on
+# unknown-cursor restart detection), frame codec torn-frame fuzz,
+# follower attach/apply/resync convergence, the staleness QoS header,
+# and restore --as-of point-in-time bit-exactness
+cdc-smoke:
+	$(PYTEST) tests/test_cdc.py -m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -180,3 +188,12 @@ bench-mesh:
 # to hash placement
 bench-autopilot:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs autopilot
+
+# CDC backbone gate: chaos schedules with a live out-of-cluster mirror
+# (byte-identical to n0 after heal, restarts driving the
+# unknown-cursor 410 → resync path), subprocess follower read scaling
+# >= 1.7x primary-alone with staleness p99 under the 1 s budget, the
+# X-Pilosa-Max-Staleness gate live, and every WAL seq between two
+# backup generations restoring bit-exactly via restore --as-of
+bench-cdc:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs cdc

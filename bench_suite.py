@@ -2753,6 +2753,90 @@ def config_multitenant(n_indexes: int = 120, n_clients: int = 8,
             mp_ok = True
             result["mp_skipped"] = "SO_REUSEPORT unavailable"
 
+        # ---- phase 6: cluster-edge caching under live CDC (ISSUE 16)
+        # Two nodes, replica_n=1: node0's Count spans shards node1
+        # owns — the exact shape the write-invalidated cache REFUSED
+        # to cache single-node ("cluster-no-cdc" refusal), because a
+        # remote write could not reach the local invalidation hook.
+        # With cdc-enabled tailers live the edge entry caches (gate:
+        # >50% hit rate on repeat reads) and a write through the PEER
+        # is never masked past the tail-poll staleness (bounded
+        # read-your-writes: the re-read converges within a deadline).
+        ce_errors: list = []
+        ce_ryw_ok = True
+        ce_hit_rate = 0.0
+        ce_prop_ms: list = []
+        ce_lag: dict = {}
+        ce_reads = 40
+        ce_kw = dict(replica_n=1, anti_entropy_interval=0,
+                     heartbeat_interval=0, use_mesh=False,
+                     result_cache_bytes=32 << 20,
+                     cdc_enabled=True, cdc_poll_interval=0.02)
+        ce0 = Server(ServerConfig(
+            data_dir=f"{tmp}/ce0", port=0, name="ce0", **ce_kw)).open()
+        ce1 = Server(ServerConfig(
+            data_dir=f"{tmp}/ce1", port=0, name="ce1",
+            seeds=[f"http://localhost:{ce0.port}"], **ce_kw)).open()
+        try:
+            for s in (ce0, ce1):
+                s.api.cluster.wait_until_normal(30)
+
+            def ce_req(port, path, body=None):
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}", data=body,
+                    method="POST")
+                with urllib.request.urlopen(r, timeout=60) as resp:
+                    return resp.status, resp.read()
+
+            ce_req(ce0.port, "/index/ce", b"{}")
+            ce_req(ce0.port, "/index/ce/field/f", b"{}")
+            expect = 4
+            for s_ in range(expect):
+                ce_req(ce0.port, "/index/ce/query",
+                       f"Set({s_ * SHARD_WIDTH + 5}, f=1)".encode())
+            deadline = time.time() + 15
+            while time.time() < deadline and not all(
+                    s.api.cdc is not None and s.api.cdc.live()
+                    for s in (ce0, ce1)):
+                time.sleep(0.05)
+            m0 = global_result_cache().metrics()
+            for _ in range(ce_reads):
+                st, body = ce_req(ce0.port, "/index/ce/query",
+                                  b"Count(Row(f=1))")
+                if st != 200 or json.loads(body)["results"] != [expect]:
+                    ce_errors.append(("ce-read", st, body[:120]))
+            m1 = global_result_cache().metrics()
+            hits = (m1["result_cache_hits_total"]
+                    - m0["result_cache_hits_total"])
+            ce_hit_rate = hits / ce_reads
+            for k in range(8):
+                ce_req(ce1.port, "/index/ce/query",
+                       f"Set({(expect + k) * SHARD_WIDTH + 5}, "
+                       f"f=1)".encode())
+                t0p = time.perf_counter()
+                dl = time.time() + 5.0
+                seen = None
+                while time.time() < dl:
+                    _, body = ce_req(ce0.port, "/index/ce/query",
+                                     b"Count(Row(f=1))")
+                    seen = json.loads(body)["results"][0]
+                    if seen == expect + k + 1:
+                        break
+                    time.sleep(0.01)
+                else:
+                    ce_ryw_ok = False
+                    ce_errors.append(("ce-ryw-stale",
+                                      expect + k + 1, seen))
+                ce_prop_ms.append(
+                    (time.perf_counter() - t0p) * 1e3)
+            ce_lag = ce0.api.cdc.peer_lag() if ce0.api.cdc else {}
+        except Exception as e:  # noqa: BLE001 — surfaced via gate
+            ce_ryw_ok = False
+            ce_errors.append(repr(e))
+        finally:
+            ce1.close()
+            ce0.close()
+
     cold_bound = max(50 * base_p99, 0.75)
     result.update({
         "requests_zipf": n_clients * requests_per_client * rounds,
@@ -2776,6 +2860,16 @@ def config_multitenant(n_indexes: int = 120, n_clients: int = 8,
         "tier_transition_errors": tier_errors,
         "read_your_writes_ok": ryw_ok,
         "read_your_writes_mp_ok": mp_ok,
+        "cluster_edge": {
+            "hit_rate": round(ce_hit_rate, 4),
+            "read_your_writes_ok": ce_ryw_ok,
+            "invalidation_p50_ms": round(
+                float(np.percentile(ce_prop_ms, 50)), 2
+            ) if ce_prop_ms else None,
+            "peer_lag": ce_lag,
+            "errors": len(ce_errors),
+            "error_sample": [str(e)[:160] for e in ce_errors[:3]],
+        },
         "client_errors": len(errors),
         "error_sample": [str(e)[:160] for e in errors[:5]],
         "wall_s": round(time.time() - t_start, 1),
@@ -2785,6 +2879,7 @@ def config_multitenant(n_indexes: int = 120, n_clients: int = 8,
         and (cold_lat_best or 0.0) <= cold_bound
         and hot_hit_rate > 0.5
         and ryw_ok and mp_ok
+        and ce_hit_rate > 0.5 and ce_ryw_ok and not ce_errors
         and demotions >= 1 and promotions >= 1
         and tier_errors == 0 and not errors
     )
@@ -3609,6 +3704,377 @@ def _spawn_cpu_mesh_entry() -> None:
     print(lines[-1], flush=True)
 
 
+def config_cdc(n_chaos_schedules: int = 3, n_clients: int = 6,
+               read_s: float = 5.0, n_shards: int = 4,
+               density: float = 0.01, seed: int = 0) -> dict:
+    """CDC backbone gate (ISSUE 16 — docs/OPERATIONS.md Replication &
+    CDC): three oracles over the WAL tail change feed.
+
+    1. **Byte-identical mirror under chaos** — an out-of-cluster
+       follower tails n0 through randomized partition/kill/restart
+       schedules (testing/chaos.py ``with_cdc``); after heal, every
+       non-empty fragment n0 holds must be byte-identical in the
+       mirror. Upstream restarts reset the seq space mid-schedule, so
+       this also drives the unknown-cursor 410 → merge-resync path.
+    2. **Follower read scaling** — primary and follower run as real OS
+       subprocesses (separate interpreters, real parallelism); on
+       >=2 cores the closed-loop read fleet against primary+follower
+       must clear ≥1.7x the primary-alone QPS; on a single core (where
+       wall-clock scaling is physically impossible) the gate is
+       capacity instead — follower-alone ≥0.5x primary, combined
+       ≥0.75x (no collapse) — with the mode recorded. Either way:
+       follower staleness p99 under the 1 s budget while a writer
+       keeps the feed moving, the follower converging to the primary's
+       count after load, and the ``X-Pilosa-Max-Staleness`` gate live
+       (an impossible budget sheds 503, a generous one serves).
+    3. **As-of ledger bit-exactness** — every WAL seq between two
+       backup generations restores bit-exactly via nearest-generation
+       + feed replay (``restore --as-of``, storage/backup.py).
+    """
+    import http.client as _hc
+    import os
+    import socket
+    import subprocess
+    import sys
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.roaring import RoaringBitmap
+    from pilosa_tpu.roaring.format import serialize
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage import Holder
+    from pilosa_tpu.storage.backup import backup_holder, restore_holder
+    from pilosa_tpu.storage.view import VIEW_STANDARD
+    from pilosa_tpu.testing.chaos import run_chaos
+
+    t_start = time.time()
+    rng = np.random.default_rng(29)
+    errors: list = []
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def req(base, path, body=None, method="POST", headers=None,
+            timeout=60):
+        r = urllib.request.Request(f"{base}{path}", data=body,
+                                   method=method,
+                                   headers=headers or {})
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, resp.read()
+
+    def spawn(data_dir: str, name: str, extra_env: dict) -> tuple:
+        port = free_port()
+        env = {
+            **os.environ, "JAX_PLATFORMS": "cpu",
+            "PILOSA_TPU_NAME": name,
+            "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "0",
+            "PILOSA_TPU_HEARTBEAT_INTERVAL": "0",
+            "PILOSA_TPU_USE_MESH": "false",
+            **extra_env,
+        }
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu", "server",
+             "--data-dir", data_dir, "--bind", "127.0.0.1",
+             "--port", str(port)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(240):
+            if proc.poll() is not None:
+                raise AssertionError(f"{name} exited rc={proc.returncode}")
+            try:
+                req(base, "/status", method="GET", timeout=5)
+                return proc, base
+            except Exception:
+                time.sleep(0.25)
+        proc.terminate()
+        raise AssertionError(f"{name} never served /status")
+
+    result: dict = {"config": "cdc", "metric": "cdc_backbone_oracles"}
+    with tempfile.TemporaryDirectory() as tmp:
+        # ---- phase 1: byte-identical mirror under chaos
+        chaos = run_chaos(
+            f"{tmp}/chaos", n_schedules=n_chaos_schedules,
+            n_events=7, seed=seed, with_cdc=True,
+        )
+
+        # ---- phase 2: follower read scaling (subprocess parallelism)
+        n_bits = int(SHARD_WIDTH * density)
+        payloads = []
+        for _ in range(n_shards):
+            ids = []
+            for row in (1, 2, 3, 4):
+                pos = rng.choice(SHARD_WIDTH, n_bits,
+                                 replace=False).astype(np.uint64)
+                ids.append((np.uint64(row) << np.uint64(20)) + pos)
+            bm = RoaringBitmap()
+            bm.add_ids(np.concatenate(ids))
+            payloads.append(serialize(bm))
+        expected = [None]  # Count(Row(f=1)) once seeded
+
+        primary = follower = None
+        qps_primary = qps_combined = qps_follower = 0.0
+        staleness: list = []
+        writes = [0]
+        converged = gated_ok = False
+        try:
+            primary, pbase = spawn(f"{tmp}/primary", "cdc-primary", {})
+            req(pbase, "/index/cdc", b"{}")
+            req(pbase, "/index/cdc/field/f", b"{}")
+            for shard, payload in enumerate(payloads):
+                req(pbase,
+                    f"/index/cdc/field/f/import-roaring/{shard}"
+                    "?remote=true", payload,
+                    headers={"Content-Type":
+                             "application/octet-stream"})
+            _, body = req(pbase, "/index/cdc/query",
+                          b"Count(Row(f=1))")
+            expected[0] = json.loads(body)["results"][0]
+
+            follower, fbase = spawn(
+                f"{tmp}/follower", "cdc-follower",
+                {"PILOSA_TPU_CDC_FOLLOW": pbase,
+                 "PILOSA_TPU_CDC_POLL_INTERVAL": "25ms",
+                 "PILOSA_TPU_CDC_STALENESS_BUDGET": "5s"})
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                try:
+                    _, body = req(fbase, "/index/cdc/query",
+                                  b"Count(Row(f=1))")
+                    if json.loads(body)["results"][0] == expected[0]:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.25)
+            else:
+                raise AssertionError("follower never caught up to seed")
+
+            stop = threading.Event()
+            side_stop = threading.Event()
+            counts: dict = {}
+
+            def reader(tag, base):
+                conn = _hc.HTTPConnection(
+                    base.split("//")[1].split(":")[0],
+                    int(base.rsplit(":", 1)[1]), timeout=60)
+                n = k = 0
+                try:
+                    while not stop.is_set():
+                        conn.request(
+                            "POST",
+                            f"/index/cdc/query",
+                            body=f"Count(Row(f={1 + (k % 4)}))".encode())
+                        resp = conn.getresponse()
+                        resp.read()
+                        if resp.status == 200:
+                            n += 1
+                        else:
+                            errors.append((tag, resp.status))
+                        k += 1
+                finally:
+                    conn.close()
+                counts[tag] = counts.get(tag, 0) + n
+
+            def run_fleet(targets, dur) -> float:
+                # constant TOTAL client threads split evenly across
+                # targets, so every window presents the same client-
+                # side load and only the serving capacity varies
+                stop.clear()
+                counts.clear()
+                per = max(1, n_clients // len(targets))
+                threads = [
+                    threading.Thread(target=reader,
+                                     args=(f"{i}:{b}", b))
+                    for b in targets for i in range(per)
+                ]
+                for t in threads:
+                    t.start()
+                time.sleep(dur)
+                stop.set()
+                for t in threads:
+                    t.join(30)
+                return sum(counts.values()) / dur
+
+            def writer():
+                k = 0
+                while not side_stop.is_set():
+                    try:
+                        st, _ = req(pbase, "/index/cdc/query",
+                                    f"Set({5 * SHARD_WIDTH + k}, "
+                                    f"f=9)".encode())
+                        if st == 200:
+                            writes[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(("writer", repr(e)))
+                    k += 1
+                    time.sleep(0.02)
+
+            def sampler():
+                while not side_stop.is_set():
+                    try:
+                        _, body = req(fbase, "/debug/vars",
+                                      method="GET", timeout=5)
+                        s = json.loads(body)["cdc"].get(
+                            "cdc_follower_staleness_seconds", -1.0)
+                        if s >= 0:
+                            staleness.append(s)
+                    except Exception:  # noqa: BLE001 — sampled gauge
+                        pass
+                    time.sleep(0.1)
+
+            # the writer + staleness sampler run across EVERY window
+            # on their own stop flag, so the baseline and the combined
+            # phase carry identical write/feed load — the only delta
+            # between windows is which servers take the read fleet
+            side = [threading.Thread(target=writer),
+                    threading.Thread(target=sampler)]
+            for t in side:
+                t.start()
+            qps_primary = run_fleet([pbase], read_s)
+            qps_combined = run_fleet([pbase, fbase], read_s)
+            qps_follower = run_fleet([fbase], read_s)
+            side_stop.set()
+            for t in side:
+                t.join(30)
+
+            # follower converges to the primary's post-load count
+            _, body = req(pbase, "/index/cdc/query",
+                          b"Count(Row(f=9))")
+            want9 = json.loads(body)["results"][0]
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                _, body = req(fbase, "/index/cdc/query",
+                              b"Count(Row(f=9))")
+                if json.loads(body)["results"][0] == want9:
+                    converged = True
+                    break
+                time.sleep(0.1)
+
+            # the staleness QoS gate is live: generous budget serves,
+            # impossible budget sheds 503 + Retry-After
+            st_ok, _ = req(fbase, "/index/cdc/query",
+                           b"Count(Row(f=1))",
+                           headers={"X-Pilosa-Max-Staleness": "30s"})
+            try:
+                req(fbase, "/index/cdc/query", b"Count(Row(f=1))",
+                    headers={"X-Pilosa-Max-Staleness": "1us"})
+                shed = False
+            except urllib.error.HTTPError as e:
+                shed = e.code == 503
+            gated_ok = st_ok == 200 and shed
+        except Exception as e:  # noqa: BLE001 — surfaced via gate
+            errors.append(repr(e))
+        finally:
+            for proc in (follower, primary):
+                if proc is not None:
+                    proc.terminate()
+                    try:
+                        proc.wait(10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+
+        # ---- phase 3: as-of ledger bit-exactness
+        asof_checked = 0
+        asof_exact = True
+        h = Holder(f"{tmp}/asof/src").open()
+        try:
+            idx = h.create_index("i", track_existence=False)
+            fld = idx.create_field("f")
+            frag = fld.view(VIEW_STANDARD, create=True).fragment(
+                0, create=True)
+            for i in range(8):
+                frag.set_bit(1, i)
+            h.wal.barrier()
+            bk = f"{tmp}/asof/bk"
+            backup_holder(h, bk)
+            ledger = {}
+            cols = set(range(8))
+            for i in range(8, 20):
+                frag.set_bit(1, i)
+                cols.add(i)
+                h.wal.barrier()
+                ledger[h.wal.durable_seq()] = sorted(cols)
+            frag.clear_bit(1, 2)
+            cols.discard(2)
+            h.wal.barrier()
+            ledger[h.wal.durable_seq()] = sorted(cols)
+            backup_holder(h, bk)
+            for seq_pt, want in ledger.items():
+                dst = f"{tmp}/asof/r{seq_pt}"
+                restore_holder(bk, dst, as_of=seq_pt)
+                rh = Holder(dst).open()
+                try:
+                    got = sorted(
+                        rh.index("i").field("f").view(VIEW_STANDARD)
+                        .fragment(0).row_columns(1).tolist())
+                finally:
+                    rh.close()
+                asof_checked += 1
+                if got != want:
+                    asof_exact = False
+                    errors.append(("asof-mismatch", seq_pt))
+        finally:
+            h.close()
+
+    scaling = qps_combined / qps_primary if qps_primary else 0.0
+    stale_p99 = (float(np.percentile(staleness, 99))
+                 if staleness else -1.0)
+    # the wall-clock scaling gate needs real parallelism: primary,
+    # follower, and the client fleet are separate OS processes, so on
+    # >=2 cores the combined window must clear 1.7x primary-alone. On
+    # a single core three processes time-slice one CPU and wall-clock
+    # scaling is physically impossible — gate capacity instead: the
+    # follower alone must serve >=0.5x the primary's QPS from its own
+    # storage, and spanning the fleet across both must not collapse
+    # (>=0.75x). The mode is recorded, never silently downgraded.
+    cores = os.cpu_count() or 1
+    if cores >= 2:
+        scaling_mode = "multicore-wall-clock"
+        scaling_ok = scaling >= 1.7
+    else:
+        scaling_mode = "single-core-capacity"
+        scaling_ok = bool(
+            qps_primary > 0
+            and qps_follower >= 0.5 * qps_primary
+            and qps_combined >= 0.75 * qps_primary)
+    result.update({
+        "chaos_schedules": chaos["schedules"],
+        "chaos_ok": chaos["ok"],
+        "chaos_failed_seeds": chaos["failed_seeds"],
+        "cdc_mirror_mismatches": chaos["cdc_mirror_mismatches"],
+        "cdc_resyncs_total": chaos["cdc_resyncs_total"],
+        "cdc_applied_ops_total": chaos["cdc_applied_ops_total"],
+        "read_qps_primary": round(qps_primary, 1),
+        "read_qps_with_follower": round(qps_combined, 1),
+        "read_qps_follower_alone": round(qps_follower, 1),
+        "follower_read_scaling": round(scaling, 3),
+        "scaling_gate_mode": scaling_mode,
+        "cpu_cores": cores,
+        "follower_staleness_p99_s": round(stale_p99, 4),
+        "staleness_samples": len(staleness),
+        "feed_writes_during_load": writes[0],
+        "follower_converged_after_load": converged,
+        "staleness_gate_live": gated_ok,
+        "asof_points_checked": asof_checked,
+        "asof_bit_exact": asof_exact,
+        "client_errors": len(errors),
+        "error_sample": [str(e)[:160] for e in errors[:5]],
+        "wall_s": round(time.time() - t_start, 1),
+    })
+    result["ok"] = bool(
+        chaos["ok"]
+        and scaling_ok
+        and 0.0 <= stale_p99 < 1.0
+        and converged and gated_ok
+        and asof_exact and asof_checked >= 13
+        and not errors
+    )
+    return result
+
+
 def config_mesh_inner(n_devices: int) -> dict:
     """One mesh size of the hierarchical-reduction gate: the flat 1-D
     mesh (the dense baseline every prior PR certified) vs the 2-D
@@ -3772,7 +4238,7 @@ def main() -> None:
         "--configs",
         default="1,2,3,4,5,mesh8,mesh,serving,mp_serving,multitenant,import,"
                 "ingest,sync,hostpath,durability,tracing,profiling,chaos,"
-                "scrub,autopilot",
+                "scrub,autopilot,cdc",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -3854,6 +4320,11 @@ def main() -> None:
         "autopilot": lambda: config_autopilot(
             hot_run_s=32.0 if args.full else 24.0,
             n_chaos_schedules=6 if args.full else 3,
+        ),
+        "cdc": lambda: config_cdc(
+            n_chaos_schedules=6 if args.full else 3,
+            read_s=8.0 if args.full else 5.0,
+            n_clients=8 if args.full else 6,
         ),
         "mesh": config_mesh,
     }

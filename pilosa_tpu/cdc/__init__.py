@@ -1,0 +1,40 @@
+"""Change-data-capture backbone: WAL tail feed, peer tailers, followers.
+
+The write path already totally orders every mutation (group-commit WAL,
+storage/wal.py); CDC exposes that order as a resumable change feed
+(``GET /internal/wal/tail``) and builds three consumers on it:
+
+  cluster-safe result caching   each node tails its peers and feeds
+                                remote write events into the PR 12
+                                invalidation path (serving/rescache.py),
+                                lifting the single-node-only refusal
+  stale-bounded read replicas   follower nodes tail an upstream cluster
+                                and serve reads under an
+                                ``X-Pilosa-Max-Staleness`` budget
+  point-in-time restore         ``restore --as-of <seq>`` replays the
+                                feed on top of the nearest backup
+                                generation (storage/backup.py)
+
+Wire format and crash model live in cdc/feed.py; the polling consumers
+in cdc/tailer.py.
+"""
+
+from pilosa_tpu.cdc.feed import (
+    DURABLE_SEQ_HEADER,
+    NEXT_SEQ_HEADER,
+    FeedGone,
+    TailGone,
+    encode_events,
+    encode_frame,
+    iter_frames,
+)
+
+__all__ = [
+    "DURABLE_SEQ_HEADER",
+    "NEXT_SEQ_HEADER",
+    "FeedGone",
+    "TailGone",
+    "encode_events",
+    "encode_frame",
+    "iter_frames",
+]

@@ -1,0 +1,442 @@
+"""CDC consumers: the peer tailer and the read-replica follower.
+
+Both ride the same resumable feed (``GET /internal/wal/tail`` —
+cdc/feed.py) but answer different questions:
+
+``CdcTailer``   runs on every CLUSTER MEMBER with CDC enabled: it tails
+                every peer's committed WAL and feeds remote write events
+                into the result cache's invalidation path
+                (serving/rescache.py), which is what makes caching
+                cluster-edge results safe — a remote write invalidates
+                this node's dependent entries within one poll interval.
+                ``live()`` is the cache's admission gate: true only
+                while every current peer's feed is attached and fresh,
+                so membership changes or a stalled peer flip the cache
+                back to refusing cluster edges (fail closed, never
+                stale).
+
+``CdcFollower`` runs on a NON-MEMBER follower (``cdc-follow`` knob): it
+                mirrors an upstream node by attaching a cursor, bulk-
+                syncing every fragment over the anti-entropy block
+                routes, then applying the tail in commit order via the
+                WAL's own recovery path (``apply_recovered`` — the op
+                semantics, cache invalidation, and residency upkeep all
+                come for free). Reads are served under a staleness
+                budget (api.check_staleness); writes are refused 403.
+                A crash or a 410 costs a full block resync — the feed
+                is applied without local WAL logging, so the cursor
+                restarts from the upstream's durable seq.
+
+Feed-gap semantics (both consumers): ``FeedGone`` means the producer
+reclaimed history past the cursor (retention budget) or restarted (seq
+space reset). Everything derived from the feed is dropped — the tailer
+clears the result cache, the follower re-syncs blocks — and the cursor
+re-attaches at the producer's durable seq.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu.cdc.feed import FeedGone
+from pilosa_tpu.storage.wal import (
+    REC_TOMBSTONE,
+    WriteAheadLog,
+    decode_op_body,
+)
+
+
+class _PeerState:
+    __slots__ = ("cursor", "caught_up_at")
+
+    def __init__(self):
+        self.cursor: int | None = None   # None = not attached yet
+        self.caught_up_at: float | None = None
+
+
+class CdcTailer:
+    """Tail every cluster peer's WAL; invalidate the local result cache
+    on remote write events. One daemon thread polls all peers round-
+    robin — the feed is a control plane (keys, not payload bits), so a
+    single poller keeps up at any realistic write rate."""
+
+    def __init__(self, api, client, poll_interval: float = 0.05,
+                 max_batch_bytes: int = 1 << 20,
+                 cursor_name: str = "tailer", logger=None):
+        self.api = api
+        self.client = client
+        self.poll_interval = max(poll_interval, 1e-3)
+        self.max_batch_bytes = max_batch_bytes
+        self.cursor_name = cursor_name
+        self.logger = logger
+        # liveness window: a peer whose feed hasn't been seen caught-up
+        # within this long makes live() false (the cache refuses cluster
+        # edges again) — bounded staleness is the whole contract
+        self.live_window = max(1.0, 20 * self.poll_interval)
+        self._peers: dict[str, _PeerState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.events_total = 0
+        self.invalidations_total = 0
+        self.resyncs_total = 0
+        self.poll_errors_total = 0
+
+    # --------------------------------------------------------------- control
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cdc-tailer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self._poll_all()
+            except Exception as e:  # noqa: BLE001 — the poller must
+                # survive anything a sick peer throws at it
+                self.poll_errors_total += 1
+                if self.logger is not None:
+                    self.logger.info("cdc tailer pass failed: %s", e)
+
+    # --------------------------------------------------------------- polling
+
+    def _peer_uris(self) -> list[str]:
+        cluster = self.api.cluster
+        if cluster is None:
+            return []
+        local = cluster.local.id
+        return [n.uri for n in cluster.sorted_nodes() if n.id != local]
+
+    def _poll_all(self) -> None:
+        uris = self._peer_uris()
+        with self._lock:
+            # forget departed peers: a removed node must not hold
+            # live() false forever
+            for gone in set(self._peers) - set(uris):
+                del self._peers[gone]
+            states = {uri: self._peers.setdefault(uri, _PeerState())
+                      for uri in uris}
+        for uri, state in states.items():
+            try:
+                self._poll_peer(uri, state)
+            except FeedGone:
+                # history gone (retention reclaim or producer restart):
+                # nothing derived from this feed is trustworthy — drop
+                # the whole cache (the clear fences in-flight fills)
+                # and re-attach
+                from pilosa_tpu.serving.rescache import global_result_cache
+
+                global_result_cache().clear()
+                state.cursor = None
+                state.caught_up_at = None
+                self.resyncs_total += 1
+            except Exception as e:  # noqa: BLE001 — transport faults,
+                # sick peers: live() decays via caught_up_at and the
+                # cache refuses cluster edges until the peer answers
+                self.poll_errors_total += 1
+                if self.logger is not None:
+                    self.logger.info("cdc poll %s failed: %s", uri, e)
+
+    def _poll_peer(self, uri: str, state: _PeerState) -> None:
+        if state.cursor is None:
+            _, durable, _ = self.client.wal_tail(
+                uri, cursor=self.cursor_name)
+            state.cursor = durable
+            state.caught_up_at = time.monotonic()
+            return
+        events, next_seq, durable = self.client.wal_tail(
+            uri, since=state.cursor, max_bytes=self.max_batch_bytes,
+            cursor=self.cursor_name)
+        for _seq, rtype, key, _body in events:
+            self.events_total += 1
+            self._invalidate(rtype, key)
+        state.cursor = next_seq
+        if next_seq >= durable:
+            state.caught_up_at = time.monotonic()
+
+    def _invalidate(self, rtype: int, key: str) -> None:
+        """Feed one remote write event into the PR 12 invalidation
+        path. Ops invalidate at (index, field) dependency granularity —
+        the same keys local fragment writes touch; tombstones (index/
+        field/shard deletes) invalidate the whole index's entries."""
+        from pilosa_tpu.serving import rescache
+
+        parts = key.rstrip("/").split("/")
+        idx = self.api.holder.index(parts[0]) if parts and parts[0] else None
+        if idx is None:
+            # unknown index: no local schema, so no cacheable entries
+            # reference it — nothing to invalidate
+            return
+        if rtype == REC_TOMBSTONE or len(parts) < 4:
+            rescache.invalidate_index_wide(idx.scope, parts[0])
+        else:
+            shard = int(parts[3]) if parts[3].isdigit() else None
+            rescache.invalidate_write(idx.scope, parts[0], parts[1],
+                                      shard)
+        self.invalidations_total += 1
+
+    # --------------------------------------------------------------- surface
+
+    def live(self) -> bool:
+        """True while EVERY current peer's feed is attached and was
+        seen caught-up within the live window — the result cache's
+        cluster-edge admission gate. No peers (single node) is live."""
+        now = time.monotonic()
+        uris = self._peer_uris()
+        with self._lock:
+            for uri in uris:
+                state = self._peers.get(uri)
+                if (state is None or state.caught_up_at is None
+                        or now - state.caught_up_at > self.live_window):
+                    return False
+        return True
+
+    def peer_lag(self) -> dict:
+        """Seconds since each peer's feed was last seen caught-up
+        (-1 = never attached)."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                uri: (round(now - s.caught_up_at, 3)
+                      if s.caught_up_at is not None else -1.0)
+                for uri, s in self._peers.items()
+            }
+
+    def metrics(self) -> dict:
+        lag = self.peer_lag()
+        finite = [v for v in lag.values() if v >= 0]
+        if finite:
+            lag_max = max(finite)
+        else:
+            # -1 = peers exist but at least one never attached;
+            # 0 = no peers at all (single node)
+            lag_max = -1.0 if lag else 0.0
+        return {
+            "cdc_live": 1 if self.live() else 0,
+            "cdc_peers": len(lag),
+            "cdc_peer_lag_seconds_max": lag_max,
+            "cdc_events_total": self.events_total,
+            "cdc_invalidations_total": self.invalidations_total,
+            "cdc_resyncs_total": self.resyncs_total,
+            "cdc_poll_errors_total": self.poll_errors_total,
+        }
+
+
+class CdcFollower:
+    """Mirror one upstream node and serve stale-bounded reads.
+
+    Lifecycle: attach a cursor (capturing the upstream's durable seq
+    BEFORE the bulk copy, so the tail overlaps the copy instead of
+    gapping it — replaying an op the block sync already carried is
+    idempotent), adopt the upstream schema, bulk-sync every fragment
+    over the anti-entropy block routes, then poll the tail forever.
+    The overlap means every committed write is either in the synced
+    blocks or in the replayed suffix (or harmlessly both)."""
+
+    def __init__(self, api, client, upstream: str,
+                 poll_interval: float = 0.05,
+                 max_batch_bytes: int = 1 << 20,
+                 cursor_name: str = "follower", logger=None):
+        self.api = api
+        self.client = client
+        self.upstream = upstream.rstrip("/")
+        self.poll_interval = max(poll_interval, 1e-3)
+        self.max_batch_bytes = max_batch_bytes
+        self.cursor_name = cursor_name
+        self.logger = logger
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._since: int | None = None
+        self._caught_up_at: float | None = None
+        self.applied_ops_total = 0
+        self.events_total = 0
+        self.resyncs_total = 0
+        self.poll_errors_total = 0
+
+    # --------------------------------------------------------------- control
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cdc-follower")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._since is None:
+                    self._attach_and_sync()
+                self._poll_once()
+            except FeedGone:
+                # cursor fell off the retained tail (or the upstream
+                # restarted): the mirror may have a gap — full resync
+                self._since = None
+                self._caught_up_at = None
+                self.resyncs_total += 1
+            except Exception as e:  # noqa: BLE001 — upstream down:
+                # staleness grows, check_staleness sheds reads, and we
+                # keep retrying
+                self.poll_errors_total += 1
+                if self.logger is not None:
+                    self.logger.info("cdc follow %s failed: %s",
+                                     self.upstream, e)
+                if self._stop.wait(min(1.0, 10 * self.poll_interval)):
+                    return
+            if self._stop.wait(self.poll_interval):
+                return
+
+    # ------------------------------------------------------------- bulk sync
+
+    def _attach_and_sync(self) -> None:
+        _, since, _durable = self.client.wal_tail(
+            self.upstream, cursor=self.cursor_name)
+        self._sync_schema()
+        self._sync_blocks()
+        self._since = since
+        self._caught_up_at = time.monotonic()
+
+    def _sync_schema(self) -> None:
+        """Adopt the upstream schema (create-only — deletions arrive as
+        feed tombstones, in order, so a schema fetch never races a
+        delete backwards). The same dict shapes the cluster join path
+        adopts from its seed."""
+        from pilosa_tpu.storage import FieldOptions
+
+        holder = self.api.holder
+        schema = self.client.schema(self.upstream)
+        for idx_schema in schema.get("indexes", []):
+            name = idx_schema["name"]
+            opts = idx_schema.get("options", {})
+            idx = holder.index(name)
+            if idx is None:
+                idx = holder.create_index(
+                    name, keys=opts.get("keys", False),
+                    track_existence=opts.get("trackExistence", True),
+                )
+            for f in idx_schema.get("fields", []):
+                if idx.field(f["name"]) is None:
+                    idx.create_field(
+                        f["name"],
+                        FieldOptions.from_dict(f.get("options", {})),
+                    )
+
+    def _sync_blocks(self) -> None:
+        """Bulk-copy every fragment from the upstream over the batched
+        sync routes, merged under the anti-entropy rules (mutex/bool
+        and BSI planes must not union stale rows into newer values —
+        parallel/cluster.py)."""
+        holder = self.api.holder
+        for index_name in list(holder.indexes):
+            idx = holder.index(index_name)
+            if idx is None:
+                continue
+            entries = self.client.sync_manifest(self.upstream, index_name)
+            for field_name, view_name, shard, blocks in entries:
+                fld = idx.field(field_name)
+                if fld is None:
+                    continue
+                wanted = [b for b, _checksum in blocks]
+                if not wanted:
+                    continue
+                bitmaps = self.client.sync_blocks(
+                    self.upstream, index_name,
+                    [(field_name, view_name, shard, wanted)])
+                frag = fld.view(view_name, create=True).fragment(
+                    shard, create=True)
+                for bm in bitmaps:
+                    if bm is None or not bm.count():
+                        continue
+                    if fld.options.type in ("mutex", "bool"):
+                        frag.add_ids_mutex(bm.to_ids())
+                    elif view_name == fld.bsi_view_name():
+                        frag.add_ids_value(bm.to_ids())
+                    else:
+                        frag.import_roaring_bitmap(bm)
+
+    # ------------------------------------------------------------- tail loop
+
+    def _poll_once(self) -> None:
+        events, next_seq, durable = self.client.wal_tail(
+            self.upstream, since=self._since,
+            max_bytes=self.max_batch_bytes, cursor=self.cursor_name)
+        for _seq, rtype, key, body in events:
+            self.events_total += 1
+            try:
+                if rtype == REC_TOMBSTONE:
+                    self._apply_tombstone(key)
+                else:
+                    self._apply_op(key, body)
+            except Exception as e:  # noqa: BLE001 — one undecodable
+                # event must not wedge the feed behind it forever
+                self.poll_errors_total += 1
+                if self.logger is not None:
+                    self.logger.info("cdc apply %s failed: %s", key, e)
+        self._since = next_seq
+        if next_seq >= durable:
+            self._caught_up_at = time.monotonic()
+
+    def _apply_op(self, key: str, body: bytes) -> None:
+        holder = self.api.holder
+        frag = WriteAheadLog._resolve_fragment(holder, key)
+        if frag is None:
+            # schema raced the feed (the op's field was created after
+            # our last schema fetch): refresh and retry once
+            self._sync_schema()
+            frag = WriteAheadLog._resolve_fragment(holder, key)
+        if frag is None:
+            raise ValueError(f"no fragment for feed key {key!r}")
+        op, ids = decode_op_body(body)
+        # the recovery apply path: op semantics + result-cache and
+        # residency invalidation, no local WAL logging (a follower
+        # crash costs a resync, not divergence)
+        frag.apply_recovered(op, ids)
+        self.applied_ops_total += 1
+
+    def _apply_tombstone(self, key: str) -> None:
+        holder = self.api.holder
+        parts = key.rstrip("/").split("/")
+        idx = holder.index(parts[0]) if parts and parts[0] else None
+        if idx is None:
+            return
+        if key.endswith("/") and len(parts) == 1:
+            holder.delete_index(parts[0])
+        elif key.endswith("/") and len(parts) == 2:
+            if idx.field(parts[1]) is not None:
+                idx.delete_field(parts[1])
+        elif len(parts) == 4 and parts[3].isdigit():
+            fld = idx.field(parts[1])
+            v = fld.view(parts[2]) if fld is not None else None
+            if v is not None:
+                v.remove_fragment(int(parts[3]))
+
+    # --------------------------------------------------------------- surface
+
+    def staleness_s(self) -> float:
+        """Seconds since this replica last observed itself caught up to
+        the upstream's durable seq; infinite until the initial sync
+        lands (check_staleness sheds every bounded read until then)."""
+        if self._caught_up_at is None:
+            return float("inf")
+        return time.monotonic() - self._caught_up_at
+
+    def metrics(self) -> dict:
+        s = self.staleness_s()
+        return {
+            "cdc_follower_staleness_seconds": (
+                round(s, 3) if s != float("inf") else -1.0),
+            "cdc_follower_applied_ops_total": self.applied_ops_total,
+            "cdc_events_total": self.events_total,
+            "cdc_resyncs_total": self.resyncs_total,
+            "cdc_poll_errors_total": self.poll_errors_total,
+        }

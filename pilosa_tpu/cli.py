@@ -122,6 +122,23 @@ repair-max-inflight = 0       # concurrent repair transfers; 0 = unbounded
 repair-compression = true     # zlib Content-Encoding on fragment and
                               # delta payloads (negotiated per peer)
 
+# Replication & CDC (docs/OPERATIONS.md): WAL tail change feed ->
+# cluster-safe result caching, stale-bounded read replicas, and
+# `restore --as-of <seq>` point-in-time restore
+cdc-enabled = false           # tail peers' WAL feeds to invalidate the
+                              # result cache cluster-wide (lifts the
+                              # single-node-only cache refusal)
+cdc-max-retention-bytes = 67108864  # WAL bytes pinned for lagging tail
+                              # cursors before they are forced off
+                              # (410 Gone -> consumer resyncs)
+cdc-poll-interval = "50ms"    # tailer poll cadence (Go duration)
+cdc-max-batch-bytes = 1048576 # max event bytes per tail poll
+# cdc-follow = ""             # upstream URI: run as a read replica
+                              # (non-quorum follower; writes 403)
+cdc-staleness-budget = "1s"   # declared follower staleness bound; reads
+                              # past it shed 503 (X-Pilosa-Max-Staleness
+                              # can tighten per request); 0 = unbounded
+
 # Serving QoS (docs/QOS.md): admission -> deadline -> hedged reads
 qos-max-inflight = 0          # concurrent-query cap; excess sheds 429 (0 = off)
 qos-tenant-inflight = 0       # per-tenant cap (X-Pilosa-Tenant); 0 = global
@@ -601,14 +618,21 @@ def cmd_restore(args) -> int:
 
     try:
         manifest = restore_holder(args.input, data_dir,
-                                  generation=args.generation)
+                                  generation=args.generation,
+                                  as_of=args.as_of)
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
-    print(
+    msg = (
         f"restored generation {manifest['generation']} -> {data_dir}: "
         f"{manifest['restoredFragments']} fragments (digest-verified)"
     )
+    if args.as_of is not None:
+        msg += (f"; replayed {manifest['replayedOps']} ops to seq "
+                f"{manifest['asOfSeq']}")
+        if manifest.get("skippedReplayOps"):
+            msg += f" ({manifest['skippedReplayOps']} skipped)"
+    print(msg)
     return 0
 
 
@@ -764,6 +788,10 @@ def main(argv=None) -> int:
     p.add_argument("-i", "--input", required=True)
     p.add_argument("--generation", type=int, default=None,
                    help="generation to restore (default: latest)")
+    p.add_argument("--as-of", type=int, default=None, dest="as_of",
+                   help="restore to an exact WAL seq: nearest anchored "
+                        "generation + change-feed replay (needs backups "
+                        "taken from a group-durability WAL)")
     p.set_defaults(fn=cmd_restore)
 
     p = sub.add_parser("version", help="print version")

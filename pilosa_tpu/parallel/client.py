@@ -553,6 +553,70 @@ class InternalClient:
         out = self._call("GET", f"{uri}/internal/fragments?index={index}")
         return out.get("fragments", [])
 
+    # ------------------------------------------------------------------- cdc
+
+    def wal_tail(self, uri: str, since: int | None = None,
+                 max_bytes: int | None = None, cursor: str | None = None):
+        """One CDC tail poll (``GET /internal/wal/tail`` — cdc/feed.py):
+        returns ``(events, next_seq, durable_seq)`` where events is
+        ``[(seq, rtype, key, body), ...]`` parsed from the frame stream.
+        ``since=None`` is the attach handshake (registers ``cursor`` at
+        the producer's durable seq, empty body). Rides the repair pacer
+        + deflate negotiation like the sync data plane — feed catch-up
+        after a follower restart is repair traffic and must obey the
+        same budget. A 410 raises FeedGone: the cursor fell off the
+        retained tail (or the producer restarted), restart from a
+        snapshot."""
+        from urllib.parse import quote
+
+        from pilosa_tpu.cdc.feed import (
+            DURABLE_SEQ_HEADER,
+            NEXT_SEQ_HEADER,
+            FeedGone,
+            iter_frames,
+        )
+
+        params = []
+        if since is not None:
+            params.append(f"since={int(since)}")
+        if max_bytes is not None:
+            params.append(f"max-bytes={int(max_bytes)}")
+        if cursor:
+            params.append(f"cursor={quote(cursor, safe='')}")
+        url = (f"{uri}/internal/wal/tail"
+               + (("?" + "&".join(params)) if params else ""))
+        try:
+            with self._repair_slot():
+                resp = self._call("GET", url,
+                                  headers=self._repair_headers(),
+                                  want_response=True)
+        except ClientError as e:
+            if e.status == 410:
+                restart, floor = -1, 0
+                try:
+                    detail = json.loads(
+                        str(e).split(": ", 2)[-1] or "{}")
+                    restart = int(detail.get("restartFrom", -1))
+                    floor = int(detail.get("floor", 0))
+                except (ValueError, TypeError):
+                    pass
+                raise FeedGone(restart, floor) from e
+            raise
+        self._pace(len(resp.data))
+        data = self._decode_body(resp)
+        events = list(iter_frames(data))
+        next_seq = int(resp.headers.get(NEXT_SEQ_HEADER, -1))
+        durable = int(resp.headers.get(DURABLE_SEQ_HEADER, -1))
+        if since is not None:
+            # a torn frame stream (iter_frames stopped early) must not
+            # advance the cursor past frames it never yielded: every seq
+            # in (since, next_seq] is guaranteed present in a whole
+            # body, so resume from the last frame actually parsed
+            expect = events[-1][0] if events else since
+            if next_seq > expect:
+                next_seq = expect
+        return events, next_seq, durable
+
     # ------------------------------------------------------ schema / cluster
 
     def schema(self, uri: str) -> dict:

@@ -14,6 +14,7 @@ from pilosa_tpu.qos.admission import (
 )
 from pilosa_tpu.qos.deadline import (
     DEADLINE_HEADER,
+    STALENESS_HEADER,
     TENANT_HEADER,
     Deadline,
     DeadlineExceeded,
@@ -32,6 +33,7 @@ __all__ = [
     "AdmissionSlot",
     "CircuitBreaker",
     "DEADLINE_HEADER",
+    "STALENESS_HEADER",
     "TENANT_HEADER",
     "Deadline",
     "DeadlineExceeded",

@@ -23,6 +23,12 @@ import time
 DEADLINE_HEADER = "X-Pilosa-Deadline-Ms"
 # Admission-control tenant identity (header-derived quotas).
 TENANT_HEADER = "X-Pilosa-Tenant"
+# Stale-bounded reads on CDC followers: the most feed lag the client
+# will accept, in the shared Go-duration grammar (utils/durations.py —
+# "250ms", "1.5s"; bare numbers are seconds). A follower whose replica
+# lag exceeds the budget answers 503 + Retry-After instead of serving
+# bytes staler than the client declared it can use.
+STALENESS_HEADER = "X-Pilosa-Max-Staleness"
 
 
 class DeadlineExceeded(Exception):

@@ -166,6 +166,18 @@ class API:
         # planner ticker, never table adoption, so placement stays
         # consistent cluster-wide under mixed configs.
         self.autopilot = None
+        # CDC plane (pilosa_tpu/cdc/): Server.open wires a CdcTailer
+        # when cdc-enabled = true on a multi-node member (peers' write
+        # events feed the result-cache invalidation path, lifting the
+        # cluster-edge refusal), and a CdcFollower when cdc-follow names
+        # an upstream (this node serves stale-bounded reads off the
+        # feed and rejects writes).
+        self.cdc = None
+        self.follower = None
+        # declared follower staleness budget in seconds (cdc-staleness-
+        # budget knob); a request's X-Pilosa-Max-Staleness header wins
+        # when tighter
+        self.cdc_staleness_budget_s: float = 1.0
 
     # ---------------------------------------------------------------- query
 
@@ -368,9 +380,18 @@ class API:
                 # attr writes change results (Row responses carry
                 # attrs) WITHOUT a fragment write event — fence every
                 # cached result of the index (serving/rescache.py);
-                # bit writes already invalidated at their fragments
-                if any(c.name in ("SetRowAttrs", "SetColumnAttrs")
-                       for c in query.write_calls()):
+                # bit writes already invalidated at their fragments.
+                # On a multi-node edge, a routed write's fragment hook
+                # fires on the OWNER, not here: fence the coordinator's
+                # own cache too, so read-your-writes holds through the
+                # write's node ahead of the CDC feed's bounded lag.
+                remote_owned = (self.cluster is not None
+                                and len(self.cluster.nodes) > 1
+                                and not remote)
+                if remote_owned or any(
+                    c.name in ("SetRowAttrs", "SetColumnAttrs")
+                    for c in query.write_calls()
+                ):
                     from pilosa_tpu.serving import rescache
 
                     idx = self.holder.index(index)
@@ -466,12 +487,23 @@ class API:
             from pilosa_tpu.serving.rescache import global_result_cache
 
             cache = global_result_cache()
-            # single-node serving shapes only (the mp owner included):
-            # a cluster edge result folds in remote data whose writes
-            # land on OTHER nodes' fragments — no local write event
-            # could invalidate it (docs/OPERATIONS.md skewed traffic)
-            if cache.enabled and (self.cluster is None
-                                  or len(self.cluster.nodes) <= 1):
+            # A cluster edge result folds in remote data whose writes
+            # land on OTHER nodes' fragments — cacheable only while the
+            # CDC tailer is live, feeding peers' write events into the
+            # invalidation path (pilosa_tpu/cdc/). Without it (or with
+            # a peer's feed lagging) the edge refuses, and the reason is
+            # counted so operators can watch the cache turn on
+            # (/debug/rescache refusals).
+            edge_ok = (self.cluster is None
+                       or len(self.cluster.nodes) <= 1)
+            if cache.enabled and not edge_ok:
+                if self.cdc is not None and self.cdc.live():
+                    edge_ok = True
+                else:
+                    cache.record_refusal(
+                        "cluster-no-cdc" if self.cdc is None
+                        else "cdc-stale")
+            if cache.enabled and edge_ok:
                 idx = self.holder.index(index)
                 if idx is not None:
                     scope = idx.scope
@@ -721,7 +753,10 @@ class API:
         partition (cluster.degraded — docs/OPERATIONS.md failure
         model) OR while its storage is degraded (ENOSPC/EIO tripped
         the StorageHealth latch — storage/integrity.py); locally-owned
-        reads still serve either way."""
+        reads still serve either way. A CDC follower is read-only by
+        construction — a write landing here would silently diverge the
+        mirror from its upstream."""
+        self._check_not_follower()
         self._check_not_storage_degraded()
         cluster = self.cluster
         if cluster is None or not getattr(cluster, "degraded", False):
@@ -750,6 +785,38 @@ class API:
         )
         err.retry_after = 5.0
         raise err
+
+    def check_staleness(self, max_staleness_s: float | None = None) -> None:
+        """Stale-bounded read gate for CDC followers: reject with 503 +
+        Retry-After when this replica's feed lag exceeds the budget —
+        the request's ``X-Pilosa-Max-Staleness`` header when given, the
+        declared ``cdc-staleness-budget`` otherwise. A no-op on
+        non-follower nodes (members answer fresh reads; a staleness
+        budget is a follower contract)."""
+        follower = self.follower
+        if follower is None:
+            return
+        budget = self.cdc_staleness_budget_s
+        if max_staleness_s is not None:
+            budget = min(budget, max_staleness_s) if budget > 0 \
+                else max_staleness_s
+        if budget <= 0:
+            return
+        staleness = follower.staleness_s()
+        if staleness > budget:
+            from pilosa_tpu.utils.stats import global_stats
+
+            global_stats().count("qos_shed", 1,
+                                 {"reason": "follower_stale"})
+            err = ApiError(
+                f"read replica is {staleness:.3f}s stale, over the "
+                f"{budget:.3f}s staleness budget; retry or relax "
+                "X-Pilosa-Max-Staleness", 503,
+            )
+            # capped: an infinite staleness (still in initial sync)
+            # must not overflow the Retry-After int rendering
+            err.retry_after = min(30.0, max(0.1, staleness - budget))
+            raise err
 
     def _ack_durable(self) -> None:
         """Group-commit durability barrier for the current request's
@@ -798,8 +865,20 @@ class API:
 
     # --------------------------------------------------------------- schema
 
+    def _check_not_follower(self) -> None:
+        """A CDC follower is read-only by construction — a local write
+        (data or schema) would silently diverge the mirror from its
+        upstream. The follower's own tail-apply bypasses the API and
+        writes through the holder directly."""
+        if self.follower is not None:
+            raise ApiError(
+                "this node is a CDC read replica (cdc-follow): writes "
+                "must go to the upstream cluster", 403,
+            )
+
     def create_index(self, name: str, keys: bool = False,
                      track_existence: bool = True) -> dict:
+        self._check_not_follower()
         self._check_not_storage_degraded()  # schema writes hit .meta
         try:
             idx = self.holder.create_index(
@@ -817,6 +896,7 @@ class API:
             self.cluster.send_sync(message)
 
     def delete_index(self, name: str) -> None:
+        self._check_not_follower()
         try:
             self.holder.delete_index(name)
         except KeyError as e:
@@ -824,6 +904,7 @@ class API:
         self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index: str, name: str, options: dict | None = None) -> dict:
+        self._check_not_follower()
         self._check_not_storage_degraded()  # schema writes hit .meta
         idx = self._index(index)
         try:
@@ -837,6 +918,7 @@ class API:
         return {"name": field.name, "options": field.options.to_dict()}
 
     def delete_field(self, index: str, name: str) -> None:
+        self._check_not_follower()
         idx = self._index(index)
         try:
             idx.delete_field(name)
@@ -1150,6 +1232,16 @@ class API:
         if node_errors:
             raise ImportRoutingError(node_errors, changed,
                                      status=status or 502)
+        if changed:
+            # remote portions' fragment write hooks fired on the OWNER
+            # nodes: fence the coordinator's own cached results for the
+            # field so read-your-writes holds through this node ahead
+            # of the CDC feed's bounded lag (serving/rescache.py)
+            from pilosa_tpu.serving import rescache
+
+            idx = self.holder.index(index)
+            if idx is not None:
+                rescache.invalidate_write(idx.scope, index, field)
         return changed
 
     def _send_roaring_batch(self, node, index, field, rows_arr,
@@ -1594,6 +1686,12 @@ class API:
         cache = global_result_cache()
         out = cache.inspect(k=k)
         out["enabled"] = cache.enabled
+        # the cluster-edge story in one place: why edges refused before
+        # CDC (refusal-reason counters), and — once the tailer is live —
+        # the per-peer feed lag that replaces the refusals
+        if self.cdc is not None:
+            out["cdc"] = {"live": self.cdc.live(),
+                          "peerLag": self.cdc.peer_lag()}
         return out
 
     def durability_metrics(self) -> dict:
@@ -1604,6 +1702,81 @@ class API:
         if wal is None:
             return {}
         return wal.metrics()
+
+    # ------------------------------------------------------------------ CDC
+
+    def wal_tail(self, since: int | None, max_bytes: int = 1 << 20,
+                 cursor: str | None = None):
+        """Serve one ``GET /internal/wal/tail`` poll: committed WAL
+        records after ``since`` as ``(events, next_seq, durable_seq)``.
+        ``since=None`` is the attach handshake — no events, just the
+        durable high-water mark for the consumer to poll from (a fresh
+        consumer owns nothing derived from the feed, so it needs no
+        history). A named ``cursor`` registers/advances in the WAL's
+        registry — the consumer's acknowledged position pins covered
+        segments against GC up to the retention budget. Raises the
+        storage plane's TailGone (HTTP layer maps it to 410)."""
+        from pilosa_tpu.storage.wal import TailGone
+
+        wal = getattr(self.holder, "wal", None)
+        if wal is None or not wal.grouped:
+            raise ApiError(
+                "wal tail requires durability-mode=group on this node",
+                501,
+            )
+        if since is None:
+            durable = wal.durable_seq()
+            if cursor:
+                wal.register_cursor(cursor, durable)
+            return [], durable, durable
+        if cursor:
+            if cursor not in wal.cursors():
+                # the registry is in-memory: a poll naming a cursor this
+                # WAL never registered proves the producer restarted
+                # (its seq space reset) or force-reclaimed the laggard.
+                # Answering 410 here closes the silent-gap window where
+                # a restarted producer's fresh seq space races past the
+                # consumer's stale position before the since > durable
+                # check can catch it — attached consumers get hard
+                # restart detection; cursorless polls keep best-effort
+                # semantics.
+                raise TailGone(wal.tail_floor(), wal.durable_seq())
+            # advancing the cursor BEFORE the read: since acknowledges
+            # everything at or below it, releasing segment pins early
+            wal.register_cursor(cursor, since)
+        try:
+            return wal.read_tail(since, max_bytes=max_bytes)
+        except TailGone:
+            if cursor:
+                # a gone cursor must stop pinning (and stop holding the
+                # floor down): the consumer restarts from the handshake
+                wal.drop_cursor(cursor)
+            raise
+
+    def cdc_metrics(self) -> dict:
+        """cdc_* series (docs/OBSERVABILITY.md): producer-side tail
+        counters ride durability_metrics (wal.metrics); this block is
+        the consumer side — tailer per-peer lag and follower apply
+        counters. Present from scrape one with zeros while CDC is off,
+        like every sibling exporter block."""
+        out = {
+            "cdc_enabled": 1 if self.cdc is not None else 0,
+            "cdc_live": 0,
+            "cdc_peers": 0,
+            "cdc_peer_lag_seconds_max": 0.0,
+            "cdc_events_total": 0,
+            "cdc_invalidations_total": 0,
+            "cdc_resyncs_total": 0,
+            "cdc_poll_errors_total": 0,
+            "cdc_follower": 1 if self.follower is not None else 0,
+            "cdc_follower_staleness_seconds": 0.0,
+            "cdc_follower_applied_ops_total": 0,
+        }
+        if self.cdc is not None:
+            out.update(self.cdc.metrics())
+        if self.follower is not None:
+            out.update(self.follower.metrics())
+        return out
 
     def integrity_metrics(self) -> dict:
         """Storage-integrity series (docs/OBSERVABILITY.md): the

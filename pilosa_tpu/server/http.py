@@ -55,6 +55,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/shards/list$"), "get_shards_list"),
     ("GET", re.compile(r"^/internal/sync/manifest$"), "get_sync_manifest"),
     ("POST", re.compile(r"^/internal/sync/blocks$"), "post_sync_blocks"),
+    ("GET", re.compile(r"^/internal/wal/tail$"), "get_wal_tail"),
     ("POST", re.compile(r"^/internal/scrub$"), "post_scrub"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
     ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
@@ -256,6 +257,31 @@ class HTTPHandler(BaseHTTPRequestHandler):
             return tenant, Deadline.after(self.api.default_deadline_s)
         return tenant, None
 
+    def _staleness_gate(self) -> None:
+        """Stale-bounded reads on a CDC follower (docs/OPERATIONS.md
+        Replication & CDC): parse ``X-Pilosa-Max-Staleness`` (the
+        shared Go-duration grammar — utils/durations.py) and refuse
+        503 + Retry-After when this node's replica lag exceeds the
+        tighter of the header and the configured budget. A no-op on
+        every node that isn't a follower — primaries serve their own
+        writes and owe no staleness bound."""
+        if self.api.follower is None:
+            return
+        from pilosa_tpu.qos import STALENESS_HEADER
+
+        raw = self.headers.get(STALENESS_HEADER)
+        budget = None
+        if raw is not None:
+            from pilosa_tpu.utils.durations import parse_duration
+
+            try:
+                budget = parse_duration(raw)
+            except ValueError as e:
+                raise ApiError(
+                    f"invalid {STALENESS_HEADER} header {raw!r}: {e}"
+                ) from e
+        self.api.check_staleness(budget)
+
     def _note_egress(self, tenant: str, index: str, nbytes: int,
                      remote: bool) -> None:
         """Fold one edge query response's bytes into the tenant ledger
@@ -284,10 +310,12 @@ class HTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _bytes(self, data: bytes) -> None:
+    def _bytes(self, data: bytes, headers: dict | None = None) -> None:
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -295,7 +323,8 @@ class HTTPHandler(BaseHTTPRequestHandler):
     # plus the CPU round trip cost more than the bytes saved.
     COMPRESS_MIN_BYTES = 256
 
-    def _bytes_negotiated(self, data: bytes) -> None:
+    def _bytes_negotiated(self, data: bytes,
+                          headers: dict | None = None) -> None:
         """Octet-stream body with optional zlib Content-Encoding,
         negotiated per request: compressed ONLY when the client
         advertised ``Accept-Encoding: deflate`` (the repair client's
@@ -303,7 +332,9 @@ class HTTPHandler(BaseHTTPRequestHandler):
         compression actually shrinks the payload — so plain clients,
         old-wire peers, and incompressible bodies all get identity
         bytes. Roaring fragment payloads compress dramatically (Chambi
-        et al. 1402.6407), which is where resize transfer time lives."""
+        et al. 1402.6407), which is where resize transfer time lives.
+        ``headers`` ride either branch (the CDC tail route's seq
+        positions must survive the compression decision)."""
         accept = (self.headers.get("Accept-Encoding") or "").lower()
         if "deflate" in accept and len(data) >= self.COMPRESS_MIN_BYTES:
             import zlib
@@ -315,10 +346,12 @@ class HTTPHandler(BaseHTTPRequestHandler):
                                  "application/octet-stream")
                 self.send_header("Content-Encoding", "deflate")
                 self.send_header("Content-Length", str(len(compressed)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(compressed)
                 return
-        self._bytes(data)
+        self._bytes(data, headers)
 
     def _raw(self, data: bytes, content_type: str = "application/json",
              status: int = 200) -> None:
@@ -379,6 +412,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         })
 
         tenant, deadline = self._qos_envelope(remote=remote)
+        self._staleness_gate()
         # PQL PROFILE (docs/OBSERVABILITY.md): ?profile=true returns a
         # per-AST-node execution profile beside the results; remote hops
         # carry the flag so the coordinator's envelope holds one
@@ -728,6 +762,12 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # one, same rate()-window reasoning as the blocks around it
         text += prometheus_block(self.api.durability_metrics(), prefix,
                                  "wal", seen=seen)
+        # CDC plane (docs/OPERATIONS.md Replication & CDC): tailer
+        # liveness + per-peer lag, invalidation/resync counters,
+        # follower staleness and applied ops — producer-side tail
+        # counters ride the wal block above; zeros while CDC is off
+        text += prometheus_block(self.api.cdc_metrics(), prefix,
+                                 seen=seen)
         # storage-integrity plane (docs/OPERATIONS.md integrity
         # runbook): degraded latch, verified-load/quarantine counters,
         # scrubber progress — zeros from scrape one like the rest
@@ -991,6 +1031,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap["residency_tiering"] = self.api.tiering_metrics()
         snap["autopilot"] = self.api.autopilot_metrics()
         snap["durability"] = self.api.durability_metrics()
+        snap["cdc"] = self.api.cdc_metrics()
         snap["integrity"] = self.api.integrity_metrics()
         snap["observability"] = self.api.observability_metrics()
         from pilosa_tpu.parallel.reduction import global_reduce_stats
@@ -1171,6 +1212,49 @@ class HTTPHandler(BaseHTTPRequestHandler):
                     payloads.append(serialize(RoaringBitmap.from_ids(ids)))
             global_stats().count("sync_delta_blocks_served", len(payloads))
             self._bytes_negotiated(encode_block_frames(payloads))
+
+    def get_wal_tail(self, query=None):
+        """Resumable CDC tail over the committed WAL (docs/OPERATIONS.md
+        Replication & CDC): ``?since=N`` streams seq-framed WAL records
+        with seq > N in commit order (cdc/feed.py frame layout);
+        ``since`` absent is the attach handshake — registers the named
+        ``cursor`` at the durable seq, empty body. ``max-bytes`` caps
+        one response (the producer stops at a group boundary and the
+        Next-Seq header tells the consumer where to resume). A cursor
+        behind the retained tail answers 410 ``{"restartFrom",
+        "floor"}`` — restart from a snapshot. Frames honor
+        Accept-Encoding: deflate like the sync routes; positions ride
+        response headers so the body stays a pure frame stream."""
+        from pilosa_tpu.cdc.feed import (
+            DURABLE_SEQ_HEADER,
+            NEXT_SEQ_HEADER,
+            TailGone,
+            encode_events,
+        )
+
+        since_raw = (query.get("since") or [None])[0] if query else None
+        since = (_int_param(since_raw, "since")
+                 if since_raw is not None else None)
+        mb_raw = (query.get("max-bytes") or [None])[0] if query else None
+        max_bytes = (_int_param(mb_raw, "max-bytes")
+                     if mb_raw is not None else 1 << 20)
+        if max_bytes <= 0:
+            raise ApiError(f"max-bytes must be positive, got {max_bytes}")
+        cursor = (query.get("cursor") or [""])[0] if query else ""
+        try:
+            events, next_seq, durable = self.api.wal_tail(
+                since, max_bytes=max_bytes, cursor=cursor or None)
+        except TailGone as e:
+            # 410 Gone, the resumability contract's hard edge: the JSON
+            # body carries where to restart so a consumer needn't parse
+            # the floor out of the error string
+            self._json({"error": str(e), "restartFrom": e.restart_from,
+                        "floor": e.floor}, status=410)
+            return
+        self._bytes_negotiated(encode_events(events), {
+            NEXT_SEQ_HEADER: str(next_seq),
+            DURABLE_SEQ_HEADER: str(durable),
+        })
 
     def get_shards_list(self, query=None):
         index = (query.get("index") or [""])[0]

@@ -81,6 +81,12 @@ class ServerConfig:
         autopilot_heat_budget: float = 1.5,
         autopilot_max_moves: int = 4,
         autopilot_min_dwell: float = 0.0,
+        cdc_enabled: bool = False,
+        cdc_max_retention_bytes: int = 64 << 20,
+        cdc_poll_interval: float = 0.05,
+        cdc_max_batch_bytes: int = 1 << 20,
+        cdc_follow: str = "",
+        cdc_staleness_budget: float = 1.0,
     ):
         self.data_dir = data_dir
         self.bind = bind
@@ -308,6 +314,42 @@ class ServerConfig:
                 f"invalid autopilot-min-dwell {autopilot_min_dwell!r} "
                 "(want >= 0; 0 = two intervals)"
             )
+        # CDC backbone (docs/OPERATIONS.md Replication & CDC):
+        # cdc-enabled runs the peer tailer that makes cluster-edge
+        # result caching safe; cdc-max-retention-bytes bounds how much
+        # WAL history consumer cursors may pin against segment GC
+        # (beyond the budget, reclaim wins and the lagging consumer
+        # gets 410 + restart-from-snapshot); cdc-follow points a
+        # non-member read replica at an upstream node's URI;
+        # cdc-staleness-budget is the follower's declared read-lag
+        # bound (X-Pilosa-Max-Staleness can only tighten it, 0 = no
+        # bound).
+        self.cdc_enabled = _parse_bool(cdc_enabled)
+        self.cdc_max_retention_bytes = int(cdc_max_retention_bytes)
+        if self.cdc_max_retention_bytes < 0:
+            raise ValueError(
+                f"invalid cdc-max-retention-bytes "
+                f"{cdc_max_retention_bytes!r} (want >= 0)"
+            )
+        self.cdc_poll_interval = float(cdc_poll_interval)
+        if self.cdc_poll_interval <= 0:
+            raise ValueError(
+                f"invalid cdc-poll-interval {cdc_poll_interval!r} "
+                "(want > 0)"
+            )
+        self.cdc_max_batch_bytes = int(cdc_max_batch_bytes)
+        if self.cdc_max_batch_bytes <= 0:
+            raise ValueError(
+                f"invalid cdc-max-batch-bytes {cdc_max_batch_bytes!r} "
+                "(want > 0)"
+            )
+        self.cdc_follow = str(cdc_follow or "")
+        self.cdc_staleness_budget = float(cdc_staleness_budget)
+        if self.cdc_staleness_budget < 0:
+            raise ValueError(
+                f"invalid cdc-staleness-budget {cdc_staleness_budget!r} "
+                "(want >= 0; 0 = unbounded)"
+            )
         from pilosa_tpu.qos.slo import SLOEngine
 
         # build once to validate; Server.open builds the live engine
@@ -483,6 +525,20 @@ class ServerConfig:
             autopilot_min_dwell=_parse_duration(
                 d.get("autopilot-min-dwell", 0.0)
             ),
+            cdc_enabled=_parse_bool(d.get("cdc-enabled", False)),
+            cdc_max_retention_bytes=int(
+                d.get("cdc-max-retention-bytes", 64 << 20)
+            ),
+            cdc_poll_interval=_parse_duration(
+                d.get("cdc-poll-interval", 0.05)
+            ),
+            cdc_max_batch_bytes=int(
+                d.get("cdc-max-batch-bytes", 1 << 20)
+            ),
+            cdc_follow=d.get("cdc-follow", ""),
+            cdc_staleness_budget=_parse_duration(
+                d.get("cdc-staleness-budget", 1.0)
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -548,6 +604,12 @@ class ServerConfig:
             "autopilot-heat-budget": self.autopilot_heat_budget,
             "autopilot-max-moves": self.autopilot_max_moves,
             "autopilot-min-dwell": self.autopilot_min_dwell,
+            "cdc-enabled": self.cdc_enabled,
+            "cdc-max-retention-bytes": self.cdc_max_retention_bytes,
+            "cdc-poll-interval": self.cdc_poll_interval,
+            "cdc-max-batch-bytes": self.cdc_max_batch_bytes,
+            "cdc-follow": self.cdc_follow,
+            "cdc-staleness-budget": self.cdc_staleness_budget,
         }
 
 
@@ -731,6 +793,40 @@ class Server:
             self._mpserve = OwnerRuntime(self).start()
             self.api.mpserve = self._mpserve
         self._wire_cluster()
+        # CDC backbone (docs/OPERATIONS.md Replication & CDC): the
+        # retention budget applies whenever the grouped WAL exists (a
+        # registered cursor may pin covered segments up to it); the
+        # peer tailer runs only with cdc-enabled, a follower mirror
+        # only with cdc-follow. Both ride the cluster's internal
+        # client, so feed transfers share the RepairPacer + deflate
+        # posture with the sync data plane.
+        wal = getattr(self.holder, "wal", None)
+        if wal is not None:
+            wal.cdc_retention_bytes = self.config.cdc_max_retention_bytes
+        self.api.cdc_staleness_budget_s = self.config.cdc_staleness_budget
+        if self.config.cdc_enabled:
+            from pilosa_tpu.cdc.tailer import CdcTailer
+
+            self.api.cdc = CdcTailer(
+                self.api, self.api.cluster.client,
+                poll_interval=self.config.cdc_poll_interval,
+                max_batch_bytes=self.config.cdc_max_batch_bytes,
+                cursor_name=f"tailer:{self.api.cluster.local.id}",
+                logger=self.logger,
+            )
+            self.api.cdc.start()
+        if self.config.cdc_follow:
+            from pilosa_tpu.cdc.tailer import CdcFollower
+
+            self.api.follower = CdcFollower(
+                self.api, self.api.cluster.client,
+                self.config.cdc_follow,
+                poll_interval=self.config.cdc_poll_interval,
+                max_batch_bytes=self.config.cdc_max_batch_bytes,
+                cursor_name=f"follower:{self.api.cluster.local.id}",
+                logger=self.logger,
+            )
+            self.api.follower.start()
         if self.config.residency_promote_interval > 0:
             from pilosa_tpu.storage.heat import global_heat as _gh
             from pilosa_tpu.storage.residency import (
@@ -880,6 +976,12 @@ class Server:
         if self.api.tierer is not None:
             self.api.tierer.close()
             self.api.tierer = None
+        if self.api.cdc is not None:
+            self.api.cdc.stop()
+            self.api.cdc = None
+        if self.api.follower is not None:
+            self.api.follower.stop()
+            self.api.follower = None
         if self._anti_entropy_timer is not None:
             self._anti_entropy_timer.cancel()
         if self._heartbeat_timer is not None:

@@ -42,12 +42,15 @@ a brand-new shard the fill never saw).
 
 Scope rules: entries are scope-qualified (the holder-unique tag, as in
 frag_id/heat keys) so in-process multi-holder setups never serve each
-other's bytes — and caching is restricted to single-node serving shapes
-(the mp owner+workers tier included: the cache lives owner-side). A
-multi-node cluster edge result folds in REMOTE data whose writes land on
-other nodes' fragments; cluster-wide invalidation needs a write feed
-(the WAL-tailing CDC of ROADMAP item 5) and is explicitly out of scope —
-``API`` refuses lookup/fill whenever the cluster has peers.
+other's bytes. A multi-node cluster edge result folds in REMOTE data
+whose writes land on other nodes' fragments, so cluster edges are only
+cacheable when the WAL-tailing CDC plane (pilosa_tpu/cdc/) is live:
+every node tails its peers' committed-seq feeds and routes remote write
+events through :meth:`ResultCache.invalidate` with the same dependency
+keys and version fences as local writes. ``API`` refuses lookup/fill on
+a cluster edge whenever the tailer is absent or unhealthy, and counts
+WHY in :meth:`ResultCache.record_refusal` so operators can watch the
+cache turn on after an upgrade (`/debug/rescache` refusals block).
 
 Eviction is bounded by bytes and heat-weighted: each entry keeps a
 decayed hit score (same lazy half-life decay as storage/heat.py), and
@@ -126,6 +129,12 @@ class ResultCache:
         self.invalidated_entries = 0
         self.evictions = 0
         self.fill_races = 0
+        # cluster-edge refusal reasons (API gate): why a cacheable
+        # query was NOT served from / filled into the cache on a
+        # multi-node edge — "cluster-no-cdc" before the CDC tailer is
+        # wired (the pre-upgrade steady state), "cdc-stale" when the
+        # tailer exists but a peer's feed is lagging its bound
+        self.refusals: dict[str, int] = {}
 
     # ------------------------------------------------------------ config
 
@@ -179,6 +188,13 @@ class ResultCache:
     def record_miss(self) -> None:
         with self._lock:
             self.misses += 1
+
+    def record_refusal(self, reason: str) -> None:
+        """A cluster-edge query skipped the cache: count the reason so
+        the /debug/rescache runbook can tell 'CDC not wired' apart from
+        'CDC wired but lagging' at a glance."""
+        with self._lock:
+            self.refusals[reason] = self.refusals.get(reason, 0) + 1
 
     def lookup(self, scope: str, index: str, pql: str) -> bytes | None:
         """peek + hit/miss accounting in one call (tests, simple
@@ -378,7 +394,12 @@ class ResultCache:
                     self.invalidated_entries,
                 "result_cache_evictions_total": self.evictions,
                 "result_cache_fill_races_total": self.fill_races,
+                "result_cache_refusals_total": sum(self.refusals.values()),
             }
+
+    def refusal_reasons(self) -> dict:
+        with self._lock:
+            return dict(self.refusals)
 
     def inspect(self, k: int = 100) -> dict:
         """GET /debug/rescache: the entry table hottest-first (decayed
@@ -407,6 +428,7 @@ class ResultCache:
             rows = rows[:k]
         out = self.metrics()
         out["halfLifeS"] = self.half_life_s
+        out["refusals"] = self.refusal_reasons()
         out["entries"] = rows
         return out
 

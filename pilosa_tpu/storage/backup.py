@@ -166,14 +166,57 @@ def _finish_generation(dest: str, manifest: dict) -> dict:
 # ------------------------------------------------------------------ backup
 
 
+def _capture_feed(wal, since: int, high: int) -> tuple[bytes, bool]:
+    """Drain the WAL tail feed for ``(since, high]`` into one frame
+    stream (cdc/feed.py layout — the bytes a live consumer would have
+    received). Returns (frames, complete): ``complete`` is False when
+    the WAL already reclaimed part of the range (retention budget) —
+    the generation still restores, but ``--as-of`` into the gap is
+    refused with a readable error instead of a silent hole."""
+    from pilosa_tpu.cdc.feed import encode_events
+    from pilosa_tpu.storage.wal import TailGone
+
+    frames = bytearray()
+    pos = since
+    try:
+        while pos < high:
+            events, next_seq, _durable = wal.read_tail(
+                pos, max_bytes=4 << 20)
+            frames += encode_events(events)
+            if next_seq <= pos:
+                break
+            pos = next_seq
+    except TailGone:
+        return bytes(frames), False
+    return bytes(frames), pos >= high
+
+
 def backup_holder(holder, dest: str) -> dict:
     """One incremental backup generation of an OPEN holder. Returns the
-    manifest (with ``newBlobs``/``reusedBlobs`` counts for reporting)."""
+    manifest (with ``newBlobs``/``reusedBlobs`` counts for reporting).
+
+    With a grouped WAL the manifest is also a point-in-time anchor for
+    ``restore --as-of`` (docs/OPERATIONS.md Replication & CDC): it
+    stamps ``walSeqLow`` (every op at or below it is IN the walked
+    content) and ``walSeq`` (no op above it is), and stores the feed
+    frames for ``(previous generation's walSeqLow, walSeq]`` as a blob
+    — the replay fuel that turns the nearest generation into any seq
+    between generations. A ``backup:`` cursor pins the NEXT window's
+    segments against GC, inside the cdc-max-retention-bytes budget."""
     dest = os.path.expanduser(dest)
     blob_dir = os.path.join(dest, "blobs")
     os.makedirs(blob_dir, exist_ok=True)
     gens = list_generations(dest)
     gen = (gens[-1] + 1) if gens else 1
+    wal = getattr(holder, "wal", None)
+    if wal is not None and not wal.grouped:
+        wal = None
+    wal_low = None
+    if wal is not None:
+        # everything appended so far must be fsynced (and so group-
+        # indexed for read_tail) before it can anchor the low mark
+        wal.barrier()
+        wal_low = wal.durable_seq()
 
     from pilosa_tpu.roaring import RoaringBitmap
     from pilosa_tpu.roaring.format import serialize
@@ -237,11 +280,44 @@ def backup_holder(holder, dest: str) -> dict:
                 reused += 1
             files[rel.replace(os.sep, "/")] = digest
 
+    wal_feed = None
+    wal_high = None
+    if wal is not None:
+        # ops landing DURING the walk may or may not be in the walked
+        # content (the bitmap union happens before the seq is assigned)
+        # — so the anchor is a band: content holds everything <= low
+        # and nothing > high. Quiesced backups (the restore --as-of
+        # contract) collapse the band to a point.
+        wal.barrier()
+        wal_high = wal.durable_seq()
+        since = wal_low
+        if gens:
+            prev = load_manifest(dest, gens[-1])
+            if prev.get("walSeqLow") is not None:
+                since = prev["walSeqLow"]
+        frames, complete = _capture_feed(wal, since, wal_high)
+        feed_digest = _digest(frames)
+        # the feed blob is as-of replay METADATA, accounted on the
+        # walFeed record — newBlobs/reusedBlobs stay checksum-block
+        # content counts ("only the changed block shipped" semantics)
+        feed_written = _write_blob(blob_dir, feed_digest, frames)
+        wal_feed = {"blob": feed_digest, "sinceSeq": since,
+                    "walSeq": wal_high, "complete": complete,
+                    "newBlob": bool(feed_written)}
+        # pin the NEXT generation's replay window ((this low, next
+        # high]) against WAL GC — within cdc-max-retention-bytes, so a
+        # stalled backup destination can't fill the disk
+        wal.register_cursor(f"backup:{_digest(dest.encode())[:8]}",
+                            wal_low)
+
     manifest = {
         "generation": gen,
         "createdAt": dt.datetime.now(dt.timezone.utc).isoformat(),
         "basedOn": gens[-1] if gens else None,
         "scope": "full",
+        "walSeqLow": wal_low,
+        "walSeq": wal_high,
+        "walFeed": wal_feed,
         "indexes": {
             iname: {
                 "options": {"keys": idx.keys,
@@ -357,17 +433,82 @@ def backup_from_host(host: str, dest: str, client=None) -> dict:
 # ----------------------------------------------------------------- restore
 
 
+def _select_as_of(src: str, gens: list[int], as_of: int):
+    """Pick the restore base and replay feed for ``--as-of <seq>``:
+    base = the newest generation whose ``walSeq`` <= as_of (its content
+    holds nothing past as_of), feed = the frame blob covering
+    ``(base.walSeqLow, as_of]`` — the base's own when as_of lands
+    exactly on its high mark, else the NEXT generation's (whose
+    ``sinceSeq`` is the base's low mark by construction)."""
+    manifests = [load_manifest(src, g) for g in gens]
+    anchored = [m for m in manifests if m.get("walSeq") is not None]
+    if not anchored:
+        raise ValueError(
+            "--as-of needs backups taken from a group-durability WAL "
+            "(no generation here carries a walSeq anchor)")
+    candidates = [m for m in anchored if m["walSeq"] <= as_of]
+    if not candidates:
+        raise ValueError(
+            f"as-of seq {as_of} predates the earliest anchored "
+            f"generation (walSeq {anchored[0]['walSeq']})")
+    base = candidates[-1]
+    if as_of == base["walSeq"] or as_of <= base.get(
+            "walSeqLow", base["walSeq"]):
+        feed = base.get("walFeed")
+    else:
+        later = [m for m in anchored
+                 if m["generation"] > base["generation"]]
+        if not later:
+            raise ValueError(
+                f"as-of seq {as_of} is past the latest generation's "
+                f"walSeq {base['walSeq']}; take a newer backup first")
+        feed = later[0].get("walFeed")
+    low = base.get("walSeqLow", base["walSeq"])
+    if low < as_of:
+        if feed is None:
+            raise ValueError(
+                "backup generation carries no WAL feed blob; cannot "
+                f"replay to seq {as_of}")
+        if not feed.get("complete", False):
+            raise ValueError(
+                "the WAL feed covering this range is incomplete (the "
+                "source WAL reclaimed part of it before the backup "
+                f"ran); cannot replay to seq {as_of} — restore a "
+                "generation boundary instead")
+        if feed["sinceSeq"] > low:
+            raise ValueError(
+                f"WAL feed starts at seq {feed['sinceSeq']}, after the "
+                f"base generation's low mark {low}; replay gap")
+    return base, feed, low
+
+
 def restore_holder(src: str, data_dir: str,
-                   generation: int | None = None) -> dict:
+                   generation: int | None = None,
+                   as_of: int | None = None) -> dict:
     """Rebuild a data dir from one backup generation. The target must
     be empty or absent; every fragment is reassembled from its block
     blobs, digest-verified against the manifest, and fsynced. Returns
-    the manifest restored."""
+    the manifest restored.
+
+    ``as_of`` restores to an exact WAL sequence number instead of a
+    generation boundary: the nearest anchored generation at or before
+    the seq is restored, then the stored change feed is replayed
+    through ``as_of`` by appending the raw WAL op records to the
+    restored fragment files (op records ARE the fragment op-log
+    format; the reopened fragments replay them onto the snapshot).
+    Deletions (tombstones) inside the replay window cannot be
+    replayed — restore a generation after the deletion instead."""
     src = os.path.expanduser(src)
     data_dir = os.path.expanduser(data_dir)
     gens = list_generations(src)
     if not gens:
         raise ValueError(f"no backup generations under {src}")
+    feed = replay_low = None
+    if as_of is not None:
+        if generation is not None:
+            raise ValueError("pass either generation or as_of, not both")
+        base, feed, replay_low = _select_as_of(src, gens, as_of)
+        generation = base["generation"]
     if generation is None:
         generation = gens[-1]
     if generation not in gens:
@@ -471,4 +612,79 @@ def restore_holder(src: str, data_dir: str,
                                  live)
         restored += 1
     manifest["restoredFragments"] = restored
+
+    if as_of is not None and replay_low is not None and replay_low < as_of:
+        manifest.update(_replay_feed(src, data_dir, manifest, feed,
+                                     replay_low, as_of))
+        manifest["asOfSeq"] = as_of
+    elif as_of is not None:
+        manifest.update({"replayedOps": 0, "skippedReplayOps": 0,
+                         "asOfSeq": as_of})
     return manifest
+
+
+def _replay_feed(src: str, data_dir: str, manifest: dict, feed: dict,
+                 low: int, as_of: int) -> dict:
+    """Append the stored change-feed ops in ``(low, as_of]`` to the
+    restored fragment files, in commit order. Op bodies are the
+    fragment op-log record format, so the appended bytes replay onto
+    the snapshot at first open — no bitmap decode round-trip. The
+    integrity sidecars written above cover the snapshot prefix only,
+    so appending after them is safe (same layout a crashed live node
+    reopens from)."""
+    from pilosa_tpu.cdc.feed import iter_frames
+    from pilosa_tpu.roaring import RoaringBitmap
+    from pilosa_tpu.roaring.format import serialize
+    from pilosa_tpu.storage.wal import REC_TOMBSTONE
+
+    frames = _read_blob(src, feed["blob"])
+    known = manifest.get("indexes", {})
+    appends: dict[str, list[bytes]] = {}
+    replayed = skipped = 0
+    for seq, rtype, key, body in iter_frames(frames):
+        if not (low < seq <= as_of):
+            continue
+        if rtype == REC_TOMBSTONE:
+            raise ValueError(
+                f"deletion of {key!r} at seq {seq} falls inside the "
+                f"as-of replay window ({low}, {as_of}]; deletions "
+                "cannot be replayed onto a restored snapshot — "
+                "restore a generation taken after the deletion"
+            )
+        parts = key.split("/")
+        if len(parts) != 4 or parts[0] not in known:
+            # an index created after the base walk: its schema isn't
+            # in this manifest, so the write has nowhere to land
+            skipped += 1
+            continue
+        iname, fname, vname, shard = parts
+        if fname != "_exists" and fname not in known[iname].get(
+                "fields", {}):
+            skipped += 1
+            continue
+        frag_path = os.path.join(data_dir, iname, fname, "views",
+                                 vname, "fragments", shard)
+        appends.setdefault(frag_path, []).append(body)
+        replayed += 1
+
+    empty = serialize(RoaringBitmap())
+    for frag_path, bodies in sorted(appends.items()):
+        frag_dir = os.path.dirname(frag_path)
+        os.makedirs(frag_dir, exist_ok=True)
+        fmeta = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(frag_dir))), ".meta")
+        if not os.path.exists(fmeta):
+            # replay created this (internal) field's first fragment
+            _atomic_write(fmeta, json.dumps(
+                {"type": "set", "cacheType": "none"}).encode())
+        if not os.path.exists(frag_path):
+            # first write to this fragment happened inside the replay
+            # window: synthesize an empty snapshot for the ops to
+            # replay onto
+            _atomic_write(frag_path, empty)
+        with open(frag_path, "ab") as f:
+            for body in bodies:
+                f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+    return {"replayedOps": replayed, "skippedReplayOps": skipped}

@@ -645,7 +645,8 @@ class Fragment:
             self.snapshot()
 
     def apply_recovered(self, op: int, ids) -> None:
-        """Apply one replayed WAL op (holder open, single-threaded): the
+        """Apply one replayed WAL op (holder open, single-threaded at
+        recovery; also the CDC follower's live tail-apply path): the
         bitmap mutation without logging — the caller snapshots and
         recounts caches once per touched fragment afterwards."""
         with self.lock:
@@ -654,7 +655,17 @@ class Fragment:
             else:
                 self.bitmap.remove_ids(ids)
             self.mutations += 1
-        residency.global_row_cache().invalidate_fragment(self.frag_id)
+        cache = residency.global_row_cache()
+        cache.invalidate_fragment(self.frag_id)
+        # route the write to dependent STACKED leaves too (positions
+        # unknown -> conservative invalidation, not in-place patching):
+        # a crash-recovery replay has none resident, but the CDC
+        # follower applies these against a live serving cache
+        for row in sorted({int(i) >> 20 for i in np.asarray(ids)}):
+            cache.apply_write(residency.WriteEvent(
+                self.index, self.field, self.view, self.shard, row,
+                scope=self.scope,
+            ))
         rescache.invalidate_write(self.scope, self.index, self.field,
                                   self.shard)
 

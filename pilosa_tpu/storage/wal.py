@@ -175,14 +175,38 @@ def tombstone_matches(key: str, tomb: str) -> bool:
     return key == tomb
 
 
+class TailGone(Exception):
+    """The requested tail position is no longer served: either segment
+    GC reclaimed it past the retention budget (``since < floor``) or the
+    node restarted and its seq space reset (``since > durable``). The
+    consumer must restart from a snapshot — invalidate everything it
+    derived from the feed and resume from ``restart_from``."""
+
+    def __init__(self, floor: int, durable: int):
+        super().__init__(
+            f"wal tail gone: floor={floor} durable={durable}")
+        self.floor = floor
+        self.restart_from = durable
+
+
 class _Segment:
-    __slots__ = ("path", "start_seq", "last_seq", "nbytes")
+    __slots__ = ("path", "start_seq", "last_seq", "nbytes", "groups",
+                 "end_seq")
 
     def __init__(self, path: str, start_seq: int):
         self.path = path
         self.start_seq = start_seq
         self.last_seq: dict[str, int] = {}  # op key -> last seq written
         self.nbytes = 0
+        # CDC tail index: one (first_seq, byte_offset, byte_len, count)
+        # entry per fsynced GROUP. Seqs within a group are consecutive
+        # (append_op/tombstone each take exactly one seq and the batch
+        # is a contiguous buffer slice), so the tail reader recovers
+        # every record's seq from the group's first_seq alone. Offsets
+        # cover durable bytes only — a group that failed its fsync is
+        # never indexed, and the faulted segment is abandoned.
+        self.groups: list[tuple[int, int, int, int]] = []
+        self.end_seq = 0
 
 
 class WriteAheadLog:
@@ -235,6 +259,18 @@ class WriteAheadLog:
         self._tombstones: list[tuple[str, int]] = []
         self._dirty: dict[str, weakref.ref] = {}
         self._checkpointing = False
+        # CDC cursor registry (storage for the /internal/wal/tail
+        # plane): name -> highest seq the consumer has acknowledged.
+        # Segment GC keeps covered segments the oldest cursor still
+        # needs, up to cdc_retention_bytes; past the budget it reclaims
+        # oldest-first anyway and advances _tail_floor so the laggard's
+        # next read raises TailGone (restart-from-snapshot).
+        self._cursors: dict[str, int] = {}
+        self._tail_floor = 0
+        self.cdc_retention_bytes = 64 << 20
+        self.cdc_forced_reclaims = 0
+        self.tail_reads = 0
+        self.tail_bytes = 0
         # observability (metrics() exports zeros from scrape one)
         self.groups = 0
         self.fsyncs = 0
@@ -411,6 +447,89 @@ class WriteAheadLog:
         with self._cond:
             return self._seq
 
+    def durable_seq(self) -> int:
+        with self._cond:
+            return self._durable_seq
+
+    # ------------------------------------------------------------- CDC tail
+
+    def register_cursor(self, name: str, seq: int) -> None:
+        """Register (or advance) a named tail cursor: the consumer has
+        acknowledged everything up to ``seq``. Registration pins covered
+        segments with records past ``seq`` against GC, within the
+        retention budget. Cursors only move forward — a stale re-poll
+        must not re-pin segments the registry already released."""
+        with self._seg_lock:
+            if seq >= self._cursors.get(name, -1):
+                self._cursors[name] = seq
+
+    def drop_cursor(self, name: str) -> None:
+        with self._seg_lock:
+            self._cursors.pop(name, None)
+
+    def cursors(self) -> dict[str, int]:
+        with self._seg_lock:
+            return dict(self._cursors)
+
+    def tail_floor(self) -> int:
+        with self._seg_lock:
+            return self._tail_floor
+
+    def read_tail(self, since: int, max_bytes: int = 1 << 20):
+        """Read committed records after ``since`` in commit order.
+        Returns ``(events, next_seq, durable_seq)`` where events is a
+        list of ``(seq, rtype, key, body)`` and ``next_seq`` is the
+        position to poll from next (== durable_seq when the read
+        drained the feed; seqs of groups lost to storage faults are
+        skipped over, never replayed). Raises TailGone when ``since``
+        predates the retention floor or postdates the durable seq (the
+        node restarted and its seq space reset)."""
+        with self._cond:
+            durable = self._durable_seq
+        with self._seg_lock:
+            if since < self._tail_floor or since > durable:
+                raise TailGone(self._tail_floor, durable)
+            plan: list[tuple[str, int, int, int, int]] = []
+            planned_bytes = 0
+            complete = True
+            for seg in self._segments:
+                for first, offset, nb, count in seg.groups:
+                    if first + count - 1 <= since:
+                        continue
+                    if plan and planned_bytes + nb > max_bytes:
+                        complete = False
+                        break
+                    plan.append((seg.path, offset, nb, first, count))
+                    planned_bytes += nb
+                if not complete:
+                    break
+        events: list[tuple[int, int, str, bytes]] = []
+        try:
+            for path, offset, nb, first, count in plan:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    buf = f.read(nb)
+                seq = first
+                for rtype, key, body in iter_wal_records(buf):
+                    # cap at the durable snapshot: a group indexed
+                    # between our durable read and the plan scan would
+                    # otherwise emit seqs past next_seq
+                    if since < seq <= durable:
+                        events.append((seq, rtype, key, body))
+                    seq += 1
+        except FileNotFoundError:
+            # GC raced the read and reclaimed a planned segment: the
+            # consumer is behind the (just-advanced) floor
+            with self._seg_lock:
+                raise TailGone(self._tail_floor, durable) from None
+        if complete:
+            next_seq = durable
+        else:
+            next_seq = events[-1][0] if events else since
+        self.tail_reads += 1
+        self.tail_bytes += sum(nb for _, _, nb, _, _ in plan)
+        return events, next_seq, durable
+
     def barrier(self, seq: int | None = None) -> None:
         """Block until every op appended so far (or up to ``seq``) is
         durable — the write ACK gate. No-op outside group mode (per-op
@@ -556,6 +675,9 @@ class WriteAheadLog:
                     self.health.trip(f"wal commit fsync: {e}")
                 continue
             with self._seg_lock:
+                seg.groups.append(
+                    (batch[0][2], seg.nbytes, len(data), len(batch)))
+                seg.end_seq = end_seq
                 seg.nbytes += len(data)
                 for key, _, seq, frag, rtype in batch:
                     if rtype == REC_TOMBSTONE:
@@ -606,6 +728,8 @@ class WriteAheadLog:
         older segment still holding ops it must kill on replay."""
         with self._seg_lock:
             keep = list(self._segments)
+            min_cursor = (min(self._cursors.values())
+                          if self._cursors else None)
             while keep:
                 seg = keep[0]
                 if not include_active and seg is self._active:
@@ -614,10 +738,31 @@ class WriteAheadLog:
                     self._covered(k, s) for k, s in seg.last_seq.items()
                 ):
                     break
+                if (min_cursor is not None and seg.end_seq > min_cursor
+                        and not include_active):
+                    # a registered CDC cursor still needs this covered
+                    # segment. Retain the contiguous covered prefix up
+                    # to the retention budget; past it, reclaim
+                    # oldest-first anyway and advance the tail floor so
+                    # the laggard's next read answers TailGone instead
+                    # of the WAL growing without bound.
+                    pinned = 0
+                    for s in keep:
+                        if s is self._active or not all(
+                            self._covered(k, q)
+                            for k, q in s.last_seq.items()
+                        ):
+                            break
+                        pinned += s.nbytes
+                    if pinned <= self.cdc_retention_bytes:
+                        break
+                    self.cdc_forced_reclaims += 1
                 try:
                     os.unlink(seg.path)
                 except OSError:
                     break
+                if seg.end_seq:
+                    self._tail_floor = max(self._tail_floor, seg.end_seq)
                 keep.pop(0)
             if len(keep) != len(self._segments):
                 self._segments = keep
@@ -776,7 +921,19 @@ class WriteAheadLog:
     def metrics(self) -> dict:
         with self._seg_lock:
             segments = len(self._segments)
+            retained = sum(s.nbytes for s in self._segments)
+            cursors = len(self._cursors)
+            min_cursor = (min(self._cursors.values())
+                          if self._cursors else 0)
+            floor = self._tail_floor
         return {
+            "cdc_cursors": cursors,
+            "cdc_min_cursor_seq": min_cursor,
+            "cdc_tail_floor": floor,
+            "cdc_retained_bytes": retained,
+            "cdc_forced_reclaims_total": self.cdc_forced_reclaims,
+            "cdc_tail_reads_total": self.tail_reads,
+            "cdc_tail_bytes_total": self.tail_bytes,
             "groups_total": self.groups,
             "fsyncs_total": self.fsyncs,
             "appended_ops_total": self.appended_ops,

@@ -61,6 +61,7 @@ class ChaosHarness:
                  reader_threads: int = 1, n_shards: int = 4,
                  with_storage_faults: bool = False,
                  with_autopilot: bool = False,
+                 with_cdc: bool = False,
                  log=lambda msg: None):
         self.tmp_dir = str(tmp_dir)
         self.n_nodes = n_nodes
@@ -81,6 +82,15 @@ class ChaosHarness:
         # event in the bag — the five oracles must hold while the
         # autopilot mints overrides and resizes UNDER the same faults
         self.with_autopilot = with_autopilot
+        # CDC mirror schedules (ISSUE 16): an out-of-cluster follower
+        # tails n0's WAL feed into its own holder for the whole
+        # schedule — kills, restarts and partitions included — gated on
+        # the byte-identical mirror oracle (everything n0 holds after
+        # heal is byte-identical in the mirror once its cursor passes
+        # n0's durable seq)
+        self.with_cdc = with_cdc
+        self.cdc_mirror = None
+        self.cdc_mirror_holder = None
         self.autopilot_moves = 0
         self.disk_plane = None
         self.corruptions_injected = 0
@@ -145,10 +155,48 @@ class ChaosHarness:
         base = self._uri(self.servers["n0"])
         _post(base, f"/index/{INDEX}", b"{}")
         _post(base, f"/index/{INDEX}/field/{FIELD}", b"{}")
+        if self.with_cdc:
+            self._start_cdc_mirror()
         return self
+
+    def _start_cdc_mirror(self) -> None:
+        """Boot the CDC mirror: a follower outside the cluster tailing
+        n0's feed into its own holder. Its InternalClient carries no
+        node identity (``fault_source`` stays ``""``), so the named
+        partition rules the schedule installs never match it — like the
+        urllib workload, the observer is not partitioned from the
+        system under test. n0 kills reset the seq space mid-schedule;
+        the follower answers the resulting FeedGone (unknown-cursor
+        410) with a merge resync, which converges because the chaos
+        workload is add-only and kills are graceful closes (the durable
+        WAL state survives)."""
+        import types
+
+        from pilosa_tpu.cdc.tailer import CdcFollower
+        from pilosa_tpu.parallel.client import InternalClient
+        from pilosa_tpu.storage import Holder
+
+        self.cdc_mirror_holder = Holder(
+            f"{self.tmp_dir}/cdc_mirror").open()
+        self.cdc_mirror = CdcFollower(
+            types.SimpleNamespace(holder=self.cdc_mirror_holder),
+            InternalClient(timeout=10.0),
+            self._uri(self.servers["n0"]),
+            poll_interval=0.05, cursor_name="chaos-mirror",
+        )
+        self.cdc_mirror.start()
 
     def close(self) -> None:
         self._stop.set()
+        if self.cdc_mirror is not None:
+            self.cdc_mirror.stop()
+            self.cdc_mirror = None
+        if self.cdc_mirror_holder is not None:
+            try:
+                self.cdc_mirror_holder.close()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+            self.cdc_mirror_holder = None
         with self._lock:
             servers = list(self.servers.values())
             self.servers = {}
@@ -507,6 +555,8 @@ class ChaosHarness:
         conflicts = {e: sorted(a) for e, a in actors_by_epoch.items()
                      if len(a) > 1}
         mismatches = self._oracle_replica_identity()
+        cdc_mismatches = (self._oracle_cdc_mirror()
+                          if self.with_cdc else [])
         dirty_disk = (self._oracle_disk_integrity()
                       if self.with_storage_faults else [])
         degraded_stuck = [
@@ -523,11 +573,75 @@ class ChaosHarness:
             "disk_integrity_failures": dirty_disk,
             "degraded_stuck": degraded_stuck,
             "autopilot_moves": self.autopilot_moves,
+            "cdc_mirror_mismatches": cdc_mismatches,
+            "cdc_resyncs": (self.cdc_mirror.resyncs_total
+                            if self.cdc_mirror is not None else 0),
+            "cdc_applied_ops": (self.cdc_mirror.applied_ops_total
+                                if self.cdc_mirror is not None else 0),
             "epochs_acted": len(actors_by_epoch),
             "ok": (not lost and not non_quorum_deletions
                    and not conflicts and not mismatches
-                   and not dirty_disk and not degraded_stuck),
+                   and not dirty_disk and not degraded_stuck
+                   and not cdc_mismatches),
         }
+
+    def _oracle_cdc_mirror(self) -> list:
+        """The CDC mirror oracle (ISSUE 16): after heal + converge, the
+        out-of-cluster follower tailing n0 holds a byte-identical copy
+        of every non-empty fragment n0 holds. Sound because EVERY write
+        into n0's fragments — client Sets and anti-entropy repair alike
+        — rides ``add_ids`` into the WAL, so it reached the mirror in
+        the bulk sync or through the feed; waiting for the mirror's
+        cursor to pass n0's durable seq turns the comparison into a
+        barrier instead of a race. Mirror-⊇-n0, not equality: ownership
+        churn can leave the mirror holding tombstoned leftovers whose
+        delete fell in a resync window, which is the documented merge-
+        resync semantics, not divergence."""
+        n0 = self.servers.get("n0")
+        if n0 is None or self.cdc_mirror is None:
+            return ["n0 or mirror not live at oracle time"]
+        wal = n0.holder.wal
+        wal.barrier()
+        durable = wal.durable_seq()
+        # compare-until-deadline, not wait-then-compare: right after an
+        # n0 restart the mirror can still carry a cursor from the OLD
+        # seq space (numerically past the fresh durable) with its
+        # unknown-cursor 410 resync in flight — a single cursor check
+        # would green-light a comparison against a mid-resync mirror.
+        # Nothing writes n0 after convergence, so a passing comparison
+        # is stable; a persistent mismatch still fails loudly.
+        deadline = time.monotonic() + 30.0
+        mismatches = ["mirror never caught up for a comparison"]
+        while time.monotonic() < deadline:
+            since = self.cdc_mirror._since
+            if since is None or since < durable:
+                time.sleep(0.1)
+                continue
+            mismatches = self._cdc_mirror_diff(n0)
+            if not mismatches:
+                return []
+            time.sleep(0.2)
+        return mismatches
+
+    def _cdc_mirror_diff(self, n0) -> list:
+        mirror = self.cdc_mirror_holder
+        mismatches = []
+        for iname, idx in n0.holder.indexes.items():
+            for fname, field in idx.fields.items():
+                for vname, view in field.views.items():
+                    for shard, frag in list(view.fragments.items()):
+                        if not frag.count():
+                            continue
+                        midx = mirror.index(iname)
+                        mf = midx.field(fname) if midx else None
+                        mv = mf.view(vname) if mf else None
+                        mfrag = mv.fragment(shard) if mv else None
+                        if (mfrag is None
+                                or mfrag.serialize_snapshot()
+                                != frag.serialize_snapshot()):
+                            mismatches.append(
+                                f"{iname}/{fname}/{vname}/{shard}")
+        return mismatches
 
     def _oracle_disk_integrity(self) -> list:
         """The corruption oracle (ISSUE 10): after heal + scrub, every
@@ -855,7 +969,7 @@ def run_mp_chaos(tmp_dir, n_schedules: int = 2, n_workers: int = 2,
 def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
               replica_n: int = 2, seed: int = 0, n_events: int = 6,
               event_gap_s: float = 0.3, with_storage_faults: bool = False,
-              with_autopilot: bool = False,
+              with_autopilot: bool = False, with_cdc: bool = False,
               log=lambda msg: None) -> dict:
     """Run ``n_schedules`` independent seeded schedules (fresh cluster
     each — a schedule's damage must not leak into the next) and fold
@@ -864,7 +978,10 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
     bit-flip and disk-full events plus the disk-integrity oracle
     (bench_suite config_scrub); ``with_autopilot`` runs the placement
     plane live (fast tickers + forced-pass events) so the same oracles
-    gate autopilot-minted resizes (bench_suite config_autopilot)."""
+    gate autopilot-minted resizes (bench_suite config_autopilot);
+    ``with_cdc`` runs an out-of-cluster CDC mirror tailing n0 for the
+    whole schedule, gated on the byte-identical mirror oracle
+    (bench_suite config_cdc)."""
     records = []
     for i in range(n_schedules):
         schedule_seed = seed * 1000 + i
@@ -874,7 +991,7 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
             seed=schedule_seed, n_events=n_events,
             event_gap_s=event_gap_s,
             with_storage_faults=with_storage_faults,
-            with_autopilot=with_autopilot, log=log,
+            with_autopilot=with_autopilot, with_cdc=with_cdc, log=log,
         )
         try:
             harness.boot()
@@ -908,6 +1025,12 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
         "degraded_stuck": sum(len(r.get("degraded_stuck", []))
                               for r in records),
         "autopilot_moves_total": sum(r.get("autopilot_moves", 0)
+                                     for r in records),
+        "cdc_mirror_mismatches": sum(
+            len(r.get("cdc_mirror_mismatches", [])) for r in records),
+        "cdc_resyncs_total": sum(r.get("cdc_resyncs", 0)
+                                 for r in records),
+        "cdc_applied_ops_total": sum(r.get("cdc_applied_ops", 0)
                                      for r in records),
         "unconverged": sum(1 for r in records if not r["converged"]),
         "failed_seeds": [r["seed"] for r in failed],
