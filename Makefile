@@ -24,7 +24,8 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 	mp-smoke multitenant-smoke mesh-smoke autopilot-smoke bench-ingest \
 	bench-serving bench-sync bench-durability bench-tracing \
 	bench-profiling bench-chaos bench-scrub bench-mp bench-multitenant \
-	bench-mesh bench-autopilot cdc-smoke bench-cdc
+	bench-mesh bench-autopilot cdc-smoke bench-cdc elastic-smoke \
+	bench-elastic
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -124,6 +125,17 @@ autopilot-smoke:
 cdc-smoke:
 	$(PYTEST) tests/test_cdc.py -m "not slow"
 
+# elastic-smoke: the membership plane — graceful drain state machine
+# (shed-writes latch, cursor handoff, clean leave, coordinator-failover
+# resume), heat-ordered byte-verified join warm-up, the range-keyed
+# placement table (byte-identity fallback, mixed-version gossip,
+# persistence round-trip), sub-shard split/merge planning, and the
+# autopilot/drain mutual-exclusion contract (docs/OPERATIONS.md
+# elastic operations)
+elastic-smoke:
+	$(PYTEST) tests/test_elastic.py tests/test_placement_ranges.py \
+		-m "not slow"
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -197,3 +209,12 @@ bench-autopilot:
 # backup generations restoring bit-exactly via restore --as-of
 bench-cdc:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs cdc
+
+# elastic membership gate: scripted 3->5->3 grow/shrink under live Zipf
+# traffic with a ledgered writer (zero lost acked writes, p99
+# continuity vs the steady-state plateau), a hot single shard recovered
+# by a sub-shard range split spreading reads across >=2 owners, and
+# chaos schedules that kill/partition mid-drain without tripping any
+# oracle
+bench-elastic:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs elastic

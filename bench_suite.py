@@ -3083,7 +3083,12 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
     (multi-process serving tier: SIGKILL workers mid-burst) gated on
     zero lost acked writes + the owner-never-wedges oracle; skipped
     (and not counted against ``ok``) only where SO_REUSEPORT is
-    unavailable."""
+    unavailable.
+
+    ISSUE 17 adds mid-drain schedules: a second ``run_chaos`` batch
+    with ``with_elastic=True`` puts graceful-drain events in the same
+    bag as kills and partitions, so faults land while a drain is in
+    flight — gated on the same oracles."""
     import socket as _socket
 
     from pilosa_tpu.testing.chaos import run_chaos, run_mp_chaos
@@ -3094,6 +3099,11 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
             tmp, n_schedules=n_schedules, n_nodes=n_nodes,
             replica_n=replica_n, n_events=n_events, seed=seed,
         )
+        drain = run_chaos(
+            tmp + "/drain", n_schedules=max(2, n_schedules // 5),
+            n_nodes=max(n_nodes, 4), replica_n=replica_n,
+            n_events=n_events, seed=seed + 7, with_elastic=True,
+        )
         if hasattr(_socket, "SO_REUSEPORT"):
             mp = run_mp_chaos(tmp + "/mp", n_schedules=2, n_workers=2,
                               n_kills=3, seed=seed)
@@ -3101,6 +3111,16 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
             mp = {"skipped": "SO_REUSEPORT unavailable", "ok": True}
     return {
         "kill_worker": mp,
+        "mid_drain": {
+            "schedules": drain["schedules"],
+            "drains_total": drain["drains_total"],
+            "lost_acked_writes": drain["lost_acked_writes"],
+            "replica_mismatches": drain["replica_mismatches"],
+            "unconverged": drain["unconverged"],
+            "failed_seeds": drain["failed_seeds"],
+            "failed_diags": drain["failed_diags"],
+            "ok": bool(drain["ok"] and drain["unconverged"] == 0),
+        },
         "config": "chaos",
         "metric": "partition_chaos_oracles",
         "schedules": out["schedules"],
@@ -3117,7 +3137,8 @@ def config_chaos(n_schedules: int = 20, n_nodes: int = 3,
         "failed_diags": out["failed_diags"],
         "wall_s": round(time.time() - t0, 1),
         "ok": bool(out["ok"] and out["unconverged"] == 0
-                   and mp.get("ok")),
+                   and mp.get("ok")
+                   and drain["ok"] and drain["unconverged"] == 0),
     }
 
 
@@ -4075,6 +4096,441 @@ def config_cdc(n_chaos_schedules: int = 3, n_clients: int = 6,
     return result
 
 
+def config_elastic(n_clients: int = 6, n_shards: int = 4,
+                   phase_s: float = 4.0, n_chaos_schedules: int = 3,
+                   seed: int = 0) -> dict:
+    """Elastic membership gate (ISSUE 17 — docs/OPERATIONS.md elastic
+    operations), three parts:
+
+    **A — scripted grow/shrink under live traffic.** A 3-node
+    in-process cluster serves a Zipf read mix plus a ledgered writer
+    while the script grows it to 5 (two cold joiners absorb their
+    shards) and drains it back to 3 (graceful ``drain`` per departing
+    node: groups move, CDC cursors hand off, the target sheds writes
+    through the tail and leaves). Gates: ZERO lost acked writes (every
+    200-acked Set queryable at the end, through two joins and two
+    drains), zero client errors (a 503/429 retried to success is
+    backpressure, not an error), and p99 CONTINUITY — no 2s window
+    goes dark, and no window's p99 exceeds max(10x the steady-state
+    plateau, 1200ms). The absolute floor absorbs the genuine
+    double-join resize window on a GIL-shared in-process cluster;
+    the real claim is "degraded, never dark": zero dark windows,
+    zero errors, zero lost writes, sub-1.2s worst p99.
+
+    **B — hot single shard recovered by a range split.** One index,
+    one shard, every byte of its heat on one owner — placement moves
+    cannot help (the unsplittable-tenant hole the range table closes).
+    With ``autopilot-split-threshold`` armed the planner must mint a
+    sub-shard split spreading the shard across >= 2 nodes, every peer
+    must adopt the range table, reads must stay byte-correct, and
+    remote reads entering through a NON-owner must actually fan out
+    across the span owners (measured per-node request deltas).
+
+    **C — chaos mid-drain.** ``run_chaos(with_elastic=True,
+    with_cdc=True)``: drain events land in the same bag as kills and
+    partitions, so faults hit MID-drain; gated on all six oracles
+    (acked writes, quorum deletions, one-coordinator-per-epoch,
+    replica identity, CDC mirror, convergence)."""
+    import random as _random
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    INDEX = "el"
+    ZIPF_S = 1.1
+    RETRY_CAP = 300
+    N_ROWS = 4
+
+    def req(method, base, path, body=None, timeout=30):
+        r = urllib.request.Request(f"{base}{path}", data=body,
+                                   method=method)
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    from pilosa_tpu.server import Server, ServerConfig
+
+    def make_server(tmp, name, seeds, **kw):
+        cfg = dict(
+            data_dir=f"{tmp}/{name}", port=0, name=name, replica_n=2,
+            seeds=seeds, anti_entropy_interval=1.0,
+            heartbeat_interval=0.1, heartbeat_timeout=0.5,
+            use_mesh=False,
+        )
+        cfg.update(kw)
+        return Server(ServerConfig(**cfg)).open()
+
+    t_all = time.time()
+    record: dict = {"config": "elastic", "metric": "elastic_membership"}
+
+    # ---- part A: scripted 3 -> 5 -> 3 under live traffic ---------------
+    servers: dict = {}
+    srv_lock = threading.Lock()
+
+    def live_bases() -> list:
+        with srv_lock:
+            return [f"http://localhost:{s.port}" for s in servers.values()]
+
+    samples: list = []
+    errors: list = []
+    ledger: set = set()
+    retried = [0]
+    stop = threading.Event()
+    t_start = [0.0]
+    lock = threading.Lock()
+
+    def one_op(rng, body):
+        t0 = time.monotonic()
+        attempts = 0
+        while True:
+            bases = live_bases()
+            if not bases:
+                return None, None, "no live nodes"
+            base = bases[rng.randrange(len(bases))]
+            try:
+                out = req("POST", base, f"/index/{INDEX}/query", body,
+                          timeout=10)
+                return time.monotonic() - t0, out, None
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.read()
+                attempts += 1
+                if code in (429, 503) and attempts <= RETRY_CAP:
+                    retried[0] += 1
+                    time.sleep(min(0.004 * attempts, 0.04)
+                               * (0.5 + rng.random()))
+                    continue
+                return None, None, f"HTTP {code}"
+            except Exception as e:  # noqa: BLE001 — a node mid-close
+                attempts += 1      # drops the connection; re-route
+                if attempts <= RETRY_CAP:
+                    time.sleep(0.01)
+                    continue
+                return None, None, f"transport: {e}"
+
+    weights = np.array([1.0 / (r + 1) ** ZIPF_S for r in range(N_ROWS)])
+    cum = np.cumsum(weights / weights.sum()).tolist()
+
+    def reader(tid: int):
+        import bisect as _bisect
+
+        rng = _random.Random(seed * 1000 + tid)
+        while not stop.is_set():
+            row = 1 + min(_bisect.bisect_left(cum, rng.random()),
+                          N_ROWS - 1)
+            lat, _out, err = one_op(rng, f"Count(Row(f={row}))".encode())
+            with lock:
+                if err is not None:
+                    errors.append(err)
+                elif lat is not None:
+                    samples.append((time.monotonic() - t_start[0], lat))
+
+    def writer():
+        rng = _random.Random(seed * 1000 + 777)
+        col = 0
+        while not stop.is_set():
+            col += 1
+            c = (col % n_shards) * SHARD_WIDTH + col
+            _lat, out, err = one_op(rng, f"Set({c}, f=9)".encode())
+            with lock:
+                if err is not None:
+                    errors.append(f"write: {err}")
+                elif out is not None and out.get("results") == [True]:
+                    ledger.add(c)
+            time.sleep(0.01)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        seeds: list = []
+        for i in range(3):
+            s = make_server(f"{tmp}/a", f"e{i}", seeds)
+            servers[f"e{i}"] = s
+            if not seeds:
+                seeds = [f"http://localhost:{s.port}"]
+        for s in servers.values():
+            assert s.api.cluster.wait_until_normal(30)
+        entry = f"http://localhost:{servers['e0'].port}"
+        req("POST", entry, f"/index/{INDEX}", b"{}")
+        req("POST", entry, f"/index/{INDEX}/field/f", b"{}")
+        for shard in range(n_shards):
+            for row in range(1, N_ROWS + 1):
+                req("POST", entry, f"/index/{INDEX}/query",
+                    f"Set({shard * SHARD_WIDTH + row}, f={row})".encode())
+
+        t_start[0] = time.monotonic()
+        threads = [threading.Thread(target=reader, args=(t,), daemon=True)
+                   for t in range(n_clients)]
+        threads.append(threading.Thread(target=writer, daemon=True))
+        for t in threads:
+            t.start()
+        script_log: list = []
+        time.sleep(phase_s)  # steady-state plateau at 3 nodes
+
+        # grow 3 -> 5: two cold joiners warm from the live heatmap
+        for name in ("e3", "e4"):
+            with srv_lock:
+                servers[name] = make_server(f"{tmp}/a", name, seeds)
+            script_log.append(
+                {"t": round(time.monotonic() - t_start[0], 2),
+                 "event": f"join {name}"})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with srv_lock:
+                views = [set(s.api.cluster.nodes)
+                         for s in servers.values()]
+            if all(v == {"e0", "e1", "e2", "e3", "e4"} for v in views):
+                break
+            time.sleep(0.2)
+        else:
+            script_log.append({"event": "membership never reached 5"})
+        time.sleep(phase_s)  # serve at 5
+
+        # snapshot join-warm counters NOW: they live on the joiners,
+        # which the shrink below drains and closes
+        warm = {k: 0 for k in ("elastic_warm_heat_ordered_total",
+                               "elastic_warm_verified_total",
+                               "elastic_warm_verify_failed_total")}
+        with srv_lock:
+            for s in servers.values():
+                m = s.api.cluster.metrics()
+                for k in warm:
+                    warm[k] += m.get(k, 0)
+
+        # shrink 5 -> 3: graceful drains, one at a time
+        drains_ok = True
+        for name in ("e3", "e4"):
+            done = False
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                with srv_lock:
+                    coord = next(
+                        (s for s in servers.values()
+                         if s.api.cluster.is_acting_coordinator), None)
+                if coord is None:
+                    time.sleep(0.2)
+                    continue
+                try:
+                    # a CDC tailer pinned to the victim: the drain's
+                    # handoff step must re-home its retention and drop
+                    # the cursor (counted in elastic_cursor_handoffs)
+                    wal = getattr(coord.api.holder, "wal", None)
+                    if wal is not None:
+                        wal.register_cursor(f"tailer:{name}", 0)
+                    coord.api.drain_start(name)
+                except Exception:  # noqa: BLE001 — resize in flight /
+                    time.sleep(0.3)  # not NORMAL yet: retry
+                    continue
+                while time.monotonic() < deadline:
+                    st = coord.api.cluster.drain_record
+                    if st.get("target") == name and st.get("state") in (
+                            "done", "failed", "aborted"):
+                        done = st["state"] == "done"
+                        break
+                    time.sleep(0.1)
+                break
+            drains_ok &= done
+            script_log.append(
+                {"t": round(time.monotonic() - t_start[0], 2),
+                 "event": f"drain {name}",
+                 "done": done})
+            with srv_lock:
+                victim = servers.pop(name, None)
+            if victim is not None:
+                victim.close()
+        time.sleep(phase_s)  # steady state back at 3
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        run_s = time.monotonic() - t_start[0]
+
+        # acked-write ledger readback (bounded retries: strays that
+        # raced a move converge through the 1s anti-entropy ticker)
+        with srv_lock:
+            probe = f"http://localhost:{servers['e0'].port}"
+        lost: list = []
+        for _ in range(8):
+            try:
+                out = req("POST", probe, f"/index/{INDEX}/query",
+                          b"Row(f=9)", timeout=30)
+                got = set(out.get("results", [{}])[0].get("columns", []))
+            except Exception:  # noqa: BLE001
+                got = set()
+            lost = sorted(ledger - got)
+            if not lost:
+                break
+            time.sleep(2.0)
+
+        cursor_handoffs = 0
+        drains_completed = 0
+        with srv_lock:
+            for s in servers.values():
+                em = s.api.elastic_metrics()
+                cursor_handoffs += em.get(
+                    "elastic_cursor_handoffs_total", 0)
+                drains_completed += em.get(
+                    "elastic_drains_completed_total", 0)
+            part_a_servers = list(servers.values())
+            servers.clear()
+        for s in part_a_servers:
+            s.close()
+
+        def p99_ms(t_lo, t_hi) -> float:
+            lats = [lat for at, lat in samples if t_lo <= at < t_hi]
+            if not lats:
+                return float("nan")
+            return round(float(np.percentile(np.array(lats), 99)) * 1e3,
+                         2)
+
+        plateau_p99 = p99_ms(1.0, phase_s)
+        timeline = [{"window_s": [w, w + 2],
+                     "p99_ms": p99_ms(w, w + 2)}
+                    for w in range(0, int(run_s), 2)]
+        dark_windows = [w["window_s"] for w in timeline
+                        if w["p99_ms"] != w["p99_ms"]]  # NaN = no sample
+        p99_worst = max((w["p99_ms"] for w in timeline
+                         if w["p99_ms"] == w["p99_ms"]),
+                        default=float("nan"))
+        continuity_ok = bool(
+            not dark_windows and plateau_p99 == plateau_p99
+            and p99_worst == p99_worst
+            and p99_worst <= max(10 * plateau_p99, 1200.0))
+
+        # ---- part B: hot single shard recovered by a range split -------
+        split_rec = _elastic_split_part(tmp, req, make_server, seed)
+
+        # ---- part C: chaos schedules that kill/partition mid-drain -----
+        from pilosa_tpu.testing.chaos import run_chaos
+
+        chaos = run_chaos(
+            f"{tmp}/chaos", n_schedules=n_chaos_schedules, n_nodes=4,
+            replica_n=2, seed=seed, n_events=8,
+            with_elastic=True, with_cdc=True,
+        )
+
+    record.update({
+        "grow_shrink": {
+            "script": script_log,
+            "drains_ok": drains_ok,
+            "drains_completed": drains_completed,
+            "cursor_handoffs": cursor_handoffs,
+            "acked_writes": len(ledger),
+            "lost_acked_writes": len(lost),
+            "lost_sample": lost[:5],
+            "client_errors": len(errors),
+            "error_sample": errors[:5],
+            "retries_shed": retried[0],
+            "plateau_p99_ms": plateau_p99,
+            "worst_window_p99_ms": p99_worst,
+            "dark_windows": dark_windows,
+            "continuity_ok": continuity_ok,
+            "timeline": timeline,
+            "join_warm": warm,
+        },
+        "split": split_rec,
+        "chaos": {
+            "schedules": chaos["schedules"],
+            "drains_total": chaos["drains_total"],
+            "lost_acked_writes": chaos["lost_acked_writes"],
+            "non_quorum_deletions": chaos["non_quorum_deletions"],
+            "coordinator_conflicts": chaos["coordinator_conflicts"],
+            "replica_mismatches": chaos["replica_mismatches"],
+            "cdc_mirror_mismatches": chaos["cdc_mirror_mismatches"],
+            "unconverged": chaos["unconverged"],
+            "failed_seeds": chaos["failed_seeds"],
+            "failed_diags": chaos["failed_diags"],
+            "ok": chaos["ok"],
+        },
+        "wall_s": round(time.time() - t_all, 1),
+        "ok": bool(
+            drains_ok and not lost and not errors and continuity_ok
+            and split_rec["ok"]
+            and chaos["ok"] and chaos["unconverged"] == 0),
+    })
+    return record
+
+
+def _elastic_split_part(tmp: str, req, make_server, seed: int) -> dict:
+    """Part B of config_elastic: one pathologically hot (index, shard)
+    on one owner; the armed splitter must spread it across nodes and
+    remote reads entering through a non-owner must fan out over the
+    span owners."""
+    import urllib.request  # noqa: F401 — req closes over it
+
+    servers: dict = {}
+    seeds: list = []
+    for i in range(3):
+        s = make_server(
+            f"{tmp}/b", f"s{i}", seeds, replica_n=1,
+            autopilot_enabled=True, autopilot_interval=300.0,
+            autopilot_split_threshold=1.5, autopilot_split_ways=2)
+        servers[f"s{i}"] = s
+        if not seeds:
+            seeds = [f"http://localhost:{s.port}"]
+    try:
+        for s in servers.values():
+            assert s.api.cluster.wait_until_normal(30)
+        entry = f"http://localhost:{servers['s0'].port}"
+        req("POST", entry, "/index/hot", b"{}")
+        req("POST", entry, "/index/hot/field/f", b"{}")
+        for col in range(64):
+            req("POST", entry, "/index/hot/query",
+                f"Set({col}, f=1)".encode())
+        for _ in range(300):  # all heat on hot/0
+            req("POST", entry, "/index/hot/query", b"Count(Row(f=1))")
+        coord = next(s for s in servers.values()
+                     if s.api.cluster.is_acting_coordinator)
+        split_minted = False
+        for _ in range(10):  # forced passes: deterministic replay
+            rec = coord.api.autopilot.run_pass()
+            if rec.get("splits"):
+                split_minted = True
+                break
+            time.sleep(0.5)
+        c = coord.api.cluster
+        spans = c.placement.get_ranges("hot", 0) or ()
+        span_owners = sorted({i for _lo, _hi, ids in spans for i in ids})
+        adopted = all(s.api.cluster.placement.range_count >= len(spans)
+                      for s in servers.values())
+        # reads stay byte-correct through the split
+        out = req("POST", entry, "/index/hot/query", b"Count(Row(f=1))")
+        count_ok = out.get("results") == [64]
+        # fan-out: drive reads through a NON-owner entry and measure
+        # which span owners' HTTP listeners absorbed the remote reads
+        non_owner = next((s for s in servers.values()
+                          if s.config.name not in span_owners), None)
+        fanout: dict = {}
+        if non_owner is not None and span_owners:
+            def served(name):
+                base = f"http://localhost:{servers[name].port}"
+                return req("GET", base, "/debug/vars")[
+                    "serving_fastlane"]["http_requests_total"]
+
+            before = {n: served(n) for n in span_owners}
+            nb = f"http://localhost:{non_owner.port}"
+            for _ in range(200):
+                req("POST", nb, "/index/hot/query", b"Count(Row(f=1))")
+            fanout = {n: served(n) - before[n] for n in span_owners}
+        spread_ok = (len(span_owners) >= 2
+                     and len([n for n, d in fanout.items() if d >= 10])
+                     >= 2)
+        return {
+            "split_minted": split_minted,
+            "spans": [[lo, hi, list(ids)] for lo, hi, ids in spans],
+            "span_owners": span_owners,
+            "adopted_by_all": adopted,
+            "count_correct": count_ok,
+            "non_owner_fanout": fanout,
+            "splits_executed": coord.api.autopilot_metrics().get(
+                "autopilot_splits_total", 0),
+            "ok": bool(split_minted and len(spans) >= 2 and adopted
+                       and count_ok and spread_ok),
+        }
+    finally:
+        for s in servers.values():
+            s.close()
+
+
 def config_mesh_inner(n_devices: int) -> dict:
     """One mesh size of the hierarchical-reduction gate: the flat 1-D
     mesh (the dense baseline every prior PR certified) vs the 2-D
@@ -4238,7 +4694,7 @@ def main() -> None:
         "--configs",
         default="1,2,3,4,5,mesh8,mesh,serving,mp_serving,multitenant,import,"
                 "ingest,sync,hostpath,durability,tracing,profiling,chaos,"
-                "scrub,autopilot,cdc",
+                "scrub,autopilot,cdc,elastic",
     )
     parser.add_argument("--cpu-mesh-inner", action="store_true",
                         help=argparse.SUPPRESS)
@@ -4325,6 +4781,11 @@ def main() -> None:
             n_chaos_schedules=6 if args.full else 3,
             read_s=8.0 if args.full else 5.0,
             n_clients=8 if args.full else 6,
+        ),
+        "elastic": lambda: config_elastic(
+            n_clients=8 if args.full else 6,
+            phase_s=6.0 if args.full else 4.0,
+            n_chaos_schedules=6 if args.full else 3,
         ),
         "mesh": config_mesh,
     }

@@ -1,20 +1,27 @@
 """Autopilot placement plane: heat-weighted shard rebalancing that
-recovers hot-spot p99 without operator action (ROADMAP item 4). The
-pure planner and the ticker live in ``planner``; the actuator surface
-(the epoch-stamped placement-override table) lives beside the hash
+recovers hot-spot p99 without operator action (ROADMAP item 4), plus
+the elastic membership plane (graceful drain, sub-shard split/merge —
+ROADMAP item 2). The pure planners and the ticker live in ``planner``,
+the drain state machine in ``elastic``; the actuator surface (the
+epoch-stamped placement-override + range table) lives beside the hash
 ring in ``pilosa_tpu.parallel.cluster``."""
 
+from pilosa_tpu.autopilot.elastic import ElasticError, ElasticManager
 from pilosa_tpu.autopilot.planner import (
     DEFAULT_HEAT_BUDGET,
     DEFAULT_MAX_MOVES,
     Autopilot,
     plan_moves,
+    plan_splits,
     shaped_move_budget,
 )
 
 __all__ = [
     "Autopilot",
+    "ElasticError",
+    "ElasticManager",
     "plan_moves",
+    "plan_splits",
     "shaped_move_budget",
     "DEFAULT_HEAT_BUDGET",
     "DEFAULT_MAX_MOVES",

@@ -1,0 +1,431 @@
+"""Elastic membership plane: coordinator-driven graceful drain.
+
+ROADMAP open item 2's closing move: the cluster could *rebalance*
+(autopilot moves) but not change SIZE cleanly — a departing node just
+broadcast node-leave and relied on replicas, which at replica_n == 1
+loses data, and at any replica count leaves the tail to anti-entropy.
+:class:`ElasticManager` drives the missing half as a resumable state
+machine built ENTIRELY from the existing epoch-fenced, quorum-gated
+primitives:
+
+``pending → moving → handoff → leaving → done`` (or ``aborted`` /
+``failed``), where
+
+- **pending**: the drain record is epoch-stamped (one minted epoch per
+  drain, rev-bumped per state change) and broadcast; adopting it flips
+  the TARGET's ``draining`` latch, so writes shed BEFORE any data
+  moves — the window where an acked write could land on a fragment
+  mid-departure is closed first;
+- **moving**: every (index, shard) group the target owns is rewritten
+  in the placement table to a least-loaded live replacement
+  (``apply_placement`` — quorum-gated, epoch-minted, gossiped), then
+  ``coordinate_resize`` makes the new owners pull their copies and the
+  post-resize cleanup drops the target's;
+- **handoff**: the target's CDC cursors on the coordinator's WAL are
+  dropped (every other member drops theirs on the node-leave they
+  receive next — the same departed-member drop that covers
+  declared-dead nodes), releasing the WAL retention those cursors
+  pinned;
+- **leaving**: the coordinator sends ``drain-leave``; the target calls
+  ``Cluster.leave()`` and departs. An unreachable target is declared
+  dead instead (quorum-gated) so the drain still terminates.
+
+The record gossips via /status and drain-update messages, so when the
+drain COORDINATOR dies mid-drain, the failover coordinator's
+``maybe_resume`` (driven from the heartbeat tick) adopts the record
+and re-enters the machine at the recorded state — every step is
+idempotent against the epoch-fenced actuators, so re-running a
+half-finished step is safe. One drain at a time, and never while a
+resize is in flight or the autopilot is mid-action (the planner
+symmetrically skips while a drain is active): one coordinated actuator
+per epoch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu.parallel.client import ClientError
+from pilosa_tpu.parallel.cluster import (
+    DRAIN_ACTIVE_STATES,
+    STATE_DEGRADED,
+    STATE_NORMAL,
+)
+
+
+class ElasticError(Exception):
+    """A drain request the coordinator refuses (or cannot take). Maps
+    to the carried HTTP status at the API edge."""
+
+    def __init__(self, message: str, status: int = 409):
+        super().__init__(message)
+        self.status = int(status)
+
+
+class _DrainInterrupted(Exception):
+    """The running drain thread lost ownership of the record (aborted
+    by the operator, or superseded by a newer drain epoch): unwind
+    without stamping a terminal state."""
+
+
+class ElasticManager:
+    """Drain state machine + elastic observability, wired as
+    ``api.elastic`` on every server (drain must work with the autopilot
+    ticker off)."""
+
+    # how long the leaving step waits for the target to depart the
+    # member list before declaring it dead instead (tests shrink this)
+    LEAVE_TIMEOUT = 10.0
+
+    def __init__(self, cluster, logger=None):
+        self.cluster = cluster
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._closed = threading.Event()
+        self.drains_started = 0
+        self.drains_completed = 0
+        self.drains_failed = 0
+        self.drains_aborted = 0
+        self.drains_resumed = 0
+        self.cursor_handoffs = 0
+
+    def close(self) -> None:
+        self._closed.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # --------------------------------------------------------- operator API
+
+    def start_drain(self, target: str) -> dict:
+        """Begin draining ``target`` (acting coordinator only). Refuses
+        — with the reason in the raised ElasticError — whenever a
+        second coordinated actuator could mint dueling resizes."""
+        c = self.cluster
+        if not c.is_acting_coordinator:
+            raise ElasticError(
+                "not the acting coordinator: start the drain there", 409)
+        with c._lock:
+            nodes = dict(c.nodes)
+        if target not in nodes:
+            raise ElasticError(f"unknown node {target!r}", 404)
+        if target == c.local.id:
+            raise ElasticError(
+                "refusing to drain the acting coordinator: drain the "
+                "other nodes first (coordination fails over only after "
+                "this node actually leaves)", 409)
+        if len(nodes) < 2:
+            raise ElasticError("nothing to drain to: single-node", 409)
+        if c.drain_active:
+            raise ElasticError(
+                f"a drain of {c.drain_record.get('target')!r} is "
+                "already in flight", 409)
+        if c.state != STATE_NORMAL:
+            raise ElasticError(
+                "cluster is resizing: one coordinated action at a time",
+                409)
+        if c.degraded or not c.check_quorum():
+            raise ElasticError("no member quorum: drain refused", 503)
+        epoch = c._bump_epoch()
+        c._note_acted(epoch, f"drain:{target}")
+        record = {
+            "epoch": epoch, "rev": 1, "target": target,
+            "state": "pending", "coordinator": c.local.id,
+            "groups": 0, "moved": 0, "error": "",
+        }
+        # the broadcast flips the target's draining latch NOW — writes
+        # shed before the first byte moves
+        c.set_drain(record)
+        self.drains_started += 1
+        if self.logger is not None:
+            self.logger.info("drain of %s started (epoch %d)",
+                             target, epoch)
+        self._spawn(record)
+        return dict(record)
+
+    def abort_drain(self) -> dict:
+        """Stamp the in-flight drain aborted: the target un-sheds, its
+        remaining groups stay where the machine left them (already-
+        moved overrides remain valid placement). Acting-coordinator
+        only — the abort must gossip from the authority peers obey."""
+        c = self.cluster
+        if not c.is_acting_coordinator:
+            raise ElasticError(
+                "not the acting coordinator: abort the drain there", 409)
+        with c._lock:
+            record = dict(c.drain_record)
+        if record.get("state") not in DRAIN_ACTIVE_STATES:
+            raise ElasticError("no drain in flight", 409)
+        record["rev"] = int(record.get("rev", 1)) + 1
+        record["state"] = "aborted"
+        c.set_drain(record)
+        self.drains_aborted += 1
+        if self.logger is not None:
+            self.logger.info("drain of %s aborted",
+                             record.get("target"))
+        return record
+
+    def status(self) -> dict:
+        c = self.cluster
+        with c._lock:
+            record = dict(c.drain_record)
+        return {
+            "drain": record,
+            "active": c.drain_active,
+            "draining": c.draining,
+        }
+
+    def maybe_resume(self) -> bool:
+        """Heartbeat-tick hook on every node: when the drain record is
+        ACTIVE, this node is the acting coordinator, and no local drain
+        thread is running, take the state machine over (coordinator
+        failover mid-drain, or a restart of the original coordinator).
+        A record whose target already departed the membership is simply
+        stamped done — the drain's goal state was reached."""
+        if self._closed.is_set():
+            return False
+        c = self.cluster
+        with c._lock:
+            record = dict(c.drain_record)
+        if record.get("state") not in DRAIN_ACTIVE_STATES:
+            return False
+        if not c.is_acting_coordinator:
+            return False
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return False  # the machine is already running here
+        target = record.get("target")
+        with c._lock:
+            present = target in c.nodes
+        if not present:
+            record["rev"] = int(record.get("rev", 1)) + 1
+            record["state"] = "done"
+            c.set_drain(record)
+            self.drains_completed += 1
+            return True
+        if c.degraded:
+            return False  # resume only with a healthy majority
+        record["rev"] = int(record.get("rev", 1)) + 1
+        record["coordinator"] = c.local.id
+        c.set_drain(record)
+        self.drains_resumed += 1
+        if self.logger is not None:
+            self.logger.info(
+                "resuming drain of %s from state %s on %s",
+                target, record.get("state"), c.local.id,
+            )
+        self._spawn(record)
+        return True
+
+    def metrics(self) -> dict:
+        c = self.cluster
+        return {
+            "elastic_drains_started_total": self.drains_started,
+            "elastic_drains_completed_total": self.drains_completed,
+            "elastic_drains_failed_total": self.drains_failed,
+            "elastic_drains_aborted_total": self.drains_aborted,
+            "elastic_drains_resumed_total": self.drains_resumed,
+            "elastic_cursor_handoffs_total": self.cursor_handoffs,
+            "elastic_drain_active": 1 if c.drain_active else 0,
+            "elastic_drain_epoch":
+                int(c.drain_record.get("epoch", 0) or 0),
+        }
+
+    def to_json(self) -> dict:
+        """GET /debug/elastic: the drain state machine, counters, and
+        the range-split placement view."""
+        c = self.cluster
+        out = self.status()
+        out["metrics"] = self.metrics()
+        out["placement"] = c.placement.to_json()
+        return out
+
+    # -------------------------------------------------------- state machine
+
+    def _spawn(self, record: dict) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._run, args=(dict(record),),
+                daemon=True, name="drain",
+            )
+            self._thread.start()
+
+    def _advance(self, record: dict, state: str) -> None:
+        """Move the record to ``state`` — but only while this thread
+        still OWNS it: an operator abort or a newer drain epoch landed
+        in the cluster record means this machine must unwind without
+        stamping anything."""
+        c = self.cluster
+        with c._lock:
+            cur = c.drain_record
+            if int(cur.get("epoch", 0) or 0) != int(record["epoch"]):
+                raise _DrainInterrupted("superseded by a newer drain")
+            if cur.get("state") in ("aborted", "failed"):
+                raise _DrainInterrupted(f"drain {cur.get('state')}")
+        record["rev"] = int(record.get("rev", 1)) + 1
+        record["state"] = state
+        self.cluster.set_drain(record)
+
+    def _run(self, record: dict) -> None:
+        c = self.cluster
+        target = record["target"]
+        try:
+            if record["state"] == "pending":
+                self._advance(record, "moving")
+            if record["state"] == "moving":
+                if self._closed.is_set():
+                    return
+                table, ranges, groups = self._drain_overrides(target)
+                record["groups"] = groups
+                epoch = c.apply_placement(table, ranges=ranges)
+                if not epoch:
+                    raise RuntimeError(
+                        "placement refused (lost coordination or quorum "
+                        "mid-drain)")
+                c.coordinate_resize()
+                record["moved"] = groups
+                self._advance(record, "handoff")
+            if record["state"] == "handoff":
+                # the target's tail cursors on THIS node's WAL go now;
+                # every other member drops its own on the node-leave
+                # broadcast the leaving step triggers
+                self.cursor_handoffs += c.drop_departed_cursors(target)
+                self._advance(record, "leaving")
+            if record["state"] == "leaving":
+                self._leave_target(record, target)
+                self._advance(record, "done")
+            self.drains_completed += 1
+            if self.logger is not None:
+                self.logger.info(
+                    "drain of %s complete: %d group(s) moved",
+                    target, record.get("moved", 0),
+                )
+        except _DrainInterrupted as e:
+            if self.logger is not None:
+                self.logger.info("drain of %s interrupted: %s", target, e)
+        except Exception as e:  # noqa: BLE001 — stamp failed, never die
+            record["error"] = repr(e)
+            try:
+                self._advance(record, "failed")
+            except _DrainInterrupted:
+                pass
+            self.drains_failed += 1
+            if self.logger is not None:
+                self.logger.error("drain of %s failed: %r", target, e)
+
+    def _leave_target(self, record: dict, target: str) -> None:
+        """Tell the target to leave; wait for the membership to reflect
+        it. An unreachable target (it died mid-drain) is declared dead
+        instead — its groups are already moved, so the declaration's
+        resize finds nothing left to do but the record still reaches
+        ``done``."""
+        c = self.cluster
+        with c._lock:
+            node = c.nodes.get(target)
+        if node is None:
+            return  # already departed
+        try:
+            # current cluster epoch, not the record's minted-at-start
+            # one: the moving step's resize bumped the epoch past it
+            # and the target would fence the leave as stale
+            c.client.send_message(node.uri, {
+                "type": "drain-leave", "node": target,
+                "epoch": int(c.epoch),
+            })
+        except ClientError:
+            pass  # fall through to the departure wait + dead fallback
+        deadline = time.monotonic() + self.LEAVE_TIMEOUT
+        while time.monotonic() < deadline:
+            with c._lock:
+                if target not in c.nodes:
+                    return
+            if self._closed.is_set():
+                return
+            time.sleep(0.05)
+        if self.logger is not None:
+            self.logger.info(
+                "drain target %s did not leave in %.1fs: declaring dead",
+                target, self.LEAVE_TIMEOUT,
+            )
+        c.declare_dead(target)
+
+    def _drain_overrides(self, target: str) -> tuple[dict, dict, int]:
+        """The moving step's plan: every (index, shard) group the
+        target owns gets an override with the target replaced by the
+        least-loaded live node not already an owner (or simply removed
+        when every live node already replicates it). Existing overrides
+        and splits are preserved minus the target; a split whose ranges
+        named the target is un-split (union routing resumes). Returns
+        (override table, ranges table, groups moved off)."""
+        c = self.cluster
+        with c._lock:
+            live = sorted(
+                i for i, n in c.nodes.items()
+                if i != target and n.state != STATE_DEGRADED
+            )
+        if not live:
+            raise RuntimeError("no live node to receive the drain")
+
+        # group universe: local fragments ∪ announced shards ∪ peer
+        # catalogs — the same union the resize planner sees
+        shards_by_index: dict[str, set[int]] = {}
+        holder = c.holder
+        if holder is not None:
+            for index_name, idx in list(holder.indexes.items()):
+                shards: set[int] = set()
+                for field in list(idx.fields.values()):
+                    for view in list(field.views.values()):
+                        shards.update(int(s) for s in view.fragments)
+                shards.update(c.get_known_shards(index_name))
+                for _f, _v, s, _node in c._peer_fragment_entries(
+                        index_name):
+                    shards.add(int(s))
+                shards_by_index[index_name] = shards
+
+        table = dict(c.placement.snapshot())
+        ranges = dict(c.placement.ranges_snapshot())
+
+        # seed receiver balance with current ownership so the drain
+        # doesn't pile every group onto one node
+        load = dict.fromkeys(live, 0)
+        for index_name, shards in shards_by_index.items():
+            for shard in shards:
+                for n in c.shard_nodes(index_name, shard):
+                    if n.id in load:
+                        load[n.id] += 1
+
+        groups = 0
+        for index_name, shards in sorted(shards_by_index.items()):
+            for shard in sorted(shards):
+                owners = [n.id for n in c.shard_nodes(index_name, shard)]
+                if target not in owners:
+                    continue
+                groups += 1
+                candidates = [i for i in live if i not in owners]
+                if candidates:
+                    repl = min(candidates, key=lambda i: load[i])
+                    load[repl] += 1
+                    new_owners = tuple(
+                        repl if i == target else i for i in owners)
+                else:  # every live node already replicates this group
+                    new_owners = tuple(
+                        i for i in owners if i != target)
+                if new_owners:
+                    table[(index_name, int(shard))] = new_owners
+
+        # scrub the target from anything the walk above didn't touch
+        for key, ids in list(table.items()):
+            if target in ids:
+                remaining = tuple(i for i in ids if i != target)
+                if remaining:
+                    table[key] = remaining
+                else:
+                    del table[key]
+        for key, spans in list(ranges.items()):
+            if any(target in ids for _lo, _hi, ids in spans):
+                del ranges[key]  # un-split: union/hash routing resumes
+        return table, ranges, groups

@@ -153,6 +153,88 @@ def plan_moves(shard_heat: dict, owners_of, node_ids, *,
     return moves
 
 
+def plan_splits(shard_heat: dict, owners_of, node_ids, current_ranges,
+                *, split_threshold: float, split_ways: int = 2,
+                shard_width: int | None = None) -> tuple[list[dict], list]:
+    """Sub-shard range planning (elastic plane). Pure, like plan_moves.
+
+    Placement moves cannot help ONE pathologically hot (index, shard):
+    wherever it lands, that node is the tail. A split spreads it by
+    keying sub-shard COLUMN ranges to distinct owners, while the
+    whole-shard override is widened to the UNION of range owners — so
+    every range owner holds the full fragment (durability unchanged,
+    replica routing spreads the load) and range-unaware peers compute
+    identical data placement from the override alone.
+
+    ``split_threshold``: a shard whose heat alone exceeds
+    ``split_threshold × mean node load`` is split; ≤ 0 disables.
+    ``current_ranges``: {(index, shard): spans} already split — a split
+    shard whose heat cools below HALF the threshold (hysteresis) is
+    merged back (returned in ``merges``); still-hot ones are left
+    alone.
+
+    Returns ``(splits, merges)``: splits are ``{"index", "shard",
+    "heat", "spans": [(lo, hi, (owner,)), ...], "owners": [union]}``
+    hottest-first, merges are (index, shard) keys to un-split."""
+    if shard_width is None:
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        shard_width = SHARD_WIDTH
+    node_ids = sorted(set(node_ids))
+    current_ranges = dict(current_ranges or {})
+    if split_threshold <= 0 or len(node_ids) < 2:
+        return [], sorted(current_ranges)
+
+    # per-node loads, same attribution as plan_moves (replicas share)
+    loads = dict.fromkeys(node_ids, 0.0)
+    for key, heat in shard_heat.items():
+        own = [i for i in (owners_of(*key) or []) if i in loads]
+        if not own or heat <= 0:
+            continue
+        for node_id in own:
+            loads[node_id] += float(heat) / len(own)
+    mean = sum(loads.values()) / len(node_ids)
+    if mean <= 0:
+        return [], sorted(current_ranges)
+    hot_cut = split_threshold * mean
+
+    splits: list[dict] = []
+    for key, heat in sorted(shard_heat.items(),
+                            key=lambda kv: kv[1], reverse=True):
+        if key in current_ranges or heat <= hot_cut:
+            continue
+        own = [i for i in (owners_of(*key) or []) if i in node_ids]
+        if not own:
+            continue
+        ways = max(2, min(int(split_ways), len(node_ids)))
+        # spread order: current owners keep their ranges first (no data
+        # movement for them), then least-loaded non-owners fill out the
+        # fan — the union grows, it NEVER shrinks below current owners
+        extra = sorted((i for i in node_ids if i not in own),
+                       key=lambda i: loads[i])
+        spread = (own + extra)[:ways]
+        if len(spread) < 2:
+            continue  # cannot spread: every node already an owner of 1
+        step = shard_width // len(spread)
+        spans = [
+            (i * step,
+             shard_width if i == len(spread) - 1 else (i + 1) * step,
+             (spread[i],))
+            for i in range(len(spread))
+        ]
+        union = own + [i for i in spread if i not in own]
+        splits.append({"index": key[0], "shard": key[1],
+                       "heat": round(float(heat), 3),
+                       "spans": spans, "owners": union})
+
+    # hysteresis merge: a split shard that cooled below half the cut
+    merges = sorted(
+        key for key in current_ranges
+        if shard_heat.get(key, 0.0) < hot_cut / 2.0
+    )
+    return splits, merges
+
+
 def shaped_move_budget(max_moves: int, pacer, interval_s: float,
                        est_move_bytes: int = NOMINAL_MOVE_BYTES) -> int:
     """Per-pass move budget shaped by the RepairPacer: never schedule
@@ -181,6 +263,8 @@ class Autopilot:
                  heat_budget: float = DEFAULT_HEAT_BUDGET,
                  max_moves: int = DEFAULT_MAX_MOVES,
                  min_dwell_s: float | None = None,
+                 split_threshold: float = 0.0,
+                 split_ways: int = 2,
                  pacer=None, logger=None):
         if heat is None:
             from pilosa_tpu.storage.heat import global_heat
@@ -198,6 +282,9 @@ class Autopilot:
         self.min_dwell_s = (float(min_dwell_s)
                             if min_dwell_s is not None and min_dwell_s > 0
                             else max(2 * self.interval_s, 1.0))
+        # sub-shard split/merge (elastic plane): 0 keeps splits off
+        self.split_threshold = float(split_threshold)
+        self.split_ways = int(split_ways)
         self.pacer = pacer
         self.logger = logger
         self._lock = threading.Lock()
@@ -209,6 +296,8 @@ class Autopilot:
         self.plans = 0
         self.moves_planned = 0
         self.moves_executed = 0
+        self.splits_executed = 0
+        self.merges_executed = 0
         self.prunes = 0
         self.skips: dict[str, int] = {}
         self.last_pass_s = 0.0
@@ -288,6 +377,13 @@ class Autopilot:
                 return self._skip("degraded")
             if c.state != STATE_NORMAL:
                 return self._skip("not-normal")
+            if getattr(c, "drain_active", False):
+                # one coordinated actuator per epoch: a drain owns the
+                # placement table until it terminates — planning now
+                # would mint dueling resizes (and vice versa: a drain
+                # refuses to start while a resize is in flight). The
+                # skip reason is visible on /debug/autopilot.
+                return self._skip("drain-in-flight")
             with c._lock:
                 node_ids = sorted(c.nodes)
                 peers = [n for n in c.nodes.values()
@@ -318,11 +414,15 @@ class Autopilot:
                 budget = max(1, budget // 2) if budget else 0
 
             now = time.monotonic()
+            current_ranges = c.placement.ranges_snapshot()
             with self._lock:
                 if len(self._moved_at) > self.MAX_TRACKED:
                     self._moved_at.clear()
                 frozen = {k for k, t in self._moved_at.items()
                           if now - t < self.min_dwell_s}
+            # a range-split shard never MOVES: relocating one owner of
+            # a split would desync the range map from the override
+            frozen |= set(current_ranges)
             moves = plan_moves(
                 shard_heat,
                 owners_of=lambda i, s: [n.id
@@ -332,13 +432,28 @@ class Autopilot:
                 max_moves=budget,
                 frozen=frozen,
             )
+            splits, merges = [], []
+            if self.split_threshold > 0:
+                splits, merges = plan_splits(
+                    shard_heat,
+                    owners_of=lambda i, s: [n.id
+                                            for n in c.shard_nodes(i, s)],
+                    node_ids=node_ids,
+                    current_ranges=current_ranges,
+                    split_threshold=self.split_threshold,
+                    split_ways=self.split_ways,
+                )
+                splits = [s for s in splits
+                          if (s["index"], s["shard"]) not in frozen][:1]
+                # one split per pass: each rides its own resize, and
+                # the hysteresis merge needs settled heat to judge
             self.plans += 1
             self.moves_planned += len(moves)
 
             # assemble the new table: current overrides, minus entries
             # gone stale (departed owners — hash placement already
             # resumed for them, materialize it) or redundant (equal to
-            # the hash walk), plus this pass's moves
+            # the hash walk), plus this pass's moves and splits
             live = set(node_ids)
             table = {}
             pruned = 0
@@ -358,35 +473,75 @@ class Autopilot:
                 else:
                     table[key] = tuple(m["owners"])
 
-            if not moves and not pruned:
+            # ranges: keep live splits, drop merged/stale ones, add new
+            ranges = {}
+            range_prunes = 0
+            for key, spans in current_ranges.items():
+                if key in merges:
+                    range_prunes += 1
+                    continue
+                span_owners = {i for _, _, ids in spans for i in ids}
+                if not span_owners <= live:
+                    # a range owner departed: un-split (union routing
+                    # already resumed via shard_nodes' fallback)
+                    range_prunes += 1
+                    table.pop(key, None)
+                    continue
+                ranges[key] = spans
+            for s in splits:
+                key = (s["index"], s["shard"])
+                ranges[key] = tuple(s["spans"])
+                # the mixed-version contract: a split ALWAYS installs
+                # its union owners as the whole-shard override
+                table[key] = tuple(s["owners"])
+            if merges:
+                for key in merges:
+                    table.pop(key, None)  # back to hash/override home
+
+            if not moves and not pruned and not splits \
+                    and not range_prunes:
                 return self._skip("in-budget")
 
-            epoch = c.apply_placement(table)
+            epoch = c.apply_placement(table, ranges=ranges)
             if not epoch:
                 return self._skip("no-quorum")
             with self._lock:
                 for m in moves:
                     self._moved_at[(m["index"], m["shard"])] = now
+                for s in splits:
+                    self._moved_at[(s["index"], s["shard"])] = now
             self.moves_executed += len(moves)
-            self.prunes += pruned
+            self.splits_executed += len(splits)
+            self.merges_executed += len(merges)
+            self.prunes += pruned + range_prunes
             if self.logger is not None:
                 self.logger.info(
-                    "autopilot epoch %d: %d move(s), %d pruned, "
-                    "burn %.2f, budget %d: %s",
-                    epoch, len(moves), pruned, burn, budget,
+                    "autopilot epoch %d: %d move(s), %d split(s), "
+                    "%d merge(s), %d pruned, burn %.2f, budget %d: %s",
+                    epoch, len(moves), len(splits), len(merges),
+                    pruned + range_prunes, burn, budget,
                     [f"{m['index']}/{m['shard']} {m['from']}→{m['to']}"
-                     for m in moves],
+                     for m in moves]
+                    + [f"split {s['index']}/{s['shard']} "
+                       f"×{len(s['spans'])}" for s in splits],
                 )
             record = {
                 "acted": True, "epoch": epoch, "moves": moves,
-                "pruned": pruned, "burn": round(burn, 3),
+                "splits": [{"index": s["index"], "shard": s["shard"],
+                            "heat": s["heat"],
+                            "spans": [[lo, hi, list(ids)]
+                                      for lo, hi, ids in s["spans"]]}
+                           for s in splits],
+                "merges": [list(k) for k in merges],
+                "pruned": pruned + range_prunes, "burn": round(burn, 3),
                 "budget": budget,
                 "heatGroups": len(shard_heat),
             }
             self._decisions.append({"at": time.time(), **record})
-            if moves:
+            if moves or splits:
                 # the actuator: new owners pull their fragments through
                 # the epoch-fenced resize, cleanup drops the old copies
+                # (a split's new union owners fetch the whole fragment)
                 c.coordinate_resize()
             return record
         finally:
@@ -408,6 +563,8 @@ class Autopilot:
             "autopilot_plans_total": self.plans,
             "autopilot_moves_planned_total": self.moves_planned,
             "autopilot_moves_executed_total": self.moves_executed,
+            "autopilot_splits_total": self.splits_executed,
+            "autopilot_merges_total": self.merges_executed,
             "autopilot_overrides_pruned_total": self.prunes,
             "autopilot_passes_skipped_total": skipped,
             "autopilot_placement_overrides": len(self.cluster.placement),
@@ -425,6 +582,8 @@ class Autopilot:
             "heatBudget": self.heat_budget,
             "maxMoves": self.max_moves,
             "minDwellS": self.min_dwell_s,
+            "splitThreshold": self.split_threshold,
+            "splitWays": self.split_ways,
             "actingCoordinator": self.cluster.is_acting_coordinator,
             "skips": dict(self.skips),
             "metrics": self.metrics(),

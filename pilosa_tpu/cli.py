@@ -92,6 +92,10 @@ autopilot-max-moves = 4       # shard-group moves per pass (further
                               # shaped by repair-max-bytes-per-sec)
 autopilot-min-dwell = 0.0     # seconds a moved shard is frozen before
                               # it may move again; 0 = two intervals
+autopilot-split-threshold = 0.0  # shard heat above this multiple of
+                              # mean node load splits the shard into
+                              # sub-shard column ranges; 0 = off
+autopilot-split-ways = 2      # ranges a hot shard is split into
 
 # Write-path durability (docs/OPERATIONS.md): what an HTTP 200 on a
 # write means

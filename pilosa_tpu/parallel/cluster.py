@@ -57,8 +57,14 @@ DEAD_HEARTBEATS = 3
 # and monotonic, and fencing them would wedge mixed-epoch metadata.
 FENCED_MESSAGES = frozenset(
     {"cluster-state", "resize-instruction", "resize-cleanup",
-     "node-leave", "placement-update"}
+     "node-leave", "placement-update", "drain-update", "drain-leave"}
 )
+
+# Drain state machine (autopilot/elastic.py): the states a drain record
+# moves through, gossiped cluster-wide so any failover coordinator can
+# resume mid-drain. ACTIVE states block a second coordinated actuator
+# (autopilot pass, another drain) from minting dueling resizes.
+DRAIN_ACTIVE_STATES = frozenset({"pending", "moving", "handoff", "leaving"})
 
 
 class ClusterDegradedError(Exception):
@@ -107,6 +113,17 @@ class PlacementTable:
     def __init__(self, path: str | None = None, logger=None):
         self._lock = threading.Lock()
         self._overrides: dict[tuple[str, int], tuple[str, ...]] = {}
+        # Sub-shard range splits (elastic plane): (index, shard) →
+        # ((lo, hi, owner-ids), ...) column ranges, sorted by lo. A
+        # split ALWAYS travels with a whole-shard override equal to the
+        # union of its range owners, so an override-unaware (older)
+        # peer — whose from_wire drops the separate "ranges" key —
+        # computes the identical data placement from overrides alone;
+        # ranges only refine which owner a range-aware reader PREFERS.
+        # Empty ⇒ byte-identical to the plain override/hash behavior.
+        self._ranges: dict[
+            tuple[str, int], tuple[tuple[int, int, tuple[str, ...]], ...]
+        ] = {}
         self.epoch = 0
         self._path = path
         self.logger = logger
@@ -128,21 +145,58 @@ class PlacementTable:
         with self._lock:
             return dict(self._overrides)
 
-    def replace(self, overrides: dict, epoch: int) -> bool:
+    def get_ranges(self, index: str, shard: int
+                   ) -> tuple[tuple[int, int, tuple[str, ...]], ...] | None:
+        with self._lock:
+            return self._ranges.get((index, int(shard)))
+
+    def ranges_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._ranges)
+
+    @property
+    def range_count(self) -> int:
+        with self._lock:
+            return sum(len(rs) for rs in self._ranges.values())
+
+    @staticmethod
+    def _clean_ranges(ranges) -> dict:
+        cleaned: dict[
+            tuple[str, int], tuple[tuple[int, int, tuple[str, ...]], ...]
+        ] = {}
+        for (index, shard), spans in (ranges or {}).items():
+            rs = []
+            for lo, hi, ids in spans or ():
+                lo, hi = int(lo), int(hi)
+                ids = tuple(str(i) for i in ids)
+                if lo < hi and ids:
+                    rs.append((lo, hi, ids))
+            if rs:
+                rs.sort(key=lambda r: r[0])
+                cleaned[(str(index), int(shard))] = tuple(rs)
+        return cleaned
+
+    def replace(self, overrides: dict, epoch: int,
+                ranges: dict | None = None) -> bool:
         """Install a whole new table stamped ``epoch``. Applies only
         when the stamp beats the current one (strictly newer — the
         coordinator mints a fresh epoch per change, so ties mean a
-        duplicate delivery of the same table). Returns applied?"""
+        duplicate delivery of the same table). ``ranges`` rides the
+        same stamp: a table replaced without them (an older coordinator
+        or a plain move plan) drops every split — correct, because the
+        matching union overrides are gone too. Returns applied?"""
         cleaned: dict[tuple[str, int], tuple[str, ...]] = {}
         for (index, shard), ids in (overrides or {}).items():
             ids = tuple(str(i) for i in ids)
             if ids:
                 cleaned[(str(index), int(shard))] = ids
+        cleaned_ranges = self._clean_ranges(ranges)
         with self._lock:
             if int(epoch) <= self.epoch:
                 self.updates_rejected += 1
                 return False
             self._overrides = cleaned
+            self._ranges = cleaned_ranges
             self.epoch = int(epoch)
             self.updates_applied += 1
             self._persist_locked()
@@ -170,12 +224,45 @@ class PlacementTable:
                 out[key] = ids
         return out
 
+    @staticmethod
+    def wire_ranges(ranges: dict) -> list[dict]:
+        return [
+            {"index": index, "shard": shard,
+             "spans": [{"lo": lo, "hi": hi, "nodes": list(ids)}
+                       for lo, hi, ids in spans]}
+            for (index, shard), spans in sorted(ranges.items())
+        ]
+
+    @staticmethod
+    def ranges_from_wire(entries) -> dict:
+        out: dict = {}
+        for e in entries or []:
+            try:
+                key = (str(e["index"]), int(e["shard"]))
+                spans = tuple(
+                    (int(s["lo"]), int(s["hi"]),
+                     tuple(str(i) for i in s.get("nodes", [])))
+                    for s in e.get("spans", [])
+                )
+            except (KeyError, TypeError, ValueError):
+                continue  # one malformed entry must not poison the rest
+            spans = tuple(s for s in spans if s[0] < s[1] and s[2])
+            if spans:
+                out[key] = spans
+        return out
+
     def to_json(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "epoch": self.epoch,
                 "overrides": self.wire_entries(self._overrides),
             }
+            if self._ranges:
+                # separate key: an override-unaware peer's from_wire
+                # ignores it and still computes identical placement
+                # from the union overrides above
+                out["ranges"] = self.wire_ranges(self._ranges)
+            return out
 
     # ------------------------------------------------------ persistence
 
@@ -195,6 +282,7 @@ class PlacementTable:
             d = json.loads(raw)
             epoch = int(d.get("epoch", 0) or 0)
             overrides = self.from_wire(d.get("overrides", []))
+            ranges = self.ranges_from_wire(d.get("ranges", []))
         except (ValueError, TypeError, AttributeError):
             # corrupt/torn file: start empty, re-adopt from gossip —
             # an override table is always reconstructible cluster state
@@ -205,6 +293,7 @@ class PlacementTable:
                 )
             return
         self._overrides = overrides
+        self._ranges = ranges
         self.epoch = epoch
 
     def _persist_locked(self) -> None:
@@ -213,11 +302,13 @@ class PlacementTable:
         import json
 
         tmp = self._path + ".tmp"
+        payload = {"epoch": self.epoch,
+                   "overrides": self.wire_entries(self._overrides)}
+        if self._ranges:
+            payload["ranges"] = self.wire_ranges(self._ranges)
         try:
             with open(tmp, "w") as f:
-                json.dump({"epoch": self.epoch,
-                           "overrides": self.wire_entries(self._overrides)},
-                          f)
+                json.dump(payload, f)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._path)
@@ -353,6 +444,22 @@ class Cluster:
         self.quorum_denials = 0
         self.rejoins = 0
         self.cleanups_deferred = 0
+        # ---- elastic membership plane (autopilot/elastic.py) ----
+        # The cluster-wide drain record: epoch-stamped at drain start,
+        # rev-bumped per state change, gossiped via /status and
+        # drain-update messages so a failover coordinator resumes the
+        # state machine where the dead one left it. Empty = no drain
+        # has ever run.
+        self.drain_record: dict = {}
+        # True on the drain TARGET while its groups move off (and after
+        # it has left the ring): writes shed 503 with the "draining"
+        # qos reason, reads keep serving the tail.
+        self.draining = False
+        # join-absorption counters: heat-ordered warm fetches and the
+        # byte-verify outcomes of the gated self-join path
+        self.warm_heat_ordered = 0
+        self.warm_verified = 0
+        self.warm_verify_failed = 0
 
     @property
     def state(self) -> str:
@@ -467,36 +574,142 @@ class Cluster:
         if epoch <= self.placement.epoch:
             return False  # cheap pre-check; replace() re-checks locked
         overrides = PlacementTable.from_wire(d.get("overrides", []))
-        applied = self.placement.replace(overrides, epoch)
+        ranges = PlacementTable.ranges_from_wire(d.get("ranges", []))
+        applied = self.placement.replace(overrides, epoch, ranges=ranges)
         if applied and self.logger is not None:
             self.logger.info(
-                "%s adopted placement table epoch %d (%d overrides)",
-                self.local.id, epoch, len(overrides),
+                "%s adopted placement table epoch %d (%d overrides, "
+                "%d split shards)",
+                self.local.id, epoch, len(overrides), len(ranges),
             )
         return applied
 
-    def apply_placement(self, overrides: dict) -> int:
+    def apply_placement(self, overrides: dict,
+                        ranges: dict | None = None) -> int:
         """Coordinator-side install of a new override table, the
         autopilot's single actuator: quorum-gated, epoch-minted (so the
         broadcast fences above every stale copy), persisted, and pushed
         to every peer. The caller then drives coordinate_resize() — new
         owners pull their fragments through the existing epoch-fenced
         machinery and the post-resize cleanup drops the old copies.
-        Returns the minted epoch, or 0 when refused (not coordinator /
-        no quorum)."""
+        ``ranges`` carries sub-shard splits (each split's union owners
+        MUST also appear as a whole-shard override — the planner and
+        drain both enforce it — so range-unaware peers compute the same
+        data placement). Returns the minted epoch, or 0 when refused
+        (not coordinator / no quorum)."""
         if not self.is_acting_coordinator:
             return 0
         if len(self.nodes) > 1 and not self.check_quorum():
             return 0
         epoch = self._bump_epoch()
         self._note_acted(epoch, "placement-update")
-        self.placement.replace(overrides, epoch)
-        self._broadcast({
+        self.placement.replace(overrides, epoch, ranges=ranges)
+        message = {
             "type": "placement-update", "epoch": epoch,
             "overrides": PlacementTable.wire_entries(
                 self.placement.snapshot()),
-        })
+        }
+        range_snapshot = self.placement.ranges_snapshot()
+        if range_snapshot:
+            message["ranges"] = PlacementTable.wire_ranges(range_snapshot)
+        self._broadcast(message)
         return epoch
+
+    # ------------------------------------------------------ drain record
+
+    @property
+    def drain_active(self) -> bool:
+        """A drain is in flight somewhere in the cluster: one
+        coordinated actuator at a time (autopilot skips, a second
+        drain is refused)."""
+        return self.drain_record.get("state") in DRAIN_ACTIVE_STATES
+
+    def set_drain(self, record: dict) -> None:
+        """Install + broadcast a drain record (coordinator side, or the
+        failover coordinator taking the state machine over). The record
+        is epoch-stamped once at drain start and rev-bumped per state
+        change, so adopt_drain orders copies without re-minting."""
+        with self._lock:
+            self.drain_record = dict(record)
+        self._apply_drain_side_effects()
+        # wire epoch is the CURRENT cluster epoch, not the record's
+        # minted-at-start epoch: the drain's own moving step bumps the
+        # cluster epoch (apply_placement + resize), and a later state
+        # advance stamped with the start epoch would be fenced as stale
+        # by every peer. Fencing guards against stale SENDERS; record
+        # ordering is (epoch, rev) inside adopt_drain.
+        self._broadcast({
+            "type": "drain-update",
+            "epoch": self.epoch,
+            "drain": dict(record),
+        })
+
+    def adopt_drain(self, d) -> bool:
+        """Apply a drain record seen on the wire (drain-update message,
+        a peer's /status, the join seed). Ordered by (epoch, rev) —
+        strictly newer wins; malformed copies are ignored."""
+        if not isinstance(d, dict) or not d:
+            return False
+        try:
+            key = (int(d.get("epoch", 0) or 0), int(d.get("rev", 0) or 0))
+        except (TypeError, ValueError):
+            return False
+        if key[0] <= 0:
+            return False
+        with self._lock:
+            cur = self.drain_record
+            cur_key = (int(cur.get("epoch", 0) or 0),
+                       int(cur.get("rev", 0) or 0))
+            if key <= cur_key:
+                return False
+            self.drain_record = dict(d)
+        self._apply_drain_side_effects()
+        return True
+
+    def _apply_drain_side_effects(self) -> None:
+        """Recompute the local ``draining`` latch from the current
+        record: the TARGET sheds writes through every active state and
+        stays shedding after "done" if it actually departed (_left) —
+        a drained node is read-only until decommissioned. A target that
+        never left (drain resolved via declare-dead, then the node
+        healed and rejoined) un-sheds on the terminal state, because it
+        is a full member again."""
+        with self._lock:
+            record = dict(self.drain_record)
+        if record.get("target") != self.local.id:
+            return
+        state = record.get("state")
+        was = self.draining
+        self.draining = (state in DRAIN_ACTIVE_STATES
+                         or (state == "done" and self._left))
+        if was != self.draining and self.logger is not None:
+            self.logger.info(
+                "%s drain latch -> %s (drain state %s)",
+                self.local.id, self.draining, state,
+            )
+
+    # ---------------------------------------------- departed-member CDC
+
+    def drop_departed_cursors(self, node_id: str) -> int:
+        """Drop WAL CDC cursors a permanently departed member
+        registered on this node's WAL (``tailer:<id>``,
+        ``follower:<id>``): a dead node's cursor would otherwise pin
+        WAL retention until force-reclaim. Called on node-leave (drain
+        handoff, graceful exit) and declare-dead; counted in the
+        ``wal_cdc_cursors_dropped_total`` metric."""
+        wal = getattr(self.holder, "wal", None) if self.holder else None
+        if wal is None:
+            return 0
+        drop = getattr(wal, "drop_cursors_for", None)
+        if drop is None:
+            return 0
+        dropped = drop(node_id)
+        if dropped and self.logger is not None:
+            self.logger.info(
+                "dropped %d CDC cursor(s) for departed member %s",
+                dropped, node_id,
+            )
+        return dropped
 
     # Epochs advance in strides, with each node minting into its own
     # hash slot: two coordinators acting CONCURRENTLY (possible in the
@@ -618,6 +831,14 @@ class Cluster:
             "cluster_cleanup_deferred_total": self.cleanups_deferred,
             "cluster_placement_overrides": len(self.placement),
             "cluster_placement_epoch": self.placement.epoch,
+            "cluster_placement_ranges": self.placement.range_count,
+            "elastic_drain_active": 1 if self.drain_active else 0,
+            "elastic_drain_epoch":
+                int(self.drain_record.get("epoch", 0) or 0),
+            "elastic_draining": 1 if self.draining else 0,
+            "elastic_warm_heat_ordered_total": self.warm_heat_ordered,
+            "elastic_warm_verified_total": self.warm_verified,
+            "elastic_warm_verify_failed_total": self.warm_verify_failed,
         }
 
     # How long the coordinator waits for every member to drain to NORMAL
@@ -961,7 +1182,11 @@ class Cluster:
         """Owners of one shard: the placement override when one applies
         (every listed owner a live member), else the pure hash walk.
         With an empty override table this is byte-identical to the
-        pre-autopilot placement — the mixed-version safety contract."""
+        pre-autopilot placement — the mixed-version safety contract.
+        A range-split shard resolves through its union override (the
+        planner installs both together), so data placement needs no
+        range awareness here; ranges refine READ preference only
+        (range_read_nodes)."""
         override = self.placement.get(index, shard)
         if override is not None:
             with self._lock:
@@ -972,6 +1197,25 @@ class Cluster:
             # a listed owner left the membership: hash placement
             # resumes for this shard until the planner re-plans
         return self.partition_nodes(self.partition(index, shard))
+
+    def range_read_nodes(self, index: str, shard: int,
+                         column_offset: int) -> list[Node] | None:
+        """Preferred readers for one column offset of a range-split
+        shard, or None when the shard has no (fully live) split. Every
+        range owner holds the WHOLE fragment (data placement is the
+        union override), so this is a routing refinement — a caller
+        that ignores it still reads correct bytes from any owner."""
+        spans = self.placement.get_ranges(index, shard)
+        if not spans:
+            return None
+        for lo, hi, ids in spans:
+            if lo <= column_offset < hi:
+                with self._lock:
+                    nodes = [self.nodes[i] for i in ids if i in self.nodes]
+                if len(nodes) == len(ids):
+                    return nodes
+                return None  # a range owner departed: union routing
+        return None
 
     def _shard_nodes_on(self, ring: list[Node], placement: dict,
                         index: str, shard: int) -> list[Node]:
@@ -1167,6 +1411,9 @@ class Cluster:
                     self._forgotten[removed.id] = removed.uri
                 self._heartbeat_failures.pop(message["id"], None)
             self._drop_resize_pending(message["id"])
+            if removed is not None:
+                # departed-member CDC: its cursors must not pin our WAL
+                self.drop_departed_cursors(message["id"])
             if self.is_acting_coordinator:
                 self._spawn_resize()
         elif kind == "create-shard":
@@ -1222,6 +1469,17 @@ class Cluster:
             # fenced above: a healed ex-coordinator's stale table was
             # already rejected; what reaches here is current-or-newer
             self.adopt_placement(message)
+        elif kind == "drain-update":
+            # fenced above; (epoch, rev) ordering inside adopt_drain
+            # handles same-epoch state advances
+            self.adopt_drain(message.get("drain"))
+        elif kind == "drain-leave":
+            # the drain coordinator finished moving this node's groups:
+            # leave the ring. Async — the coordinator's send must not
+            # block on our departure broadcast fan-out.
+            if message.get("node") == self.local.id:
+                threading.Thread(target=self.leave, daemon=True,
+                                 name="drain-leave").start()
         elif kind == "resize-progress":
             with self._resize_cv:
                 if message.get("job") == self._resize_job:
@@ -1335,10 +1593,11 @@ class Cluster:
                 peer_epoch = int(st.get("epoch", 0) or 0)
                 if peer_epoch > self.epoch:
                     self.adopt_epoch(peer_epoch)
-                # placement gossips with the heartbeat: a node that
-                # missed the placement-update broadcast (partitioned,
+                # placement + drain record gossip with the heartbeat: a
+                # node that missed the broadcast (partitioned,
                 # restarting) converges on the next probe round
                 self.adopt_placement(st.get("placement"))
+                self.adopt_drain(st.get("drain"))
                 peer_ids = {n.get("id") for n in st.get("nodes", [])}
                 if (peer_ids and self.local.id not in peer_ids
                         and (peer_epoch >= self.epoch
@@ -1443,6 +1702,8 @@ class Cluster:
         self.deaths_declared += 1
         self._note_acted(epoch, f"declare-dead:{node_id}")
         self._drop_resize_pending(node_id)
+        # a declared-dead member's CDC cursors must not pin retention
+        self.drop_departed_cursors(node_id)
         for node in self.sorted_nodes():
             if node.id == self.local.id:
                 continue
@@ -1511,6 +1772,7 @@ class Cluster:
                 self._note_membership_changed_locked()
             self.adopt_epoch(int(st.get("epoch", 0) or 0))
             self.adopt_placement(st.get("placement"))
+            self.adopt_drain(st.get("drain"))
             for node in self.sorted_nodes():
                 if node.id == self.local.id:
                     continue
@@ -1564,6 +1826,7 @@ class Cluster:
                 self._note_membership_changed_locked()
             self.adopt_epoch(int(via_status.get("epoch", 0) or 0))
             self.adopt_placement(via_status.get("placement"))
+            self.adopt_drain(via_status.get("drain"))
             self.degraded = False
             for node in self.sorted_nodes():
                 if node.id == self.local.id:
@@ -1597,8 +1860,10 @@ class Cluster:
         self.adopt_epoch(int(status.get("epoch", 0) or 0))
         # the placement table rides the same status payload: a joiner
         # must compute the SAME ownership as the members from its first
-        # resize-instruction onward
+        # resize-instruction onward; the drain record rides along so a
+        # joiner can immediately act as a failover drain coordinator
         self.adopt_placement(status.get("placement"))
+        self.adopt_drain(status.get("drain"))
         # Gate BEFORE announcing: the announce triggers the coordinator's
         # resize, whose post-resize cleanup waits for every member to
         # drain to NORMAL — this node must never be observable as NORMAL
@@ -1757,28 +2022,105 @@ class Cluster:
         """The fetch body, with the local-fetch gate already held;
         always releases it. A failure is logged loudly (the async join
         path has no caller to raise to) and leaves the gap to
-        anti-entropy repair."""
+        anti-entropy repair.
+
+        Join absorption (elastic plane): the inventory fetch is ordered
+        HOTTEST SHARD FIRST from the cluster heatmap — a joiner starts
+        holding the shards that matter to the serving tail instead of a
+        hash-random order — and every fetched fragment is byte-verified
+        (block checksums vs its source) before it may skip the
+        follow-on freshness diff. An unverified copy stays in the
+        diff's work list, so the query gate never releases a fragment
+        whose bytes were not either verified or block-diff repaired —
+        reads for a shard serve only once its copy is byte-verified
+        (the gate holds the whole node in RESIZING throughout)."""
         try:
             peer_entries = self._peer_entries_by_index()
             sources = self._owned_missing_sources(peer_entries)
+            if len(sources) > 1:
+                heat = self._cluster_shard_heat()
+                if heat:
+                    sources.sort(
+                        key=lambda s: heat.get(
+                            (s["index"], int(s["shard"])), 0.0),
+                        reverse=True,
+                    )
+                    self.warm_heat_ordered += len(sources)
             self.fetch_fragments(sources)
+            verified = self._verify_fetched(sources)
             # Freshness: fragments we ALREADY held may be stale from an
             # outage window (writes landed on replicas while this node
             # was away). Block-diff them against replicas before the
             # gate releases, so a rejoining node never serves the stale
             # window — the full fetch above covers only missing
-            # fragments (skipped here), a checksum-block diff is far
-            # cheaper than re-downloading every held payload, and the
-            # peer catalog walk is shared with the inventory above.
-            self.sync_holder(
-                peer_entries=peer_entries,
-                skip={(s["index"], s["field"], s["view"], s["shard"])
-                      for s in sources},
-            )
+            # fragments (the byte-verified ones skip here), a
+            # checksum-block diff is far cheaper than re-downloading
+            # every held payload, and the peer catalog walk is shared
+            # with the inventory above.
+            self.sync_holder(peer_entries=peer_entries, skip=verified)
         except Exception as e:  # noqa: BLE001 — must not die silently
             self._log_exception("self-join fragment fetch", e)
         finally:
             self._end_local_fetch()
+
+    def _cluster_shard_heat(self) -> dict:
+        """(index, shard) → heat merged from every reachable peer's
+        heatmap — the join-absorption warm order. Best-effort: an
+        unreachable peer (or a peer whose wire predates the heatmap
+        route) contributes nothing, and an empty result leaves the
+        fetch in catalog order."""
+        peers = [n for n in self.sorted_nodes() if n.id != self.local.id]
+        if not peers:
+            return {}
+        try:
+            from pilosa_tpu.storage.heat import merge_shard_heat
+        except Exception:  # noqa: BLE001 — heat plane absent
+            return {}
+
+        def one(node):
+            try:
+                return self.client.heatmap(
+                    node.uri, timeout=self.heartbeat_timeout,
+                ).get("shards", [])
+            except Exception:  # noqa: BLE001 — old wire / unreachable
+                return []
+
+        try:
+            return merge_shard_heat(concurrent_map(one, peers))
+        except Exception:  # noqa: BLE001 — malformed rows must not
+            return {}      # fail the join fetch
+
+    def _verify_fetched(self, sources: list[dict]) -> set:
+        """Byte-verify freshly fetched fragments against their primary
+        source: a fragment whose 100-row block checksums match is
+        warm-verified and may skip the follow-on freshness diff; a
+        mismatch (the source advanced mid-fetch, a torn transfer, a
+        fallback source supplied the bytes) or an unreachable source
+        keeps the fragment IN the diff, which repairs it block-by-block
+        before the gate releases."""
+        verified: set = set()
+        for src in sources:
+            key = (src["index"], src["field"], src["view"], src["shard"])
+            idx = self.holder.index(src["index"])
+            field = idx.field(src["field"]) if idx else None
+            view = field.view(src["view"]) if field is not None else None
+            frag = (view.fragment(int(src["shard"]))
+                    if view is not None else None)
+            local_blocks = dict(frag.blocks()) if frag is not None else {}
+            try:
+                peer_blocks = dict(self.client.fragment_blocks(
+                    src["from"], src["index"], src["field"], src["view"],
+                    int(src["shard"]),
+                ))
+            except ClientError:
+                self.warm_verify_failed += 1
+                continue  # unverifiable: leave it to the freshness diff
+            if local_blocks == peer_blocks:
+                verified.add(key)
+                self.warm_verified += 1
+            else:
+                self.warm_verify_failed += 1
+        return verified
 
     def fetch_fragments(self, sources: list[dict]) -> int:
         """Execute the receiving half of resize instructions: fetch and

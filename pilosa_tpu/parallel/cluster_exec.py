@@ -67,6 +67,10 @@ class ClusterExecutor:
         # (ServerConfig) restores per-query dispatch.
         self.remote_batch = remote_batch
         self._wave_batcher = None
+        # read rotation over a range-split shard's span owners (elastic
+        # plane): bumped per routed read; a lost increment under the
+        # benign unlocked race just repeats a pick
+        self._range_rr = 0
         self._shards_cache: dict[str, tuple[float, list[int]]] = {}
         self._lock = threading.Lock()
         # key translation goes through the coordinator (reference:
@@ -272,10 +276,31 @@ class ClusterExecutor:
             if any(n.id == self.cluster.local.id for n in nodes):
                 local.append(shard)
                 continue
-            live = [n for n in nodes if n.state == "NORMAL"] or nodes
-            target = live[0]
+            target = self._range_read_target(index_name, shard)
+            if target is None:
+                live = [n for n in nodes if n.state == "NORMAL"] or nodes
+                target = live[0]
             remote.setdefault(target.id, (target, []))[1].append(shard)
         return local, list(remote.values())
+
+    def _range_read_target(self, index_name: str, shard: int):
+        """Read-preference refinement for a range-split shard (elastic
+        plane): successive reads rotate across the split's span owners
+        — every one holds the WHOLE fragment through the union
+        override, so any pick reads correct bytes, and the rotation is
+        what spreads a hot single shard's read QPS after the planner
+        splits it. None for an unsplit shard (or a departed span
+        owner): the caller falls back to plain owner routing."""
+        spans = self.cluster.placement.get_ranges(index_name, shard)
+        if not spans:
+            return None
+        self._range_rr += 1
+        lo = spans[self._range_rr % len(spans)][0]
+        nodes = self.cluster.range_read_nodes(index_name, shard, lo)
+        if not nodes:
+            return None
+        live = [n for n in nodes if n.state == "NORMAL"]
+        return live[0] if live else None
 
     def _route_all_replicas(self, index_name: str, shards: list[int]):
         """Group shards by EVERY replica that holds them. Row-wide writes

@@ -27,6 +27,13 @@ from pilosa_tpu.qos.hedge import (
 )
 from pilosa_tpu.qos.slo import SLOEngine, SLOObjective
 
+# Canonical ``qos_shed`` reason label for writes refused on a draining
+# node (elastic plane): the target of an in-flight drain sheds writes
+# 503 while its shard groups move off, so no acked write can land on a
+# fragment mid-departure; reads keep serving the tail
+# (docs/OBSERVABILITY.md, docs/OPERATIONS.md elastic operations).
+SHED_REASON_DRAINING = "draining"
+
 __all__ = [
     "AdmissionController",
     "AdmissionError",
@@ -39,6 +46,7 @@ __all__ = [
     "DeadlineExceeded",
     "HedgePolicy",
     "LatencyTracker",
+    "SHED_REASON_DRAINING",
     "SLOEngine",
     "SLOObjective",
     "ServingQos",

@@ -174,6 +174,11 @@ class API:
         # feed and rejects writes).
         self.cdc = None
         self.follower = None
+        # elastic membership plane (autopilot/elastic.py): Server.open
+        # wires an ElasticManager on every clustered node — graceful
+        # drain must work with the autopilot ticker off. None on a bare
+        # API (no server), where drain endpoints answer 503.
+        self.elastic = None
         # declared follower staleness budget in seconds (cdc-staleness-
         # budget knob); a request's X-Pilosa-Max-Staleness header wins
         # when tighter
@@ -758,6 +763,7 @@ class API:
         mirror from its upstream."""
         self._check_not_follower()
         self._check_not_storage_degraded()
+        self._check_not_draining()
         cluster = self.cluster
         if cluster is None or not getattr(cluster, "degraded", False):
             return
@@ -766,6 +772,29 @@ class API:
             "this node until the partition heals; locally-owned reads "
             "still serve"
         )
+
+    def _check_not_draining(self) -> None:
+        """Shed edge writes on the target of an in-flight drain
+        (elastic plane): its shard groups are moving off, and an acked
+        write landing mid-departure is exactly the lost-write window
+        the drain closes by shedding FIRST. Reads keep serving the
+        tail. 503 + Retry-After with the ``draining`` qos_shed
+        reason."""
+        cluster = self.cluster
+        if cluster is None or not getattr(cluster, "draining", False):
+            return
+        from pilosa_tpu.qos import SHED_REASON_DRAINING
+        from pilosa_tpu.utils.stats import global_stats
+
+        global_stats().count("qos_shed", 1,
+                             {"reason": SHED_REASON_DRAINING})
+        err = ApiError(
+            "node is draining: writes are shed while its shard groups "
+            "move off; reads still serve until the drain completes",
+            503,
+        )
+        err.retry_after = 5.0
+        raise err
 
     def _check_not_storage_degraded(self) -> None:
         """503 + Retry-After while the disk is sick (a failed WAL
@@ -1439,6 +1468,11 @@ class API:
             # to the pre-autopilot wire format.
             if self.cluster.placement.epoch > 0:
                 out["placement"] = self.cluster.placement.to_json()
+            # the drain record gossips the same way (elastic plane):
+            # omitted until a drain has ever run, so the common wire
+            # stays byte-identical
+            if self.cluster.drain_record.get("epoch"):
+                out["drain"] = dict(self.cluster.drain_record)
         else:
             out = {
                 "state": "NORMAL",
@@ -1503,7 +1537,73 @@ class API:
             "cluster_quorum_denials_total": 0,
             "cluster_rejoins_total": 0,
             "cluster_cleanup_deferred_total": 0,
+            "cluster_placement_overrides": 0,
+            "cluster_placement_epoch": 0,
+            "cluster_placement_ranges": 0,
+            "elastic_drain_active": 0,
+            "elastic_drain_epoch": 0,
+            "elastic_draining": 0,
+            "elastic_warm_heat_ordered_total": 0,
+            "elastic_warm_verified_total": 0,
+            "elastic_warm_verify_failed_total": 0,
         }
+
+    def elastic_metrics(self) -> dict:
+        """elastic_* drain series for /metrics and /debug/vars — zeros
+        with no manager wired, so the series exist from scrape one."""
+        if self.elastic is not None:
+            return self.elastic.metrics()
+        return {
+            "elastic_drains_started_total": 0,
+            "elastic_drains_completed_total": 0,
+            "elastic_drains_failed_total": 0,
+            "elastic_drains_aborted_total": 0,
+            "elastic_drains_resumed_total": 0,
+            "elastic_cursor_handoffs_total": 0,
+            "elastic_drain_active": 0,
+            "elastic_drain_epoch": 0,
+        }
+
+    def elastic_json(self) -> dict:
+        """GET /debug/elastic: the drain state machine inspector."""
+        if self.elastic is not None:
+            return {"enabled": True, **self.elastic.to_json()}
+        out = {"enabled": False, "drain": {}, "active": False,
+               "draining": False, "metrics": self.elastic_metrics()}
+        if self.cluster is not None:
+            out["placement"] = self.cluster.placement.to_json()
+        return out
+
+    def drain_start(self, node: str) -> dict:
+        """POST /cluster/drain/<node>: begin a coordinator-driven
+        graceful drain of ``node`` (docs/OPERATIONS.md elastic
+        operations runbook)."""
+        from pilosa_tpu.autopilot.elastic import ElasticError
+
+        if self.elastic is None:
+            raise ApiError("elastic plane not wired on this node", 503)
+        try:
+            return self.elastic.start_drain(node)
+        except ElasticError as e:
+            raise ApiError(str(e), e.status)
+
+    def drain_abort(self) -> dict:
+        """DELETE /cluster/drain: abort the in-flight drain (the target
+        un-sheds; already-moved groups stay where they landed)."""
+        from pilosa_tpu.autopilot.elastic import ElasticError
+
+        if self.elastic is None:
+            raise ApiError("elastic plane not wired on this node", 503)
+        try:
+            return self.elastic.abort_drain()
+        except ElasticError as e:
+            raise ApiError(str(e), e.status)
+
+    def drain_status(self) -> dict:
+        """GET /cluster/drain: the drain record + latches."""
+        if self.elastic is not None:
+            return self.elastic.status()
+        return {"drain": {}, "active": False, "draining": False}
 
     def observability_metrics(self) -> dict:
         """Tracing / inspector / slow-query series for /metrics and
@@ -1668,6 +1768,8 @@ class API:
             "autopilot_plans_total": 0,
             "autopilot_moves_planned_total": 0,
             "autopilot_moves_executed_total": 0,
+            "autopilot_splits_total": 0,
+            "autopilot_merges_total": 0,
             "autopilot_overrides_pruned_total": 0,
             "autopilot_passes_skipped_total": 0,
             "autopilot_placement_overrides":
@@ -1879,8 +1981,19 @@ class API:
             }
         }
 
-    def shard_nodes(self, index: str, shard: int) -> list[dict]:
+    def shard_nodes(self, index: str, shard: int,
+                    col: int | None = None) -> list[dict]:
         if self.cluster:
+            if col is not None:
+                # range-split refinement (elastic plane): a shard-aware
+                # client asking with a column gets the span owners
+                # preferred for that column's range; every span owner
+                # holds the whole fragment, so the fallback below is
+                # always correct too
+                nodes = self.cluster.range_read_nodes(
+                    index, shard, int(col) - shard * SHARD_WIDTH)
+                if nodes:
+                    return [n.to_json() for n in nodes]
             return self.cluster.shard_nodes_json(index, shard)
         return [{"id": "local", "uri": "localhost"}]
 

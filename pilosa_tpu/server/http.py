@@ -76,6 +76,10 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/heatmap$"), "get_heatmap"),
     ("GET", re.compile(r"^/debug/rescache$"), "get_rescache"),
     ("GET", re.compile(r"^/debug/autopilot$"), "get_autopilot"),
+    ("GET", re.compile(r"^/debug/elastic$"), "get_elastic"),
+    ("POST", re.compile(r"^/cluster/drain/([^/]+)$"), "post_drain"),
+    ("DELETE", re.compile(r"^/cluster/drain$"), "delete_drain"),
+    ("GET", re.compile(r"^/cluster/drain$"), "get_drain"),
     ("GET", re.compile(r"^/debug/slo$"), "get_slo"),
     ("GET", re.compile(r"^/debug/workers$"), "get_workers"),
     ("GET", re.compile(r"^/debug/queries$"), "get_inflight_queries"),
@@ -758,6 +762,12 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # node still adopts overrides minted by the coordinator
         text += prometheus_block(self.api.autopilot_metrics(), prefix,
                                  seen=seen)
+        # elastic membership plane (docs/OPERATIONS.md elastic
+        # operations): drain state-machine counters plus join warm-up
+        # heat-ordering/byte-verify counters — zeros from scrape one;
+        # the drain gauges stay live on every node via record gossip
+        text += prometheus_block(self.api.elastic_metrics(), prefix,
+                                 seen=seen)
         # write-path durability (group-commit WAL): zeros from scrape
         # one, same rate()-window reasoning as the blocks around it
         text += prometheus_block(self.api.durability_metrics(), prefix,
@@ -971,6 +981,30 @@ class HTTPHandler(BaseHTTPRequestHandler):
                           else {"epoch": 0, "overrides": []}),
         })
 
+    def get_elastic(self, query=None):
+        """Elastic-plane inspector (docs/OPERATIONS.md elastic
+        operations): the drain state machine record, join warm-up
+        counters, and the range-keyed placement table — readable on
+        every node because the drain record gossips with the epoch."""
+        self._json(self.api.elastic_json())
+
+    def post_drain(self, node, query=None):
+        """Start a coordinator-driven graceful drain of ``node``:
+        mints an epoch, moves every shard group the target owns, hands
+        off its CDC cursors, then removes it from the ring."""
+        self._body()  # drain unread bytes: keep-alive reuse
+        self._json(self.api.drain_start(node))
+
+    def delete_drain(self, query=None):
+        """Abort the in-flight drain (coordinator only): stamps the
+        record aborted so the worker stops at its next state check."""
+        self._body()
+        self._json(self.api.drain_abort())
+
+    def get_drain(self, query=None):
+        """Drain state machine record plus active/draining flags."""
+        self._json(self.api.drain_status())
+
     def get_slo(self, query=None):
         """Declared objectives with per-window burn rates and breach
         flags (docs/OBSERVABILITY.md)."""
@@ -1030,6 +1064,7 @@ class HTTPHandler(BaseHTTPRequestHandler):
         snap["result_cache"] = self.api.rescache_metrics()
         snap["residency_tiering"] = self.api.tiering_metrics()
         snap["autopilot"] = self.api.autopilot_metrics()
+        snap["elastic"] = self.api.elastic_metrics()
         snap["durability"] = self.api.durability_metrics()
         snap["cdc"] = self.api.cdc_metrics()
         snap["integrity"] = self.api.integrity_metrics()
@@ -1087,7 +1122,11 @@ class HTTPHandler(BaseHTTPRequestHandler):
         shard_param = (query.get("shard") or [None])[0]
         if shard_param is None:
             raise ApiError("shard param required", 400)
-        self._json(self.api.shard_nodes(index, _int_param(shard_param, "shard")))
+        col_param = (query.get("col") or [None])[0]
+        self._json(self.api.shard_nodes(
+            index, _int_param(shard_param, "shard"),
+            col=(_int_param(col_param, "col")
+                 if col_param is not None else None)))
 
     def get_fragment_data(self, query=None):
         index = (query.get("index") or [""])[0]

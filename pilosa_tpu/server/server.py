@@ -81,6 +81,8 @@ class ServerConfig:
         autopilot_heat_budget: float = 1.5,
         autopilot_max_moves: int = 4,
         autopilot_min_dwell: float = 0.0,
+        autopilot_split_threshold: float = 0.0,
+        autopilot_split_ways: int = 2,
         cdc_enabled: bool = False,
         cdc_max_retention_bytes: int = 64 << 20,
         cdc_poll_interval: float = 0.05,
@@ -314,6 +316,23 @@ class ServerConfig:
                 f"invalid autopilot-min-dwell {autopilot_min_dwell!r} "
                 "(want >= 0; 0 = two intervals)"
             )
+        # Elastic sub-shard split/merge (docs/OPERATIONS.md elastic
+        # operations): a shard hotter than split-threshold x mean node
+        # load is split into split-ways column ranges spread across
+        # nodes; 0 disables the splitter (whole-shard placement only).
+        self.autopilot_split_threshold = float(autopilot_split_threshold)
+        if self.autopilot_split_threshold < 0:
+            raise ValueError(
+                f"invalid autopilot-split-threshold "
+                f"{autopilot_split_threshold!r} (want >= 0; 0 disables "
+                "sub-shard splits)"
+            )
+        self.autopilot_split_ways = int(autopilot_split_ways)
+        if self.autopilot_split_ways < 2:
+            raise ValueError(
+                f"invalid autopilot-split-ways {autopilot_split_ways!r} "
+                "(want >= 2: a split needs at least two ranges)"
+            )
         # CDC backbone (docs/OPERATIONS.md Replication & CDC):
         # cdc-enabled runs the peer tailer that makes cluster-edge
         # result caching safe; cdc-max-retention-bytes bounds how much
@@ -525,6 +544,14 @@ class ServerConfig:
             autopilot_min_dwell=_parse_duration(
                 d.get("autopilot-min-dwell", 0.0)
             ),
+            autopilot_split_threshold=float(
+                d.get("autopilot-split-threshold",
+                      d.get("autopilot_split_threshold", 0.0))
+            ),
+            autopilot_split_ways=int(
+                d.get("autopilot-split-ways",
+                      d.get("autopilot_split_ways", 2))
+            ),
             cdc_enabled=_parse_bool(d.get("cdc-enabled", False)),
             cdc_max_retention_bytes=int(
                 d.get("cdc-max-retention-bytes", 64 << 20)
@@ -604,6 +631,8 @@ class ServerConfig:
             "autopilot-heat-budget": self.autopilot_heat_budget,
             "autopilot-max-moves": self.autopilot_max_moves,
             "autopilot-min-dwell": self.autopilot_min_dwell,
+            "autopilot-split-threshold": self.autopilot_split_threshold,
+            "autopilot-split-ways": self.autopilot_split_ways,
             "cdc-enabled": self.cdc_enabled,
             "cdc-max-retention-bytes": self.cdc_max_retention_bytes,
             "cdc-poll-interval": self.cdc_poll_interval,
@@ -827,6 +856,15 @@ class Server:
                 logger=self.logger,
             )
             self.api.follower.start()
+        # Elastic membership plane (docs/OPERATIONS.md elastic
+        # operations): wired on every node — not just when autopilot is
+        # on — so whichever node is the acting coordinator can drive a
+        # drain, and can resume one adopted from a failed coordinator.
+        from pilosa_tpu.autopilot.elastic import ElasticManager
+
+        self.api.elastic = ElasticManager(
+            self.api.cluster, logger=self.logger
+        )
         if self.config.residency_promote_interval > 0:
             from pilosa_tpu.storage.heat import global_heat as _gh
             from pilosa_tpu.storage.residency import (
@@ -858,6 +896,8 @@ class Server:
                 heat_budget=self.config.autopilot_heat_budget,
                 max_moves=self.config.autopilot_max_moves,
                 min_dwell_s=self.config.autopilot_min_dwell or None,
+                split_threshold=self.config.autopilot_split_threshold,
+                split_ways=self.config.autopilot_split_ways,
                 pacer=self.api.cluster.client.pacer,
                 logger=self.logger,
             ).start()
@@ -973,6 +1013,9 @@ class Server:
         if self.api.autopilot is not None:
             self.api.autopilot.close()
             self.api.autopilot = None
+        if self.api.elastic is not None:
+            self.api.elastic.close()
+            self.api.elastic = None
         if self.api.tierer is not None:
             self.api.tierer.close()
             self.api.tierer = None
@@ -1028,6 +1071,12 @@ class Server:
             try:
                 if self.api.cluster is not None and len(self.api.cluster.nodes) > 1:
                     self.api.cluster.heartbeat()
+                    # drain resumption rides the heartbeat tick: if this
+                    # node became acting coordinator while a gossiped
+                    # drain record is still active, pick up the state
+                    # machine where the dead coordinator left it
+                    if self.api.elastic is not None:
+                        self.api.elastic.maybe_resume()
             except Exception as e:
                 self.logger.warning("heartbeat failed: %s", e)
             self._schedule_heartbeat()

@@ -271,6 +271,7 @@ class WriteAheadLog:
         self.cdc_forced_reclaims = 0
         self.tail_reads = 0
         self.tail_bytes = 0
+        self.cursors_dropped = 0
         # observability (metrics() exports zeros from scrape one)
         self.groups = 0
         self.fsyncs = 0
@@ -466,6 +467,22 @@ class WriteAheadLog:
     def drop_cursor(self, name: str) -> None:
         with self._seg_lock:
             self._cursors.pop(name, None)
+
+    def drop_cursors_for(self, node_id: str) -> int:
+        """Drop every cursor a departed member registered here —
+        names carry the owner as a ``:<node-id>`` suffix
+        (``tailer:<id>``, ``follower:<id>``). A permanently departed
+        node's cursor would otherwise pin WAL retention until
+        force-reclaim (the cursor-leak satellite of the elastic
+        plane). Returns the number dropped; counted in
+        ``cdc_cursors_dropped_total``."""
+        suffix = f":{node_id}"
+        with self._seg_lock:
+            names = [n for n in self._cursors if n.endswith(suffix)]
+            for n in names:
+                del self._cursors[n]
+            self.cursors_dropped += len(names)
+        return len(names)
 
     def cursors(self) -> dict[str, int]:
         with self._seg_lock:
@@ -934,6 +951,7 @@ class WriteAheadLog:
             "cdc_forced_reclaims_total": self.cdc_forced_reclaims,
             "cdc_tail_reads_total": self.tail_reads,
             "cdc_tail_bytes_total": self.tail_bytes,
+            "cdc_cursors_dropped_total": self.cursors_dropped,
             "groups_total": self.groups,
             "fsyncs_total": self.fsyncs,
             "appended_ops_total": self.appended_ops,

@@ -62,6 +62,7 @@ class ChaosHarness:
                  with_storage_faults: bool = False,
                  with_autopilot: bool = False,
                  with_cdc: bool = False,
+                 with_elastic: bool = False,
                  log=lambda msg: None):
         self.tmp_dir = str(tmp_dir)
         self.n_nodes = n_nodes
@@ -89,6 +90,14 @@ class ChaosHarness:
         # heal is byte-identical in the mirror once its cursor passes
         # n0's durable seq)
         self.with_cdc = with_cdc
+        # elastic-drain schedules (ISSUE 17): the bag gains a graceful
+        # drain of a random member, and kills/partitions then land MID-
+        # DRAIN — all six oracles must hold while shard groups move off
+        # the target, its CDC cursors hand off, and it leaves the ring;
+        # the finale aborts whatever drain is still in flight, retires
+        # nodes that departed, and restarts them as fresh joiners
+        self.with_elastic = with_elastic
+        self.drains_started = 0
         self.cdc_mirror = None
         self.cdc_mirror_holder = None
         self.autopilot_moves = 0
@@ -282,6 +291,11 @@ class ChaosHarness:
         for s in self._live():
             try:
                 s.api.cluster.heartbeat()
+                # chaos servers run heartbeat_interval=0 (the harness IS
+                # the ticker), so drain resumption after a coordinator
+                # kill rides this round exactly as the server tick would
+                if s.api.elastic is not None:
+                    s.api.elastic.maybe_resume()
             except Exception:  # noqa: BLE001 — a heartbeat pass racing
                 pass           # a concurrent kill must not abort the run
 
@@ -418,6 +432,79 @@ class ChaosHarness:
                         f"skip={record.get('reason')}")
         return "autopilot-skipped (no live coordinator)"
 
+    def _event_drain(self) -> str:
+        """Start a graceful drain of a random member through the acting
+        coordinator — subsequent bag events (kills, partitions, more
+        heartbeats) then land mid-drain, which is the point. Victims
+        exclude the coordinator (it drives the move) and, under
+        with_cdc, n0 (the mirror oracle compares against n0's holder).
+        Refusals (drain already in flight, degraded, too few nodes) are
+        the elastic plane's guardrails working; they log and move on."""
+        live = self._live()
+        if len(live) < 3:
+            return "drain-skipped (<3 live)"
+        coord = next((s for s in live
+                      if s.api.cluster.is_acting_coordinator), None)
+        if coord is None:
+            return "drain-skipped (no live coordinator)"
+        victims = sorted(
+            s.config.name for s in live
+            if s.config.name != coord.config.name
+            and not (self.with_cdc and s.config.name == "n0")
+        )
+        if not victims:
+            return "drain-skipped (no eligible victim)"
+        victim = self.rng.choice(victims)
+        try:
+            coord.api.elastic.start_drain(victim)
+        except Exception as e:  # noqa: BLE001 — guardrail refusals
+            return f"drain-refused {e}"
+        self.drains_started += 1
+        return f"drain {victim} (via {coord.config.name})"
+
+    def _settle_drains(self) -> None:
+        """Finale, step one: no drain may still be mutating placement
+        while the finale rebuilds full membership. Abort the active
+        record on the acting coordinator, then wait out every worker
+        thread (an abort is only observed at the worker's next state
+        advance)."""
+        for s in self._live():
+            c = s.api.cluster
+            if (c.is_acting_coordinator
+                    and getattr(c, "drain_active", False)):
+                try:
+                    s.api.elastic.abort_drain()
+                    self.log("  finale: drain-abort "
+                             f"{c.drain_record.get('target')}")
+                except Exception:  # noqa: BLE001 — already terminal
+                    pass
+                break
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            busy = [s for s in self._live()
+                    if s.api.elastic is not None
+                    and getattr(s.api.elastic, "_thread", None) is not None
+                    and s.api.elastic._thread.is_alive()]
+            if not busy:
+                return
+            time.sleep(0.1)
+
+    def _retire_departed(self) -> None:
+        """Finale, step two: a drained target LEFT the ring (its
+        ``_left`` latch refuses auto-rejoin), but its server object is
+        still running read-only. Retire it like a kill — harvest,
+        remember the port, close — so the restart loop below brings it
+        back as a fresh joiner and convergence reaches full membership."""
+        with self._lock:
+            departed = [name for name, s in self.servers.items()
+                        if getattr(s.api.cluster, "_left", False)]
+            retired = {name: self.servers.pop(name) for name in departed}
+        for name, server in retired.items():
+            self._harvest(server)
+            self.downed[name] = server.port
+            server.close()
+            self.log(f"  finale: retire-departed {name}")
+
     def run_schedule(self) -> dict:
         """Workload on, randomized events, then heal + converge and
         check every oracle. Returns the schedule's record."""
@@ -439,6 +526,8 @@ class ChaosHarness:
                         (self._event_disk_full, 2)]
         if self.with_autopilot:
             choices += [(self._event_autopilot_pass, 3)]
+        if self.with_elastic:
+            choices += [(self._event_drain, 3)]
         bag = [fn for fn, w in choices for _ in range(w)]
         t0 = time.monotonic()
         for _ in range(self.n_events):
@@ -456,12 +545,16 @@ class ChaosHarness:
             t.join(timeout=10)
         self.plane.heal()
         self._heal_disk()
+        if self.with_elastic:
+            self._settle_drains()
+            self._retire_departed()
         while self.downed:
             self.log(f"  finale: {self._event_restart()}")
         converged = self._converge(deadline_s=60)
         record = self._check_oracles()
         record.update({
             "events": list(self.events),
+            "drains": self.drains_started,
             "converged": converged,
             "converge_diag": getattr(self, "converge_diag", None),
             "acked_writes": len(self.acked),
@@ -970,6 +1063,7 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
               replica_n: int = 2, seed: int = 0, n_events: int = 6,
               event_gap_s: float = 0.3, with_storage_faults: bool = False,
               with_autopilot: bool = False, with_cdc: bool = False,
+              with_elastic: bool = False,
               log=lambda msg: None) -> dict:
     """Run ``n_schedules`` independent seeded schedules (fresh cluster
     each — a schedule's damage must not leak into the next) and fold
@@ -981,7 +1075,9 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
     gate autopilot-minted resizes (bench_suite config_autopilot);
     ``with_cdc`` runs an out-of-cluster CDC mirror tailing n0 for the
     whole schedule, gated on the byte-identical mirror oracle
-    (bench_suite config_cdc)."""
+    (bench_suite config_cdc); ``with_elastic`` adds graceful-drain
+    events so kills and partitions land mid-drain (bench_suite
+    config_elastic), gated on all of the above."""
     records = []
     for i in range(n_schedules):
         schedule_seed = seed * 1000 + i
@@ -991,7 +1087,8 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
             seed=schedule_seed, n_events=n_events,
             event_gap_s=event_gap_s,
             with_storage_faults=with_storage_faults,
-            with_autopilot=with_autopilot, with_cdc=with_cdc, log=log,
+            with_autopilot=with_autopilot, with_cdc=with_cdc,
+            with_elastic=with_elastic, log=log,
         )
         try:
             harness.boot()
@@ -1026,6 +1123,7 @@ def run_chaos(tmp_dir, n_schedules: int = 20, n_nodes: int = 3,
                               for r in records),
         "autopilot_moves_total": sum(r.get("autopilot_moves", 0)
                                      for r in records),
+        "drains_total": sum(r.get("drains", 0) for r in records),
         "cdc_mirror_mismatches": sum(
             len(r.get("cdc_mirror_mismatches", [])) for r in records),
         "cdc_resyncs_total": sum(r.get("cdc_resyncs", 0)

@@ -1,0 +1,187 @@
+"""Range-keyed placement table (elastic plane): sub-shard column
+ranges riding the override table's epoch stamp.
+
+The contract these tests pin is the same mixed-version discipline the
+override table itself carries, extended one level down: a table with NO
+ranges is byte-identical to plain override/hash placement, a split
+ALWAYS travels with a whole-shard override equal to the union of its
+range owners (so an override-unaware peer computes identical data
+placement from overrides alone), and ranges refine READ preference
+only — a reader that ignores them still reads correct bytes from any
+union owner."""
+
+import json
+import random
+
+from test_autopilot import _bare_cluster, _reference_owners
+
+from pilosa_tpu.parallel.cluster import PlacementTable
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+HALF = SHARD_WIDTH // 2
+
+
+class TestByteIdentityFallback:
+    def test_no_ranges_byte_identical_across_random_memberships(self):
+        """Randomized: a table with overrides but ZERO ranges leaves
+        shard_nodes equal to the override/hash walk and range_read_nodes
+        always None — the empty-ranges fallback contract."""
+        rng = random.Random(2293)
+        for _ in range(30):
+            n = rng.randint(2, 7)
+            ids = rng.sample([f"node-{i}" for i in range(32)], n)
+            replica_n = rng.randint(1, 3)
+            c = _bare_cluster(ids, replica_n=replica_n)
+            assert c.placement.range_count == 0
+            for _ in range(20):
+                index = rng.choice(["i", "t"])
+                shard = rng.randint(0, 500)
+                got = [x.id for x in c.shard_nodes(index, shard)]
+                assert got == _reference_owners(
+                    list(c.nodes.values()), replica_n, index, shard)
+                assert c.range_read_nodes(
+                    index, shard, rng.randrange(SHARD_WIDTH)) is None
+
+    def test_split_data_placement_is_the_union_override(self):
+        """A split's whole-shard ownership comes from its union
+        override; range_read_nodes refines per-offset reads to the
+        covering span's owner."""
+        c = _bare_cluster(["n0", "n1", "n2"], replica_n=1)
+        spans = ((0, HALF, ("n0",)), (HALF, SHARD_WIDTH, ("n1",)))
+        assert c.placement.replace(
+            {("i", 0): ("n0", "n1")}, epoch=1024,
+            ranges={("i", 0): spans})
+        assert [x.id for x in c.shard_nodes("i", 0)] == ["n0", "n1"]
+        assert [x.id for x in c.range_read_nodes("i", 0, 0)] == ["n0"]
+        assert [x.id for x in c.range_read_nodes("i", 0, HALF - 1)] \
+            == ["n0"]
+        assert [x.id for x in c.range_read_nodes("i", 0, HALF)] == ["n1"]
+        # other shards are untouched by the split
+        assert c.range_read_nodes("i", 1, 0) is None
+
+    def test_departed_range_owner_falls_back_to_union_routing(self):
+        """A span whose owner left the membership stops refining —
+        range_read_nodes returns None and reads fall back to the
+        union/hash owners (who all hold the full fragment)."""
+        c = _bare_cluster(["n0", "n1", "n2"], replica_n=1)
+        spans = ((0, HALF, ("n0",)), (HALF, SHARD_WIDTH, ("n1",)))
+        c.placement.replace({("i", 0): ("n0", "n1")}, epoch=1024,
+                            ranges={("i", 0): spans})
+        with c._lock:
+            c.nodes.pop("n1")
+            c._note_membership_changed_locked()
+        assert c.range_read_nodes("i", 0, HALF) is None
+        # the surviving span still refines
+        assert [x.id for x in c.range_read_nodes("i", 0, 0)] == ["n0"]
+
+
+class TestMixedVersionGossip:
+    def test_old_peer_adopts_overrides_only_same_data_placement(self):
+        """An override-unaware (older) peer parses the gossiped table
+        through from_wire, which has no notion of the "ranges" key —
+        it must land on the IDENTICAL data placement from the union
+        overrides alone."""
+        new = _bare_cluster(["n0", "n1", "n2"], replica_n=1)
+        spans = ((0, HALF, ("n1",)), (HALF, SHARD_WIDTH, ("n2",)))
+        assert new.placement.replace(
+            {("i", 0): ("n1", "n2"), ("i", 3): ("n0",)}, epoch=1024,
+            ranges={("i", 0): spans})
+        wire = new.placement.to_json()
+        assert "ranges" in wire  # the new node gossips them
+
+        old = _bare_cluster(["n0", "n1", "n2"], replica_n=1)
+        # an older replace() has no ranges parameter to pass: adopt
+        # the overrides exactly as its from_wire would produce them
+        assert old.placement.replace(
+            PlacementTable.from_wire(wire["overrides"]),
+            epoch=wire["epoch"])
+        assert old.placement.range_count == 0
+        for shard in range(8):
+            assert ([x.id for x in old.shard_nodes("i", shard)]
+                    == [x.id for x in new.shard_nodes("i", shard)])
+
+    def test_ranges_wire_round_trip_skips_malformed(self):
+        ranges = {("i", 0): ((0, HALF, ("a",)),
+                             (HALF, SHARD_WIDTH, ("b", "c"))),
+                  ("j", 7): ((0, SHARD_WIDTH, ("a",)),)}
+        entries = PlacementTable.wire_ranges(ranges)
+        assert PlacementTable.ranges_from_wire(entries) == ranges
+        entries.append({"index": "k"})  # no shard
+        entries.append({"index": "k", "shard": 1,
+                        "spans": [{"lo": 5, "hi": 5, "nodes": ["a"]}]})
+        entries.append({"index": "k", "shard": 2,
+                        "spans": [{"lo": 0, "hi": 9, "nodes": []}]})
+        entries.append("garbage")
+        assert PlacementTable.ranges_from_wire(entries) == ranges
+
+    def test_replace_without_ranges_drops_splits(self):
+        """A plain move plan (or an older coordinator) replacing the
+        table without ranges drops every split — correct, because the
+        matching union overrides are gone too."""
+        t = PlacementTable()
+        assert t.replace({("i", 0): ("a", "b")}, epoch=5,
+                         ranges={("i", 0): ((0, HALF, ("a",)),
+                                            (HALF, SHARD_WIDTH, ("b",)))})
+        assert t.range_count == 2
+        assert t.replace({("i", 1): ("c",)}, epoch=6)
+        assert t.range_count == 0
+        assert t.get_ranges("i", 0) is None
+
+    def test_clean_ranges_drops_empty_and_inverted_spans(self):
+        t = PlacementTable()
+        assert t.replace(
+            {("i", 0): ("a", "b")}, epoch=5,
+            ranges={("i", 0): ((HALF, 0, ("a",)),      # inverted
+                               (0, HALF, ()),           # no owner
+                               (HALF, SHARD_WIDTH, ("b",)))})
+        assert t.get_ranges("i", 0) == ((HALF, SHARD_WIDTH, ("b",)),)
+
+
+class TestPersistence:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "placement")
+        t = PlacementTable(path=path)
+        spans = ((0, HALF, ("a",)), (HALF, SHARD_WIDTH, ("b",)))
+        assert t.replace({("i", 0): ("a", "b"), ("j", 2): ("c",)},
+                         epoch=2048, ranges={("i", 0): spans})
+        reloaded = PlacementTable(path=path)
+        assert reloaded.epoch == 2048
+        assert reloaded.get("i", 0) == ("a", "b")
+        assert reloaded.get("j", 2) == ("c",)
+        assert reloaded.get_ranges("i", 0) == spans
+        assert reloaded.range_count == 2
+
+    def test_persisted_file_is_valid_json_with_ranges_key(self, tmp_path):
+        path = str(tmp_path / "placement")
+        t = PlacementTable(path=path)
+        t.replace({("i", 0): ("a",)}, epoch=7,
+                  ranges={("i", 0): ((0, SHARD_WIDTH, ("a",)),)})
+        with open(path) as f:
+            d = json.load(f)
+        assert d["epoch"] == 7
+        assert d["ranges"][0]["spans"][0] == {
+            "lo": 0, "hi": SHARD_WIDTH, "nodes": ["a"]}
+
+    def test_corrupt_file_starts_empty(self, tmp_path):
+        path = str(tmp_path / "placement")
+        t = PlacementTable(path=path)
+        t.replace({("i", 0): ("a",)}, epoch=7,
+                  ranges={("i", 0): ((0, SHARD_WIDTH, ("a",)),)})
+        with open(path, "wb") as f:
+            f.write(b'{"epoch": 7, "ranges": [tor')
+        reloaded = PlacementTable(path=path)
+        assert reloaded.epoch == 0
+        assert len(reloaded) == 0 and reloaded.range_count == 0
+
+    def test_unsplit_persists(self, tmp_path):
+        """A later replace that merges the split back must not leave
+        the stale ranges in the persisted file."""
+        path = str(tmp_path / "placement")
+        t = PlacementTable(path=path)
+        t.replace({("i", 0): ("a", "b")}, epoch=5,
+                  ranges={("i", 0): ((0, HALF, ("a",)),
+                                     (HALF, SHARD_WIDTH, ("b",)))})
+        t.replace({("i", 0): ("a", "b")}, epoch=6)
+        reloaded = PlacementTable(path=path)
+        assert reloaded.epoch == 6
+        assert reloaded.range_count == 0
