@@ -25,7 +25,7 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 	bench-serving bench-sync bench-durability bench-tracing \
 	bench-profiling bench-chaos bench-scrub bench-mp bench-multitenant \
 	bench-mesh bench-autopilot cdc-smoke bench-cdc elastic-smoke \
-	bench-elastic
+	bench-elastic hostpath-smoke bench-hostpath
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -136,6 +136,17 @@ elastic-smoke:
 	$(PYTEST) tests/test_elastic.py tests/test_placement_ranges.py \
 		-m "not slow"
 
+# hostpath-smoke: the vectorized roaring kernel layer — byte-identity
+# property tests (random + adversarial + corruption-fuzz fragments) for
+# every kernel vs the per-container reference walks, PROFILE
+# container-scan accounting parity, and the static lint that keeps
+# per-container python loops out of the rewired host paths
+# (docs/OPERATIONS.md host-path kernels)
+hostpath-smoke:
+	$(PYTEST) tests/test_roaring_kernels.py tests/test_hostpath_lint.py \
+		-m "not slow"
+	env JAX_PLATFORMS=cpu python scripts/check_hostpath_loops.py
+
 bench-ingest:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs ingest
 
@@ -169,6 +180,13 @@ bench-chaos:
 # quantiles, and the kill-a-worker chaos schedule
 bench-mp:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs mp_serving
+
+# host-path gate: the three rewired roaring host paths (row decode,
+# scrub digesting, sync manifest diff) timed against in-bench copies of
+# the retired per-container loops — byte-identical and >= 2x each —
+# plus the Executor.submit host-cost number
+bench-hostpath:
+	env JAX_PLATFORMS=cpu python bench_suite.py --configs hostpath
 
 # storage-integrity gate: scrubber serving overhead >= 0.97x off,
 # detection-latency bound, the corruption-heal + ENOSPC oracles, and

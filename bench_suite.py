@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import tempfile
 import time
 
@@ -1719,6 +1720,229 @@ def config_sync(n_fragments: int = 192, n_divergent: int = 32,
 
 
 def config_hostpath(n_shards: int = 8) -> dict:
+    """Host-path gate, two halves (ISSUE 18):
+
+    1. **Roaring kernel microbenches** — the three host paths the
+       vectorized kernel layer (pilosa_tpu/roaring/kernels.py)
+       rewired: row **decode** (residency miss), **scrub**-style block
+       digesting, and **sync** manifest-diff block materialization.
+       Each is timed against an in-bench copy of the retired
+       per-container loop over the SAME fragment, asserted
+       byte-identical, and gated at >= 2x. PROFILE-tree attribution
+       (containers scanned by kind, one tally per kernel call) rides
+       the decode half.
+    2. **Executor submit** — host cost of the pipelined submit path
+       with the batched device program stubbed (parse -> plan cache ->
+       operand memo -> micro-batch group), tracked as a number so a
+       serving-path host regression shows up as a regression."""
+    kernels_half = _hostpath_kernel_microbenches()
+    submit_half = _hostpath_submit(n_shards)
+    return {
+        "config": "hostpath",
+        "metric": "hostpath_kernel_speedups",
+        "microbenches": kernels_half["microbenches"],
+        "min_speedup": kernels_half["min_speedup"],
+        "bytes_identical": kernels_half["bytes_identical"],
+        "profile_attribution": kernels_half["profile_attribution"],
+        "submit": submit_half,
+        "ok": bool(kernels_half["ok"] and submit_half["ok"]),
+        "note": ("kernel microbenches: batched numpy kernels vs the "
+                 "retired per-container reference loops, byte-identical "
+                 "outputs asserted in-bench, gate >= 2x on each of "
+                 "decode/scrub/sync. submit: Executor.submit with the "
+                 "batched device program stubbed (see submit.note)."),
+    }
+
+
+def _hostpath_kernel_microbenches() -> dict:
+    """Scrub / sync / decode against per-container reference loops."""
+    import tempfile
+
+    from pilosa_tpu.roaring import kernels
+    from pilosa_tpu.storage.fragment import BLOCK_ROWS, Fragment
+    from pilosa_tpu.storage.integrity import block_digests
+    from pilosa_tpu.utils.cost import (
+        QueryProfile,
+        activate_cost,
+        deactivate_cost,
+        new_cost_context,
+        use_node,
+    )
+
+    rng = np.random.default_rng(18)
+
+    # ------------------------------------------ per-container references
+    # (verbatim shape of the retired loops — tests/test_roaring_kernels
+    # pins byte-identity; here they are the baseline being beaten)
+
+    def ref_to_ids(bm) -> np.ndarray:
+        parts = []
+        for key in bm.keys:
+            c = bm._containers.get(key)
+            if c is None or not c.n:
+                continue
+            parts.append((np.uint64(key) << np.uint64(16))
+                         + c.lows().astype(np.uint64))
+        if not parts:
+            return np.empty(0, np.uint64)
+        return np.concatenate(parts)
+
+    def ref_row_words(bm, row: int) -> np.ndarray:
+        return bm.dense_range_words32(row << 20, (row + 1) << 20)
+
+    def ref_block_ids(ids: np.ndarray, blocks) -> dict:
+        width = np.uint64(BLOCK_ROWS << 20)
+        out = {}
+        for b in blocks:
+            lo = np.uint64(b) * width
+            out[int(b)] = ids[(ids >= lo) & (ids < lo + width)]
+        return out
+
+    def best_of(fn, repeats: int = 5) -> float:
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    micro = {}
+    identical = True
+    with tempfile.TemporaryDirectory() as tmp:
+        frag = Fragment(f"{tmp}/f", "i", "f", "standard", 0).open()
+        # genuinely mixed-kind fragment across many blocks: mostly
+        # sparse array rows (~4 set bits per container), some mid-density
+        # array rows (~437 per container), a few bitmap rows (8000 per
+        # container, past the 4096 array ceiling), and run rows
+        rows, cols = [], []
+        for r in range(0, 220, 2):
+            if r % 44 == 0:  # bitmap row: every container dense
+                for k in range(16):
+                    rows.append(np.full(8000, r, np.uint64))
+                    cols.append((np.uint64(k) << np.uint64(16))
+                                + rng.choice(1 << 16, 8000,
+                                             replace=False).astype(np.uint64))
+            else:
+                n = 7000 if r % 6 == 2 else 60
+                rows.append(np.full(n, r, np.uint64))
+                cols.append(rng.integers(0, 1 << 20, n, dtype=np.uint64))
+        for r in (221, 223):
+            rows.append(np.full(120000, r, np.uint64))
+            cols.append(np.arange(120000, dtype=np.uint64))
+        frag.bulk_import(np.concatenate(rows), np.concatenate(cols))
+        bm = frag.bitmap
+
+        # decode: residency-miss dense row materialization over a kind
+        # mix (sparse + mid arrays dominate, as on a real fragment, plus
+        # a bitmap row and a run row), PROFILE attribution on the
+        # kernel side
+        dense_rows = ([r for r in range(0, 220, 2)
+                       if r % 44 and r % 6 != 2][:16]
+                      + [r for r in range(0, 220, 2) if r % 6 == 2][:4]
+                      + [0, 221])
+        profile = QueryProfile("i", "hostpath-bench")
+        ctx = new_cost_context("bench", "i", profile=profile)
+        node = profile.node_for(0, None)
+        tok = activate_cost(ctx)
+        try:
+            with use_node(ctx, node):
+                got_rows = [frag.row_words(r) for r in dense_rows]
+        finally:
+            deactivate_cost(tok)
+        want_rows = [ref_row_words(bm, r) for r in dense_rows]
+        identical &= all(np.array_equal(g, w)
+                         for g, w in zip(got_rows, want_rows))
+        t_kernel = best_of(
+            lambda: [frag.row_words(r) for r in dense_rows])
+        t_ref = best_of(
+            lambda: [ref_row_words(bm, r) for r in dense_rows])
+        micro["decode"] = {
+            "reference_us": round(t_ref * 1e6, 1),
+            "kernel_us": round(t_kernel * 1e6, 1),
+            "speedup": round(t_ref / t_kernel, 2) if t_kernel else 0.0,
+        }
+        profile_attr = {
+            "containers_scanned": {
+                "array": ctx.c_array, "bitmap": ctx.c_bitmap,
+                "run": ctx.c_run,
+            },
+            "kernel_calls": len(dense_rows),
+            "note": ("one note_containers tally per kernel call on the "
+                     "batched path; totals equal the per-container walk "
+                     "(pinned by tests/test_roaring_kernels.py)"),
+        }
+
+        # scrub: verified-load id materialization straight off the
+        # serialized snapshot bytes (verify_fragment_file's
+        # build_bitmap=False path and the scrubber's replica-copy
+        # checksum both reduce to this). The timed half is the part the
+        # kernels changed — bytes -> sorted ids; the blake2b digesting
+        # that follows consumes byte-identical input on both sides and
+        # is reported once as a constant.
+        from pilosa_tpu.roaring.format import deserialize, serialize
+
+        snap = serialize(bm)
+
+        def scrub_kernel():
+            return kernels.snapshot_ids(snap)[0]
+
+        def scrub_ref():
+            # the retired path: container-object decode, then the
+            # per-container lows() walk (live to_ids now rides the
+            # kernels, so the walk is reconstructed in-bench)
+            return ref_to_ids(deserialize(snap)[0])
+
+        # time first, verify after: the identity checks materialize
+        # multi-MB byte strings, and leaving those on the heap during
+        # timing skews BOTH sides with allocator (mmap) churn
+        t_kernel = best_of(scrub_kernel)
+        t_ref = best_of(scrub_ref)
+        ids_k, ids_r = scrub_kernel(), scrub_ref()
+        identical &= bool(np.array_equal(ids_k, ids_r))
+        identical &= (block_digests(ids_k, BLOCK_ROWS)
+                      == block_digests(ids_r, BLOCK_ROWS))
+        t_digest = best_of(lambda: block_digests(ids_k, BLOCK_ROWS))
+        micro["scrub"] = {
+            "reference_us": round(t_ref * 1e6, 1),
+            "kernel_us": round(t_kernel * 1e6, 1),
+            "speedup": round(t_ref / t_kernel, 2) if t_kernel else 0.0,
+            "digest_us_both_sides": round(t_digest * 1e6, 1),
+        }
+
+        # sync: a manifest diff wants N divergent blocks — materialize
+        # their id sets (http.post_sync_blocks serves exactly this)
+        wanted = sorted({int(r) // BLOCK_ROWS
+                         for r in range(0, 220, 2)})
+
+        def sync_kernel():
+            return frag.blocks_ids(wanted)
+
+        def sync_ref():
+            return ref_block_ids(ref_to_ids(bm), wanted)
+
+        gk, gr = sync_kernel(), sync_ref()
+        identical &= (sorted(gk) == sorted(gr) and all(
+            gk[b].tobytes() == gr[b].tobytes() for b in gk))
+        t_kernel = best_of(sync_kernel)
+        t_ref = best_of(sync_ref)
+        micro["sync"] = {
+            "reference_us": round(t_ref * 1e6, 1),
+            "kernel_us": round(t_kernel * 1e6, 1),
+            "speedup": round(t_ref / t_kernel, 2) if t_kernel else 0.0,
+        }
+        frag.close()
+
+    min_speedup = min(m["speedup"] for m in micro.values())
+    return {
+        "microbenches": micro,
+        "min_speedup": min_speedup,
+        "bytes_identical": bool(identical),
+        "profile_attribution": profile_attr,
+        "ok": bool(identical and min_speedup >= 2.0),
+    }
+
+
+def _hostpath_submit(n_shards: int = 8) -> dict:
     """Host-side cost of the pipelined submit path, device excluded.
 
     The executor-vs-kernel ratio is bounded by how fast the HOST can
@@ -1788,7 +2012,6 @@ def config_hostpath(n_shards: int = 8) -> dict:
         off = measure(False)
         holder.close()
     return {
-        "config": "hostpath",
         "metric": "submit_host_us_per_query",
         "value": round(on * 1e6, 1),
         "unit": "us/query",
@@ -2902,10 +3125,14 @@ def config_mp_serving(n_shards: int = 4,
     The headline is plateau-vs-plateau: max QPS over the client sweep
     per worker count, plus the worker-reported ring round-trip
     quantiles. ``ok`` requires byte-identical responses across every
-    shape and run (digest oracle vs a serial pass), a 4-worker plateau
-    ≥ 2× the single-process fast-lane plateau (ROADMAP target ≥4×
-    where cores allow), and one kill-a-worker chaos schedule passing
-    both mp oracles (zero lost acked writes, owner never wedges)."""
+    shape and run (digest oracle vs a serial pass), one kill-a-worker
+    chaos schedule passing both mp oracles (zero lost acked writes,
+    owner never wedges), and a core-aware scaling bar (ISSUE 18):
+    4-worker plateau ≥ 4× the single-process fast-lane plateau when
+    the box has ≥ 6 cores (workers + owner + clients each get a real
+    core), ≥ 2× on 3-5 cores, and on fewer the box is recorded as
+    hardware-saturated — the result carries ``cores`` and the measured
+    ``saturation`` point and only the correctness oracles gate."""
     import http.client as _hc
     import socket as _socket
     import subprocess
@@ -3042,6 +3269,26 @@ def config_mp_serving(n_shards: int = 4,
 
     speedup = (plateaus[max(worker_counts)] / plateaus[0]
                if plateaus[0] else 0.0)
+    # core-aware scaling gate (ISSUE 18): N workers + 1 owner + client
+    # subprocesses need real cores to show scaling. On >=6 cores the
+    # ROADMAP >=4x target is enforced; on 3-5 cores the shapes
+    # time-share and >=2x is the honest bar; below that the box is
+    # hardware-saturated — scaling is not measurable, so only the
+    # correctness oracles (byte-identity, zero client errors, chaos)
+    # gate, and the saturation point is recorded instead.
+    cores = os.cpu_count() or 1
+    best_plateau = max(plateaus.values()) if plateaus else 0.0
+    saturation_workers = next(
+        (w for w in sorted(plateaus)
+         if plateaus[w] >= 0.95 * best_plateau), max(worker_counts))
+    if cores >= 6:
+        scaling_ok, scaling_gate = speedup >= 4.0, "speedup >= 4.0"
+    elif cores >= 3:
+        scaling_ok, scaling_gate = speedup >= 2.0, "speedup >= 2.0"
+    else:
+        scaling_ok = True
+        scaling_gate = ("ungated: hardware-saturated (< 3 cores); "
+                        "correctness + chaos oracles still gate")
     return {
         "config": "mp_serving",
         "metric": "mp_serving_plateau_scaling",
@@ -3050,12 +3297,19 @@ def config_mp_serving(n_shards: int = 4,
         "curve": curve,
         "plateau_qps_by_workers": plateaus,
         "speedup_max_workers": round(speedup, 2),
+        "cores": cores,
+        "scaling_gate": scaling_gate,
+        "saturation": {
+            "plateau_workers": saturation_workers,
+            "note": ("smallest worker count within 5% of the best "
+                     "plateau on this box"),
+        },
         "ring_rtt": rtt,
         "client_errors": errors_total,
         "bytes_identical": identical,
         "kill_worker_chaos": chaos,
         "wall_s": round(time.time() - t0, 1),
-        "ok": bool(identical and errors_total == 0 and speedup >= 2.0
+        "ok": bool(identical and errors_total == 0 and scaling_ok
                    and chaos["ok"]),
     }
 

@@ -39,7 +39,10 @@ from __future__ import annotations
 import threading
 import time
 
+import numpy as np
+
 from pilosa_tpu.cdc.feed import FeedGone
+from pilosa_tpu.roaring import kernels
 from pilosa_tpu.storage.wal import (
     REC_TOMBSTONE,
     WriteAheadLog,
@@ -354,15 +357,24 @@ class CdcFollower:
                     [(field_name, view_name, shard, wanted)])
                 frag = fld.view(view_name, create=True).fragment(
                     shard, create=True)
-                for bm in bitmaps:
-                    if bm is None or not bm.count():
-                        continue
-                    if fld.options.type in ("mutex", "bool"):
-                        frag.add_ids_mutex(bm.to_ids())
-                    elif view_name == fld.bsi_view_name():
-                        frag.add_ids_value(bm.to_ids())
-                    else:
-                        frag.import_roaring_bitmap(bm)
+                # one id kernel per block bitmap, ONE merge per
+                # fragment: the upstream blocks are one consistent
+                # fragment, so the conflict-aware merges see the whole
+                # id set at once (a BSI column's planes span blocks —
+                # per-block applies handed add_ids_value partial
+                # columns) and the fragment lock is taken once
+                parts = [kernels.fragment_ids(kernels.flatten(bm))
+                         for bm in bitmaps
+                         if bm is not None and bm.count()]
+                if not parts:
+                    continue
+                ids = np.sort(np.concatenate(parts))
+                if fld.options.type in ("mutex", "bool"):
+                    frag.add_ids_mutex(ids)
+                elif view_name == fld.bsi_view_name():
+                    frag.add_ids_value(ids)
+                else:
+                    frag.add_ids(ids)
 
     # ------------------------------------------------------------- tail loop
 

@@ -33,6 +33,7 @@ import time
 import uuid
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.roaring import kernels
 from pilosa_tpu.testing import faults
 from pilosa_tpu.utils.pool import concurrent_map
 
@@ -1074,9 +1075,11 @@ class Cluster:
                 except ClientError:
                     covered = False
                     break
-                peer_ids = {int(i) for i in bm.to_ids()}
-                if not {int(i) for i in
-                        frag.block_ids(block)} <= peer_ids:
+                # subset test as one galloping set-difference kernel
+                # over the two sorted id arrays, not Python sets
+                peer_ids = kernels.fragment_ids(kernels.flatten(bm))
+                if kernels.setdiff_sorted(
+                        frag.block_ids(block), peer_ids).size:
                     covered = False  # we hold bits this owner lacks
                     break
             if covered:
@@ -2670,10 +2673,9 @@ class Cluster:
                     ))
                 except ClientError:
                     continue
-            wanted = sorted(
-                b for b, checksum in peer_blocks.items()
-                if local_blocks.get(b) != checksum
-            )
+            # the ONE manifest-diff implementation (roaring/kernels.py),
+            # shared with the CDC bulk sync and the scrub replica fetch
+            wanted = kernels.diff_digests(local_blocks, peer_blocks)
             if not wanted:
                 continue
             merged_any = False
@@ -2687,12 +2689,14 @@ class Cluster:
                     # single-value fields: union repair would resurrect
                     # rows a newer import cleared; conflicting columns
                     # keep the local row
-                    added = frag.add_ids_mutex(bm.to_ids())
+                    added = frag.add_ids_mutex(
+                        kernels.fragment_ids(kernels.flatten(bm)))
                 elif view_name == field.bsi_view_name():
                     # BSI planes: per-column all-or-nothing — unioning
                     # stale planes into a newer value would fabricate
                     # values
-                    added = frag.add_ids_value(bm.to_ids())
+                    added = frag.add_ids_value(
+                        kernels.fragment_ids(kernels.flatten(bm)))
                 else:
                     added = frag.import_roaring_bitmap(bm)
                 if added:

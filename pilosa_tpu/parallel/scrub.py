@@ -42,7 +42,10 @@ import os
 import threading
 import time
 
+import numpy as np
+
 from pilosa_tpu.parallel.pacer import RepairPacer
+from pilosa_tpu.roaring import kernels
 from pilosa_tpu.storage.integrity import (
     CorruptFragmentError,
     global_integrity,
@@ -142,7 +145,11 @@ class Scrubber:
         the scanned/bytes counters one-per-fragment. Raises
         CorruptFragmentError."""
         try:
-            _bitmap, data, _ops_at = verify_fragment_file(frag.path)
+            # build_bitmap=False: the kernel parser digests the snapshot
+            # bytes directly (roaring/kernels.py) — the scrubber never
+            # needs the Container tree, only the verdict
+            _bitmap, data, _ops_at = verify_fragment_file(
+                frag.path, build_bitmap=False)
         except CorruptFragmentError:
             raise
         finally:
@@ -233,14 +240,17 @@ class Scrubber:
                     bitmaps = client.sync_blocks(
                         node.uri, iname, [(fname, vname, shard, wanted)],
                     )
-                    copy = RoaringBitmap()
-                    for bm in bitmaps:
-                        copy.add_ids(bm.to_ids())
-                    if block_digests(copy.to_ids()) != [
+                    # one batched id kernel per block bitmap, one sort,
+                    # one from_ids — not N add_ids merges + a re-walk
+                    parts = [kernels.fragment_ids(kernels.flatten(bm))
+                             for bm in bitmaps]
+                    ids = (np.sort(np.concatenate(parts)) if parts
+                           else np.empty(0, np.uint64))
+                    if block_digests(ids) != [
                         (int(b), d) for b, d in entry
                     ]:
                         continue  # raced or torn transfer: next replica
-                    return copy
+                    return RoaringBitmap.from_ids(ids)
                 # legacy-wire peer: whole-fragment GET, verified
                 # against the peer's per-fragment block checksums (the
                 # same no-trust bar as the manifest path — an
@@ -254,7 +264,9 @@ class Scrubber:
                     from pilosa_tpu.roaring.format import load_any
 
                     copy, _ = load_any(data)
-                    if block_digests(copy.to_ids()) != [
+                    if block_digests(
+                        kernels.fragment_ids(kernels.flatten(copy))
+                    ) != [
                         (int(b), d) for b, d in blocks
                     ]:
                         continue  # raced or torn transfer: next replica

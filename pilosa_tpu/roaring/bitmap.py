@@ -168,19 +168,14 @@ class RoaringBitmap:
         return self._containers.get(key)
 
     def to_ids(self) -> np.ndarray:
-        parts = []
-        for key in self.keys:
-            # .get + skip: a racing remove pops an emptied container before
-            # reassigning self.keys, so a lock-free reader can see a key
-            # whose container is already gone (matches dense_range_words32).
-            c = self._containers.get(key)
-            if c is None:
-                continue
-            lows = c.lows().astype(np.uint64)
-            parts.append(lows + (np.uint64(key) << np.uint64(16)))
-        if not parts:
-            return np.empty(0, np.uint64)
-        return np.concatenate(parts)
+        # whole-bitmap materialization rides the vectorized kernel layer:
+        # one flatten (lock-free .get + skip, same race discipline as
+        # dense_range_words32) then one batched kernel call — the
+        # per-container lows() loop lives on only as the test reference
+        # (tests/test_roaring_kernels.py pins byte-identity)
+        from pilosa_tpu.roaring import kernels
+
+        return kernels.fragment_ids(kernels.flatten(self))
 
     def count(self) -> int:
         return sum(c.n for c in self._containers.values())
@@ -233,24 +228,12 @@ class RoaringBitmap:
         must not pay that on large fragments."""
         if stop <= start or not self.keys:
             return np.empty(0, np.uint64)
-        lo_key = start >> 16
-        hi_key = (stop - 1) >> 16
-        i = bisect.bisect_left(self.keys, lo_key)
-        parts = []
-        while i < len(self.keys) and self.keys[i] <= hi_key:
-            key = self.keys[i]
-            c = self.container(key)
-            if c is not None and c.n:
-                parts.append(
-                    (np.uint64(key) << np.uint64(16))
-                    + c.lows().astype(np.uint64)
-                )
-            i += 1
-        if not parts:
-            return np.empty(0, np.uint64)
-        ids = np.concatenate(parts)
-        # trim partial edge containers (cheap vs re-slicing per part)
-        return ids[(ids >= np.uint64(start)) & (ids < np.uint64(stop))]
+        from pilosa_tpu.roaring import kernels
+
+        # key-bounded flatten + one batched kernel; partial edge
+        # containers are trimmed by one vectorized mask inside
+        flat = kernels.flatten(self, start >> 16, (stop - 1) >> 16)
+        return kernels.range_ids(flat, start, stop)
 
     def contains_lows(self, key: int, lows: np.ndarray) -> np.ndarray:
         """Vectorized membership of uint16 lows in ONE container, probed

@@ -67,9 +67,14 @@ class ImportRoutingError(ApiError):
 # groups are lock-disjoint). Overridden by the ``ingest-workers``
 # ServerConfig knob. Default 1 (serial): on CPython the per-group work is
 # GIL-bound (roaring container merges + small numpy ops), and measured
-# thread fan-out LOSES throughput on tmpfs-backed storage; raise the knob
-# where fragment writes pay real disk latency (fsync'd disks, network
-# filesystems) so groups overlap I/O stalls — see docs/INGEST.md.
+# thread fan-out LOSES throughput on tmpfs-backed storage. Re-measured
+# after the vectorized host-path kernel work (round 6): the kernels
+# batch the READ paths (decode/digest/diff), not the write-side
+# container merges bulk_import runs, so the GIL-bound profile — and the
+# default — stand (8 shard groups x 60k bits, tmpfs: 1.57/1.62/1.56
+# M rows/s at 1/2/4 workers). Raise the knob where fragment writes pay
+# real disk latency (fsync'd disks, network filesystems) so groups
+# overlap I/O stalls — see docs/INGEST.md.
 INGEST_WORKERS_DEFAULT = 1
 
 

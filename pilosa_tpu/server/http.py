@@ -783,6 +783,14 @@ class HTTPHandler(BaseHTTPRequestHandler):
         # scrubber progress — zeros from scrape one like the rest
         text += prometheus_block(self.api.integrity_metrics(), prefix,
                                  seen=seen)
+        # host-path roaring kernels (docs/OPERATIONS.md host-path
+        # kernels): batched decode/set-op call counts and materialized
+        # id volume — zeros from scrape one; a flat kernel_calls rate
+        # under load means traffic is all residency hits
+        from pilosa_tpu.roaring.kernels import global_kernel_stats
+
+        text += prometheus_block(global_kernel_stats().metrics(), prefix,
+                                 seen=seen)
         # multi-chip reduction plane (docs/OPERATIONS.md multi-chip
         # mesh): per-dispatch reduction-lane bytes, dense-equivalent vs
         # actual encoded inter-group traffic plus roaring row gathers —
@@ -1246,9 +1254,18 @@ class HTTPHandler(BaseHTTPRequestHandler):
                 fld = idx.field(fname) if idx is not None else None
                 v = fld.view(vname) if fld is not None else None
                 frag = v.fragment(shard) if v else None
-                for block in blocks:
-                    ids = frag.block_ids(block) if frag is not None else []
-                    payloads.append(serialize(RoaringBitmap.from_ids(ids)))
+                if frag is None:
+                    payloads.extend(
+                        serialize(RoaringBitmap.from_ids([]))
+                        for _ in blocks)
+                    continue
+                # one flatten + one id kernel + one boundary search for
+                # ALL requested blocks (fragment.blocks_ids) — the old
+                # loop re-materialized the whole fragment per block
+                by_block = frag.blocks_ids(blocks)
+                payloads.extend(
+                    serialize(RoaringBitmap.from_ids(by_block[int(b)]))
+                    for b in blocks)
             global_stats().count("sync_delta_blocks_served", len(payloads))
             self._bytes_negotiated(encode_block_frames(payloads))
 

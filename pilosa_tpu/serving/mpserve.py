@@ -57,12 +57,15 @@ import sys
 import threading
 import time
 
+from pilosa_tpu.qos import Deadline
 from pilosa_tpu.serving.shmring import (
     RingFull,
     ShmRing,
     decode_frame,
     encode_frame,
 )
+from pilosa_tpu.utils.cost import cost_enabled
+from pilosa_tpu.utils.tracing import global_tracer, use_span
 
 # Messages on the handshake channel are newline-delimited: a bare `!` is
 # a doorbell (ring has records), a `{...}` line is a JSON control
@@ -618,48 +621,62 @@ class OwnerRuntime:
                     break
             except (TypeError, ValueError):
                 break  # ring torn down by a concurrent reap
+            # one blocking permit keeps the backpressure contract
+            # (saturated pool → this drain stalls → ring fills → the
+            # worker sheds 429); opportunistic non-blocking acquires
+            # size a batch so ONE consumer-lock acquisition pops a
+            # doorbell's worth of records — the per-record pop()
+            # round-trip was the measured intake ceiling at plateau
             self._capacity.acquire()
+            permits = 1
+            while permits < 64 and self._capacity.acquire(blocking=False):
+                permits += 1
             try:
-                rec = ring.pop()
+                recs = ring.pop_many(permits)
             except (TypeError, ValueError):
-                rec = None  # torn down mid-drain
-            if rec is None:
+                recs = []  # torn down mid-drain
+            for _ in range(permits - len(recs)):
                 self._capacity.release()
-                continue  # a torn slot was skipped; depth re-checks
-            n += 1
-            try:
-                header, body = decode_frame(rec)
-            except ValueError as e:
-                self._capacity.release()
-                self.logger.warning("mpserve: dropping bad frame: %s", e)
-                continue
-            key = None
-            if (header.get("op", "q") == "q" and header.get("ro")
-                    and "sh" not in header and "o" not in header
-                    and "dl" not in header and "tr" not in header):
-                key = (header.get("ix", ""), body)
-                joined = False
-                with self._memo_lock:
-                    ex = self._memo.get(key)
-                    if ex is not None and not ex.submitted.is_set():
-                        ex.followers.append((ws, ws.gen, header))
-                        joined = True
-                    else:
-                        ex = _SharedExec()
-                        self._memo[key] = ex
-                if joined:
-                    self._capacity.release()
-                    with self._mlock:
-                        self.deduped += 1
-                    continue
-                self._workq.put((ws, ws.gen, header, body, key, ex))
-            else:
-                self._workq.put((ws, ws.gen, header, body, None, None))
+            for rec in recs:
+                n += 1
+                self._intake_frame(ws, rec)
         if n:
             with self._mlock:
                 self.batches += 1
                 self.batched_requests += n
                 self.last_batch = n
+
+    def _intake_frame(self, ws: _WorkerState, rec: bytes) -> None:
+        """Route one popped submit record (its capacity permit is held
+        by the caller and travels with the work item; every early
+        return releases it)."""
+        try:
+            header, body = decode_frame(rec)
+        except ValueError as e:
+            self._capacity.release()
+            self.logger.warning("mpserve: dropping bad frame: %s", e)
+            return
+        if (header.get("op", "q") == "q" and header.get("ro")
+                and "sh" not in header and "o" not in header
+                and "dl" not in header and "tr" not in header):
+            key = (header.get("ix", ""), body)
+            joined = False
+            with self._memo_lock:
+                ex = self._memo.get(key)
+                if ex is not None and not ex.submitted.is_set():
+                    ex.followers.append((ws, ws.gen, header))
+                    joined = True
+                else:
+                    ex = _SharedExec()
+                    self._memo[key] = ex
+            if joined:
+                self._capacity.release()
+                with self._mlock:
+                    self.deduped += 1
+                return
+            self._workq.put((ws, ws.gen, header, body, key, ex))
+        else:
+            self._workq.put((ws, ws.gen, header, body, None, None))
 
     # ------------------------------------------------------------ execution
 
@@ -734,8 +751,6 @@ class OwnerRuntime:
         dedupe reports in single-process mode)."""
         if not ex.followers:
             return
-        from pilosa_tpu.utils.cost import cost_enabled
-
         st = int(meta.get("st", 200))
         elapsed = float(meta.get("ex") or 0.0)
         error = st >= 500
@@ -766,10 +781,10 @@ class OwnerRuntime:
         already ran worker-side (``pre_admitted``); the WAL ACK barrier,
         cost/SLO accounting, and inflight tracking all run here exactly
         as in single-process mode."""
-        from pilosa_tpu.qos import Deadline
-        from pilosa_tpu.server.api import ApiError
-        from pilosa_tpu.utils.cost import cost_enabled
-        from pilosa_tpu.utils.tracing import global_tracer, use_span
+        from pilosa_tpu.server.api import ApiError  # heavy module: the
+        # owner has it loaded long before the first frame, but hoisting
+        # it would drag the full storage stack into worker imports
+        # (worker.py imports this module)
 
         index = header.get("ix", "")
         tenant = header.get("t", "default")

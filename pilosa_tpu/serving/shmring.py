@@ -233,69 +233,88 @@ class ShmRing:
         """Consume one record, or ``None`` when the ring is empty or the
         next record failed validation (counted in ``torn`` and skipped —
         the caller just polls again)."""
-        buf = self._buf
         with self._clock:
-            tail = self._tail()
-            head = self._head()
-            if tail >= head:
-                return None
-            parts: list[bytes] = []
-            first = True
-            while True:
-                off = _HDR_SIZE + (tail % self.slots) * self._slot_size
-                seq, length, crc = _SLOT_HDR.unpack_from(buf, off)
-                more = bool(length & _MORE)
-                is_first = bool(length & _FIRST)
-                length &= _LEN_MASK
-                payload = bytes(
-                    buf[off + _SLOT_HDR.size:off + _SLOT_HDR.size + length]
-                ) if length <= self.slot_bytes else b""
-                if (seq != tail + 1 or length > self.slot_bytes
-                        or zlib.crc32(payload) != crc
-                        or is_first != first):
-                    # torn/corrupt record: consume this slot AND any
-                    # published continuation chunks of the same record
-                    # (a valid-looking continuation must never be
-                    # reassembled into a headless record), surface
-                    # nothing
-                    self.torn += 1
-                    tail += 1
-                    while tail < head:
-                        off = (_HDR_SIZE
-                               + (tail % self.slots) * self._slot_size)
-                        seq2, length2, _ = _SLOT_HDR.unpack_from(buf, off)
-                        if seq2 != tail + 1 or (length2 & _FIRST):
-                            break  # next record (or unreadable slot)
-                        tail += 1
-                    struct.pack_into("<Q", buf, _TAIL_OFF, tail)
-                    return None
-                parts.append(payload)
+            rec, _ = self._pop_locked()
+            return rec
+
+    def _pop_locked(self) -> tuple[bytes | None, bool]:
+        """One record with ``_clock`` already held. Returns ``(record,
+        progressed)``: ``(None, True)`` = a torn record was consumed
+        and skipped, ``(None, False)`` = ring empty."""
+        buf = self._buf
+        if buf is None:  # closed concurrently (shutdown/reap race)
+            return None, False
+        tail = struct.unpack_from("<Q", buf, _TAIL_OFF)[0]
+        head = struct.unpack_from("<Q", buf, _HEAD_OFF)[0]
+        if tail >= head:
+            return None, False
+        parts: list[bytes] = []
+        first = True
+        while True:
+            off = _HDR_SIZE + (tail % self.slots) * self._slot_size
+            seq, length, crc = _SLOT_HDR.unpack_from(buf, off)
+            more = bool(length & _MORE)
+            is_first = bool(length & _FIRST)
+            length &= _LEN_MASK
+            payload = bytes(
+                buf[off + _SLOT_HDR.size:off + _SLOT_HDR.size + length]
+            ) if length <= self.slot_bytes else b""
+            if (seq != tail + 1 or length > self.slot_bytes
+                    or zlib.crc32(payload) != crc
+                    or is_first != first):
+                # torn/corrupt record: consume this slot AND any
+                # published continuation chunks of the same record
+                # (a valid-looking continuation must never be
+                # reassembled into a headless record), surface
+                # nothing
+                self.torn += 1
                 tail += 1
-                first = False
-                if not more:
-                    struct.pack_into("<Q", buf, _TAIL_OFF, tail)
-                    self.popped += 1
-                    return b"".join(parts)
-                if tail >= head:
-                    # continuation promised but not published — cannot
-                    # happen with a live correct producer (head moves
-                    # after the whole record); treat as torn
-                    self.torn += 1
-                    struct.pack_into("<Q", buf, _TAIL_OFF, tail)
-                    return None
+                while tail < head:
+                    off = (_HDR_SIZE
+                           + (tail % self.slots) * self._slot_size)
+                    seq2, length2, _ = _SLOT_HDR.unpack_from(buf, off)
+                    if seq2 != tail + 1 or (length2 & _FIRST):
+                        break  # next record (or unreadable slot)
+                    tail += 1
+                struct.pack_into("<Q", buf, _TAIL_OFF, tail)
+                return None, True
+            parts.append(payload)
+            tail += 1
+            first = False
+            if not more:
+                struct.pack_into("<Q", buf, _TAIL_OFF, tail)
+                self.popped += 1
+                return b"".join(parts), True
+            if tail >= head:
+                # continuation promised but not published — cannot
+                # happen with a live correct producer (head moves
+                # after the whole record); treat as torn
+                self.torn += 1
+                struct.pack_into("<Q", buf, _TAIL_OFF, tail)
+                return None, True
+
+    def pop_many(self, limit: int | None = None) -> list[bytes]:
+        """Consume up to ``limit`` records (all published records when
+        ``None``) under ONE consumer-lock acquisition. Torn records are
+        counted and skipped without ending the batch. This is the
+        drain-side half of the doorbell-coalescing design: the owner's
+        per-record cost at plateau was dominated by lock/cursor
+        round-trips in ``pop`` (PROFILE'd at ~9.7us/record vs ~4.4us
+        batched), not by the payload copies."""
+        out: list[bytes] = []
+        with self._clock:
+            while limit is None or len(out) < limit:
+                rec, progressed = self._pop_locked()
+                if rec is not None:
+                    out.append(rec)
+                elif not progressed:
+                    break  # empty — torn skips keep draining
+        return out
 
     def drain(self, limit: int | None = None) -> list[bytes]:
         """Pop until empty (or ``limit`` records) — one drain per
         doorbell is how worker waves reach the owner as a batch."""
-        out: list[bytes] = []
-        while limit is None or len(out) < limit:
-            rec = self.pop()
-            if rec is None:
-                if self.depth() == 0:
-                    break
-                continue  # a torn slot was skipped; keep draining
-            out.append(rec)
-        return out
+        return self.pop_many(limit)
 
     # ------------------------------------------------------ dead-peer reap
 
@@ -306,19 +325,22 @@ class ShmRing:
         surviving side resets the consumer cursor so the ring is
         immediately reusable and nothing is left half-in-flight."""
         with self._plock, self._clock:
-            head = self._head()
-            tail = self._tail()
+            buf = self._buf
+            if buf is None:  # already closed (shutdown beat the reap)
+                return 0
+            head = struct.unpack_from("<Q", buf, _HEAD_OFF)[0]
+            tail = struct.unpack_from("<Q", buf, _TAIL_OFF)[0]
             dropped = 0
             # count RECORDS (one _FIRST chunk each; continuation chunks
             # collapse), best-effort: the headers may themselves be
             # torn, in which case each unreadable slot counts as one
             while tail < head:
                 off = _HDR_SIZE + (tail % self.slots) * self._slot_size
-                seq, length, _ = _SLOT_HDR.unpack_from(self._buf, off)
+                seq, length, _ = _SLOT_HDR.unpack_from(buf, off)
                 tail += 1
                 if seq != tail or (length & _FIRST):
                     dropped += 1
-            struct.pack_into("<Q", self._buf, _TAIL_OFF, head)
+            struct.pack_into("<Q", buf, _TAIL_OFF, head)
             return dropped
 
     def metrics(self) -> dict:

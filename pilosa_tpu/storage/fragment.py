@@ -25,6 +25,7 @@ import threading
 import numpy as np
 
 from pilosa_tpu.roaring import RoaringBitmap, OP_ADD, OP_REMOVE
+from pilosa_tpu.roaring import kernels
 from pilosa_tpu.roaring.format import (
     deserialize,
     encode_op,
@@ -246,20 +247,17 @@ class Fragment:
         if memo is not None and memo[0] == self.mutations:
             return memo[1]
         version = self.mutations
-        keys, cards = [], []
-        for key in self.bitmap.keys:
-            c = self.bitmap.container(key)  # .get: lock-free vs removes
-            if c is not None and c.n:
-                keys.append(key)
-                cards.append(c.n)
-        if not keys:
+        # flatten is the one sanctioned container walk (lock-free .get +
+        # skip inside kernels.flatten); the row fold is pure vectorized
+        # metadata math on the flat key/cardinality arrays
+        flat = kernels.flatten(self.bitmap)
+        if flat.n_containers == 0:
             out = (np.empty(0, np.int64), np.empty(0, np.int64))
         else:
-            rows = np.asarray(keys, np.int64) >> 4
-            cards = np.asarray(cards, np.int64)
+            rows = flat.keys >> 4
             uniq, inv = np.unique(rows, return_inverse=True)
             counts = np.zeros(uniq.size, np.int64)
-            np.add.at(counts, inv, cards)
+            np.add.at(counts, inv, flat.cards)
             out = (uniq, counts)
         for a in out:  # shared across callers: in-place edits would
             a.setflags(write=False)  # corrupt the memo silently
@@ -267,30 +265,22 @@ class Fragment:
         return out
 
     def row_words(self, row: int) -> np.ndarray:
-        """Dense uint32[32768] for one row (host side)."""
-        base = row << 20
+        """Dense uint32[32768] for one row (host side): one flatten of
+        the row's 16-container window, one batched decode kernel —
+        byte-identical to the per-container ``dense_range_words32``
+        walk it replaced (tests/test_roaring_kernels.py)."""
+        base_key = (row << 20) >> 16
+        flat = kernels.flatten(self.bitmap, base_key, base_key + 15)
         cost = current_cost()
         if cost is not None:
             # Container-taxonomy cost accounting (Chambi et al.
-            # 1402.6407): this decode walks the row's 16 containers, so
-            # tally kinds for the active request's profile/ledger. Only
-            # residency MISSES reach this path — steady-state hot
-            # queries pay nothing here.
-            from pilosa_tpu.roaring.bitmap import ARRAY, BITMAP, RUN
-
-            a = b = r = 0
-            for key in range(base >> 16, (base >> 16) + 16):
-                c = self.bitmap.container(key)
-                if c is None or not c.n:
-                    continue
-                if c.kind == ARRAY:
-                    a += 1
-                elif c.kind == BITMAP:
-                    b += 1
-                elif c.kind == RUN:
-                    r += 1
-            cost.note_containers(a, b, r)
-        return self.bitmap.dense_range_words32(base, base + SHARD_WIDTH)
+            # 1402.6407): ONE tally per kernel call, totals identical
+            # to the retired per-container walk (the flat view holds
+            # exactly the row's non-empty containers). Only residency
+            # MISSES reach this path — steady-state hot queries pay
+            # nothing here.
+            cost.note_containers(*flat.kind_counts())
+        return kernels.dense_words32(flat, base_key, 16)
 
     def device_row(self, row: int):
         """Device-resident dense row, decoded through the residency cache."""
@@ -836,8 +826,12 @@ class Fragment:
         if memo is not None and memo[0] == self.mutations:
             return memo[1]
         version = self.mutations
+        # flatten under the lock (metadata-only; containers are
+        # immutable once published), materialize + digest outside it —
+        # the id kernel no longer serializes writers
         with self.lock:
-            ids = self.bitmap.to_ids()
+            flat = kernels.flatten(self.bitmap)
+        ids = kernels.fragment_ids(flat)
         # one digest implementation (storage/integrity.py) shared by
         # the sync manifests, backup blob addressing, verify-on-load,
         # and the scrubber — every plane speaks the same checksums
@@ -847,11 +841,17 @@ class Fragment:
 
     def block_ids(self, block: int) -> np.ndarray:
         """All bit ids in one checksum block (for block repair)."""
+        return self.blocks_ids([block])[block]
+
+    def blocks_ids(self, blocks) -> dict[int, np.ndarray]:
+        """Ids of MANY checksum blocks from one materialization: one
+        flatten + one id kernel + one searchsorted slice per request —
+        the sync block server used to pay a full ``to_ids`` PER block
+        (O(blocks × population))."""
         with self.lock:
-            ids = self.bitmap.to_ids()
-        lo = np.uint64(block * BLOCK_ROWS) << np.uint64(20)
-        hi = np.uint64((block + 1) * BLOCK_ROWS) << np.uint64(20)
-        return ids[(ids >= lo) & (ids < hi)]
+            flat = kernels.flatten(self.bitmap)
+        ids = kernels.fragment_ids(flat)
+        return kernels.block_slices(ids, blocks, BLOCK_ROWS)
 
     # -------------------------------------------------------------- TopN feed
 

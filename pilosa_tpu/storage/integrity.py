@@ -153,7 +153,11 @@ def verify_snapshot_blocks(bitmap, sidecar: list[tuple[int, str]],
     sidecar (computed before op replay — the sidecar describes exactly
     the snapshot portion of the file). Raises CorruptFragmentError on
     the first differing block."""
-    live = block_digests(bitmap.to_ids())
+    _check_digests(block_digests(bitmap.to_ids()), sidecar, path)
+
+
+def _check_digests(live: list[tuple[int, str]],
+                   sidecar: list[tuple[int, str]], path: str) -> None:
     if live == sidecar:
         return
     want = dict(sidecar)
@@ -211,16 +215,36 @@ def load_verified(data: bytes, path: str, verify: bool = False):
     return bitmap, ops_at
 
 
-def verify_fragment_file(path: str):
+def verify_fragment_file(path: str, build_bitmap: bool = True):
     """THE disk-vs-disk verification recipe, shared by the scrubber,
     the chaos disk-integrity oracle, and the CLI check verb: read the
     file (through the fault plane's read seam), decode the snapshot
     with typed errors, and — when a sidecar exists — compare block
     digests. Raises CorruptFragmentError; returns (bitmap, data,
-    ops_at) so callers can replay/weigh the op tail."""
+    ops_at) so callers can replay/weigh the op tail.
+
+    ``build_bitmap=False`` is the scrub fast path: the snapshot's ids
+    go straight from the bytes through the vectorized kernel parser
+    (roaring/kernels.py) into the digests — no Container objects are
+    built — and the returned bitmap is None. Structural validation and
+    the digest verdict are identical (the kernel parser raises the
+    same errors on the same inputs)."""
     data = read_file(path)
-    bitmap, ops_at = load_verified(data, path, verify=False)
     sidecar = load_checksums(path + CHECKSUM_SUFFIX)
+    if not build_bitmap:
+        from pilosa_tpu.roaring import kernels
+
+        try:
+            ids, ops_at = kernels.snapshot_ids(data)
+        except DECODE_ERRORS as e:
+            offset = len(data) if "truncated" in str(e).lower() else None
+            raise CorruptFragmentError(
+                path, f"snapshot decode failed: {e}", offset=offset,
+            ) from e
+        if sidecar is not None:
+            _check_digests(block_digests(ids), sidecar, path)
+        return None, data, ops_at
+    bitmap, ops_at = load_verified(data, path, verify=False)
     if sidecar is not None:
         verify_snapshot_blocks(bitmap, sidecar, path)
     return bitmap, data, ops_at

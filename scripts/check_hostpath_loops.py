@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Static lint: no new per-container host loops in kernel-consumer modules.
+
+PR 18 moved every whole-fragment host-path decode (row decode, block
+digests, sync manifest materialization, scrub verification, CDC encode)
+onto the batched numpy kernels in ``pilosa_tpu/roaring/kernels.py``.
+A per-container ``for`` loop that walks container payloads in any of
+those modules re-introduces the exact Python-envelope cost the kernel
+layer retired — and it does so silently, because the output stays
+byte-identical while throughput quietly regresses.
+
+This lint walks the AST of each consumer module and fails on any loop
+or comprehension whose source touches a container-walk marker
+(``.container(``, ``._containers``, ``.lows()``, ``contains_low``,
+``dense_range_words32``). Point probes that are cheaper than a kernel
+dispatch are pinned in ALLOWLIST by (module, enclosing function);
+adding an entry is a reviewed decision, not a default.
+
+Run from the repo root:  python scripts/check_hostpath_loops.py
+Exit 0 = clean, 1 = violations (one line each), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+# The five consumer surfaces named by the kernel layer's contract.
+MODULES = [
+    "pilosa_tpu/storage/fragment.py",
+    "pilosa_tpu/storage/integrity.py",
+    "pilosa_tpu/parallel/scrub.py",
+    "pilosa_tpu/parallel/cluster.py",
+    "pilosa_tpu/cdc/tailer.py",
+]
+
+# Source substrings that mean "this code is touching container
+# internals" — a loop over any of them is a per-container walk.
+MARKERS = (
+    ".container(",
+    "._containers",
+    ".lows()",
+    "contains_low",
+    "dense_range_words32",
+)
+
+# (module, enclosing function) pairs allowed to keep a container loop.
+ALLOWLIST = {
+    # single-position membership probe over candidate keys: O(16)
+    # metadata lookups, strictly cheaper than flattening the fragment
+    ("pilosa_tpu/storage/fragment.py", "rows_containing"),
+}
+
+_LOOP_NODES = (
+    ast.For,
+    ast.AsyncFor,
+    ast.While,
+    ast.ListComp,
+    ast.SetComp,
+    ast.DictComp,
+    ast.GeneratorExp,
+)
+
+
+def _check_module(root: Path, rel: str) -> list[str]:
+    path = root / rel
+    src = path.read_text()
+    tree = ast.parse(src, filename=rel)
+    problems: list[str] = []
+
+    def visit(node: ast.AST, func: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        if isinstance(node, _LOOP_NODES):
+            seg = ast.get_source_segment(src, node) or ""
+            hit = next((m for m in MARKERS if m in seg), None)
+            if hit is not None and (rel, func) not in ALLOWLIST:
+                problems.append(
+                    f"{rel}:{node.lineno}: per-container loop in "
+                    f"{func}() touches {hit!r} — use the batched "
+                    f"kernels in pilosa_tpu/roaring/kernels.py "
+                    f"(or pin an ALLOWLIST entry with review)"
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(tree, "<module>")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parents[1]
+    if not root.is_dir():
+        print(f"check_hostpath_loops: not a directory: {root}", file=sys.stderr)
+        return 2
+    problems: list[str] = []
+    for rel in MODULES:
+        if not (root / rel).exists():
+            print(f"check_hostpath_loops: missing module: {rel}", file=sys.stderr)
+            return 2
+        problems.extend(_check_module(root, rel))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"check_hostpath_loops: {len(problems)} violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

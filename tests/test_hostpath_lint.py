@@ -1,0 +1,72 @@
+"""Tier-1 wiring for scripts/check_hostpath_loops.py: the repo stays
+clean, and the lint actually bites when a per-container loop sneaks
+back into a kernel-consumer module."""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+SCRIPT = REPO / "scripts" / "check_hostpath_loops.py"
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, str(SCRIPT), *args],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_repo_is_clean():
+    res = _run()
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_lint_catches_reintroduced_container_loop(tmp_path):
+    # clone the consumer set into a scratch root, then regress one file
+    for rel in [
+        "pilosa_tpu/storage/fragment.py",
+        "pilosa_tpu/storage/integrity.py",
+        "pilosa_tpu/parallel/scrub.py",
+        "pilosa_tpu/parallel/cluster.py",
+        "pilosa_tpu/cdc/tailer.py",
+    ]:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    victim = tmp_path / "pilosa_tpu" / "storage" / "integrity.py"
+    victim.write_text(victim.read_text() + (
+        "\n\ndef _regressed_walk(bitmap):\n"
+        "    out = []\n"
+        "    for key in bitmap.keys:\n"
+        "        out.append(bitmap.container(key).lows())\n"
+        "    return out\n"
+    ))
+    res = _run(str(tmp_path))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "integrity.py" in res.stdout
+    assert "_regressed_walk" in res.stdout
+
+
+def test_allowlist_is_pinned_not_wildcarded(tmp_path):
+    # a loop in a NON-allowlisted function of fragment.py must fail
+    # even though fragment.py has an allowlist entry
+    for rel in [
+        "pilosa_tpu/storage/fragment.py",
+        "pilosa_tpu/storage/integrity.py",
+        "pilosa_tpu/parallel/scrub.py",
+        "pilosa_tpu/parallel/cluster.py",
+        "pilosa_tpu/cdc/tailer.py",
+    ]:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    victim = tmp_path / "pilosa_tpu" / "storage" / "fragment.py"
+    victim.write_text(victim.read_text() + (
+        "\n\ndef _other_walk(bm):\n"
+        "    return [bm.container(k) for k in bm.keys]\n"
+    ))
+    res = _run(str(tmp_path))
+    assert res.returncode == 1
+    assert "_other_walk" in res.stdout

@@ -179,6 +179,12 @@ def test_status_info_version_metrics(node):
     req("POST", f"{node}/index/i/query", b"Count(Row(f=1))")
     text = req("GET", f"{node}/metrics", raw=True).decode()
     assert "pilosa_tpu_serving_waves_total" in text
+    # host-path kernel counters present from scrape one (PR 18) — and
+    # the query above decoded at least one row through the kernels
+    assert "pilosa_tpu_hostpath_kernel_calls_total" in text
+    kline = [l for l in text.splitlines()
+             if l.startswith("pilosa_tpu_hostpath_kernel_calls_total")]
+    assert int(kline[0].split()[1]) > 0
     (budget_line,) = [l for l in text.splitlines()
                       if l.startswith("pilosa_tpu_residency_budget_bytes")]
     dv = req("GET", f"{node}/debug/vars")
