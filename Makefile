@@ -24,8 +24,8 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 	mp-smoke multitenant-smoke mesh-smoke autopilot-smoke bench-ingest \
 	bench-serving bench-sync bench-durability bench-tracing \
 	bench-profiling bench-chaos bench-scrub bench-mp bench-multitenant \
-	bench-mesh bench-autopilot cdc-smoke bench-cdc elastic-smoke \
-	bench-elastic hostpath-smoke bench-hostpath
+	bench-mesh bench-mesh-quantized bench-autopilot cdc-smoke bench-cdc \
+	elastic-smoke bench-elastic hostpath-smoke bench-hostpath
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -101,12 +101,14 @@ multitenant-smoke:
 # vs single-device across mesh sizes 1/2/4/8 incl. 2-D groups x shards
 # factorizations at non-divisible shard counts, the narrowed-lane wire
 # model + PROFILE reduceBytes, the roaring row-frame roundtrip, the
-# experimental-fallback multi-mesh serialization guard, and the
-# query_raw vs cache-hit envelope mirror contract
-# (docs/OPERATIONS.md multi-chip mesh)
+# quantized candidate-ranking lane (error-bound/window properties +
+# verify_quantized byte-identity + wire counters), the MULTICHIP record
+# schema + hardened trace parse, the experimental-fallback multi-mesh
+# serialization guard, and the query_raw vs cache-hit envelope mirror
+# contract (docs/OPERATIONS.md multi-chip mesh)
 mesh-smoke:
 	$(PYTEST) tests/test_mesh_reduction.py tests/test_envelope_contract.py \
-		-m "not slow"
+		tests/test_multichip_schema.py -m "not slow"
 
 # autopilot-smoke: the placement plane — planner properties (uniform ⇒
 # zero moves, hot-spot drain, dwell freezing), placement-table fencing/
@@ -205,10 +207,28 @@ bench-multitenant:
 # multi-chip reduction-plane gate: per-mesh-size (2/4/8, 2-D
 # factorizations) subprocesses over the canonical 20 dryrun shapes —
 # byte-identical vs the dense 1-D path, >=4x reduction-lane wire-byte
-# reduction on Row/TopN, cols/sec + reduce-bytes records written to
-# MULTICHIP_r06.json
+# reduction on Row/TopN, a measured quantized-ranking net wire
+# reduction with byte-identical results (verify_quantized), and
+# model-vs-measured wire reconciliation (or a structured skip on
+# CPU-only hosts); records written to MULTICHIP_r07.json, shape pinned
+# by scripts/check_multichip_schema.py
 bench-mesh:
 	env JAX_PLATFORMS=cpu python bench_suite.py --configs mesh
+	python scripts/check_multichip_schema.py
+
+# just the quantized-ranking leg of the gate, per mesh size: the 8-bit
+# lane's byte-identity certification + wire delta without the full
+# record rewrite (docs/OPERATIONS.md quantized candidate ranking)
+bench-mesh-quantized:
+	env JAX_PLATFORMS= PALLAS_AXON_POOL_IPS= \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench_suite.py --mesh-inner 2
+	env JAX_PLATFORMS= PALLAS_AXON_POOL_IPS= \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench_suite.py --mesh-inner 4
+	env JAX_PLATFORMS= PALLAS_AXON_POOL_IPS= \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench_suite.py --mesh-inner 8
 
 # autopilot placement-plane gate: a 3-process cluster under
 # hot-spotted Zipf traffic — tail p99 recovers to <=1.5x the

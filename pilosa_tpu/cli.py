@@ -41,6 +41,11 @@ heartbeat-timeout = 2.0       # tight per-probe timeout for liveness
                               # auto - mesh when >1 JAX device)
 # mesh-groups = 0             # reduction groups for multi-chip meshes;
                               # 0 = auto (flat 1-D mesh)
+# topn-quantized-ranking = false # EQuARX 8-bit TopN/GroupBy candidate
+                              # ranking on the inter-group wire; final
+                              # results stay byte-identical (exact
+                              # recount on the error-bound-widened
+                              # window)
 # device-budget-bytes = 0     # HBM residency budget; 0 = auto
 long-query-time = 0.0         # log queries slower than this; 0 = off
 max-writes-per-request = 5000 # reject larger write batches; 0 = unlimited

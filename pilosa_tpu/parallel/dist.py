@@ -161,6 +161,17 @@ def _dist_body(structure, reduce_kind: str, leaf_ranks: tuple, hier=None):
             return reduce_split(batch.split_sum(out))
         if reduce_kind == "countrows":
             return reduce_split(batch.split_sum(out, axis=0))
+        if reduce_kind == "countrows_q":
+            # quantized candidate-ranking lane: exact intra-group psum
+            # of the split channels, then the 8-bit scaled inter-group
+            # hop (reduction.hier_quantized_counts — lossless
+            # pass-through on a flat mesh). Only the executor's TopN
+            # ranking pass dispatches this kind; the exact recount of
+            # the widened window rides plain 'countrows'.
+            part = lax.psum(batch.split_sum(out, axis=0), SHARDS_AXIS)
+            return reduction.hier_quantized_counts(
+                part, GROUPS_AXIS if hier is not None else None
+            )
         if reduce_kind == "bsisum":
             plane_counts, n = out  # [S_loc, depth], [S_loc]
             return reduce_split(
@@ -264,11 +275,16 @@ def _dist_fn_batched(mesh, structure, reduce_kind: str, leaf_ranks: tuple,
 
 
 def _dist_groupby_level_fn(mesh, filt_structure, n_filt: int, n_scalars: int,
-                           n_gather: int, has_agg: bool):
+                           n_gather: int, has_agg: bool,
+                           quantized: bool = False):
     """SPMD GroupBy level program (same per-shard body as the local
     builder, reduced over the mesh — hierarchically on a 2-D mesh, like
-    every other split-sum lane)."""
-    key = ("gbl", mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg)
+    every other split-sum lane). ``quantized`` routes the per-candidate
+    counts through the 8-bit ranking lane — only intermediate PRUNING
+    levels use it (their counts merely gate candidate survival); the
+    final level always stays lossless, so reported counts are exact."""
+    key = ("gbl", mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg,
+           quantized)
     fn = _DIST_JIT_CACHE.get(key)
     if fn is not None:
         return fn
@@ -302,7 +318,13 @@ def _dist_groupby_level_fn(mesh, filt_structure, n_filt: int, n_scalars: int,
 
         out = jax.vmap(per_shard)(*leaves)
         if not has_agg:
-            return reduce_split(batch.split_sum(out, axis=0)).ravel()
+            packed = batch.split_sum(out, axis=0)
+            if quantized:
+                part = lax.psum(packed, SHARDS_AXIS)
+                return reduction.hier_quantized_counts(
+                    part, GROUPS_AXIS if hier is not None else None
+                ).ravel()
+            return reduce_split(packed).ravel()
         return jnp.concatenate([
             reduce_split(batch.split_sum(o, axis=0)).ravel() for o in out
         ])
@@ -345,14 +367,29 @@ class DistExecutor(Executor):
     through the HTTP layer (parallel/cluster_exec.py), as the reference's
     do."""
 
-    def __init__(self, holder, mesh=None, groups: int | None = None):
+    def __init__(self, holder, mesh=None, groups: int | None = None,
+                 quantized_ranking: bool = False,
+                 verify_quantized: bool = False):
         super().__init__(holder)
         self.mesh = mesh if mesh is not None else make_mesh(groups=groups)
         # micro-batch argument budgeting counts per-DEVICE bytes: leaves
         # are sharded over the mesh, so each chip holds 1/size of them
         self.arg_shard_factor = self.mesh.size
         self._hier = mesh_groups(self.mesh)
+        # EQuARX quantized candidate-ranking lane (topn-quantized-ranking
+        # knob): TopN ranking + GroupBy pruning counts cross the
+        # inter-group wire as 8-bit scaled lanes; final results stay
+        # byte-identical via the widened-window exact recount. On a flat
+        # 1-D mesh the lane is a lossless pass-through (same code path,
+        # zero error bound). verify_quantized additionally runs the
+        # lossless path per TopN and asserts identity — the bench/dryrun
+        # certification mode, not for serving.
+        self.quantized_ranking = bool(quantized_ranking)
+        self.verify_quantized = bool(verify_quantized)
         _LIVE_EXECUTORS.add(self)
+
+    def _quant_ranking_active(self) -> bool:
+        return self.quantized_ranking
 
     def _make_block(self, shard_list):
         return ShardAssignment(shard_list, self.mesh)
@@ -385,9 +422,10 @@ class DistExecutor(Executor):
                                 n_scalars, n_queries)
 
     def _groupby_level_program(self, filt_structure, n_filt, n_scalars,
-                               n_gather, has_agg):
+                               n_gather, has_agg, quantized=False):
         return _dist_groupby_level_fn(
-            self.mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg
+            self.mesh, filt_structure, n_filt, n_scalars, n_gather, has_agg,
+            quantized,
         )
 
     # ------------------------------------------------ dispatch wrapping
@@ -419,20 +457,50 @@ class DistExecutor(Executor):
         elems = 1
         for d in out_shape:
             elems *= int(d)
-        dense = reduction.dense_reduce_bytes(self.mesh.size, elems)
-        if self._hier is None:
-            actual, intra = dense, 0
-        else:
-            g, spg = self._hier
-            actual, intra = reduction.hier_reduce_bytes(
-                reduce_kind, elems, g, spg, max(padded // g, 1)
+        quantized = 0
+        if reduce_kind in ("countrows_q", "groupby_q"):
+            # quantized ranking dispatch: the packed section is
+            # [2, R + n_blocks] (batched: leading B; groupby: raveled,
+            # accounted per chunk). Recover R from the section width and
+            # model the 8-bit hop vs its lossless countrows equivalent.
+            width = (elems // 2 if reduce_kind == "groupby_q"
+                     else int(out_shape[-1]))
+            mult = max(elems // (2 * width), 1)
+            n_rows = reduction.quant_real_elems(width)
+            # dense equivalent: the flat ring moving the same candidate
+            # lanes as exact [2, R] int32 split channels
+            dense = reduction.dense_reduce_bytes(
+                self.mesh.size, 2 * n_rows * mult
             )
+            if self._hier is None:
+                actual, intra, lossless = dense, 0, dense
+            else:
+                g, spg = self._hier
+                actual, intra, lossless = reduction.quant_hier_bytes(
+                    n_rows, g, spg, max(padded // g, 1)
+                )
+                actual, intra, lossless = (
+                    actual * mult, intra * mult, lossless * mult
+                )
+            reduction.global_reduce_stats().note_quant_reduce(
+                actual, lossless
+            )
+            quantized = actual
+        else:
+            dense = reduction.dense_reduce_bytes(self.mesh.size, elems)
+            if self._hier is None:
+                actual, intra = dense, 0
+            else:
+                g, spg = self._hier
+                actual, intra = reduction.hier_reduce_bytes(
+                    reduce_kind, elems, g, spg, max(padded // g, 1)
+                )
         reduction.global_reduce_stats().note_reduce(
             dense, actual, intra, self._hier is not None
         )
         cost = current_cost()
         if cost is not None:
-            cost.note_reduce(dense, actual)
+            cost.note_reduce(dense, actual, quantized=quantized)
 
     def _row_host(self, stacked, block):
         """Row-gather readback. On the hierarchical mesh the dense

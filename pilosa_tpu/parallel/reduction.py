@@ -11,9 +11,13 @@ both borrowed from systems that already pay this bill:
   exactly on the receiver. Unlike EQuARX's lossy block scaling, every
   lane here must stay BIT-EXACT — counts and BSI aggregates are answers,
   not gradients — so narrowing only happens where the static bound proves
-  losslessness, with an int32 exact fallback. (Lossy scaling stays
-  reserved for TopN *candidate ranking* lanes, where a final exact
-  re-verify would bound the error; no lane uses it yet.)
+  losslessness, with an int32 exact fallback. The reserved accuracy
+  budget is now spent where EQuARX actually spends it: the
+  *candidate-ranking* lanes of TopN/GroupBy (hier_quantized_counts)
+  carry 8-bit max-scaled mantissas with a transmitted error bound, the
+  executor widens the final candidate window by that bound, and the
+  exact recount on the widened window keeps results byte-identical
+  (topn-quantized-ranking knob, default off — docs/OPERATIONS.md).
 
 * Roaring-compressed row gathers (Chambi et al., arXiv:1402.6407): a
   materialized Row result crossing the wire as dense words pays
@@ -57,6 +61,15 @@ SPLIT_MASK = (1 << SPLIT_SHIFT) - 1
 # SPLIT_MASK (lo) and SHARD_WIDTH >> SPLIT_SHIFT (hi)
 HI_PER_SLOT = SHARD_WIDTH >> SPLIT_SHIFT
 
+# Quantized candidate-ranking lane (EQuARX-style, arXiv:2506.17615):
+# candidates per max-scale block. One int32 scale + one error-bound lane
+# amortize over QUANT_BLOCK uint8 mantissas, so the encoded payload is
+# ~1 byte/candidate vs the >=3 bytes/candidate of the lossless split
+# channels. Exactness note: the merged per-group total is carried in one
+# int32 lane, exact while group totals stay < 2^31 — i.e. up to 2^11
+# fully-set shards per group, far beyond any mesh this plane drives.
+QUANT_BLOCK = 256
+
 
 def lane_dtype_bytes(bound: int) -> int:
     """Width of the narrowest integer lane proven lossless for values in
@@ -87,6 +100,112 @@ def split_channel_bounds(group_slots: int) -> tuple[int, int]:
 # so (psum over the shards axis) + (gather + local sum over groups)
 # equals the flat psum channel-for-channel, and the narrow cast is a
 # no-op on values the static bound covers.
+
+
+def quant_blocks(n_rows: int) -> int:
+    """Number of QUANT_BLOCK-sized scale blocks covering ``n_rows``
+    candidate lanes."""
+    return max(1, -(-n_rows // QUANT_BLOCK))
+
+
+def quant_total_elems(n_rows: int) -> int:
+    """Lanes in a quantized packed result: the approx counts plus one
+    error-bound lane per scale block."""
+    return n_rows + quant_blocks(n_rows)
+
+
+def quant_real_elems(total: int) -> int:
+    """Inverse of quant_total_elems (host accounting sees only the packed
+    shape). Exact by construction: total is monotone in n_rows."""
+    n = max(1, total - quant_blocks(total))
+    while quant_total_elems(n) < total:
+        n += 1
+    return n
+
+
+def quant_payload_bytes(n_rows: int) -> int:
+    """Encoded bytes ONE group contributes to the quantized inter-group
+    hop: a uint8 mantissa per candidate + an int32 scale per block."""
+    return n_rows * 1 + quant_blocks(n_rows) * 4
+
+
+def hier_quantized_counts(part, groups_axis):
+    """Inter-group hop for a CANDIDATE-RANKING split-sum partial
+    ``[2, R]`` — the EQuARX-style lossy lane (arXiv:2506.17615).
+
+    Per QUANT_BLOCK of candidates the per-group totals are max-scaled to
+    8 bits: integer scale ``s = max(1, ceil(max/255))`` and
+    stochastic-free deterministic round-to-nearest
+    ``q = (v + s//2) // s`` (pure int32 arithmetic — bit-reproducible
+    across dispatch order and group count, unlike float rounding).
+
+    Error bound (the stated contract the executor's window widening
+    relies on): per group ``|v - q*s| <= (s+1)//2``, and exactly 0 when
+    ``s == 1`` (max <= 255 quantizes losslessly). The decoded total's
+    error is at most the SUM of the per-group bounds, which the program
+    computes from the gathered scales and returns as one extra lane per
+    block — the bound crosses the wire with the data, so the host never
+    has to re-derive it from mesh geometry.
+
+    Returns split-form ``[2, R + n_blocks]``: approx counts followed by
+    per-block error bounds (batch.merge_split + split_quantized decode).
+    ``groups_axis=None`` (flat 1-D mesh) is the lossless pass-through:
+    approx == exact, bound == 0.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    flat = part[0] + (part[1] << SPLIT_SHIFT)  # exact int32 group totals
+    n_rows = flat.shape[0]
+    nb = quant_blocks(n_rows)
+    if groups_axis is None:
+        out = jnp.concatenate([flat, jnp.zeros((nb,), jnp.int32)])
+        return jnp.stack([out & SPLIT_MASK, out >> SPLIT_SHIFT])
+    pad = nb * QUANT_BLOCK - n_rows
+    blocks = jnp.pad(flat, (0, pad)).reshape(nb, QUANT_BLOCK)
+    mx = jnp.max(blocks, axis=1)
+    s = jnp.maximum((mx + 254) // 255, 1)  # [nb] int32 block scales
+    q = ((blocks + (s[:, None] >> 1)) // s[:, None]).astype(jnp.uint8)
+    gq = lax.all_gather(q, groups_axis)  # [G, nb, B] uint8  — the wire
+    gs = lax.all_gather(s, groups_axis)  # [G, nb] int32     — the wire
+    approx = jnp.sum(gq.astype(jnp.int32) * gs[:, :, None], axis=0)
+    approx = approx.reshape(nb * QUANT_BLOCK)[:n_rows]
+    err = jnp.sum(jnp.where(gs > 1, (gs + 1) >> 1, 0), axis=0)  # [nb]
+    out = jnp.concatenate([approx, err])
+    return jnp.stack([out & SPLIT_MASK, out >> SPLIT_SHIFT])
+
+
+def split_quantized(merged: np.ndarray, n_rows: int
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Host decode of one merged quantized section ``[R + n_blocks]``
+    (after batch.merge_split): (approx counts [R], per-candidate error
+    bound [R] — each candidate inherits its scale block's bound)."""
+    nb = quant_blocks(n_rows)
+    approx = np.asarray(merged[:n_rows], np.int64)
+    err_blocks = np.asarray(merged[n_rows:n_rows + nb], np.int64)
+    err = np.repeat(err_blocks, QUANT_BLOCK)[:n_rows]
+    return approx, err
+
+
+def quant_topn_window(approx: np.ndarray, err: np.ndarray, n: int
+                      ) -> np.ndarray:
+    """Indices of every candidate that could still be in the exact top
+    ``n`` given approx counts with per-candidate error bound ``err``
+    (true count in [approx-err, approx+err]).
+
+    Rule: admit j unless n candidates have a LOWER bound strictly above
+    j's UPPER bound — those n have provably greater exact counts, so j's
+    exact rank exceeds n under any tie-break. The window is therefore a
+    superset of the exact top n (tests/test_mesh_reduction.py holds the
+    property), and the widening per candidate is exactly its error
+    bound on each side."""
+    m = len(approx)
+    if n <= 0 or m <= n:
+        return np.arange(m)
+    lo = approx - err
+    hi = approx + err
+    cut = np.partition(lo, m - n)[m - n]  # n-th largest lower bound
+    return np.nonzero(hi >= cut)[0]
 
 
 def hier_split_channels(part, groups_axis: str, group_slots: int):
@@ -151,6 +270,21 @@ def hier_reduce_bytes(reduce_kind: str, out_elems: int, groups: int,
     return inter, intra
 
 
+def quant_hier_bytes(n_rows: int, groups: int, shards_per_group: int,
+                     group_slots: int) -> tuple[int, int, int]:
+    """(inter, intra, lossless_inter) for one QUANTIZED ranking dispatch
+    of ``n_rows`` candidate lanes: the 8-bit scaled inter-group hop, the
+    unchanged dense intra-group all-reduce of the [2, R] split channels,
+    and what the same hop would have cost on the lossless countrows
+    lane — the delta the dist_reduce_quantized_* series reports."""
+    inter = groups * (groups - 1) * quant_payload_bytes(n_rows)
+    intra = groups * 2 * max(shards_per_group - 1, 0) * 2 * n_rows * 4
+    lossless = groups * (groups - 1) * inter_group_payload_bytes(
+        "countrows", 2 * n_rows, group_slots
+    )
+    return inter, intra, lossless
+
+
 # -------------------------------------------------- row-gather wire sim
 
 
@@ -213,6 +347,11 @@ class ReduceStats:
             self.row_gathers = 0
             self.row_dense_bytes = 0
             self.row_actual_bytes = 0
+            self.quant_dispatches = 0
+            self.quant_actual_bytes = 0
+            self.quant_lossless_bytes = 0
+            self.quant_window_rows = 0
+            self.quant_candidate_rows = 0
 
     def note_reduce(self, dense: int, actual: int, intra: int,
                     hier: bool) -> None:
@@ -222,6 +361,25 @@ class ReduceStats:
             self.dense_bytes += dense
             self.actual_bytes += actual
             self.intra_bytes += intra
+
+    def note_quant_reduce(self, actual: int, lossless: int) -> None:
+        """One quantized ranking dispatch: the encoded hop bytes vs what
+        the lossless lane would have moved for the same candidates.
+        Rides ALONGSIDE note_reduce (the hop is real actual_bytes
+        traffic); this series isolates the quantization delta."""
+        with self._lock:
+            self.quant_dispatches += 1
+            self.quant_actual_bytes += actual
+            self.quant_lossless_bytes += lossless
+
+    def note_quant_window(self, window_rows: int, candidate_rows: int
+                          ) -> None:
+        """One TopN window selection: candidates surviving into the
+        exact recount vs the full ranked set — the other half of the
+        saving (the lossless pass shrinks to the window)."""
+        with self._lock:
+            self.quant_window_rows += window_rows
+            self.quant_candidate_rows += candidate_rows
 
     def note_row_gather(self, dense: int, actual: int) -> None:
         with self._lock:
@@ -240,6 +398,11 @@ class ReduceStats:
                 "row_gathers": self.row_gathers,
                 "row_dense_bytes": self.row_dense_bytes,
                 "row_actual_bytes": self.row_actual_bytes,
+                "quantized_dispatches": self.quant_dispatches,
+                "quantized_actual_bytes": self.quant_actual_bytes,
+                "quantized_lossless_bytes": self.quant_lossless_bytes,
+                "quantized_window_rows": self.quant_window_rows,
+                "quantized_candidate_rows": self.quant_candidate_rows,
             }
 
 

@@ -34,6 +34,7 @@ class ServerConfig:
         heartbeat_timeout: float = 2.0,
         use_mesh: bool | None = None,
         mesh_groups: int = 0,
+        topn_quantized_ranking: bool = False,
         tracing: bool = False,
         trace_sample_rate: float = 0.0,
         trace_log_dir: str = "",
@@ -120,6 +121,13 @@ class ServerConfig:
                 f"invalid mesh-groups {mesh_groups!r} (want >= 0)"
             )
         self.mesh_groups = mesh_groups
+        # EQuARX quantized TopN/GroupBy candidate ranking (default off):
+        # ranking counts cross the inter-group wire as 8-bit scaled
+        # lanes; final results stay byte-identical via the
+        # widened-window exact recount (docs/OPERATIONS.md "Multi-chip
+        # mesh"). Only meaningful with the mesh executor; harmless
+        # (lossless pass-through) on a flat mesh.
+        self.topn_quantized_ranking = bool(topn_quantized_ranking)
         # Distributed tracing (docs/OBSERVABILITY.md): `tracing = true`
         # is the legacy always-on switch (rate 1.0); `trace-sample-rate`
         # sets probabilistic sampling directly (0 = off, zero-overhead).
@@ -438,6 +446,9 @@ class ServerConfig:
                 if d.get("use-mesh") not in (None, "") else None
             ),
             mesh_groups=int(d.get("mesh-groups", 0) or 0),
+            topn_quantized_ranking=_parse_bool(
+                d.get("topn-quantized-ranking", False)
+            ),
             qos_max_inflight=int(d.get("qos-max-inflight", 0)),
             qos_tenant_inflight=int(d.get("qos-tenant-inflight", 0)),
             qos_default_deadline=_parse_duration(
@@ -595,6 +606,7 @@ class ServerConfig:
             "device-budget-bytes": self.device_budget_bytes,
             "use-mesh": self.use_mesh,
             "mesh-groups": self.mesh_groups,
+            "topn-quantized-ranking": self.topn_quantized_ranking,
             "qos-max-inflight": self.qos_max_inflight,
             "qos-tenant-inflight": self.qos_tenant_inflight,
             "qos-default-deadline": self.qos_default_deadline,
@@ -983,8 +995,11 @@ class Server:
         if use_mesh:
             from pilosa_tpu.parallel.dist import DistExecutor
 
-            local = DistExecutor(self.holder,
-                                 groups=self.config.mesh_groups or None)
+            local = DistExecutor(
+                self.holder,
+                groups=self.config.mesh_groups or None,
+                quantized_ranking=self.config.topn_quantized_ranking,
+            )
         else:
             local = Executor(self.holder)
         self.api.executor = ClusterExecutor(

@@ -71,7 +71,7 @@ class ProfileNode:
                  "row_cache_hits", "row_cache_misses", "plan_cache_hit",
                  "operand_memo_hit", "rows_materialized", "device_bytes",
                  "reduce_dense_bytes", "reduce_actual_bytes",
-                 "children", "leaves")
+                 "reduce_quant_bytes", "children", "leaves")
 
     def __init__(self, name: str, pql: str = ""):
         self.name = name
@@ -92,6 +92,7 @@ class ProfileNode:
         self.device_bytes = 0
         self.reduce_dense_bytes = 0
         self.reduce_actual_bytes = 0
+        self.reduce_quant_bytes = 0
         # static AST skeleton (ready-to-emit dicts, shared via the
         # skeleton memo — never mutated)
         self.children: list[dict] = []
@@ -121,6 +122,10 @@ class ProfileNode:
             # inter-group lane this dispatch actually paid for
             out["reduceBytes"] = {"denseEquiv": self.reduce_dense_bytes,
                                   "actual": self.reduce_actual_bytes}
+            if self.reduce_quant_bytes:
+                # portion of `actual` that crossed on the 8-bit EQuARX
+                # ranking lane (topn-quantized-ranking)
+                out["reduceBytes"]["quantized"] = self.reduce_quant_bytes
         if self.leaves:
             out["leaves"] = self.leaves
         if self.children:
@@ -241,7 +246,8 @@ class CostContext:
                  "c_array", "c_bitmap", "c_run", "row_cache_hits",
                  "row_cache_misses", "plan_cache_hits", "plan_cache_misses",
                  "rows_materialized", "device_bytes", "reduce_dense_bytes",
-                 "reduce_actual_bytes", "profile", "current")
+                 "reduce_actual_bytes", "reduce_quant_bytes", "profile",
+                 "current")
 
     def __init__(self, tenant: str = "default", index: str = "",
                  profile: QueryProfile | None = None):
@@ -261,6 +267,7 @@ class CostContext:
         self.device_bytes = 0
         self.reduce_dense_bytes = 0
         self.reduce_actual_bytes = 0
+        self.reduce_quant_bytes = 0
         self.profile = profile
         self.current: ProfileNode | None = None
 
@@ -318,16 +325,21 @@ class CostContext:
         if node is not None:
             node.rows_materialized += n
 
-    def note_reduce(self, dense: int, actual: int) -> None:
+    def note_reduce(self, dense: int, actual: int,
+                    quantized: int = 0) -> None:
         """One reduction-lane crossing on the hierarchical mesh
         (parallel/reduction.py): flat dense-equivalent bytes vs the
-        encoded bytes actually modeled on the inter-group wire."""
+        encoded bytes actually modeled on the inter-group wire.
+        ``quantized`` marks the portion of ``actual`` that crossed on
+        the 8-bit EQuARX ranking lane."""
         self.reduce_dense_bytes += dense
         self.reduce_actual_bytes += actual
+        self.reduce_quant_bytes += quantized
         node = self.current
         if node is not None:
             node.reduce_dense_bytes += dense
             node.reduce_actual_bytes += actual
+            node.reduce_quant_bytes += quantized
 
     def note_plan(self, hit: bool) -> None:
         if hit:
@@ -358,6 +370,8 @@ class CostContext:
         if self.reduce_dense_bytes:
             out["reduceBytes"] = {"denseEquiv": self.reduce_dense_bytes,
                                   "actual": self.reduce_actual_bytes}
+            if self.reduce_quant_bytes:
+                out["reduceBytes"]["quantized"] = self.reduce_quant_bytes
         return out
 
 

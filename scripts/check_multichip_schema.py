@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Pin the MULTICHIP record schema (bench_suite ``mesh`` config).
+
+The mesh bench's per-size records gate three contracts — byte-identical
+results, the hierarchical wire-byte ratio, and (since r07) the
+quantized-ranking wire reduction + model-vs-measured reconciliation.
+Downstream tooling greps these records, so shape drift is a silent
+break: this script validates the committed MULTICHIP_r07.json (and any
+path given on the command line) field-by-field and exits nonzero with
+one line per problem. tests/test_multichip_schema.py runs it in tier-1
+against the committed record and synthetic good/bad documents.
+
+Usage: python scripts/check_multichip_schema.py [record.json ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+NUMERIC = (int, float)
+
+# (field name, required types) for each per-mesh record
+MESH_FIELDS = [
+    ("n_devices", int),
+    ("mesh_shape", list),
+    ("n_shards", int),
+    ("shapes", int),
+    ("identical", bool),
+    ("mismatches", list),
+    ("cols_per_sec", NUMERIC),
+    ("row_topn_reduce_bytes", dict),
+    ("reduce_bytes", dict),
+    ("quantized", dict),
+    ("wire_reconciliation", dict),
+    ("ok", bool),
+]
+
+REDUCE_BYTES_FIELDS = [
+    "dispatches", "hier_dispatches", "dense_bytes", "actual_bytes",
+    "intra_bytes", "row_gathers", "row_dense_bytes", "row_actual_bytes",
+    # the quantized-ranking counters ride the same snapshot (and surface
+    # on /metrics as dist_reduce_quantized_*)
+    "quantized_dispatches", "quantized_actual_bytes",
+    "quantized_lossless_bytes", "quantized_window_rows",
+    "quantized_candidate_rows",
+]
+
+QUANT_WIRE_FIELDS = [
+    "lossless_inter_bytes", "quantized_inter_bytes", "ratio", "lane_ratio",
+]
+
+RECON_STATUSES = {"measured", "skipped"}
+
+
+def _typename(t) -> str:
+    if isinstance(t, tuple):
+        return "/".join(x.__name__ for x in t)
+    return t.__name__
+
+
+def _need(out, where, obj, field, types=NUMERIC):
+    if field not in obj:
+        out.append(f"{where}: missing {field!r}")
+        return None
+    v = obj[field]
+    # bool is an int subclass; only accept it where asked for
+    if isinstance(v, bool) and types not in (bool,):
+        out.append(f"{where}.{field}: expected {_typename(types)}, "
+                   f"got bool")
+        return None
+    if not isinstance(v, types):
+        out.append(f"{where}.{field}: expected {_typename(types)}, "
+                   f"got {type(v).__name__}")
+        return None
+    return v
+
+
+def check_record(rec: dict, where: str = "mesh") -> list[str]:
+    """Validate ONE per-mesh record; returns a list of problem strings
+    (empty = conforming)."""
+    out: list[str] = []
+    if not isinstance(rec, dict):
+        return [f"{where}: record is {type(rec).__name__}, not dict"]
+    for field, types in MESH_FIELDS:
+        _need(out, where, rec, field, types)
+
+    rtb = rec.get("row_topn_reduce_bytes")
+    if isinstance(rtb, dict):
+        for f in ("dense_equiv", "actual", "ratio"):
+            _need(out, f"{where}.row_topn_reduce_bytes", rtb, f)
+
+    rb = rec.get("reduce_bytes")
+    if isinstance(rb, dict):
+        for f in REDUCE_BYTES_FIELDS:
+            _need(out, f"{where}.reduce_bytes", rb, f)
+
+    q = rec.get("quantized")
+    if isinstance(q, dict):
+        _need(out, f"{where}.quantized", q, "identical", bool)
+        _need(out, f"{where}.quantized", q, "mismatches", list)
+        _need(out, f"{where}.quantized", q, "ranking_queries", int)
+        _need(out, f"{where}.quantized", q, "ok", bool)
+        wire = _need(out, f"{where}.quantized", q, "wire", dict)
+        if wire is not None:
+            for f in QUANT_WIRE_FIELDS:
+                _need(out, f"{where}.quantized.wire", wire, f)
+        window = _need(out, f"{where}.quantized", q, "window", dict)
+        if window is not None:
+            for f in ("candidate_rows", "window_rows"):
+                _need(out, f"{where}.quantized.window", window, f)
+
+    wr = rec.get("wire_reconciliation")
+    if isinstance(wr, dict):
+        status = _need(out, f"{where}.wire_reconciliation", wr,
+                       "status", str)
+        _need(out, f"{where}.wire_reconciliation", wr, "band", list)
+        _need(out, f"{where}.wire_reconciliation", wr, "model_bytes")
+        if status is not None and status not in RECON_STATUSES:
+            out.append(f"{where}.wire_reconciliation.status: {status!r} "
+                       f"not in {sorted(RECON_STATUSES)}")
+        if status == "measured":
+            _need(out, f"{where}.wire_reconciliation", wr,
+                  "measured_bytes")
+            _need(out, f"{where}.wire_reconciliation", wr,
+                  "within_band", bool)
+        elif status == "skipped":
+            # the structured-skip contract: a reason string, never a
+            # bare failure
+            _need(out, f"{where}.wire_reconciliation", wr, "reason", str)
+    return out
+
+
+def check_document(doc: dict) -> list[str]:
+    """Validate a whole MULTICHIP_r07-style document."""
+    out: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, not dict"]
+    for field, types in [("config", str), ("metric", str),
+                         ("meshes", list), ("ok", bool)]:
+        _need(out, "doc", doc, field, types)
+    meshes = doc.get("meshes")
+    if isinstance(meshes, list):
+        if not meshes:
+            out.append("doc.meshes: empty")
+        for i, rec in enumerate(meshes):
+            out.extend(check_record(rec, f"meshes[{i}]"))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MULTICHIP_r07.json")]
+    rc = 0
+    for path in paths:
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"{path}: unreadable: {e}")
+            rc = 1
+            continue
+        problems = check_document(doc)
+        for p in problems:
+            print(f"{path}: {p}")
+        if problems:
+            rc = 1
+        else:
+            print(f"{path}: ok ({len(doc['meshes'])} mesh records)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -404,13 +404,22 @@ class TestClusterCache:
             s0 = uri(servers[0])
             _wait(lambda: req("GET", f"{s0}/debug/rescache")
                   .get("cdc", {}).get("live"), msg="cdc live on node0")
-            assert _query(s0, "i", "Count(Row(f=1))")["results"] == [4]
-            before = req("GET", f"{s0}/debug/rescache")
-            assert _query(s0, "i", "Count(Row(f=1))")["results"] == [4]
-            after = req("GET", f"{s0}/debug/rescache")
-            assert (after["result_cache_hits_total"]
-                    > before["result_cache_hits_total"]), \
-                "cluster-edge result never cached despite live CDC"
+
+            # `live` means the tailers are polling, not that the seed
+            # writes' events have drained — a fill racing the catch-up
+            # invalidations refuses on the version fence (by design,
+            # counted as a fill race), so poll until a fill lands and
+            # the re-read HITS instead of demanding the first fill win
+            def cached_hit():
+                before = req("GET", f"{s0}/debug/rescache")
+                assert _query(s0, "i", "Count(Row(f=1))")[
+                    "results"] == [4]
+                after = req("GET", f"{s0}/debug/rescache")
+                return (after["result_cache_hits_total"]
+                        > before["result_cache_hits_total"])
+
+            _wait(cached_hit,
+                  msg="cluster-edge result cached despite live CDC")
             # write through the PEER: its WAL event must reach node0's
             # tailer and invalidate the cached edge result
             s1 = uri(servers[1])

@@ -179,6 +179,151 @@ class TestWireAccounting:
             totals["reduceBytes"]["actual"] > 0
 
 
+class TestQuantizedRanking:
+    """Satellite: the EQuARX-style 8-bit candidate-ranking lane
+    (`topn-quantized-ranking`). Contracts pinned here:
+
+    * final TopN/GroupBy results are byte-identical to the lossless
+      lane on every mesh factorization and shard count (the window
+      widening provably covers any rank perturbation, and the window
+      is recounted exactly);
+    * the numpy property bound — per-row quantization error never
+      exceeds the transmitted per-block bound, and the widened window
+      always contains the exact top-n;
+    * the quantized wire counters flow through ReduceStats (and from
+      there to /metrics as dist_reduce_quantized_*).
+    """
+
+    # a ranking-heavy field: 64 rows with distinct global counts so the
+    # quantized lane has real rank structure to perturb
+    @pytest.fixture(scope="class")
+    def qholder(self, tmp_path_factory):
+        holder = Holder(str(tmp_path_factory.mktemp("meshq") / "data")).open()
+        idx = holder.create_index("rank")
+        many = idx.create_field("many")
+        few = idx.create_field("few")
+        cols = []
+        for shard in range(N_SHARDS):
+            base = shard * SHARD_WIDTH
+            c = 0
+            for r in range(64):
+                # row r gets 2+r bits per shard: every row's global
+                # count is distinct, so the ranking has real structure
+                # and the widened window can actually shrink
+                for _ in range(2 + r):
+                    col = base + (c * 97) % SHARD_WIDTH
+                    many.set_bit(r, col)
+                    cols.append(col)
+                    c += 1
+            few.set_bit(1, base)
+            few.set_bit(2, base + 5)
+        idx.mark_columns_exist(cols)
+        yield holder
+        holder.close()
+
+    @pytest.fixture(scope="class")
+    def qbase(self, qholder):
+        return Executor(qholder)
+
+    QUANT_QUERIES = [
+        "TopN(many, n=3)",
+        "TopN(many, n=8)",
+        "TopN(many, n=5, threshold=40)",
+        "TopN(few, n=2)",
+        "GroupBy(Rows(few))",
+    ]
+
+    # 1-D flat (lossless pass-through), 2x2, 4x2 — the ISSUE's matrix
+    QUANT_CONFIGS = [(2, None), (4, 2), (8, 2)]
+
+    @pytest.mark.parametrize(
+        "cfg", QUANT_CONFIGS,
+        ids=[f"{n}dev-g{g or 1}" for n, g in QUANT_CONFIGS])
+    def test_final_results_byte_identical(self, cfg, qholder, qbase):
+        """verify_quantized re-runs the lossless recount in-process and
+        raises on ANY divergence, so this also certifies the window."""
+        dist = DistExecutor(qholder, make_mesh(cfg[0], groups=cfg[1]),
+                            quantized_ranking=True, verify_quantized=True)
+        for k in (1, 5, N_SHARDS):  # incl. non-divisible
+            shards = list(range(k))
+            for pql in self.QUANT_QUERIES:
+                (want,) = qbase.execute("rank", pql, shards=shards)
+                (got,) = dist.execute("rank", pql, shards=shards)
+                assert result_to_json(got) == result_to_json(want), (
+                    f"mesh={cfg} shards={k} {pql}"
+                )
+
+    def test_error_bound_and_window_coverage_property(self):
+        """Pure-numpy property sweep of the device lane's math: the
+        per-row reconstruction error never exceeds the transmitted
+        per-block bound (so the bound IS a valid window widening), and
+        the widened window always contains the exact top-n."""
+        rng = np.random.default_rng(5)
+        B = reduction.QUANT_BLOCK
+        for _ in range(25):
+            n_rows = int(rng.integers(1, 700))
+            groups = int(rng.integers(1, 5))
+            exact_parts = rng.integers(
+                0, 1 << int(rng.integers(4, 22)), size=(groups, n_rows))
+            nb = reduction.quant_blocks(n_rows)
+            padded = np.zeros((groups, nb * B), np.int64)
+            padded[:, :n_rows] = exact_parts
+            blocks = padded.reshape(groups, nb, B)
+            # the device program, re-derived: integer max-scale,
+            # deterministic round-to-nearest, 8-bit payload
+            s = np.maximum((blocks.max(axis=2) + 254) // 255, 1)
+            q = (blocks + (s[:, :, None] >> 1)) // s[:, :, None]
+            assert q.max() <= 255
+            approx = (q * s[:, :, None]).reshape(
+                groups, -1)[:, :n_rows].sum(axis=0)
+            err_blocks = np.where(s > 1, (s + 1) >> 1, 0).sum(axis=0)
+            err = np.repeat(err_blocks, B)[:n_rows]
+            exact = exact_parts.sum(axis=0)
+            assert np.all(np.abs(approx - exact) <= err)
+            if exact_parts.max() <= 255:
+                # sub-byte blocks quantize exactly: zero budget spent
+                assert np.all(err == 0) and np.all(approx == exact)
+            n = int(rng.integers(1, min(16, n_rows) + 1))
+            widx = set(
+                np.asarray(
+                    reduction.quant_topn_window(approx, err, n)).tolist())
+            top = sorted(range(n_rows), key=lambda r: (-exact[r], r))[:n]
+            assert set(top) <= widx
+
+    def test_quantized_wire_counters(self, qholder):
+        """Production mode (no verify recount): the quantized lane's
+        actual inter-group bytes beat the modeled lossless bytes, and
+        the window shrinks the exact recount below the candidate set."""
+        dist = DistExecutor(qholder, make_mesh(4, groups=2),
+                            quantized_ranking=True)
+        dist.execute("rank", "TopN(many, n=3)")  # warm the programs
+        stats = reduction.global_reduce_stats()
+        stats.reset()
+        dist.execute("rank", "TopN(many, n=3)")
+        snap = stats.snapshot()
+        assert snap["quantized_dispatches"] >= 1
+        assert 0 < snap["quantized_actual_bytes"] \
+            < snap["quantized_lossless_bytes"]
+        assert 0 < snap["quantized_window_rows"] \
+            < snap["quantized_candidate_rows"]
+
+    def test_pruned_groupby_quantized_levels(self, qholder, qbase,
+                                             monkeypatch):
+        """Force the prefix-pruning GroupBy strategy: non-final levels
+        ride the quantized lane (survival gating on approx+err upper
+        bounds never drops a true survivor), the final level is always
+        lossless — results byte-identical."""
+        import pilosa_tpu.executor.executor as ex_mod
+
+        monkeypatch.setattr(ex_mod, "GROUPBY_DENSE_MAX_GROUPS", 1)
+        dist = DistExecutor(qholder, make_mesh(4, groups=2),
+                            quantized_ranking=True, verify_quantized=True)
+        pql = "GroupBy(Rows(many), Rows(few))"
+        (want,) = qbase.execute("rank", pql)
+        (got,) = dist.execute("rank", pql)
+        assert result_to_json(got) == result_to_json(want)
+
+
 class TestFallbackGuard:
     """Satellite: when shard_map is the experimental fallback, dispatches
     from executors over DIFFERENT meshes must serialize (the documented

@@ -1,0 +1,230 @@
+"""Tier-1 wiring for the mesh-bench record contract (ISSUE 19 sat. #2/#3):
+
+* scripts/check_multichip_schema.py pins the MULTICHIP_r07 record shape
+  (quantized wire block, reconciliation block, reduce_bytes quantized_*
+  counters) — validated here against the COMMITTED record and against
+  synthetic good/bad documents, plus the CLI exit codes;
+* bench_suite.parse_trace_events is the hardened perfetto parse — every
+  failure mode must come back as a structured ``reason`` string (never a
+  crash, never a bare None), and transfer bytes are attributed only on
+  device-pid lanes.
+"""
+
+import gzip
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+SCRIPT = REPO / "scripts" / "check_multichip_schema.py"
+RECORD = REPO / "MULTICHIP_r07.json"
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+schema = _load("check_multichip_schema", SCRIPT)
+
+
+def good_record():
+    return {
+        "n_devices": 4, "mesh_shape": [2, 2], "n_shards": 7, "shapes": 20,
+        "identical": True, "mismatches": [], "cols_per_sec": 10 ** 9,
+        "row_topn_reduce_bytes": {
+            "dense_equiv": 1048768, "actual": 2272, "ratio": 461.6},
+        "reduce_bytes": {
+            "dispatches": 12, "hier_dispatches": 12, "dense_bytes": 3072,
+            "actual_bytes": 646, "intra_bytes": 2048, "row_gathers": 4,
+            "row_dense_bytes": 4194304, "row_actual_bytes": 5812,
+            "quantized_dispatches": 0, "quantized_actual_bytes": 0,
+            "quantized_lossless_bytes": 0, "quantized_window_rows": 0,
+            "quantized_candidate_rows": 0},
+        "quantized": {
+            "identical": True, "mismatches": [], "ranking_queries": 4,
+            "wire": {"lossless_inter_bytes": 1960,
+                     "quantized_inter_bytes": 804,
+                     "ratio": 2.44, "lane_ratio": 4.62},
+            "window": {"candidate_rows": 166, "window_rows": 28},
+            "ok": True},
+        "wire_reconciliation": {
+            "model_bytes": 8064, "band": [0.5, 2.0],
+            "device_lane": "cpu-threads", "status": "skipped",
+            "reason": "no-transfer-lanes-in-trace (CPU-only host)",
+            "within_band": None},
+        "ok": True,
+    }
+
+
+def good_document():
+    return {"config": "mesh", "metric": "hier_reduction_mesh_scaling",
+            "meshes": [good_record()], "ok": True}
+
+
+class TestSchemaChecker:
+    def test_committed_record_conforms(self):
+        assert RECORD.exists(), "MULTICHIP_r07.json not committed"
+        doc = json.loads(RECORD.read_text())
+        assert schema.check_document(doc) == []
+
+    def test_good_synthetic_document(self):
+        assert schema.check_document(good_document()) == []
+
+    def test_measured_status_needs_measured_fields(self):
+        rec = good_record()
+        rec["wire_reconciliation"].update(
+            {"status": "measured", "measured_bytes": 9000,
+             "within_band": True})
+        assert schema.check_record(rec) == []
+        del rec["wire_reconciliation"]["measured_bytes"]
+        assert any("measured_bytes" in p for p in schema.check_record(rec))
+
+    def test_bad_records_are_pointed_at(self):
+        rec = good_record()
+        del rec["quantized"]["wire"]["lane_ratio"]
+        probs = schema.check_record(rec)
+        assert any("quantized.wire" in p and "lane_ratio" in p
+                   for p in probs)
+
+        rec = good_record()
+        del rec["reduce_bytes"]["quantized_actual_bytes"]
+        assert any("quantized_actual_bytes" in p
+                   for p in schema.check_record(rec))
+
+        rec = good_record()
+        rec["wire_reconciliation"]["status"] = "maybe"
+        assert any("status" in p for p in schema.check_record(rec))
+
+        rec = good_record()
+        rec["identical"] = 1  # int is not an acceptable bool stand-in
+        assert any("identical" in p for p in schema.check_record(rec))
+
+        # a degraded subprocess record ({"n_devices", "ok", "error"})
+        # must FAIL validation — the committed record may not hide one
+        probs = schema.check_document({
+            "config": "mesh", "metric": "hier_reduction_mesh_scaling",
+            "meshes": [{"n_devices": 8, "ok": False, "error": "boom"}],
+            "ok": False})
+        assert any("missing" in p for p in probs)
+
+    def test_skipped_status_needs_reason(self):
+        rec = good_record()
+        del rec["wire_reconciliation"]["reason"]
+        assert any("reason" in p for p in schema.check_record(rec))
+
+    def test_cli_exit_codes(self, tmp_path):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(good_document()))
+        bad_doc = good_document()
+        del bad_doc["meshes"][0]["quantized"]
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(bad_doc))
+        ok = subprocess.run([sys.executable, str(SCRIPT), str(good)],
+                            capture_output=True, text=True, timeout=60)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        fail = subprocess.run([sys.executable, str(SCRIPT), str(bad)],
+                              capture_output=True, text=True, timeout=60)
+        assert fail.returncode == 1
+        assert "quantized" in fail.stdout
+
+
+# ---------------------------------------------------------------------------
+# parse_trace_events: synthetic perfetto traces
+
+
+bench = _load("bench_suite_under_test", REPO / "bench_suite.py")
+
+
+def _write_trace(tmp_path, events, name="t.trace.json.gz"):
+    d = tmp_path / "plugins" / "profile" / "run"
+    d.mkdir(parents=True, exist_ok=True)
+    with gzip.open(d / name, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+
+
+def device_trace_events():
+    """A TPU-shaped trace: device process with an XLA Ops lane, one
+    all-reduce op carrying profiler byte attribution, one covering
+    module span on another thread (must NOT be double counted)."""
+    return [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0 (chip 0)"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 2,
+         "args": {"name": "XLA Modules"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.3", "dur": 40},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "all-reduce.1",
+         "dur": 10, "args": {"bytes_accessed": 1234}},
+        {"ph": "X", "pid": 7, "tid": 2, "name": "module-span",
+         "dur": 500},
+    ]
+
+
+class TestParseTraceEvents:
+    def test_empty_dir_is_structured_skip(self, tmp_path):
+        r = bench.parse_trace_events(str(tmp_path))
+        assert r["ok"] is False
+        assert r["reason"] == "no-trace-files"
+        assert r["transfer"]["reason"] == "no-trace-files"
+
+    def test_device_lane_attribution(self, tmp_path):
+        _write_trace(tmp_path, device_trace_events())
+        r = bench.parse_trace_events(str(tmp_path))
+        assert r["ok"] is True
+        assert r["device_lane"] == "device-ops"
+        assert r["device_us"] == 50.0  # ops lane only, no module span
+        assert r["transfer"] == {"ok": True, "bytes": 1234, "events": 1,
+                                 "reason": None}
+
+    def test_cpu_only_host_is_structured_skip(self, tmp_path):
+        _write_trace(tmp_path, [
+            {"ph": "M", "name": "process_name", "pid": 3,
+             "args": {"name": "/host:CPU"}},
+            {"ph": "M", "name": "thread_name", "pid": 3, "tid": 9,
+             "args": {"name": "tf_XLA_worker_0"}},
+            # CPU lanes name the same fused collectives but model no
+            # wire — bytes there must NOT be attributed
+            {"ph": "X", "pid": 3, "tid": 9, "name": "all-reduce.0",
+             "dur": 25, "args": {"bytes_accessed": 999}},
+        ])
+        r = bench.parse_trace_events(str(tmp_path))
+        assert r["ok"] is True
+        assert r["device_lane"] == "cpu-threads"
+        assert r["device_us"] == 25.0
+        assert r["transfer"]["ok"] is False
+        assert r["transfer"]["bytes"] == 0
+        assert r["transfer"]["reason"] == \
+            "no-transfer-lanes-in-trace (CPU-only host)"
+
+    def test_transfer_without_bytes_has_its_own_reason(self, tmp_path):
+        ev = device_trace_events()
+        del ev[4]["args"]  # the collective loses its byte attribution
+        _write_trace(tmp_path, ev)
+        r = bench.parse_trace_events(str(tmp_path))
+        assert r["ok"] is True
+        assert r["transfer"]["ok"] is False
+        assert r["transfer"]["events"] == 1
+        assert r["transfer"]["reason"] == \
+            "transfer-events-without-byte-attribution"
+
+    def test_corrupt_trace_is_parse_error_reason(self, tmp_path):
+        d = tmp_path / "plugins" / "profile" / "run"
+        d.mkdir(parents=True)
+        (d / "x.trace.json.gz").write_bytes(b"not gzip at all")
+        r = bench.parse_trace_events(str(tmp_path))
+        assert r["ok"] is False
+        assert r["reason"] == "trace-parse-errors"
+
+    def test_byte_key_conventions(self):
+        f = bench._transfer_event_bytes
+        assert f({"args": {"bytes accessed": "2,048"}}) == 2048
+        assert f({"args": {"bytes_transferred": 7.0}}) == 7
+        assert f({"args": {"bytes": ""}}) is None
+        assert f({"args": {"bytes": "n/a"}}) is None
+        assert f({}) is None

@@ -134,3 +134,30 @@ def test_ids_form_is_always_exact(env):
     holder, ex, frag = env
     got = ex.execute("i", f"TopN(f, Row(g=1), ids=[{NEEDLE}, 1], n=0)")[0]
     assert [(p.id, p.count) for p in got] == [(NEEDLE, NEEDLE_BITS)]
+
+
+def test_quantized_ranking_adds_no_approximation(env):
+    """`topn-quantized-ranking` is a WIRE optimization, not a second
+    approximation layer: the 8-bit lane only reorders the candidate
+    RANKING, and the widened window is recounted exactly — so the
+    quantized DistExecutor matches the single-device Executor
+    byte-for-byte on every TopN form, including the adversarial
+    filtered shape (both lanes share phase 1's candidate window, so
+    they share its documented bound — nothing more). verify_quantized
+    re-runs the lossless recount in-process and raises on divergence,
+    and ids= queries bypass the lane entirely (already an exact
+    recount, nothing to rank)."""
+    holder, ex, frag = env
+    from pilosa_tpu.parallel import DistExecutor, make_mesh
+
+    quant = DistExecutor(holder, make_mesh(2), quantized_ranking=True,
+                         verify_quantized=True)
+    for pql in ("TopN(f, n=5)",
+                "TopN(f, n=3)",
+                "TopN(f, Row(g=1), n=3)",
+                "TopN(f, n=4, threshold=100)",
+                f"TopN(f, Row(g=1), ids=[{NEEDLE}, 1], n=0)"):
+        (want,) = ex.execute("i", pql)
+        (got,) = quant.execute("i", pql)
+        assert [(p.id, p.count) for p in got] == \
+            [(p.id, p.count) for p in want], pql
