@@ -25,7 +25,8 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 	bench-serving bench-sync bench-durability bench-tracing \
 	bench-profiling bench-chaos bench-scrub bench-mp bench-multitenant \
 	bench-mesh bench-mesh-quantized bench-autopilot cdc-smoke bench-cdc \
-	elastic-smoke bench-elastic hostpath-smoke bench-hostpath
+	elastic-smoke bench-elastic hostpath-smoke bench-hostpath \
+	ingest-kernel-smoke
 
 test:
 	$(PYTEST) tests/ -m "not slow"
@@ -146,6 +147,17 @@ elastic-smoke:
 # (docs/OPERATIONS.md host-path kernels)
 hostpath-smoke:
 	$(PYTEST) tests/test_roaring_kernels.py tests/test_hostpath_lint.py \
+		-m "not slow"
+	env JAX_PLATFORMS=cpu python scripts/check_hostpath_loops.py
+
+# ingest-kernel-smoke: the write-path fast lane — byte-identity
+# property/fuzz tests for the whole-batch merge kernels vs the retired
+# per-container loop (randomized + adversarial batches, mutex/BSI merge
+# rules, batched membership probes, WAL-replay equivalence), plus the
+# host-path lint over the write-side consumer modules
+# (docs/OPERATIONS.md write-path fast lane)
+ingest-kernel-smoke:
+	$(PYTEST) tests/test_merge_kernels.py tests/test_hostpath_lint.py \
 		-m "not slow"
 	env JAX_PLATFORMS=cpu python scripts/check_hostpath_loops.py
 

@@ -1394,6 +1394,54 @@ def config_import(n_shards: int = 8, rows_per_shard: int = 4,
             server.close()
 
 
+def _merge_kernel_microbench(n_keys: int = 4096, per_key: int = 24,
+                             reps: int = 9, seed: int = 7) -> dict:
+    """In-bench merge-kernel gate: the whole-batch merge kernel
+    (roaring/merge_kernels.merge_ids) vs the retired per-container
+    write loop (bitmap._merge_loop, kept verbatim as the reference) on
+    the bulk-import shape — one batch touching MANY containers with a
+    couple dozen ids each, where the per-container Python envelope the
+    kernel retires dominates. Byte-identity is asserted on EVERY rep
+    (serialize equality + changed-count equality); best-of-``reps``
+    timing on both sides."""
+    from pilosa_tpu.roaring import merge_kernels, serialize
+    from pilosa_tpu.roaring.bitmap import RoaringBitmap
+    from pilosa_tpu.roaring.format import deserialize
+
+    rng = np.random.default_rng(seed)
+
+    def draw():
+        keys = rng.integers(0, n_keys, n_keys * per_key).astype(np.uint64)
+        lows = rng.integers(0, 65536, keys.size).astype(np.uint64)
+        return np.unique((keys << np.uint64(16)) + lows)
+
+    blob = serialize(RoaringBitmap.from_ids(draw()))
+    batch = draw()
+    best_kernel = best_loop = float("inf")
+    identical = True
+    for _ in range(reps):
+        bm_k, _ = deserialize(blob)
+        t0 = time.perf_counter()
+        changed_k = merge_kernels.merge_ids(bm_k, batch.copy(), False)
+        best_kernel = min(best_kernel, time.perf_counter() - t0)
+        bm_l, _ = deserialize(blob)
+        t0 = time.perf_counter()
+        changed_l = bm_l._merge_loop(batch.copy(), False)
+        best_loop = min(best_loop, time.perf_counter() - t0)
+        identical = (identical and changed_k == changed_l
+                     and serialize(bm_k) == serialize(bm_l))
+    speedup = best_loop / best_kernel if best_kernel else 0.0
+    return {
+        "shape": {"containers": n_keys, "ids_per_container": per_key,
+                  "batch_ids": int(batch.size)},
+        "kernel_ms": round(best_kernel * 1e3, 2),
+        "loop_ms": round(best_loop * 1e3, 2),
+        "speedup": round(speedup, 2),
+        "bytes_identical": bool(identical),
+        "ok": bool(identical and speedup >= 2.0),
+    }
+
+
 def config_ingest(n_remote: int = 3, n_shards: int = 16,
                   density: float = 0.02, delay_s: float = 0.05) -> dict:
     """Parallel ingest pipeline (ISSUE 3): routed-import fan-out with an
@@ -1407,7 +1455,16 @@ def config_ingest(n_remote: int = 3, n_shards: int = 16,
 
     Also reports the local shard-group apply rate with the bounded
     worker pool on vs off (ingest-workers knob) — engine-layer, no
-    injected latency."""
+    injected latency — and runs the merge-kernel microbench (write-path
+    fast lane): the whole-batch merge kernel must clear >=2x over the
+    retired per-container loop with byte-identity asserted in-bench.
+
+    Core-aware gating (the mp_serving precedent): the fan-out oracles
+    are sleep-dominated and gate on any box, and the merge microbench
+    is single-threaded numpy-vs-Python so it gates on any box too; only
+    the local-apply worker-pool scaling needs real cores — >=6 cores
+    enforces >=1.3x, 3-5 cores >=1.1x, below that the box is
+    hardware-saturated and the ratio is recorded ungated."""
     import threading
 
     from pilosa_tpu.parallel.cluster import Cluster, Node
@@ -1503,13 +1560,30 @@ def config_ingest(n_remote: int = 3, n_shards: int = 16,
     eng_ser = engine(1)
     eng_par = engine(4)
 
+    # core-aware local-apply gate: the bounded worker pool shares this
+    # box's cores with the bench driver itself, so scaling is only
+    # measurable with real cores to spread onto (mp_serving precedent)
+    cores = os.cpu_count() or 1
+    eng_ratio = eng_ser / eng_par if eng_par else 0.0
+    if cores >= 6:
+        eng_ok, eng_gate = eng_ratio >= 1.3, "local-apply >= 1.3x"
+    elif cores >= 3:
+        eng_ok, eng_gate = eng_ratio >= 1.1, "local-apply >= 1.1x"
+    else:
+        eng_ok = True
+        eng_gate = ("ungated: hardware-saturated (< 3 cores); ratio "
+                    "recorded, fan-out + merge-kernel oracles still gate")
+
+    merge = _merge_kernel_microbench()
+
     delay_wall = max(wall_par - wall_base, 0.0)
     ok = (changed_par == changed_ser == cols.size
           # delay-attributable fan-out time tracks the slowest node's
           # busy time (max), NOT the sum over nodes
           and delay_wall < (max_busy + sum_busy) / 2
           # parallel routed path beats the serialized one on same data
-          and wall_par < 0.75 * wall_ser)
+          and wall_par < 0.75 * wall_ser
+          and eng_ok and merge["ok"])
     return {
         "config": "ingest",
         "metric": "routed_import_bits_per_sec",
@@ -1524,6 +1598,10 @@ def config_ingest(n_remote: int = 3, n_shards: int = 16,
         "sum_node_busy_ms": round(sum_busy * 1e3, 1),
         "local_apply_bits_per_sec_serial": round(cols.size / eng_ser, 1),
         "local_apply_bits_per_sec_parallel": round(cols.size / eng_par, 1),
+        "local_apply_scaling": round(eng_ratio, 2),
+        "cores": cores,
+        "local_apply_gate": eng_gate,
+        "merge_kernel": merge,
         "nodes": n_remote + 1, "shards": n_shards,
         "bits": int(cols.size), "injected_delay_ms": delay_s * 1e3,
         "ok": bool(ok),
@@ -4880,6 +4958,68 @@ def _elastic_split_part(tmp: str, req, make_server, seed: int) -> dict:
         spread_ok = (len(span_owners) >= 2
                      and len([n for n, d in fanout.items() if d >= 10])
                      >= 2)
+        # write amplification through the split: plain Sets entering
+        # through the non-owner must narrow to each column's span owner
+        # (one remote send per write), while a range-ineligible write
+        # (Clear — union repair cannot remove a bit a narrowed send
+        # skipped) keeps the full union fan-out to every span owner.
+        # The wire-byte ratio between the two on the same columns IS
+        # the write-amp reduction the range-aware fast lane buys.
+        write_amp: dict = {}
+        if non_owner is not None and span_owners:
+            from pilosa_tpu.parallel.cluster import global_route_stats
+
+            rs = global_route_stats()
+            nb = f"http://localhost:{non_owner.port}"
+            n_writes = 64
+            before_w = (rs.range_slices, rs.union_writes, rs.wire_bytes)
+            for col in range(n_writes):
+                req("POST", nb, "/index/hot/query",
+                    f"Set({col}, f=2)".encode())
+            mid_w = (rs.range_slices, rs.union_writes, rs.wire_bytes)
+            for col in range(n_writes):
+                req("POST", nb, "/index/hot/query",
+                    f"Clear({col}, f=3)".encode())
+            after_w = (rs.range_slices, rs.union_writes, rs.wire_bytes)
+            ranged_bytes = mid_w[2] - before_w[2]
+            union_bytes = after_w[2] - mid_w[2]
+            # zero lost acked writes, two ways: (a) range-aware reads
+            # (non-owner entry fans out per span, hitting the exact
+            # owner each narrowed Set landed on) see every write NOW;
+            # (b) anti-entropy's union repair refills the OTHER union
+            # owners, after which a read through any owner sees them
+            out2 = req("POST", nb, "/index/hot/query",
+                       b"Count(Row(f=2))")
+            converged = False
+            for _ in range(40):
+                out3 = req("POST", entry, "/index/hot/query",
+                           b"Count(Row(f=2))")
+                if out3.get("results") == [n_writes]:
+                    converged = True
+                    break
+                time.sleep(0.5)
+            write_amp = {
+                "writes": n_writes,
+                "range_sliced": mid_w[0] - before_w[0],
+                "union_fallback_writes": after_w[1] - mid_w[1],
+                "ranged_bytes_per_write": round(
+                    ranged_bytes / n_writes, 1),
+                "union_bytes_per_write": round(
+                    union_bytes / n_writes, 1),
+                "write_amp_reduction": round(
+                    union_bytes / ranged_bytes, 2) if ranged_bytes
+                else 0.0,
+                "acked_writes_readable": out2.get("results")
+                == [n_writes],
+                "union_repair_converged": converged,
+            }
+        write_amp_ok = bool(
+            write_amp
+            and write_amp["range_sliced"] >= 1
+            and write_amp["union_fallback_writes"] >= 1
+            and write_amp["acked_writes_readable"]
+            and write_amp["union_repair_converged"]
+            and write_amp["write_amp_reduction"] >= 1.5)
         return {
             "split_minted": split_minted,
             "spans": [[lo, hi, list(ids)] for lo, hi, ids in spans],
@@ -4887,10 +5027,11 @@ def _elastic_split_part(tmp: str, req, make_server, seed: int) -> dict:
             "adopted_by_all": adopted,
             "count_correct": count_ok,
             "non_owner_fanout": fanout,
+            "write_amp": write_amp,
             "splits_executed": coord.api.autopilot_metrics().get(
                 "autopilot_splits_total", 0),
             "ok": bool(split_minted and len(spans) >= 2 and adopted
-                       and count_ok and spread_ok),
+                       and count_ok and spread_ok and write_amp_ok),
         }
     finally:
         for s in servers.values():

@@ -155,7 +155,8 @@ def plan_moves(shard_heat: dict, owners_of, node_ids, *,
 
 def plan_splits(shard_heat: dict, owners_of, node_ids, current_ranges,
                 *, split_threshold: float, split_ways: int = 2,
-                shard_width: int | None = None) -> tuple[list[dict], list]:
+                shard_width: int | None = None,
+                replica_n: int = 1) -> tuple[list[dict], list]:
     """Sub-shard range planning (elastic plane). Pure, like plan_moves.
 
     Placement moves cannot help ONE pathologically hot (index, shard):
@@ -174,8 +175,10 @@ def plan_splits(shard_heat: dict, owners_of, node_ids, current_ranges,
     alone.
 
     Returns ``(splits, merges)``: splits are ``{"index", "shard",
-    "heat", "spans": [(lo, hi, (owner,)), ...], "owners": [union]}``
-    hottest-first, merges are (index, shard) keys to un-split."""
+    "heat", "spans": [(lo, hi, (owner, ...)), ...], "owners": [union]}``
+    hottest-first (each span carrying ``replica_n`` owners, so
+    range-narrowed writes keep full replica durability), merges are
+    (index, shard) keys to un-split."""
     if shard_width is None:
         from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -216,10 +219,15 @@ def plan_splits(shard_heat: dict, owners_of, node_ids, current_ranges,
         if len(spread) < 2:
             continue  # cannot spread: every node already an owner of 1
         step = shard_width // len(spread)
+        # each span gets replica_n owners (cycling through the spread)
+        # so range-aware WRITE routing keeps full replica durability:
+        # a narrowed set reaches as many nodes as hash placement would.
+        # replica_n=1 degenerates to the original one-owner spans.
+        width = max(1, min(int(replica_n), len(spread)))
         spans = [
             (i * step,
              shard_width if i == len(spread) - 1 else (i + 1) * step,
-             (spread[i],))
+             tuple(spread[(i + j) % len(spread)] for j in range(width)))
             for i in range(len(spread))
         ]
         union = own + [i for i in spread if i not in own]
@@ -442,6 +450,7 @@ class Autopilot:
                     current_ranges=current_ranges,
                     split_threshold=self.split_threshold,
                     split_ways=self.split_ways,
+                    replica_n=c.replica_n,
                 )
                 splits = [s for s in splits
                           if (s["index"], s["shard"]) not in frozen][:1]

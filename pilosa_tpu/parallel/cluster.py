@@ -68,6 +68,36 @@ FENCED_MESSAGES = frozenset(
 DRAIN_ACTIVE_STATES = frozenset({"pending", "moving", "handoff", "leaving"})
 
 
+class RouteStats:
+    """Process-wide write-routing counters (``routing_range_*`` series
+    on /metrics — docs/OBSERVABILITY.md). Plain int adds, no lock:
+    dashboards, not invariants."""
+
+    __slots__ = ("range_slices", "range_fallbacks", "union_writes",
+                 "wire_bytes")
+
+    def __init__(self):
+        self.range_slices = 0     # write slices narrowed to span owners
+        self.range_fallbacks = 0  # eligible slices forced back to union
+        self.union_writes = 0     # write sends routed by union fan-out
+        self.wire_bytes = 0       # payload bytes shipped to remote owners
+
+    def metrics(self) -> dict:
+        return {
+            "routing_range_slices_total": self.range_slices,
+            "routing_range_fallback_total": self.range_fallbacks,
+            "routing_range_union_writes_total": self.union_writes,
+            "routing_range_wire_bytes_total": self.wire_bytes,
+        }
+
+
+_ROUTE_STATS = RouteStats()
+
+
+def global_route_stats() -> RouteStats:
+    return _ROUTE_STATS
+
+
 class ClusterDegradedError(Exception):
     """This node cannot reach a majority of the member list (minority
     side of a partition): coordination and writes are refused, locally-
@@ -1188,8 +1218,9 @@ class Cluster:
         pre-autopilot placement — the mixed-version safety contract.
         A range-split shard resolves through its union override (the
         planner installs both together), so data placement needs no
-        range awareness here; ranges refine READ preference only
-        (range_read_nodes)."""
+        range awareness here; ranges refine routing PREFERENCE only —
+        read targets (range_read_nodes) and plain-set write slices
+        (range_write_spans) — never membership of the data."""
         override = self.placement.get(index, shard)
         if override is not None:
             with self._lock:
@@ -1219,6 +1250,30 @@ class Cluster:
                     return nodes
                 return None  # a range owner departed: union routing
         return None
+
+    def range_write_spans(self, index: str, shard: int
+                          ) -> list[tuple[int, int, list[Node] | None]] | None:
+        """Write-routing view of a shard's sub-shard column ranges:
+        ``[(lo, hi, owners-or-None), ...]`` covering the adopted spans,
+        or None when the shard has no split (the union/hash path). A
+        span whose owner list has a departed member yields ``None``
+        owners — the caller must fall back to union fan-out for columns
+        in that span (anti-entropy converges the refill; a narrowed send
+        to a half-live span could strand the slice). Only PLAIN SET
+        writes may use this: union repair converges a non-span owner
+        that missed a set, but cannot undo a clear, a mutex row move, or
+        a BSI value it never saw (see cluster_exec._route_all_replicas)
+        — those keep full union fan-out."""
+        spans = self.placement.get_ranges(index, shard)
+        if not spans:
+            return None
+        out: list[tuple[int, int, list[Node] | None]] = []
+        with self._lock:
+            for lo, hi, ids in spans:
+                nodes = [self.nodes[i] for i in ids if i in self.nodes]
+                out.append((lo, hi,
+                            nodes if len(nodes) == len(ids) else None))
+        return out
 
     def _shard_nodes_on(self, ring: list[Node], placement: dict,
                         index: str, shard: int) -> list[Node]:

@@ -32,8 +32,14 @@ from pilosa_tpu.executor.executor import (
 from pilosa_tpu.executor.result import GroupCount, Pair, RowResult, ValCount
 from pilosa_tpu.ops.packing import pack_bits
 from pilosa_tpu.parallel.client import ClientError
-from pilosa_tpu.parallel.cluster import Cluster, ClusterDegradedError, Node
+from pilosa_tpu.parallel.cluster import (
+    Cluster,
+    ClusterDegradedError,
+    Node,
+    global_route_stats,
+)
 from pilosa_tpu.qos.deadline import DeadlineExceeded
+from pilosa_tpu.storage.field import TYPE_BOOL, TYPE_INT, TYPE_MUTEX
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.pql.ast import Query
 from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_of
@@ -795,7 +801,11 @@ class ClusterExecutor:
             raise PQLError(f"{call.name} requires a column")
         shard = shard_of(int(col))
         owners = self.cluster.shard_nodes(idx.name, shard)
+        owners = self._narrow_write_owners(idx, call, shard, int(col),
+                                           owners)
+        route_stats = global_route_stats()
         result = False
+        pql = call.to_pql()
         for node in owners:
             if node.id == self.cluster.local.id:
                 result = bool(self.local._execute_call(idx, call)) or result
@@ -803,8 +813,9 @@ class ClusterExecutor:
                     self.cluster.note_local_shards(idx.name, [shard])
             else:
                 try:
+                    route_stats.wire_bytes += len(pql)
                     out = self.cluster.client.query_node(
-                        node.uri, idx.name, call.to_pql(), [shard], remote=True
+                        node.uri, idx.name, pql, [shard], remote=True
                     )
                     result = bool(out["results"][0]) or result
                 except ClientError as e:
@@ -813,6 +824,41 @@ class ClusterExecutor:
                     elif e.status != 404:  # 404 = schema lag: skip quietly
                         raise PQLError(str(e)) from e
         return result
+
+    def _narrow_write_owners(self, idx, call: Call, shard: int, col: int,
+                             owners):
+        """Range-aware write routing for point writes: a plain ``Set``
+        into a range-split shard goes only to its column span's owners
+        (every other union owner converges through anti-entropy's union
+        repair). Everything else — ``Clear`` (union repair cannot remove
+        a bit a narrowed send skipped), mutex/bool (row moves), int
+        (value overwrite), timestamped sets (extra view rows) — keeps
+        the full union fan-out, as does a span whose owner departed."""
+        route_stats = global_route_stats()
+        if call.name != "Set" or call.arg("timestamp") is not None:
+            route_stats.union_writes += 1
+            return owners
+        try:
+            fname, _ = self.local._row_field_and_value(call)
+            field = idx.field(fname)
+        except PQLError:
+            field = None
+        if field is None or field.options.type in (TYPE_BOOL, TYPE_INT,
+                                                   TYPE_MUTEX):
+            route_stats.union_writes += 1
+            return owners
+        spans = self.cluster.range_write_spans(idx.name, shard)
+        if spans:
+            off = col - shard * SHARD_WIDTH
+            for rlo, rhi, span_nodes in spans:
+                if rlo <= off < rhi:
+                    if span_nodes is not None:
+                        route_stats.range_slices += 1
+                        return span_nodes
+                    route_stats.range_fallbacks += 1
+                    return owners
+        route_stats.union_writes += 1
+        return owners
 
     # --------------------------------------------------------------- reduce
 

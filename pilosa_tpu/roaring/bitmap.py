@@ -282,6 +282,28 @@ class RoaringBitmap:
         return self._merge(ids, remove=True)
 
     def _merge(self, ids, remove: bool) -> int:
+        """Dispatch a mutation batch: whole-batch merge kernel
+        (roaring/merge_kernels.py — single numpy dispatches across ALL
+        touched containers, GIL released inside them) above the size
+        threshold, the per-container loop below it (a point write must
+        not pay batch bookkeeping). Both produce byte-identical
+        containers — tests/test_merge_kernels.py pins the property, so
+        the threshold is pure performance tuning."""
+        ids = np.atleast_1d(np.asarray(ids, dtype=np.uint64))
+        if ids.size == 0:
+            return 0
+        from pilosa_tpu.roaring import merge_kernels
+
+        if ids.size >= merge_kernels.KERNEL_MIN_IDS:
+            return merge_kernels.merge_ids(self, ids, remove)
+        merge_kernels.global_merge_stats().loop_fallbacks += 1
+        return self._merge_loop(ids, remove)
+
+    def _merge_loop(self, ids: np.ndarray, remove: bool) -> int:
+        """The per-container merge loop: small-batch fast path AND the
+        byte-identity reference for the whole-batch kernel (the same
+        role the retired per-container read paths play in
+        tests/test_roaring_kernels.py)."""
         ids = np.atleast_1d(np.asarray(ids, dtype=np.uint64))
         if ids.size == 0:
             return 0

@@ -65,16 +65,17 @@ class ImportRoutingError(ApiError):
 # Default width of the bounded worker pool applying independent local
 # shard groups of one import batch (fragments carry their own locks, so
 # groups are lock-disjoint). Overridden by the ``ingest-workers``
-# ServerConfig knob. Default 1 (serial): on CPython the per-group work is
-# GIL-bound (roaring container merges + small numpy ops), and measured
-# thread fan-out LOSES throughput on tmpfs-backed storage. Re-measured
-# after the vectorized host-path kernel work (round 6): the kernels
-# batch the READ paths (decode/digest/diff), not the write-side
-# container merges bulk_import runs, so the GIL-bound profile — and the
-# default — stand (8 shard groups x 60k bits, tmpfs: 1.57/1.62/1.56
-# M rows/s at 1/2/4 workers). Raise the knob where fragment writes pay
-# real disk latency (fsync'd disks, network filesystems) so groups
-# overlap I/O stalls — see docs/INGEST.md.
+# ServerConfig knob. Default 1 (serial). Re-measured after the
+# write-path merge kernels (roaring/merge_kernels.py) replaced the
+# per-container merge loops: serial apply itself got ~3.3x faster
+# (8 shard groups x 60k bits, tmpfs: 5.0-5.4 M rows/s at 1 worker vs
+# 1.57 before), 2 workers lands within noise of serial and 4 workers
+# loses ~15% to pool overhead on a saturated box. The per-group work is
+# now one big numpy kernel call (which releases the GIL) plus a thin
+# Python envelope, so modest overlap is possible where spare cores
+# exist — but not enough, measured, to move the default. Raise the knob
+# where fragment writes pay real disk latency (fsync'd disks, network
+# filesystems) so groups overlap I/O stalls — see docs/INGEST.md.
 INGEST_WORKERS_DEFAULT = 1
 
 
@@ -1143,20 +1144,7 @@ class API:
         ts_arr = (np.asarray(list(timestamps), dtype=object)
                   if timestamps is not None else None)
 
-        order, bounds, shards_sorted = shard_groups(columns_arr)
-        local_parts: list[np.ndarray] = []
-        remote_parts: dict[str, tuple[object, list[np.ndarray]]] = {}
-        for i in range(bounds.size - 1):
-            lo, hi = int(bounds[i]), int(bounds[i + 1])
-            sel = order[lo:hi]
-            for node in self.cluster.shard_nodes(
-                index, int(shards_sorted[lo])
-            ):
-                if node.id == self.cluster.local.id:
-                    local_parts.append(sel)
-                else:
-                    remote_parts.setdefault(node.id, (node, []))[1].append(sel)
-
+        bulk_roaring = False
         if values is None:
             # mutex/bool batches must NOT ride the roaring route: its
             # receiver unions blindly, so a remote replica would keep a
@@ -1167,6 +1155,57 @@ class API:
             fld_type = self._field(self._index(index), field).options.type
             bulk_roaring = (timestamps is None and not clear
                             and fld_type not in (TYPE_MUTEX, TYPE_BOOL))
+
+        from pilosa_tpu.parallel.cluster import global_route_stats
+
+        route_stats = global_route_stats()
+        order, bounds, shards_sorted = shard_groups(columns_arr)
+        local_parts: list[np.ndarray] = []
+        remote_parts: dict[str, tuple[object, list[np.ndarray]]] = {}
+
+        def dispatch(node, sel: np.ndarray) -> None:
+            if node.id == self.cluster.local.id:
+                local_parts.append(sel)
+            else:
+                remote_parts.setdefault(node.id, (node, []))[1].append(sel)
+
+        for i in range(bounds.size - 1):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            sel = order[lo:hi]
+            shard = int(shards_sorted[lo])
+            owners = self.cluster.shard_nodes(index, shard)
+            # range-aware write routing (ROADMAP item 2 remainder): a
+            # range-split shard's PLAIN SET slices go only to their span
+            # owners — anti-entropy's union repair converges the other
+            # union owners, which is exactly why only set batches may be
+            # narrowed (a clear/mutex/BSI write a union owner missed can
+            # never be repaired back out — see range_write_spans)
+            spans = (self.cluster.range_write_spans(index, shard)
+                     if bulk_roaring else None)
+            if spans:
+                offs = columns_arr[sel] - shard * SHARD_WIDTH
+                covered = np.zeros(sel.size, bool)
+                for rlo, rhi, span_nodes in spans:
+                    m = (offs >= rlo) & (offs < rhi)
+                    if not m.any():
+                        continue
+                    if span_nodes is None:
+                        # a span owner departed: union fan-out carries
+                        # this slice until the planner re-plans
+                        route_stats.range_fallbacks += 1
+                        continue
+                    covered |= m
+                    route_stats.range_slices += 1
+                    for node in span_nodes:
+                        dispatch(node, sel[m])
+                rest = sel[~covered]
+                if rest.size:
+                    for node in owners:
+                        dispatch(node, rest)
+            else:
+                route_stats.union_writes += 1
+                for node in owners:
+                    dispatch(node, sel)
 
         stats = global_stats()
 
@@ -1285,6 +1324,7 @@ class API:
         ``rows_arr``/``cols_arr`` are the node's already-sliced arrays."""
         import numpy as np
 
+        from pilosa_tpu.parallel.cluster import global_route_stats
         from pilosa_tpu.roaring import RoaringBitmap
         from pilosa_tpu.roaring.format import serialize
 
@@ -1293,11 +1333,15 @@ class API:
         order, bounds, shards_sorted = shard_groups(cols)
         rows_arr, cols = rows_arr[order], cols[order]
         changed = 0
+        route_stats = global_route_stats()
         for i in range(bounds.size - 1):
             lo, hi = int(bounds[i]), int(bounds[i + 1])
             ids = (rows_arr[lo:hi] * np.uint64(SHARD_WIDTH)
                    + (cols[lo:hi] & np.uint64(SHARD_WIDTH - 1)))
             data = serialize(RoaringBitmap.from_ids(np.unique(ids)))
+            # per-acked-write wire accounting: the elastic bench's
+            # write-amplification gate reads this before/after a split
+            route_stats.wire_bytes += len(data)
             changed += self.cluster.client.import_roaring(
                 node.uri, index, field, int(shards_sorted[lo]), data
             )

@@ -791,6 +791,17 @@ class HTTPHandler(BaseHTTPRequestHandler):
 
         text += prometheus_block(global_kernel_stats().metrics(), prefix,
                                  seen=seen)
+        # write-path fast lane (docs/OPERATIONS.md): whole-batch merge
+        # kernel counters + range-aware write-routing counters — zeros
+        # from scrape one; loop_fallbacks rising under bulk load means
+        # batches are arriving below the kernel cutover size
+        from pilosa_tpu.parallel.cluster import global_route_stats
+        from pilosa_tpu.roaring.merge_kernels import global_merge_stats
+
+        text += prometheus_block(global_merge_stats().metrics(), prefix,
+                                 seen=seen)
+        text += prometheus_block(global_route_stats().metrics(), prefix,
+                                 seen=seen)
         # multi-chip reduction plane (docs/OPERATIONS.md multi-chip
         # mesh): per-dispatch reduction-lane bytes, dense-equivalent vs
         # actual encoded inter-group traffic plus roaring row gathers —

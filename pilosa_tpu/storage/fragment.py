@@ -65,6 +65,21 @@ DEFAULT_SNAPSHOT_OP_THRESHOLD = 2048
 BLOCK_ROWS = 100
 
 
+def _group_by_row(rows: np.ndarray, positions: np.ndarray):
+    """Yield ``(row, positions_in_row)`` ascending by row, preserving
+    each row's original position order — one stable sort instead of a
+    per-row mask scan."""
+    if rows.size == 0:
+        return
+    order = np.argsort(rows, kind="stable")
+    sorted_rows = rows[order]
+    sorted_pos = positions[order]
+    uniq, starts = np.unique(sorted_rows, return_index=True)
+    bounds = np.append(starts, sorted_rows.size)
+    for i, r in enumerate(uniq.tolist()):
+        yield int(r), sorted_pos[bounds[i]:bounds[i + 1]]
+
+
 class Fragment:
     def __init__(
         self,
@@ -412,34 +427,31 @@ class Fragment:
             raise ValueError("position out of shard range")
         keep = keep_last_unique(positions)
         rows, positions = rows[keep], positions[keep]
-        with self.lock:
-            member_cache: dict = {}
+        from pilosa_tpu.roaring import merge_kernels
 
-            def member(r: int) -> np.ndarray:
-                m = member_cache.get(r)
-                if m is None:
-                    m = self.bitmap.row_member(r, positions)
-                    member_cache[r] = m
-                return m
+        with self.lock:
+            # ONE batched probe yields every (current-row, column) pair
+            # set among the batch columns — replacing the old
+            # row_member scan over ALL fragment rows (O(rows x batch))
+            cur_rows, cur_idx = merge_kernels.set_rows_for_positions(
+                self.bitmap, positions)
+            conflict = cur_rows.astype(np.uint64) != rows[cur_idx]
+            target_set = np.zeros(positions.size, bool)
+            target_set[cur_idx[~conflict]] = True
 
             add_parts: list = []
             rem_parts: list = []
             rows_added: list = []
             rows_removed: list = []
-            for r in self.row_ids():
-                rem_m = member(r) & (rows != np.uint64(r))
-                if rem_m.any():
-                    p = positions[rem_m]
-                    rem_parts.append((np.uint64(r) << np.uint64(20)) + p)
-                    rows_removed.append((int(r), p))
-            changed = 0
-            for r in np.unique(rows).tolist():
-                add_m = (rows == np.uint64(r)) & ~member(int(r))
-                if add_m.any():
-                    p = positions[add_m]
-                    add_parts.append((np.uint64(r) << np.uint64(20)) + p)
-                    rows_added.append((int(r), p))
-                    changed += int(add_m.sum())
+            for r, p in _group_by_row(cur_rows[conflict],
+                                      positions[cur_idx[conflict]]):
+                rem_parts.append((np.uint64(r) << np.uint64(20)) + p)
+                rows_removed.append((r, p))
+            add_m = ~target_set
+            changed = int(add_m.sum())
+            for r, p in _group_by_row(rows[add_m], positions[add_m]):
+                add_parts.append((np.uint64(r) << np.uint64(20)) + p)
+                rows_added.append((r, p))
             self._apply_batch_locked(add_parts, rem_parts,
                                      rows_added, rows_removed)
             return changed
@@ -458,12 +470,15 @@ class Fragment:
             ids = np.sort(np.concatenate(rem_parts))
             self.bitmap.remove_ids(ids)
             self._log_op(OP_REMOVE, ids)
+        feed = self._row_count_feed(len(rows_added) + len(rows_removed))
         for r, p in rows_added:
             self._after_row_write(int(r), positions=p, added=True,
-                                  count_stat=False)
+                                  count_stat=False,
+                                  row_count=feed(int(r)))
         for r, p in rows_removed:
             self._after_row_write(int(r), positions=p, added=False,
-                                  count_stat=False)
+                                  count_stat=False,
+                                  row_count=feed(int(r)))
         # the batch-amortized tail (same shape as _after_rows_added):
         # ONE stats bump, ONE result-cache write event, ONE heat record
         # for the whole batch — a bit_depth-32 BSI import must not take
@@ -497,12 +512,21 @@ class Fragment:
         stored = np.asarray(stored, np.uint64)
         if positions.size and int(positions.max()) >= SHARD_WIDTH:
             raise ValueError("position out of shard range")
+        from pilosa_tpu.roaring import merge_kernels
+
         with self.lock:
             add_parts: list = []
             rem_parts: list = []
             rows_added: list = []
             rows_removed: list = []
-            exists_new = ~self.bitmap.row_member(exists_row, positions)
+            # exists row + every bit plane probed in ONE batched pass
+            # (the old code ran a row_member scan per plane: 1+depth
+            # full-keyspace probes per import)
+            member = merge_kernels.member_matrix(
+                self.bitmap,
+                [exists_row] + [offset_row + i for i in range(bit_depth)],
+                positions)
+            exists_new = ~member[0]
             changed_cols = exists_new.copy()
             if exists_new.any():
                 p = positions[exists_new]
@@ -513,7 +537,7 @@ class Fragment:
             for i in range(bit_depth):
                 row = offset_row + i
                 desired = ((stored >> np.uint64(i)) & np.uint64(1)) == 1
-                cur = self.bitmap.row_member(row, positions)
+                cur = member[1 + i]
                 add_m = desired & ~cur
                 rem_m = ~desired & cur
                 if add_m.any():
@@ -567,11 +591,17 @@ class Fragment:
         ids = ids[keep_last_unique(pos)]
         pos = ids & np.uint64(SHARD_WIDTH - 1)
         rows = ids >> np.uint64(SHARD_WIDTH_EXP)
+        from pilosa_tpu.roaring import merge_kernels
+
         with self.lock:
+            # one batched probe finds every locally-set (row, column)
+            # pair among the incoming columns (was a row_member scan
+            # over every fragment row)
+            cur_rows, cur_idx = merge_kernels.set_rows_for_positions(
+                self.bitmap, pos)
             keep = np.ones(ids.size, bool)
-            for r in self.row_ids():
-                local = self.bitmap.row_member(r, pos)
-                keep &= ~(local & (rows != np.uint64(r)))
+            conflict = cur_rows.astype(np.uint64) != rows[cur_idx]
+            keep[cur_idx[conflict]] = False
             ids = ids[keep]
             return self.add_ids(ids) if ids.size else 0
 
@@ -639,6 +669,7 @@ class Fragment:
         recovery; also the CDC follower's live tail-apply path): the
         bitmap mutation without logging — the caller snapshots and
         recounts caches once per touched fragment afterwards."""
+        ids = np.atleast_1d(np.asarray(ids, np.uint64))
         with self.lock:
             if op == OP_ADD:
                 self.bitmap.add_ids(ids)
@@ -651,7 +682,7 @@ class Fragment:
         # unknown -> conservative invalidation, not in-place patching):
         # a crash-recovery replay has none resident, but the CDC
         # follower applies these against a live serving cache
-        for row in sorted({int(i) >> 20 for i in np.asarray(ids)}):
+        for row in np.unique(ids >> np.uint64(20)).tolist():
             cache.apply_write(residency.WriteEvent(
                 self.index, self.field, self.view, self.shard, row,
                 scope=self.scope,
@@ -722,25 +753,41 @@ class Fragment:
         if self._open:
             self._file = open(self.path, "ab")
 
+    def _row_count_feed(self, n_rows: int):
+        """Row-count source for batch bookkeeping: above a few touched
+        rows, ONE ``row_counts()`` metadata pass feeds every
+        ``row_cache.add`` instead of a ``count_row`` probe per row.
+        Callers invoke this AFTER the batch's mutations are applied (the
+        memo keys on the mutation counter). Small batches return None
+        per row — the point-write probe is cheaper than the full pass."""
+        if n_rows <= 8:
+            return lambda row: None
+        r_ids, r_counts = self.row_counts()
+
+        def feed(row: int):
+            i = int(np.searchsorted(r_ids, row))
+            if i < r_ids.size and int(r_ids[i]) == row:
+                return int(r_counts[i])
+            return 0  # the batch emptied this row
+
+        return feed
+
     def _after_rows_added(self, rows: np.ndarray, positions: np.ndarray) -> None:
         """Per-row write bookkeeping for bulk adds: group positions by row
         with one sort instead of a per-row mask scan (which is O(n·rows)
         and turns large imports quadratic)."""
-        order = np.argsort(rows, kind="stable")
-        sorted_rows = rows[order]
-        sorted_pos = positions[order]
-        uniq, starts = np.unique(sorted_rows, return_index=True)
-        bounds = np.append(starts, sorted_rows.size)
-        for i, row in enumerate(uniq.tolist()):
+        groups = list(_group_by_row(rows, positions))
+        feed = self._row_count_feed(len(groups))
+        for row, p in groups:
             self._after_row_write(
-                int(row), positions=sorted_pos[bounds[i]:bounds[i + 1]],
-                added=True, count_stat=False,
+                row, positions=p, added=True, count_stat=False,
+                row_count=feed(row),
             )
         # one counter bump for the whole batch: parallel ingest workers
         # would otherwise serialize on the global stats lock per row
         from pilosa_tpu.utils.stats import global_stats
 
-        global_stats().count("fragment_row_writes", int(uniq.size))
+        global_stats().count("fragment_row_writes", len(groups))
         # ONE result-cache write event per batch (the per-row calls
         # above pass count_stat=False and skip theirs) — unconditional:
         # the cost kill switch gates accounting, never correctness
@@ -758,11 +805,14 @@ class Fragment:
                                        scope=self.scope)
 
     def _after_row_write(self, row: int, positions=None, added=None,
-                         count_stat: bool = True) -> None:
+                         count_stat: bool = True,
+                         row_count: int | None = None) -> None:
         """Invalidate this fragment's own device entries and route the
         write to dependent stacked leaves for in-place patching (instead
         of the old global generation purge — one Set() must not evict
-        unrelated resident leaves)."""
+        unrelated resident leaves). Batch paths pass ``row_count`` from
+        one shared ``row_counts()`` metadata pass; point writes leave it
+        None and pay one ``count_row``."""
         cache = residency.global_row_cache()
         cache.invalidate(self.frag_id + (row,))
         cache.invalidate_fragment(self.frag_id + ("__planes__",))
@@ -770,7 +820,9 @@ class Fragment:
             self.index, self.field, self.view, self.shard, row,
             positions=positions, added=added, scope=self.scope,
         ))
-        self.row_cache.add(row, self.count_row(row))
+        if row_count is None:
+            row_count = self.count_row(row)
+        self.row_cache.add(row, row_count)
         if count_stat:
             # the WAL-visible write point: a cached result depending on
             # this (index, field, shard) must die BEFORE the write's

@@ -26,13 +26,23 @@ import ast
 import sys
 from pathlib import Path
 
-# The five consumer surfaces named by the kernel layer's contract.
+# The consumer surfaces named by the kernel layers' contracts: the
+# five read-side modules from PR 18 plus the write-path consumers the
+# merge-kernel rewire (roaring/merge_kernels.py) cleaned — bulk import
+# routing, WAL replay, and the routed PQL write path. bitmap.py is
+# listed too: its only sanctioned container loops are the pinned
+# reference/point-probe functions below, so a per-container merge loop
+# cannot quietly grow back beside the kernel dispatcher.
 MODULES = [
     "pilosa_tpu/storage/fragment.py",
     "pilosa_tpu/storage/integrity.py",
     "pilosa_tpu/parallel/scrub.py",
     "pilosa_tpu/parallel/cluster.py",
     "pilosa_tpu/cdc/tailer.py",
+    "pilosa_tpu/roaring/bitmap.py",
+    "pilosa_tpu/server/api.py",
+    "pilosa_tpu/storage/wal.py",
+    "pilosa_tpu/parallel/cluster_exec.py",
 ]
 
 # Source substrings that mean "this code is touching container
@@ -50,6 +60,18 @@ ALLOWLIST = {
     # single-position membership probe over candidate keys: O(16)
     # metadata lookups, strictly cheaper than flattening the fragment
     ("pilosa_tpu/storage/fragment.py", "rows_containing"),
+    # bitmap.py's sanctioned loops: container assembly/metadata walks
+    # with no batched equivalent, point probes cheaper than a kernel
+    # dispatch, and _merge_loop — the retired write loop kept verbatim
+    # as the small-batch path and the merge kernels' byte-identity
+    # reference (tests/test_merge_kernels.py diffs against it)
+    ("pilosa_tpu/roaring/bitmap.py", "from_ids"),
+    ("pilosa_tpu/roaring/bitmap.py", "count"),
+    ("pilosa_tpu/roaring/bitmap.py", "count_range"),
+    ("pilosa_tpu/roaring/bitmap.py", "dense_range_words32"),
+    ("pilosa_tpu/roaring/bitmap.py", "row_member"),
+    ("pilosa_tpu/roaring/bitmap.py", "_merge_loop"),
+    ("pilosa_tpu/roaring/bitmap.py", "__eq__"),
 }
 
 _LOOP_NODES = (

@@ -118,6 +118,33 @@ class TestPlanSplits:
             ["a", "b", "c"], {}, split_threshold=1.5, split_ways=16)
         assert len(splits[0]["spans"]) == 3
 
+    def test_replica_width_spans(self):
+        # replica_n > 1 widens each span's owner tuple so a narrowed
+        # plain-Set write still lands on replica_n nodes; the union
+        # (and with it data placement) is unchanged, and replica_n=1
+        # degenerates to the original single-owner spans byte-for-byte
+        one, _ = plan_splits(
+            {("i", 0): 100.0, ("i", 1): 2.0}, self.owners_of,
+            ["a", "b", "c"], {}, split_threshold=1.5, replica_n=1)
+        assert all(len(ids) == 1 for _lo, _hi, ids in one[0]["spans"])
+        two, _ = plan_splits(
+            {("i", 0): 100.0, ("i", 1): 2.0}, self.owners_of,
+            ["a", "b", "c"], {}, split_threshold=1.5, replica_n=2)
+        spans = two[0]["spans"]
+        assert all(len(ids) == 2 for _lo, _hi, ids in spans)
+        # same tiling and same lead owner per span as the replica_n=1
+        # plan; the extra replica is the next node round-robin
+        assert [(lo, hi) for lo, hi, _ in spans] \
+            == [(lo, hi) for lo, hi, _ in one[0]["spans"]]
+        assert [ids[0] for _lo, _hi, ids in spans] \
+            == [ids[0] for _lo, _hi, ids in one[0]["spans"]]
+        assert two[0]["owners"] == one[0]["owners"]
+        # width clamps to the spread: replica_n beyond membership
+        wide, _ = plan_splits(
+            {("i", 0): 100.0, ("i", 1): 2.0}, self.owners_of,
+            ["a", "b"], {}, split_threshold=1.5, replica_n=5)
+        assert all(len(ids) == 2 for _lo, _hi, ids in wide[0]["spans"])
+
 
 class TestDepartedCursors:
     def test_wal_drops_only_the_departed_members_cursors(self, tmp_path):

@@ -1,7 +1,10 @@
 """Tier-1 wiring for scripts/check_hostpath_loops.py: the repo stays
 clean, and the lint actually bites when a per-container loop sneaks
-back into a kernel-consumer module."""
+back into a kernel-consumer module (read-side kernels AND the write
+path's merge-kernel consumers — the module list lives in the script
+and is imported here so the two can't drift)."""
 
+import importlib.util
 import shutil
 import subprocess
 import sys
@@ -9,6 +12,12 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
 SCRIPT = REPO / "scripts" / "check_hostpath_loops.py"
+
+_spec = importlib.util.spec_from_file_location("check_hostpath_loops",
+                                               SCRIPT)
+_lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_lint)
+MODULES = _lint.MODULES
 
 
 def _run(*args):
@@ -18,23 +27,34 @@ def _run(*args):
     )
 
 
+def _clone_consumers(tmp_path):
+    for rel in MODULES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+
+
 def test_repo_is_clean():
     res = _run()
     assert res.returncode == 0, res.stdout + res.stderr
 
 
-def test_lint_catches_reintroduced_container_loop(tmp_path):
-    # clone the consumer set into a scratch root, then regress one file
+def test_write_path_modules_are_covered():
+    # the merge-kernel consumer surfaces cannot silently drop out of
+    # the lint: routing, WAL replay, and the dispatcher's home module
     for rel in [
         "pilosa_tpu/storage/fragment.py",
-        "pilosa_tpu/storage/integrity.py",
-        "pilosa_tpu/parallel/scrub.py",
-        "pilosa_tpu/parallel/cluster.py",
-        "pilosa_tpu/cdc/tailer.py",
+        "pilosa_tpu/server/api.py",
+        "pilosa_tpu/storage/wal.py",
+        "pilosa_tpu/parallel/cluster_exec.py",
+        "pilosa_tpu/roaring/bitmap.py",
     ]:
-        dst = tmp_path / rel
-        dst.parent.mkdir(parents=True, exist_ok=True)
-        shutil.copy(REPO / rel, dst)
+        assert rel in MODULES, rel
+
+
+def test_lint_catches_reintroduced_container_loop(tmp_path):
+    # clone the consumer set into a scratch root, then regress one file
+    _clone_consumers(tmp_path)
     victim = tmp_path / "pilosa_tpu" / "storage" / "integrity.py"
     victim.write_text(victim.read_text() + (
         "\n\ndef _regressed_walk(bitmap):\n"
@@ -49,19 +69,26 @@ def test_lint_catches_reintroduced_container_loop(tmp_path):
     assert "_regressed_walk" in res.stdout
 
 
+def test_lint_catches_regressed_write_merge_loop(tmp_path):
+    # the exact regression the write-path rewire retired: a
+    # per-container merge loop beside the kernel dispatcher
+    _clone_consumers(tmp_path)
+    victim = tmp_path / "pilosa_tpu" / "roaring" / "bitmap.py"
+    victim.write_text(victim.read_text() + (
+        "\n\ndef _regressed_merge(bm, ids):\n"
+        "    for key in sorted(bm._containers):\n"
+        "        bm._containers[key] = bm._containers[key]\n"
+        "    return 0\n"
+    ))
+    res = _run(str(tmp_path))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "_regressed_merge" in res.stdout
+
+
 def test_allowlist_is_pinned_not_wildcarded(tmp_path):
     # a loop in a NON-allowlisted function of fragment.py must fail
     # even though fragment.py has an allowlist entry
-    for rel in [
-        "pilosa_tpu/storage/fragment.py",
-        "pilosa_tpu/storage/integrity.py",
-        "pilosa_tpu/parallel/scrub.py",
-        "pilosa_tpu/parallel/cluster.py",
-        "pilosa_tpu/cdc/tailer.py",
-    ]:
-        dst = tmp_path / rel
-        dst.parent.mkdir(parents=True, exist_ok=True)
-        shutil.copy(REPO / rel, dst)
+    _clone_consumers(tmp_path)
     victim = tmp_path / "pilosa_tpu" / "storage" / "fragment.py"
     victim.write_text(victim.read_text() + (
         "\n\ndef _other_walk(bm):\n"

@@ -1,0 +1,340 @@
+"""Property tests: whole-batch merge kernels vs the retired
+per-container write loop (pilosa_tpu/roaring/merge_kernels.py).
+
+The kernels' contract is BYTE-IDENTITY with ``_merge_loop`` — the
+per-container merge kept verbatim in bitmap.py as the small-batch path
+and THE reference here. Every test serializes both results and
+compares bytes, over randomized array/bitmap/run mixes, adversarial
+batches (promote-threshold boundaries, container-filling adds,
+remove-to-empty), the mutex/BSI merge rules, the batched membership
+probes, and WAL-replay equivalence (the crash ledger replays through
+the same dispatcher, so both paths must reconstruct identical bytes).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import merge_kernels, serialize
+from pilosa_tpu.roaring.bitmap import (
+    ARRAY_MAX,
+    BITMAP,
+    RUN,
+    RoaringBitmap,
+)
+from pilosa_tpu.roaring.format import OP_ADD, OP_REMOVE
+from pilosa_tpu.storage.fragment import Fragment
+
+from tests.test_roaring_kernels import make_bitmap
+
+U = np.uint64
+
+
+def make_pair(rng, n_containers, kinds="mixed", key_span=64):
+    """Two byte-identical bitmaps: one merges via the kernel, one via
+    the reference loop."""
+    bm = make_bitmap(rng, n_containers, kinds=kinds, key_span=key_span)
+    ref = deser_clone(bm)
+    return bm, ref
+
+
+def deser_clone(bm):
+    from pilosa_tpu.roaring.format import deserialize
+
+    clone, _ = deserialize(serialize(bm))
+    return clone
+
+
+def assert_merge_identical(bm, ref, batch, remove):
+    got = merge_kernels.merge_ids(bm, batch.copy(), remove)
+    want = ref._merge_loop(batch.copy(), remove)
+    assert got == want, (got, want, remove)
+    assert serialize(bm) == serialize(ref)
+    assert bm.keys == ref.keys
+
+
+# ------------------------------------------------------- randomized fuzz
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_merge_matches_loop_randomized(seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(6):
+        bm, ref = make_pair(rng, int(rng.integers(0, 30)))
+        span = int(rng.integers(1, 64)) << 16
+        batch = rng.integers(0, span,
+                             int(rng.integers(64, 20000))).astype(U)
+        assert_merge_identical(bm, ref, batch, bool(rng.integers(0, 2)))
+
+
+@pytest.mark.parametrize("kind", ["array", "bitmap", "run", "full",
+                                  "single"])
+def test_merge_matches_loop_each_kind(kind):
+    rng = np.random.default_rng(hash(kind) % 2**32)
+    for remove in (False, True):
+        bm, ref = make_pair(rng, 8, kinds=kind, key_span=8)
+        batch = rng.integers(0, 8 << 16, 5000).astype(U)
+        assert_merge_identical(bm, ref, batch, remove)
+
+
+def test_merge_duplicate_and_unsorted_batches():
+    rng = np.random.default_rng(3)
+    bm, ref = make_pair(rng, 10)
+    base = rng.integers(0, 16 << 16, 4000).astype(U)
+    batch = np.concatenate([base, base[:1000], base[::-1]])
+    assert_merge_identical(bm, ref, batch, False)
+
+
+# ------------------------------------------------------ adversarial edges
+
+
+def test_array_promote_threshold_boundary():
+    # the reference promotes an ARRAY to word space when
+    # c.n + deduped-batch-size crosses ARRAY_MAX — probe the exact
+    # boundary from both sides
+    for base_n in (ARRAY_MAX - 10, ARRAY_MAX - 1, ARRAY_MAX):
+        for extra in (9, 10, 11, 12):
+            pre = np.arange(base_n, dtype=U) * U(3)
+            bm = RoaringBitmap.from_ids(pre)
+            ref = RoaringBitmap.from_ids(pre)
+            batch = np.arange(extra, dtype=U) * U(3) + U(1)
+            assert_merge_identical(bm, ref, batch, False)
+
+
+def test_bitmap_stays_bitmap_above_array_max():
+    # non-canonical on purpose: a merged bitmap container above
+    # ARRAY_MAX keeps BITMAP kind even where runs would be smaller
+    rng = np.random.default_rng(0)
+    pre = np.unique(rng.integers(0, 65536, 60000)).astype(U)
+    bm = RoaringBitmap.from_ids(pre)
+    ref = RoaringBitmap.from_ids(pre)
+    assert bm._containers[0].kind == BITMAP
+    assert_merge_identical(bm, ref, np.arange(65536, dtype=U), False)
+    assert bm._containers[0].kind == BITMAP
+    assert ref._containers[0].kind == BITMAP
+
+
+def test_delta_zero_keeps_existing_container_object():
+    # a no-op merge must not rebuild the container (the loop keeps the
+    # object; readers hold references)
+    pre = np.arange(0, 130000, 2, dtype=U)
+    bm = RoaringBitmap.from_ids(pre)
+    before = dict(bm._containers)
+    batch = np.arange(0, 130000, 4, dtype=U)  # all already set
+    assert merge_kernels.merge_ids(bm, batch, False) == 0
+    for key, c in before.items():
+        assert bm._containers[key] is c
+
+
+def test_remove_to_empty_pops_containers():
+    pre = np.arange(200, dtype=U) + (U(5) << U(16))
+    bm = RoaringBitmap.from_ids(pre)
+    ref = RoaringBitmap.from_ids(pre)
+    batch = np.concatenate([pre, np.arange(64, dtype=U)])  # key 0 absent
+    assert_merge_identical(bm, ref, batch, True)
+    assert bm.keys == []
+
+
+def test_run_existing_containers_merge():
+    # run containers take the sorted-stream path: their payloads expand
+    # in one vectorized pass and the rebuilt kind re-derives from the
+    # from_lows cost model
+    pre = np.arange(60000, dtype=U)
+    bm = RoaringBitmap.from_ids(pre)
+    ref = RoaringBitmap.from_ids(pre)
+    assert bm._containers[0].kind == RUN
+    assert_merge_identical(
+        bm, ref, np.arange(60000, 65536, dtype=U), False)
+
+
+def test_small_batches_fall_back_to_loop():
+    stats = merge_kernels.global_merge_stats()
+    before = stats.loop_fallbacks
+    bm = RoaringBitmap()
+    bm.add_ids(np.arange(merge_kernels.KERNEL_MIN_IDS - 1, dtype=U))
+    assert stats.loop_fallbacks == before + 1
+    ref = RoaringBitmap()
+    ref._merge_loop(np.arange(merge_kernels.KERNEL_MIN_IDS - 1,
+                              dtype=U), False)
+    assert serialize(bm) == serialize(ref)
+
+
+# ----------------------------------------------------- membership probes
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_set_rows_for_positions_matches_row_member(seed):
+    rng = np.random.default_rng(seed)
+    ids = ((rng.integers(0, 30, 20000).astype(U) << U(20))
+           + rng.integers(0, 1 << 20, 20000).astype(U))
+    bm = RoaringBitmap.from_ids(ids)
+    pos = rng.integers(0, 1 << 20, 3000).astype(U)
+    rows_k, idx_k = merge_kernels.set_rows_for_positions(bm, pos)
+    got = {(int(r), int(i)) for r, i in zip(rows_k, idx_k)}
+    want = set()
+    for r in sorted({k >> 4 for k in bm.keys}):
+        m = bm.row_member(r, pos)
+        want.update((int(r), int(i)) for i in np.nonzero(m)[0])
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_member_matrix_matches_row_member(seed):
+    rng = np.random.default_rng(100 + seed)
+    ids = ((rng.integers(0, 40, 15000).astype(U) << U(20))
+           + rng.integers(0, 1 << 20, 15000).astype(U))
+    bm = RoaringBitmap.from_ids(ids)
+    pos = rng.integers(0, 1 << 20, 2000).astype(U)
+    rows = [0, 2, 3, 7, 39, 41]  # row 41 has no containers
+    got = merge_kernels.member_matrix(bm, rows, pos)
+    for i, r in enumerate(rows):
+        assert np.array_equal(got[i], bm.row_member(r, pos)), r
+
+
+# ------------------------------------------------- mutex/BSI merge rules
+
+
+def _frag(tmp_path, name, field_kind="set"):
+    return Fragment(str(tmp_path / name), "i", field_kind,
+                    "standard", 0).open()
+
+
+def _frag_pairs(frag):
+    ids = frag.bitmap.to_ids()
+    return {(int(i) >> 20, int(i) & ((1 << 20) - 1)) for i in ids}
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_import_mutex_matches_sequential_semantics(seed, tmp_path):
+    # the mutex rule, stated independently: each column keeps exactly
+    # its LAST imported row; previously-set other rows clear; changed
+    # counts columns whose bit was newly added
+    rng = np.random.default_rng(seed)
+    frag = _frag(tmp_path, f"m{seed}")
+    n0 = int(rng.integers(0, 4000))
+    r0 = rng.integers(0, 16, n0).astype(U)
+    p0 = rng.integers(0, 1 << 20, n0).astype(U)
+    frag.import_mutex(r0.copy(), p0.copy())
+
+    model = {}  # column -> row (sequential set-with-clear semantics)
+    for r, p in zip(r0.tolist(), p0.tolist()):
+        model[p] = r
+
+    n1 = int(rng.integers(1, 4000))
+    r1 = rng.integers(0, 16, n1).astype(U)
+    p1 = rng.integers(0, 1 << 20, n1).astype(U)
+    changed = frag.import_mutex(r1.copy(), p1.copy())
+
+    want_changed = 0
+    final = dict(model)
+    for p, r in {int(p): int(r) for p, r in zip(p1, r1)}.items():
+        if final.get(p) != r:
+            want_changed += 1
+        final[p] = r
+    assert changed == want_changed
+    assert _frag_pairs(frag) == {(r, p) for p, r in final.items()}
+    frag.close()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_add_ids_mutex_keeps_local_rows(seed, tmp_path):
+    rng = np.random.default_rng(10 + seed)
+    frag = _frag(tmp_path, f"am{seed}")
+    n0 = int(rng.integers(1, 3000))
+    r0 = rng.integers(0, 12, n0).astype(U)
+    p0 = rng.integers(0, 1 << 20, n0).astype(U)
+    frag.import_mutex(r0.copy(), p0.copy())
+    local = {p: r for r, p in _frag_pairs(frag)}
+    local_pairs = _frag_pairs(frag)
+
+    n1 = int(rng.integers(1, 3000))
+    incoming = ((rng.integers(0, 12, n1).astype(U) << U(20))
+                + rng.integers(0, 1 << 20, n1).astype(U))
+    frag.add_ids_mutex(incoming.copy())
+
+    # survivors: keep-last per incoming column, dropped when the local
+    # fragment holds the column in a DIFFERENT row
+    cand = {}
+    for i in incoming.tolist():
+        cand[i & ((1 << 20) - 1)] = i >> 20
+    want = set(local_pairs)
+    for p, r in cand.items():
+        if p in local and local[p] != r:
+            continue
+        want.add((r, p))
+    assert _frag_pairs(frag) == want
+    frag.close()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_import_bsi_matches_value_semantics(seed, tmp_path):
+    rng = np.random.default_rng(20 + seed)
+    frag = _frag(tmp_path, f"b{seed}")
+    depth = int(rng.integers(1, 33))
+    model = {}  # column -> stored value
+    for _ in range(3):
+        pos = np.unique(
+            rng.integers(0, 1 << 20, int(rng.integers(1, 2500)))
+        ).astype(U)
+        vals = rng.integers(0, 1 << depth, pos.size).astype(U)
+        changed = frag.import_bsi(pos.copy(), vals.copy(), depth)
+        want_changed = 0
+        for p, v in zip(pos.tolist(), vals.tolist()):
+            if model.get(p) != v:
+                want_changed += 1
+            model[p] = v
+        assert changed == want_changed
+        want = set()
+        for p, v in model.items():
+            want.add((0, p))  # exists row
+            for i in range(depth):
+                if (v >> i) & 1:
+                    want.add((2 + i, p))
+        assert _frag_pairs(frag) == want
+    frag.close()
+
+
+# --------------------------------------------------- WAL-replay identity
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_replay_identical_through_kernel_and_loop(seed, tmp_path,
+                                                  monkeypatch):
+    # the crash ledger replays through the same dispatcher as live
+    # writes — a recovered fragment must be bit-exact no matter which
+    # path (kernel or loop) applied each op
+    rng = np.random.default_rng(30 + seed)
+    ops = []
+    for _ in range(8):
+        n = int(rng.integers(1, 6000))
+        ids = ((rng.integers(0, 24, n).astype(U) << U(20))
+               + rng.integers(0, 1 << 20, n).astype(U))
+        ops.append((OP_ADD if rng.integers(0, 3) else OP_REMOVE, ids))
+
+    frag_k = _frag(tmp_path, "rk")
+    for op, ids in ops:
+        frag_k.apply_recovered(op, ids.copy())
+    kernel_bytes = serialize(frag_k.bitmap)
+    frag_k.close()
+
+    # force every merge through the retired loop
+    monkeypatch.setattr(merge_kernels, "KERNEL_MIN_IDS", 1 << 62)
+    frag_l = _frag(tmp_path, "rl")
+    for op, ids in ops:
+        frag_l.apply_recovered(op, ids.copy())
+    assert serialize(frag_l.bitmap) == kernel_bytes
+    frag_l.close()
+
+
+def test_merge_stats_counters_advance():
+    stats = merge_kernels.global_merge_stats()
+    calls, ids_n = stats.kernel_calls, stats.ids_merged
+    bm = RoaringBitmap()
+    batch = np.arange(5000, dtype=U)
+    merge_kernels.merge_ids(bm, batch, False)
+    assert stats.kernel_calls == calls + 1
+    assert stats.ids_merged == ids_n + 5000
+    for key in ("ingest_merge_kernel_calls_total",
+                "ingest_merge_ids_total",
+                "ingest_merge_loop_fallbacks_total",
+                "ingest_merge_probe_calls_total"):
+        assert key in stats.metrics()

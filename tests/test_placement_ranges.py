@@ -75,6 +75,43 @@ class TestByteIdentityFallback:
         assert [x.id for x in c.range_read_nodes("i", 0, 0)] == ["n0"]
 
 
+class TestRangeWriteSpans:
+    def test_unsplit_shard_returns_none(self):
+        c = _bare_cluster(["n0", "n1"], replica_n=1)
+        assert c.range_write_spans("i", 0) is None
+        c.placement.replace({("i", 0): ("n0",)}, epoch=8)
+        assert c.range_write_spans("i", 0) is None
+
+    def test_split_shard_yields_per_span_owner_slices(self):
+        c = _bare_cluster(["n0", "n1", "n2"], replica_n=1)
+        spans = ((0, HALF, ("n0",)), (HALF, SHARD_WIDTH, ("n1", "n2")))
+        c.placement.replace(
+            {("i", 0): ("n0", "n1", "n2")}, epoch=1024,
+            ranges={("i", 0): spans})
+        got = c.range_write_spans("i", 0)
+        assert [(lo, hi, [x.id for x in nodes])
+                for lo, hi, nodes in got] \
+            == [(0, HALF, ["n0"]),
+                (HALF, SHARD_WIDTH, ["n1", "n2"])]
+
+    def test_departed_span_owner_yields_none_owners_for_that_span(self):
+        """The half-live-split contract: the caller must union-fan-out
+        columns of the departed span (a narrowed send could strand the
+        slice), while the surviving span keeps narrowing."""
+        c = _bare_cluster(["n0", "n1", "n2"], replica_n=1)
+        spans = ((0, HALF, ("n0",)), (HALF, SHARD_WIDTH, ("n1",)))
+        c.placement.replace({("i", 0): ("n0", "n1")}, epoch=1024,
+                            ranges={("i", 0): spans})
+        with c._lock:
+            c.nodes.pop("n1")
+            c._note_membership_changed_locked()
+        got = c.range_write_spans("i", 0)
+        assert [x.id for x in got[0][2]] == ["n0"]
+        assert got[1][2] is None
+        assert (got[0][:2], got[1][:2]) == ((0, HALF),
+                                            (HALF, SHARD_WIDTH))
+
+
 class TestMixedVersionGossip:
     def test_old_peer_adopts_overrides_only_same_data_placement(self):
         """An override-unaware (older) peer parses the gossiped table
